@@ -1,0 +1,32 @@
+"""quorum_intersection_tpu — a TPU-native framework for deciding the
+quorum-intersection property of Federated Byzantine Agreement Systems.
+
+Capability-equivalent to the reference C++ tool ``fixxxedpoint/quorum_intersection``
+(see /root/reference/quorum_intersection.cpp), re-designed TPU-first:
+
+- ``fbas``      — stellarbeat JSON frontend, trust graph, Tarjan SCC
+- ``encode``    — nested quorum sets flattened into dense threshold-circuit arrays
+- ``backends``  — pluggable QuorumChecker backends: pure-Python oracle, native C++
+                  oracle, and the JAX/TPU batched-bitmask engine
+- ``analytics`` — PageRank power iteration + Graphviz export with SCC coloring
+- ``parallel``  — device-mesh / sharding helpers for the candidate-sweep axis
+- ``utils``     — logging, phase timers, throughput counters, sweep checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from quorum_intersection_tpu.fbas.schema import QSet, FbasNode, Fbas, parse_fbas
+from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph
+from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit
+
+__all__ = [
+    "QSet",
+    "FbasNode",
+    "Fbas",
+    "parse_fbas",
+    "TrustGraph",
+    "build_graph",
+    "Circuit",
+    "encode_circuit",
+    "__version__",
+]

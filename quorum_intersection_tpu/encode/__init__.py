@@ -1,0 +1,5 @@
+"""Encoding: nested quorum sets → dense threshold-circuit arrays."""
+
+from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit, node_sat_np, max_quorum_np
+
+__all__ = ["Circuit", "encode_circuit", "node_sat_np", "max_quorum_np"]

@@ -1,0 +1,202 @@
+"""Flatten nested quorum sets into a dense **threshold circuit** suitable for
+batched TPU evaluation (SURVEY.md §7.3 "Nested qsets on TPU").
+
+The reference evaluates slice satisfaction by recursion over qset objects with
+dual early-exit counters (`/root/reference/quorum_intersection.cpp:90-138`).
+That recursion is hostile to XLA (dynamic control flow, pointer chasing), so we
+re-express the same math as a layered monotone threshold circuit:
+
+- one **unit** per quorum set occurrence; unit ``i < n`` is node *i*'s
+  top-level quorum set, inner sets get fresh unit ids;
+- ``sat(u) = [ |members(u) ∩ avail| + Σ_{c ∈ children(u)} sat(c) ≥ threshold(u) ]``
+- node *i* has a satisfied slice iff ``avail[i] ∧ sat(i)`` — the self-
+  availability conjunct is quirk Q4 (cpp:95-98; checking it once at the root is
+  equivalent to the reference's per-recursion check because the owner is the
+  same at every depth).
+
+Children are strictly deeper than parents, so ``depth+1`` synchronous sweeps of
+the update rule computed over *all* units converge exactly — each sweep is two
+dense matmuls (``avail @ members`` and ``sat @ childᵀ``), which is precisely
+the shape the MXU wants.  Early-exit counters are pointless on TPU: evaluating
+everything densely in a batch is the fast path.
+
+Degenerate thresholds are **normalized away at encode time** so device kernels
+carry no quirk logic:
+
+- null/empty qset (Q2)      → threshold 1 with zero members: never satisfiable;
+- ``threshold == 0`` (Q3)   → ``members + children + 1``: never satisfiable.
+  NB the reference's behavior here is *chaotic*, not unsatisfiable: its
+  ``threshold == 0`` check sits after the per-member decrements (cpp:105-118),
+  so a zero-threshold slice is TRUE iff its first member is unavailable.  We
+  deliberately normalize instead of reproducing that (see
+  ``fbas/semantics.py:slice_satisfied``);
+- ``threshold < 0``         → same normalization (the reference would wrap it
+  into an astronomically large unsigned value: never satisfiable);
+- ``threshold > members``   → kept as-is (naturally unsatisfiable).
+
+Dangling-reference policy (Q1) is resolved earlier, in
+:mod:`quorum_intersection_tpu.fbas.graph`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
+
+UNSAT_SENTINEL_DOC = "threshold normalized to members+children+1 ⇒ never satisfiable"
+
+
+@dataclass
+class Circuit:
+    """Dense threshold-circuit encoding of a trust graph's quorum sets.
+
+    Array inventory (``U`` = unit count, ``n`` = node count):
+
+    - ``thresholds``  (U,)  int32 — normalized thresholds (see module docs)
+    - ``members``     (U,n) uint8 — members[u, v] = 1 iff node v is a direct
+      validator of unit u (0/1 — multiplicity is NOT kept here: the reference
+      counts a duplicated validator once per occurrence in the *slice* test
+      loop (cpp:103-110)... see note below)
+    - ``child``       (U,U) uint8 — child[u, c] = 1 iff unit c is an inner set
+      of unit u
+    - ``unit_depth``  (U,)  int32 — 0 for roots, +1 per nesting level
+    - ``depth``       — max(unit_depth)
+
+    **Duplicate-validator note:** the reference iterates the validator list, so
+    a validator listed twice contributes two votes (cpp:103-110).  ``members``
+    therefore stores *vote counts*, not 0/1 — uint8 counts (a validator listed
+    >255 times in one slice would be pathological input).
+
+    CSR views (``mem_indptr``/``mem_indices`` with per-entry ``mem_counts``,
+    ``child_indptr``/``child_indices``) feed the native C++ backend the same
+    circuit without densification.
+    """
+
+    n: int
+    n_units: int
+    depth: int
+    thresholds: np.ndarray
+    members: np.ndarray
+    child: np.ndarray
+    unit_depth: np.ndarray
+    mem_indptr: np.ndarray = field(repr=False, default=None)
+    mem_indices: np.ndarray = field(repr=False, default=None)
+    mem_counts: np.ndarray = field(repr=False, default=None)
+    child_indptr: np.ndarray = field(repr=False, default=None)
+    child_indices: np.ndarray = field(repr=False, default=None)
+
+    @property
+    def lanes(self) -> int:
+        """uint32 lanes needed to pack an n-node availability mask."""
+        return (self.n + 31) // 32
+
+
+def encode_circuit(graph: TrustGraph) -> Circuit:
+    """Encode every node's quorum set into one shared threshold circuit."""
+    n = graph.n
+    # First pass: count inner units to size arrays. Roots are units 0..n-1.
+    n_units = n
+    for q in graph.qsets:
+        stack = list(q.inner)
+        while stack:
+            iq = stack.pop()
+            n_units += 1
+            stack.extend(iq.inner)
+
+    thresholds = np.zeros(n_units, dtype=np.int32)
+    members = np.zeros((n_units, n), dtype=np.uint8)
+    child = np.zeros((n_units, n_units), dtype=np.uint8)
+    unit_depth = np.zeros(n_units, dtype=np.int32)
+
+    next_unit = [n]
+
+    def fill(unit: int, q: IndexedQSet, depth: int) -> None:
+        unit_depth[unit] = depth
+        n_members = len(q.members) + len(q.inner)
+        if q.threshold is None:
+            # Q2: null qset — threshold 1 over zero members: never satisfiable.
+            thresholds[unit] = 1
+            return
+        if q.threshold <= 0:
+            # Q3 normalization: never satisfiable.
+            thresholds[unit] = n_members + 1
+        else:
+            thresholds[unit] = min(q.threshold, np.iinfo(np.int32).max)
+        for v in q.members:
+            if members[unit, v] == np.iinfo(np.uint8).max:
+                raise ValueError(f"validator {v} listed >255 times in one quorum set")
+            members[unit, v] += 1
+        for iq in q.inner:
+            cu = next_unit[0]
+            next_unit[0] += 1
+            child[unit, cu] = 1
+            fill(cu, iq, depth + 1)
+
+    for i, q in enumerate(graph.qsets):
+        fill(i, q, 0)
+    assert next_unit[0] == n_units
+
+    # CSR views for the native backend.
+    mem_lists: List[np.ndarray] = []
+    mem_count_lists: List[np.ndarray] = []
+    child_lists: List[np.ndarray] = []
+    mem_indptr = np.zeros(n_units + 1, dtype=np.int32)
+    child_indptr = np.zeros(n_units + 1, dtype=np.int32)
+    for u in range(n_units):
+        midx = np.nonzero(members[u])[0].astype(np.int32)
+        mem_lists.append(midx)
+        mem_count_lists.append(members[u, midx].astype(np.int32))
+        cidx = np.nonzero(child[u])[0].astype(np.int32)
+        child_lists.append(cidx)
+        mem_indptr[u + 1] = mem_indptr[u] + len(midx)
+        child_indptr[u + 1] = child_indptr[u] + len(cidx)
+    mem_indices = np.concatenate(mem_lists) if mem_lists else np.zeros(0, np.int32)
+    mem_counts = np.concatenate(mem_count_lists) if mem_count_lists else np.zeros(0, np.int32)
+    child_indices = np.concatenate(child_lists) if child_lists else np.zeros(0, np.int32)
+
+    return Circuit(
+        n=n,
+        n_units=n_units,
+        depth=int(unit_depth.max(initial=0)),
+        thresholds=thresholds,
+        members=members,
+        child=child,
+        unit_depth=unit_depth,
+        mem_indptr=mem_indptr,
+        mem_indices=mem_indices.astype(np.int32),
+        mem_counts=mem_counts.astype(np.int32),
+        child_indptr=child_indptr,
+        child_indices=child_indices.astype(np.int32),
+    )
+
+
+def node_sat_np(circuit: Circuit, avail: np.ndarray) -> np.ndarray:
+    """NumPy reference evaluator: which nodes have a satisfied slice?
+
+    ``avail``: (..., n) bool.  Returns (..., n) bool.  This is the
+    specification the JAX kernels are differentially tested against; it must
+    agree with :func:`quorum_intersection_tpu.fbas.semantics.slice_satisfied`.
+    """
+    avail_f = avail.astype(np.int32)
+    base = avail_f @ circuit.members.T.astype(np.int32)  # (..., U)
+    sat = np.zeros(avail.shape[:-1] + (circuit.n_units,), dtype=np.int32)
+    child_t = circuit.child.T.astype(np.int32)
+    for _ in range(circuit.depth + 1):
+        sat = ((base + sat @ child_t) >= circuit.thresholds).astype(np.int32)
+    return (sat[..., : circuit.n] & avail_f).astype(bool)
+
+
+def max_quorum_np(circuit: Circuit, avail: np.ndarray) -> np.ndarray:
+    """Greatest-fixpoint quorum inside ``avail`` (..., n) — NumPy reference for
+    the device fixpoint kernel (parity with cpp:140-177 restricted-availability
+    semantics: candidates and availability are the same set here)."""
+    cur = avail.astype(bool).copy()
+    while True:
+        nxt = node_sat_np(circuit, cur)
+        if np.array_equal(nxt, cur):
+            return cur
+        cur = nxt

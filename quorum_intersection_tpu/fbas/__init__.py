@@ -1,0 +1,6 @@
+"""Frontend: stellarbeat JSON → validated FBAS model → trust graph + SCCs."""
+
+from quorum_intersection_tpu.fbas.schema import QSet, FbasNode, Fbas, parse_fbas
+from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph, tarjan_scc
+
+__all__ = ["QSet", "FbasNode", "Fbas", "parse_fbas", "TrustGraph", "build_graph", "tarjan_scc"]

@@ -1,0 +1,115 @@
+"""Synthetic FBAS generators — the seed corpus for differential testing and
+benchmarking (SURVEY.md §4.3, BASELINE.json configs).
+
+All generators emit stellarbeat-style raw dicts (the same shape
+:func:`quorum_intersection_tpu.fbas.schema.parse_fbas` accepts), so every
+synthetic network also exercises the JSON frontend.
+
+The generators follow the reference fixtures' de-facto test methodology —
+*same topology, one knob turned* (SURVEY.md §4.1): each safe generator has a
+broken twin differing by a single threshold.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+
+def _node(key: str, name: str, qset) -> Dict:
+    return {"publicKey": key, "name": name, "quorumSet": qset}
+
+
+def _qset(threshold: int, validators: List[str], inner: Optional[List] = None) -> Dict:
+    return {
+        "threshold": threshold,
+        "validators": validators,
+        "innerQuorumSets": inner or [],
+    }
+
+
+def keys(n: int, prefix: str = "NODE") -> List[str]:
+    return [f"{prefix}{i:04d}" for i in range(n)]
+
+
+def majority_fbas(n: int, *, broken: bool = False, prefix: str = "NODE") -> List[Dict]:
+    """Symmetric k-of-n FBAS with k = n//2 + 1 — all quorums intersect.
+
+    ``broken=True`` turns one knob, mirroring the reference's
+    ``broken_trivial.json`` methodology (threshold 2→1 on one node,
+    `broken_trivial.json:20`): node 0's threshold drops to 1, making {node0}
+    a quorum disjoint from any majority of the remaining nodes.
+    """
+    ks = keys(n, prefix)
+    k = n // 2 + 1
+    nodes = []
+    for i, key in enumerate(ks):
+        t = 1 if (broken and i == 0) else k
+        nodes.append(_node(key, f"n{i}", _qset(t, list(ks))))
+    return nodes
+
+
+def hierarchical_fbas(
+    n_orgs: int, per_org: int, *, broken: bool = False, org_threshold: Optional[int] = None
+) -> List[Dict]:
+    """Stellar-like tiered FBAS: each node requires a majority of organizations,
+    where an organization counts if a majority of its validators are available —
+    expressed with one inner quorum set per organization (nesting depth 1,
+    matching the bundled fixtures' observed max depth, SURVEY.md §7.3).
+
+    ``broken=True`` lowers the first node's org threshold to 1.
+    """
+    org_keys = [keys(per_org, f"ORG{o}N") for o in range(n_orgs)]
+    all_nodes: List[Dict] = []
+    t_orgs = org_threshold if org_threshold is not None else n_orgs // 2 + 1
+    inner = [_qset(per_org // 2 + 1, list(ok)) for ok in org_keys]
+    for o in range(n_orgs):
+        for i, key in enumerate(org_keys[o]):
+            t = 1 if (broken and o == 0 and i == 0) else t_orgs
+            all_nodes.append(_node(key, f"org{o}-v{i}", _qset(t, [], list(inner))))
+    return all_nodes
+
+
+def trivial_pair() -> Dict[str, List[Dict]]:
+    """Tiny 3-node pass/fail pair, structurally the same test idea as the
+    reference's ``correct_trivial.json`` / ``broken_trivial.json`` (2-of-3
+    majority; broken twin lowers one threshold to 1)."""
+    return {
+        "correct": majority_fbas(3, prefix="TRIV"),
+        "broken": majority_fbas(3, broken=True, prefix="TRIV"),
+    }
+
+
+def random_fbas(
+    n: int,
+    *,
+    seed: int = 0,
+    slice_size: Optional[int] = None,
+    nested_prob: float = 0.0,
+    null_prob: float = 0.0,
+    dangling_prob: float = 0.0,
+) -> List[Dict]:
+    """Random FBAS: each node trusts a random subset, threshold a random
+    majority-ish fraction of it.  Knobs add nested inner sets, null qsets and
+    dangling references to exercise quirk policies (Q1/Q2)."""
+    rng = random.Random(seed)
+    ks = keys(n, "RND")
+    nodes = []
+    for i, key in enumerate(ks):
+        if rng.random() < null_prob:
+            nodes.append(_node(key, f"r{i}", None))
+            continue
+        size = slice_size or rng.randint(3, max(3, min(n, 8)))
+        size = min(size, n)
+        chosen = rng.sample(ks, size)
+        if rng.random() < dangling_prob:
+            chosen[rng.randrange(len(chosen))] = f"MISSING{rng.randrange(1000):04d}"
+        inner: List[Dict] = []
+        if rng.random() < nested_prob and size >= 4:
+            split = size // 2
+            inner = [_qset(max(1, (size - split) // 2 + 1), chosen[split:])]
+            chosen = chosen[:split]
+        t = max(1, (len(chosen) + len(inner)) * 2 // 3 + 1)
+        t = min(t, len(chosen) + len(inner))
+        nodes.append(_node(key, f"r{i}", _qset(t, chosen, inner)))
+    return nodes

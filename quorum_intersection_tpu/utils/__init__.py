@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: trace logging, phase timers, throughput counters."""
+
+from quorum_intersection_tpu.utils.logging import get_logger, set_trace
+from quorum_intersection_tpu.utils.timers import PhaseTimers, Throughput
+
+__all__ = ["get_logger", "set_trace", "PhaseTimers", "Throughput"]

@@ -1,0 +1,38 @@
+"""Severity-filtered logging — the capability of the reference's boost::log
+setup (`/root/reference/quorum_intersection.cpp:735-742`): default level INFO,
+``-t/--trace`` drops the filter to TRACE-equivalent (DEBUG here).  Solver
+internals log at trace level just as the reference saturates its solver with
+``BOOST_LOG_TRIVIAL(trace)`` messages.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_ROOT_NAME = "quorum_intersection_tpu"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    logger = logging.getLogger(_ROOT_NAME)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter("[%(levelname)s] %(name)s: %(message)s"))
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"{_ROOT_NAME}.{name}" if name else _ROOT_NAME)
+
+
+def set_trace(enabled: bool = True) -> None:
+    """Enable trace-level (DEBUG) logging, the analog of the reference's ``-t``."""
+    _configure()
+    logging.getLogger(_ROOT_NAME).setLevel(logging.DEBUG if enabled else logging.INFO)

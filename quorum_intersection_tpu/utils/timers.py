@@ -1,0 +1,52 @@
+"""Structured per-phase timers and candidate-throughput counters.
+
+The reference's only observability is trace logs and a static branch-and-bound
+call counter (`/root/reference/quorum_intersection.cpp:258`).  The TPU-native
+equivalent (SURVEY.md §5) is structured: named phase timers plus a throughput
+counter measuring candidate quorums checked per second (the BASELINE.json
+headline metric).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+
+@dataclass
+class PhaseTimers:
+    """Accumulating named wall-clock timers."""
+
+    totals: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + elapsed
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, float]:
+        return dict(sorted(self.totals.items(), key=lambda kv: -kv[1]))
+
+
+@dataclass
+class Throughput:
+    """Candidate-checking throughput counter (candidates/sec)."""
+
+    candidates: int = 0
+    seconds: float = 0.0
+
+    def add(self, n: int, seconds: float) -> None:
+        self.candidates += n
+        self.seconds += seconds
+
+    @property
+    def per_second(self) -> float:
+        return self.candidates / self.seconds if self.seconds > 0 else 0.0

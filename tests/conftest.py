@@ -1,0 +1,38 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere — the TPU-native analog of a fake multi-chip backend (SURVEY.md §4.3):
+sharding/mesh tests run against 8 emulated devices without TPU hardware.
+"""
+
+import os
+import pathlib
+
+# Must be set before the first `import jax` in any test module.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+REFERENCE_DIR = pathlib.Path("/root/reference")
+
+
+def reference_fixture(name: str) -> pathlib.Path:
+    """Path to a bundled reference fixture, skipping if unavailable.
+
+    The four golden JSON fixtures are loaded straight from the read-only
+    reference checkout rather than copied into this repo.
+    """
+    path = REFERENCE_DIR / name
+    if not path.exists():
+        pytest.skip(f"reference fixture {name} not available")
+    return path
+
+
+@pytest.fixture
+def ref_fixture():
+    return reference_fixture

@@ -1,0 +1,90 @@
+"""Sanitizer tests — reference-compat filter plus recursive/dangling upgrades."""
+
+import json
+import subprocess
+import sys
+
+from quorum_intersection_tpu.fbas.sanitize import dangling_refs, sanitize
+
+
+def _node(key, qset):
+    return {"publicKey": key, "quorumSet": qset}
+
+
+SANE = _node("A", {"threshold": 2, "validators": ["A", "B"], "innerQuorumSets": []})
+INSANE_TOP = _node("B", {"threshold": 5, "validators": ["A", "B"], "innerQuorumSets": []})
+INSANE_INNER = _node(
+    "C",
+    {
+        "threshold": 1,
+        "validators": [],
+        "innerQuorumSets": [{"threshold": 9, "validators": ["A"], "innerQuorumSets": []}],
+    },
+)
+NULL_NODE = _node("D", None)
+
+
+def test_compat_filter_matches_reference_semantics():
+    # Reference filter (fix_quorum_configurations.py:11-15): top-level only.
+    out = sanitize([SANE, INSANE_TOP, INSANE_INNER, NULL_NODE], compat=True)
+    assert [n["publicKey"] for n in out] == ["A", "C", "D"]
+
+
+def test_recursive_filter_catches_inner_insanity():
+    out = sanitize([SANE, INSANE_TOP, INSANE_INNER, NULL_NODE])
+    assert [n["publicKey"] for n in out] == ["A", "D"]
+
+
+def test_null_qset_kept_not_crashed():
+    # The reference script TypeErrors on null qsets (verified on its own
+    # correct.json); we keep them — they are harmless (Q2).
+    assert sanitize([NULL_NODE]) == [NULL_NODE]
+
+
+def test_numeric_string_threshold_agrees_with_schema():
+    # The sanitizer must accept what parse_fbas accepts (numeric strings).
+    node = _node("S", {"threshold": "2", "validators": ["A", "B"], "innerQuorumSets": []})
+    assert sanitize([node]) == [node]
+    bad = _node("S", {"threshold": "two", "validators": ["A", "B"], "innerQuorumSets": []})
+    assert sanitize([bad]) == []
+
+
+def test_zero_threshold_flagging():
+    zero = _node("Z", {"threshold": 0, "validators": [], "innerQuorumSets": []})
+    assert sanitize([zero]) == [zero]
+    assert sanitize([zero], flag_zero_threshold=True) == []
+
+
+def test_dangling_refs_reported():
+    nodes = [
+        _node("A", {"threshold": 1, "validators": ["A", "GHOST"], "innerQuorumSets": []}),
+        _node(
+            "B",
+            {
+                "threshold": 1,
+                "validators": [],
+                "innerQuorumSets": [{"threshold": 1, "validators": ["PHANTOM"], "innerQuorumSets": []}],
+            },
+        ),
+    ]
+    assert dangling_refs(nodes) == {"GHOST", "PHANTOM"}
+
+
+def test_cli_stdin_stdout_roundtrip():
+    data = [SANE, INSANE_TOP]
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu.fbas.sanitize"],
+        input=json.dumps(data),
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    assert json.loads(proc.stdout) == [SANE]
+
+
+def test_reference_fixture_sanitize_no_crash(ref_fixture):
+    with open(ref_fixture("correct.json")) as f:
+        data = json.load(f)
+    out = sanitize(data, compat=True)
+    assert len(out) <= len(data)
+    assert all("publicKey" in n for n in out)

@@ -1,0 +1,127 @@
+"""Frontend schema tests: parsing, validation errors, fixture ground truth.
+
+Fixture structural numbers come from SURVEY.md §4.1 (verified during the
+survey session against the reference fixtures).
+"""
+
+import json
+
+import pytest
+
+from quorum_intersection_tpu.fbas.schema import (
+    FbasSchemaError,
+    NULL_QSET,
+    QSet,
+    parse_fbas,
+)
+
+
+def test_parse_minimal():
+    fbas = parse_fbas(
+        '[{"publicKey": "A", "name": "alice", '
+        '"quorumSet": {"threshold": 1, "validators": ["A"], "innerQuorumSets": []}}]'
+    )
+    assert len(fbas) == 1
+    assert fbas[0].public_key == "A"
+    assert fbas[0].name == "alice"
+    assert fbas[0].qset == QSet(threshold=1, validators=("A",))
+    assert fbas.label(0) == "alice"
+
+
+def test_name_optional_defaults_empty():
+    fbas = parse_fbas('[{"publicKey": "A", "quorumSet": null}]')
+    assert fbas[0].name == ""
+    assert fbas.label(0) == "A"  # label falls back to publicKey (cpp:507)
+
+
+def test_null_and_empty_qset_are_null():
+    fbas = parse_fbas(
+        '[{"publicKey": "A", "quorumSet": null},'
+        ' {"publicKey": "B", "quorumSet": {}}]'
+    )
+    assert fbas[0].qset is NULL_QSET
+    assert fbas[1].qset is NULL_QSET
+    assert fbas[0].qset.is_null
+
+
+def test_nested_inner_sets():
+    fbas = parse_fbas(
+        json.dumps(
+            [
+                {
+                    "publicKey": "A",
+                    "quorumSet": {
+                        "threshold": 2,
+                        "validators": ["A"],
+                        "innerQuorumSets": [
+                            {
+                                "threshold": 1,
+                                "validators": ["B"],
+                                "innerQuorumSets": [
+                                    {"threshold": 1, "validators": ["C"]}
+                                ],
+                            }
+                        ],
+                    },
+                }
+            ]
+        )
+    )
+    q = fbas[0].qset
+    assert q.max_depth() == 2
+    assert list(q.all_validator_refs()) == ["A", "B", "C"]
+    assert q.member_count() == 2
+
+
+def test_ignored_extra_keys():
+    fbas = parse_fbas(
+        '[{"publicKey": "A", "updatedAt": "2020-01-01", '
+        '"quorumSet": {"threshold": 1, "validators": ["A"], "hashKey": "zzz"}}]'
+    )
+    assert fbas[0].qset.threshold == 1
+
+
+def test_falsy_wrong_typed_fields_rejected():
+    # Regression: `x or ()` used to coerce falsy wrong types (0, false, "") to
+    # the empty list instead of raising.
+    with pytest.raises(FbasSchemaError, match="validators"):
+        parse_fbas('[{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": 0}}]')
+    with pytest.raises(FbasSchemaError, match="innerQuorumSets"):
+        parse_fbas('[{"publicKey": "A", "quorumSet": {"threshold": 1, "innerQuorumSets": false}}]')
+
+
+def test_numeric_string_threshold_accepted():
+    # boost::property_tree stores scalars as strings; keep input compat.
+    fbas = parse_fbas('[{"publicKey": "A", "quorumSet": {"threshold": "2", "validators": []}}]')
+    assert fbas[0].qset.threshold == 2
+
+
+@pytest.mark.parametrize(
+    "doc,msg",
+    [
+        ('{"publicKey": "A"}', "array"),
+        ('[{"name": "x", "quorumSet": null}]', "publicKey"),
+        ('[{"publicKey": "A"}]', "quorumSet"),
+        ('[{"publicKey": "A", "quorumSet": {"validators": ["A"]}}]', "threshold"),
+        ('[{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": "A"}}]', "validators"),
+        ('[{"publicKey": "A", "quorumSet": null}, {"publicKey": "A", "quorumSet": null}]', "duplicate"),
+    ],
+)
+def test_schema_errors(doc, msg):
+    with pytest.raises(FbasSchemaError, match=msg):
+        parse_fbas(doc)
+
+
+def test_reference_fixture_counts(ref_fixture):
+    """Node and null-qset counts match SURVEY.md §4.1 [verified] numbers."""
+    expectations = {
+        "correct_trivial.json": (3, 0),
+        "broken_trivial.json": (3, 0),
+        "correct.json": (74, 26),
+        "broken.json": (78, 28),
+    }
+    for name, (n_nodes, n_null) in expectations.items():
+        with open(ref_fixture(name)) as f:
+            fbas = parse_fbas(f)
+        assert len(fbas) == n_nodes
+        assert sum(1 for node in fbas if node.qset.is_null) == n_null

@@ -1,0 +1,97 @@
+"""Reference-faithful set-semantics tests: slice satisfaction quirks Q2-Q4,
+fixpoint behavior, hand-computed cases (SURVEY.md §4.3 item 4)."""
+
+from quorum_intersection_tpu.fbas.graph import IndexedQSet, build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import is_quorum, max_quorum, slice_satisfied
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+
+def _graph(data):
+    return build_graph(parse_fbas(data))
+
+
+def q(t, members=(), inner=()):
+    return IndexedQSet(threshold=t, members=tuple(members), inner=tuple(inner))
+
+
+class TestSliceSatisfied:
+    def test_simple_threshold(self):
+        qs = q(2, [0, 1, 2])
+        assert slice_satisfied(0, qs, [True, True, False])
+        assert not slice_satisfied(0, qs, [True, False, False])
+
+    def test_q4_self_availability_required(self):
+        # Owner 3 not in its own validator list — still must be available (cpp:95-98).
+        qs = q(1, [0])
+        assert not slice_satisfied(3, qs, [True, True, True, False])
+        assert slice_satisfied(3, qs, [True, True, True, True])
+
+    def test_q2_null_qset_never_satisfiable(self):
+        assert not slice_satisfied(0, IndexedQSet(threshold=None), [True])
+
+    def test_q3_zero_threshold_never_satisfiable(self):
+        assert not slice_satisfied(0, q(0, [0, 1]), [True, True])
+        assert not slice_satisfied(0, q(0), [True])
+
+    def test_q3_threshold_above_members_never_satisfiable(self):
+        assert not slice_satisfied(0, q(3, [0, 1]), [True, True])
+
+    def test_inner_sets_count_as_one_vote(self):
+        # 2 votes needed: validator 0 + satisfied inner {1 or 2}.
+        qs = q(2, [0], [q(1, [1, 2])])
+        assert slice_satisfied(0, qs, [True, False, True])
+        assert not slice_satisfied(0, qs, [True, False, False])
+
+    def test_nested_depth_two(self):
+        deep = q(1, [], [q(1, [], [q(1, [2])])])
+        assert slice_satisfied(0, deep, [True, False, True])
+        assert not slice_satisfied(0, deep, [True, False, False])
+
+    def test_inner_self_availability_uses_owner(self):
+        # Inner recursion passes the *owner*, not the inner members (cpp:121).
+        qs = q(1, [], [q(1, [1])])
+        assert not slice_satisfied(0, qs, [False, True])
+
+
+class TestMaxQuorum:
+    def test_majority_is_quorum(self):
+        g = _graph(majority_fbas(5))
+        avail = [True] * 5
+        assert sorted(max_quorum(g, range(5), avail)) == [0, 1, 2, 3, 4]
+        # 3-of-5 subset is also a quorum (k = 3)
+        avail = [True, True, True, False, False]
+        assert sorted(max_quorum(g, [0, 1, 2], avail)) == [0, 1, 2]
+        # ...but a 2-node subset is not
+        avail = [True, True, False, False, False]
+        assert max_quorum(g, [0, 1], avail) == []
+
+    def test_avail_restored_after_call(self):
+        g = _graph(majority_fbas(5))
+        avail = [True, True, False, False, False]
+        max_quorum(g, [0, 1], avail)
+        assert avail == [True, True, False, False, False]  # cpp:171-173
+
+    def test_cascade_removal(self):
+        # 0 needs 1, 1 needs 2, 2 needs itself only; removing 2 cascades.
+        data = [
+            {"publicKey": "A", "quorumSet": {"threshold": 2, "validators": ["A", "B"]}},
+            {"publicKey": "B", "quorumSet": {"threshold": 2, "validators": ["B", "C"]}},
+            {"publicKey": "C", "quorumSet": {"threshold": 1, "validators": ["C"]}},
+        ]
+        g = _graph(data)
+        avail = [True, True, True]
+        assert sorted(max_quorum(g, range(3), avail)) == [0, 1, 2]
+        avail = [True, True, False]
+        assert max_quorum(g, [0, 1], avail) == []
+
+    def test_null_qset_nodes_never_in_quorum(self):
+        data = majority_fbas(3) + [{"publicKey": "NULL1", "quorumSet": None}]
+        g = _graph(data)
+        avail = [True] * 4
+        assert sorted(max_quorum(g, range(4), avail)) == [0, 1, 2]
+
+    def test_is_quorum(self):
+        g = _graph(majority_fbas(5))
+        assert is_quorum(g, [0, 1, 2])
+        assert not is_quorum(g, [0, 1])
