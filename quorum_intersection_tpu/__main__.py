@@ -1,0 +1,7 @@
+"""``python -m quorum_intersection_tpu`` — the CLI entry point."""
+
+import sys
+
+from quorum_intersection_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,6 @@
+"""Analytics side modes: trust-graph PageRank and Graphviz export."""
+
+from quorum_intersection_tpu.analytics.pagerank import pagerank, format_pagerank
+from quorum_intersection_tpu.analytics.graphviz import write_graphviz_sccs
+
+__all__ = ["pagerank", "format_pagerank", "write_graphviz_sccs"]

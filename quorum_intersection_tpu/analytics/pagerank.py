@@ -1,0 +1,123 @@
+"""Trust-graph PageRank — power iteration as one dense jnp matvec loop,
+semantics-equivalent to the reference's custom variant
+(`/root/reference/quorum_intersection.cpp:532-583`), which differs from
+textbook PageRank in several pinned ways (SURVEY.md C15):
+
+- initial mass 1 on **vertex 0** only (cpp:543), not uniform;
+- per iteration every vertex gets base mass ``m / N`` (cpp:555-557) where
+  ``m`` is the ``--dangling_factor`` (default 0.0001, *not* the classic 0.15);
+- each vertex with out-degree > 0 sends ``(1-m)/outdeg · rank`` along **every**
+  out-edge occurrence — parallel edges and self-loops count with multiplicity
+  (Q7, cpp:561-570); dangling vertices simply leak their mass;
+- the L1 convergence diff is computed on the **un-normalized** new vector
+  (cpp:573-575), which is then normalized by the accumulated sum (cpp:576);
+- stop at ``diff ≤ convergence`` or ``maxIterations`` (cpp:551).
+
+The whole loop is a ``lax.while_loop`` over a dense (N, N) float32 count
+matrix — a single fused matvec per iteration, trivially TPU-native.  Exact
+float accumulation order differs from the C++ per-edge loop; agreement is to
+float32 tolerance, pinned by differential tests against a pure-Python
+re-model.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+
+
+def adjacency_counts(graph: TrustGraph) -> np.ndarray:
+    """Dense (N, N) float32 matrix: A[v, w] = multiplicity of edge v→w."""
+    a = np.zeros((graph.n, graph.n), dtype=np.float32)
+    for v, targets in enumerate(graph.succ):
+        for w in targets:
+            a[v, w] += 1.0
+    return a
+
+
+def pagerank_np(
+    graph: TrustGraph,
+    m: float = 0.0001,
+    convergence: float = 0.0001,
+    max_iterations: int = 100000,
+) -> np.ndarray:
+    """NumPy re-model of cpp:532-583 — the differential baseline for the JAX
+    path and a dependency-light fallback."""
+    n = graph.n
+    if n == 0:
+        return np.zeros(0, dtype=np.float32)
+    a = adjacency_counts(graph)
+    outdeg = a.sum(axis=1)
+    rank = np.zeros(n, dtype=np.float32)
+    rank[0] = 1.0
+    m = np.float32(m)
+    base = m / np.float32(n)
+    diff = np.float32(convergence) + 1
+    it = 0
+    while diff > convergence and it < max_iterations:
+        send = np.where(outdeg > 0, (1 - m) / np.maximum(outdeg, 1) * rank, 0.0).astype(
+            np.float32
+        )
+        tmp = base + a.T @ send
+        total = m + (outdeg * send).sum(dtype=np.float32)
+        diff = np.abs(tmp - rank).sum(dtype=np.float32)
+        rank = (tmp / total).astype(np.float32)
+        it += 1
+    return rank
+
+
+def pagerank(
+    graph: TrustGraph,
+    m: float = 0.0001,
+    convergence: float = 0.0001,
+    max_iterations: int = 100000,
+) -> np.ndarray:
+    """JAX power iteration (jit + lax.while_loop); runs on TPU or CPU."""
+    n = graph.n
+    if n == 0:
+        return np.zeros(0, dtype=np.float32)
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = jnp.asarray(adjacency_counts(graph))
+    outdeg = a.sum(axis=1)
+    has_out = outdeg > 0
+    inv_out = jnp.where(has_out, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
+    mf = jnp.float32(m)
+    base = mf / n
+    conv = jnp.float32(convergence)
+
+    def cond(carry):
+        rank, diff, it = carry
+        return jnp.logical_and(diff > conv, it < max_iterations)
+
+    def body(carry):
+        rank, _, it = carry
+        send = (1 - mf) * inv_out * rank
+        tmp = base + a.T @ send
+        total = mf + jnp.sum(outdeg * send)
+        diff = jnp.sum(jnp.abs(tmp - rank))
+        return tmp / total, diff, it + 1
+
+    rank0 = jnp.zeros(n, dtype=jnp.float32).at[0].set(1.0)
+    init = (rank0, conv + 1, jnp.int32(0))
+    rank, _, _ = jax.jit(lambda c: lax.while_loop(cond, body, c))(init)
+    return np.asarray(rank)
+
+
+def sorted_ranks(graph: TrustGraph, ranks: np.ndarray) -> List[Tuple[str, float]]:
+    """Sort descending by rank, ties ascending by label (cpp:601-608)."""
+    pairs = [(graph.label(v), float(ranks[v])) for v in range(graph.n)]
+    return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+
+def format_pagerank(graph: TrustGraph, ranks: np.ndarray) -> str:
+    """``label: value`` lines under a ``PageRank:`` header (cpp:585-613, :731)."""
+    lines = ["PageRank:"]
+    for label, value in sorted_ranks(graph, ranks):
+        lines.append(f"{label}: {value:g}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,23 @@
+"""Pluggable quorum-disjointness search backends.
+
+The polynomial phases (parse, graph, SCC reduction, per-SCC quorum scan) are
+shared host code in :mod:`quorum_intersection_tpu.pipeline`; a *backend* owns
+only the NP-hard part — deciding whether the quorum-bearing SCC contains two
+disjoint quorums — mirroring how the BASELINE.json north star splits the
+reference into a frontend + pluggable QuorumChecker.
+
+Backends:
+
+- ``python``     — pure-Python branch-and-bound, reference-faithful (the
+                   portable correctness oracle)
+- ``cpp``        — native C++ branch-and-bound over the flattened threshold
+                   circuit (the fast CPU oracle)
+- ``tpu-sweep``  — JAX exhaustive batched subset sweep (small SCCs; verdict-
+                   equivalent by the half-size argument, exact by construction)
+- ``tpu-hybrid`` — host frontier + batched device fixpoint evaluation
+- ``auto``       — picks per-SCC-size: sweep for tiny, hybrid/cpp beyond
+"""
+
+from quorum_intersection_tpu.backends.base import SccCheckResult, SearchBackend, get_backend
+
+__all__ = ["SccCheckResult", "SearchBackend", "get_backend"]

@@ -1,0 +1,92 @@
+"""Automatic backend selection.
+
+Strategy (SURVEY.md §7.2 step 4 rationale):
+
+- **small SCC** (≤ ``sweep_limit`` nodes): the TPU exhaustive subset sweep is
+  exact, embarrassingly parallel, and fastest — candidate space 2^|scc| is
+  bounded;
+- **large SCC**: the pruned search is the only tractable option — prefer the
+  native C++ oracle, falling back to the pure-Python oracle; the TPU hybrid
+  (host frontier + batched device fixpoints) is selected with
+  ``prefer_tpu=True``.
+
+Every selection is logged; failures to import/compile an accelerator backend
+degrade gracefully to the next option so the CLI always yields a verdict.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.auto")
+
+DEFAULT_SWEEP_LIMIT = 24
+
+
+class AutoBackend:
+    name = "auto"
+
+    def __init__(
+        self,
+        prefer_tpu: bool = False,
+        sweep_limit: int = DEFAULT_SWEEP_LIMIT,
+        seed: Optional[int] = None,
+        randomized: bool = False,
+    ) -> None:
+        self.prefer_tpu = prefer_tpu
+        self.sweep_limit = sweep_limit
+        self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
+
+    def _sweep(self):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+
+        return TpuSweepBackend()
+
+    def _hybrid(self):
+        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+
+        return TpuHybridBackend()
+
+    def _cpu_oracle(self):
+        try:
+            from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+
+            backend = CppOracleBackend(**self._oracle_options)
+            backend.ensure_built()
+            return backend
+        except Exception as exc:  # noqa: BLE001 — degrade to pure Python
+            log.info("native C++ oracle unavailable (%s); using Python oracle", exc)
+            from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+
+            return PythonOracleBackend(**self._oracle_options)
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        if len(scc) <= self.sweep_limit:
+            try:
+                backend = self._sweep()
+                log.debug("auto: sweep backend for |scc|=%d", len(scc))
+                return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
+            except Exception as exc:  # noqa: BLE001
+                log.info("sweep backend unavailable (%s); falling back", exc)
+        if self.prefer_tpu:
+            try:
+                backend = self._hybrid()
+                log.debug("auto: hybrid backend for |scc|=%d", len(scc))
+                return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
+            except Exception as exc:  # noqa: BLE001
+                log.info("hybrid backend unavailable (%s); falling back", exc)
+        backend = self._cpu_oracle()
+        log.debug("auto: %s backend for |scc|=%d", backend.name, len(scc))
+        return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)

@@ -1,0 +1,75 @@
+"""Backend protocol and registry."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, runtime_checkable
+
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+
+
+@dataclass
+class SccCheckResult:
+    """Outcome of the disjoint-quorum search inside one SCC.
+
+    ``intersects`` is the verdict for this SCC: True iff every pair of quorums
+    intersects.  On False, ``q1``/``q2`` are a witness pair of disjoint
+    quorums (the reference surfaces the same via out-params, cpp:351-352).
+    ``stats`` carries backend counters (branch-and-bound calls, candidates
+    checked, device batches, seconds) for observability parity and the
+    benchmark metric.
+    """
+
+    intersects: bool
+    q1: Optional[List[int]] = None
+    q2: Optional[List[int]] = None
+    stats: Dict[str, float] = field(default_factory=dict)
+
+
+@runtime_checkable
+class SearchBackend(Protocol):
+    name: str
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        """Decide disjoint-quorum existence within ``scc``.
+
+        ``scope_to_scc=False`` reproduces the reference's availability
+        semantics — the whole graph starts available (cpp:354, quirk Q6) —
+        which is only sound for a sink SCC.  ``True`` scopes availability to
+        the SCC, the principled default for non-sink components.
+        """
+        ...
+
+
+def get_backend(name: str, **options) -> SearchBackend:
+    """Instantiate a backend by name (lazy imports keep JAX out of the
+    pure-CPU paths)."""
+    if name == "python":
+        from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+
+        return PythonOracleBackend(**options)
+    if name == "cpp":
+        from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+
+        return CppOracleBackend(**options)
+    if name == "tpu-sweep":
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+
+        return TpuSweepBackend(**options)
+    if name == "tpu-hybrid":
+        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+
+        return TpuHybridBackend(**options)
+    if name in ("tpu", "auto"):
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+
+        return AutoBackend(prefer_tpu=(name == "tpu"), **options)
+    raise ValueError(f"unknown backend {name!r}")

@@ -1,0 +1,248 @@
+"""Pure-Python branch-and-bound oracle — the portable correctness anchor.
+
+Re-implements the reference's exponential search with the same pruning logic
+(`/root/reference/quorum_intersection.cpp:252-400`), written fresh against the
+pinned spec in SURVEY.md §2.1 C6-C9:
+
+- :func:`find_best_node`        — branching heuristic: max in-degree within the
+  current quorum excluding the restriction set (cpp:203-250).  The reference
+  tie-breaks uniformly at random (its only nondeterminism; verdict-independent,
+  SURVEY.md C7 [verified]); default here is deterministic (lowest vertex index
+  among the argmax set), with an optional seeded RNG mode that is
+  distributionally equivalent (uniform over the same argmax set).
+- :func:`is_minimal_quorum`     — quorum whose every single-node removal kills
+  all quorums inside it (cpp:179-201).
+- :func:`iterate_minimal_quorums` — inclusion/exclusion enumeration of minimal
+  quorums over (toRemove, dontRemove) with the reference's four prunes
+  (cpp:261, :266-268, :281-291, :303-314, :325-328).
+- :class:`PythonOracleBackend.check_scc` — the disjointness driver: for each
+  minimal quorum Q, search for a quorum disjoint from Q; candidates larger than
+  ⌊|scc|/2⌋ are pruned since two disjoint quorums cannot both exceed half
+  (cpp:386-391).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.python")
+
+
+def find_best_node(
+    quorum: Sequence[int],
+    restriction: Sequence[int],
+    graph: TrustGraph,
+    rng: Optional[random.Random] = None,
+) -> int:
+    """Next branch variable: a max-in-degree node within ``quorum`` minus
+    ``restriction`` (cpp:203-250).
+
+    The reference's reservoir-style randomized tie-break lands on a uniform
+    member of the final argmax set; we pick the lowest index (deterministic)
+    or ``rng.choice`` over the same set.  Parallel edges and self-loops count
+    with multiplicity (Q7, cpp:224-229).
+    """
+    eligible = set(quorum) - set(restriction)
+    indeg: Dict[int, int] = {}
+    for node in quorum:
+        for w in graph.succ[node]:
+            if w in eligible:
+                indeg[w] = indeg.get(w, 0) + 1
+    if not indeg:
+        return quorum[0]  # bestNode initialization fallback (cpp:221)
+    max_deg = max(indeg.values())
+    candidates = sorted(w for w, d in indeg.items() if d == max_deg)
+    if rng is not None:
+        return rng.choice(candidates)
+    return candidates[0]
+
+
+def is_minimal_quorum(nodes: Sequence[int], graph: TrustGraph) -> bool:
+    """``nodes`` contains a quorum AND removing any single node kills all
+    quorums inside it (cpp:179-201)."""
+    avail = [False] * graph.n
+    for v in nodes:
+        avail[v] = True
+    if not max_quorum(graph, nodes, avail):
+        return False
+    for v in nodes:
+        avail[v] = False
+        if max_quorum(graph, nodes, avail):
+            return False
+        avail[v] = True
+    return True
+
+
+class _SearchState:
+    """Mutable search bookkeeping shared across the recursion."""
+
+    __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls")
+
+    def __init__(self) -> None:
+        self.bnb_calls = 0
+        self.minimal_quorums = 0
+        self.fixpoint_calls = 0
+
+
+def iterate_minimal_quorums(
+    to_remove: List[int],
+    dont_remove: List[int],
+    graph: TrustGraph,
+    visitor: Callable[[List[int]], bool],
+    current_visitor: Callable[[List[int]], bool],
+    state: _SearchState,
+    rng: Optional[random.Random],
+) -> bool:
+    """Branch-and-bound enumeration of minimal quorums (cpp:252-346).
+
+    Invariant: every minimal quorum ⊆ toRemove ∪ dontRemove that contains all
+    of dontRemove is eventually visited (or the search stops once ``visitor``
+    returns True).  Prunes, in order:
+
+    1. ``current_visitor(dontRemove)`` — caller-supplied size prune (cpp:261);
+    2. both sets empty (cpp:266-268);
+    3. dontRemove already contains a quorum → report iff dontRemove *is* a
+       minimal quorum, then stop descending either way (cpp:281-291: any
+       proper superset cannot be minimal);
+    4. no quorum in toRemove ∪ dontRemove (cpp:303-306);
+    5. the max quorum does not contain all of dontRemove (cpp:308-314);
+    6. nothing outside dontRemove left to branch on (cpp:325-328).
+
+    Then branch on ``bestNode``: excluded first (cpp:336), included second
+    (cpp:343-345).
+    """
+    state.bnb_calls += 1
+    if current_visitor(dont_remove):
+        return False
+    if not to_remove and not dont_remove:
+        return False
+
+    avail = [False] * graph.n
+    for v in dont_remove:
+        avail[v] = True
+
+    state.fixpoint_calls += 1
+    if max_quorum(graph, dont_remove, avail):
+        if is_minimal_quorum(dont_remove, graph):
+            state.minimal_quorums += 1
+            return visitor(list(dont_remove))
+        return False
+
+    for v in to_remove:
+        avail[v] = True
+    state.fixpoint_calls += 1
+    quorum = max_quorum(graph, dont_remove + to_remove, avail)
+    if not quorum:
+        return False
+
+    quorum_set = set(quorum)
+    for v in dont_remove:
+        if v not in quorum_set:
+            return False
+
+    best = find_best_node(quorum, dont_remove, graph, rng)
+
+    remaining = quorum_set - set(dont_remove)
+    if not remaining:
+        return False
+
+    new_to_remove = sorted(v for v in remaining if v != best)
+    if iterate_minimal_quorums(
+        new_to_remove, dont_remove, graph, visitor, current_visitor, state, rng
+    ):
+        return True
+    return iterate_minimal_quorums(
+        new_to_remove, dont_remove + [best], graph, visitor, current_visitor, state, rng
+    )
+
+
+class PythonOracleBackend:
+    """Reference-faithful disjointness search on the host."""
+
+    name = "python"
+    needs_circuit = False  # works on TrustGraph set semantics directly
+
+    def __init__(self, seed: Optional[int] = None, randomized: bool = False) -> None:
+        self._rng = random.Random(seed) if (randomized or seed is not None) else None
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        t0 = time.perf_counter()
+        state = _SearchState()
+
+        if scope_to_scc:
+            avail = [False] * graph.n
+            for v in scc:
+                avail[v] = True
+        else:
+            # Reference semantics: the whole graph starts available (Q6,
+            # cpp:354) — sound for a sink SCC, whose slices cannot reference
+            # outside nodes.
+            avail = [True] * graph.n
+
+        outcome: Dict[str, object] = {"intersects": True, "q1": None, "q2": None}
+
+        def visitor(quorum: List[int]) -> bool:
+            # Mark Q unavailable, search the SCC for a disjoint quorum
+            # (cpp:357-384).
+            for v in quorum:
+                avail[v] = False
+            state.fixpoint_calls += 1
+            disjoint = max_quorum(graph, scc, avail)
+            if disjoint:
+                outcome["intersects"] = False
+                outcome["q1"] = disjoint
+                outcome["q2"] = list(quorum)
+                return True
+            for v in quorum:
+                avail[v] = True
+            return False
+
+        half = len(scc) // 2
+
+        def current_visitor(candidate: List[int]) -> bool:
+            # Two disjoint quorums cannot both exceed ⌊|scc|/2⌋ (cpp:386-391).
+            return len(candidate) > half
+
+        # The B&B recursion is ~2 frames per level of |scc|; lift the limit
+        # for large components.
+        needed = 4 * len(scc) + 1000
+        old_limit = sys.getrecursionlimit()
+        if needed > old_limit:
+            sys.setrecursionlimit(needed)
+        try:
+            iterate_minimal_quorums(
+                list(scc), [], graph, visitor, current_visitor, state, self._rng
+            )
+        finally:
+            if needed > old_limit:
+                sys.setrecursionlimit(old_limit)
+
+        seconds = time.perf_counter() - t0
+        return SccCheckResult(
+            intersects=bool(outcome["intersects"]),
+            q1=outcome["q1"],
+            q2=outcome["q2"],
+            stats={
+                "backend": self.name,
+                "bnb_calls": state.bnb_calls,
+                "minimal_quorums": state.minimal_quorums,
+                "fixpoint_calls": state.fixpoint_calls,
+                "seconds": seconds,
+            },
+        )

@@ -1,0 +1,162 @@
+"""Command-line interface — flag-compatible superset of the reference CLI
+(`/root/reference/quorum_intersection.cpp:744-800`).
+
+Contract parity (SURVEY.md §2.2):
+
+- input is always **stdin**, output always stdout; no file arguments;
+- default mode decides quorum intersection and prints ``true``/``false``
+  (cpp:790-797), exiting 0 iff intersecting else 1;
+- ``-p/--pagerank`` switches to PageRank mode, always exit 0 (cpp:784-788);
+- ``-g/--graph`` dumps the SCC-colored Graphviz digraph *before* the verdict
+  (cpp:635-637), which still runs;
+- ``-v/--verbose`` narrates SCC/quorum findings; ``-t/--trace`` enables
+  trace-level logging;
+- ``-i/--max_iterations``, ``-m/--dangling_factor``, ``-c/--convergence``
+  tune PageRank (defaults 100000 / 0.0001 / 0.0001, cpp:746-765);
+- an invalid option prints ``Invalid option!`` plus usage and exits 1
+  (cpp:771-775); ``-h/--help`` prints usage and exits 0.
+
+Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
+``--scc-select``, ``--scope-scc``, ``--seed``, ``--randomized``, ``--compat``
+(reference-bug-compatible shorthand: alias0 dangling + front SCC selection),
+``--timing``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from quorum_intersection_tpu.utils.logging import get_logger, set_trace
+
+log = get_logger("cli")
+
+
+class _RefCompatParser(argparse.ArgumentParser):
+    """argparse with the reference's error contract: ``Invalid option!`` +
+    usage on stderr, exit code 1 (cpp:771-775)."""
+
+    def error(self, message: str) -> None:  # type: ignore[override]
+        # The reference writes both to cout (cpp:772-774).
+        sys.stdout.write("Invalid option!\n")
+        self.print_help(sys.stdout)
+        raise SystemExit(1)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = _RefCompatParser(
+        prog="quorum_intersection_tpu",
+        description=(
+            "Decide the quorum-intersection property of a Stellar FBAS "
+            "(stellarbeat /nodes/raw JSON on stdin)."
+        ),
+        add_help=False,
+    )
+    p.add_argument("--help", "-h", action="help", help="produce help message")
+    p.add_argument("--verbose", "-v", action="store_true", help="print info about the analyzed configuration")
+    p.add_argument("--graph", "-g", action="store_true", help="print graphviz representation of the configuration")
+    p.add_argument("--trace", "-t", action="store_true", help="print debug information")
+    p.add_argument("--pagerank", "-p", action="store_true", help="compute PageRank of the trust graph instead")
+    p.add_argument("--max_iterations", "-i", type=int, default=100000, metavar="N",
+                   help="maximal number of PageRank iterations (default 100000)")
+    p.add_argument("--dangling_factor", "-m", type=float, default=0.0001, metavar="F",
+                   help="PageRank dangling factor (default 0.0001)")
+    p.add_argument("--convergence", "-c", type=float, default=0.0001, metavar="F",
+                   help="PageRank convergence threshold (default 0.0001)")
+    # --- superset flags ---
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep", "tpu-hybrid"],
+                   help="disjoint-quorum search backend (default auto)")
+    p.add_argument("--dangling-policy", default=None, choices=["strict", "alias0"],
+                   help="unknown validator refs: strict=never available (default), "
+                        "alias0=reference-compatible aliasing to vertex 0 (Q1)")
+    p.add_argument("--scc-select", default=None, choices=["quorum-bearing", "front"],
+                   help="which SCC to search: the quorum-bearing one (default, Q5 fix) "
+                        "or Tarjan component 0 like the reference")
+    p.add_argument("--scope-scc", action="store_true",
+                   help="scope availability to the searched SCC (principled; default "
+                        "reproduces the reference's whole-graph availability, Q6)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="seed for the randomized branching tie-break (implies --randomized)")
+    p.add_argument("--randomized", action="store_true",
+                   help="use the reference's randomized branching tie-break instead of "
+                        "the deterministic lowest-index rule")
+    p.add_argument("--compat", action="store_true",
+                   help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
+    p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.trace:
+        set_trace(True)
+
+    dangling = args.dangling_policy or ("alias0" if args.compat else "strict")
+    scc_select = args.scc_select or ("front" if args.compat else "quorum-bearing")
+
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.graph import build_graph
+
+    try:
+        fbas = parse_fbas(sys.stdin)
+    except ValueError as exc:
+        # FbasSchemaError and json.JSONDecodeError both derive from ValueError.
+        # (The reference crashes with an uncaught ptree exception here; a clean
+        # diagnostic + exit 1 is a deliberate improvement.)
+        sys.stderr.write(f"invalid FBAS configuration: {exc}\n")
+        return 1
+
+    graph = build_graph(fbas, dangling=dangling)
+
+    if args.pagerank:
+        from quorum_intersection_tpu.analytics.pagerank import format_pagerank, pagerank_np
+
+        ranks = pagerank_np(
+            graph,
+            m=args.dangling_factor,
+            convergence=args.convergence,
+            max_iterations=args.max_iterations,
+        )
+        sys.stdout.write(format_pagerank(graph, ranks))
+        return 0  # PageRank mode always exits 0 (cpp:787)
+
+    from quorum_intersection_tpu.backends.base import get_backend
+    from quorum_intersection_tpu.pipeline import solve_graph
+
+    backend_options = {}
+    if args.backend in ("python", "cpp", "auto", "tpu") and (
+        args.seed is not None or args.randomized
+    ):
+        backend_options = {"seed": args.seed, "randomized": True}
+    try:
+        backend = get_backend(args.backend, **backend_options)
+    except (ImportError, ValueError) as exc:
+        sys.stderr.write(f"backend {args.backend!r} unavailable: {exc}\n")
+        return 1
+
+    result = solve_graph(
+        graph,
+        backend=backend,
+        verbose=args.verbose,
+        out=sys.stdout,
+        graphviz=args.graph,
+        scc_select=scc_select,
+        scope_to_scc=args.scope_scc,
+    )
+
+    if args.timing:
+        for name, seconds in result.timers.items():
+            sys.stderr.write(f"[timing] {name}: {seconds * 1000:.2f} ms\n")
+        for key, value in result.stats.items():
+            sys.stderr.write(f"[stats] {key}: {value}\n")
+
+    sys.stdout.write("true\n" if result.intersects else "false\n")
+    return 0 if result.intersects else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,8 +57,13 @@ class TrustGraph:
     n: int
     succ: List[List[int]]
     qsets: List[IndexedQSet]
-    labels: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)  # publicKeys
+    names: List[str] = field(default_factory=list)  # raw names ("" if unset)
     dangling_refs: int = 0
+
+    def label(self, v: int) -> str:
+        """Display label: name if non-empty else publicKey (cpp:507, :596-597)."""
+        return self.names[v] if self.names[v] else self.node_ids[v]
 
     @property
     def n_edges(self) -> int:
@@ -115,8 +120,14 @@ def build_graph(fbas: Fbas, dangling: DanglingPolicy = "strict") -> TrustGraph:
         out_edges: List[int] = []
         qsets.append(_index_qset(node.qset, fbas.index, dangling, out_edges, stats))
         succ.append(out_edges)
-    labels = [fbas.label(i) for i in range(n)]
-    return TrustGraph(n=n, succ=succ, qsets=qsets, labels=labels, dangling_refs=stats[0])
+    return TrustGraph(
+        n=n,
+        succ=succ,
+        qsets=qsets,
+        node_ids=[node.public_key for node in fbas],
+        names=[node.name for node in fbas],
+        dangling_refs=stats[0],
+    )
 
 
 def tarjan_scc(n: int, succ: List[List[int]]) -> Tuple[int, List[int]]:

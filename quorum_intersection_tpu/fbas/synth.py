@@ -57,7 +57,9 @@ def hierarchical_fbas(
     expressed with one inner quorum set per organization (nesting depth 1,
     matching the bundled fixtures' observed max depth, SURVEY.md §7.3).
 
-    ``broken=True`` lowers the first node's org threshold to 1.
+    ``broken=True`` gives the first node a degenerate self-only slice
+    (threshold 1 over itself), making {node0} a quorum disjoint from the
+    surviving org-majority quorum of everyone else.
     """
     org_keys = [keys(per_org, f"ORG{o}N") for o in range(n_orgs)]
     all_nodes: List[Dict] = []
@@ -65,8 +67,10 @@ def hierarchical_fbas(
     inner = [_qset(per_org // 2 + 1, list(ok)) for ok in org_keys]
     for o in range(n_orgs):
         for i, key in enumerate(org_keys[o]):
-            t = 1 if (broken and o == 0 and i == 0) else t_orgs
-            all_nodes.append(_node(key, f"org{o}-v{i}", _qset(t, [], list(inner))))
+            if broken and o == 0 and i == 0:
+                all_nodes.append(_node(key, f"org{o}-v{i}", _qset(1, [key])))
+            else:
+                all_nodes.append(_node(key, f"org{o}-v{i}", _qset(t_orgs, [], list(inner))))
     return all_nodes
 
 

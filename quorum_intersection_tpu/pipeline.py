@@ -1,0 +1,200 @@
+"""Orchestration: parse → graph → SCC reduction → guard → backend search.
+
+Capability parity with the reference's ``solve`` drivers
+(`/root/reference/quorum_intersection.cpp:615-716`), with the Q5 fix
+(SURVEY.md §2.3): the exponential search runs in **the** quorum-bearing SCC,
+not blindly ``sccs.front()``.  When the guard passes (exactly one SCC contains
+a quorum) the two coincide on every Stellar-like topology — and on all bundled
+fixtures [verified] — but ``front()`` could silently return a vacuous ``true``
+if Tarjan numbering ever put the quorum-bearing SCC elsewhere;
+``scc_select="front"`` reproduces the reference choice for differential runs.
+
+Verbose narration mirrors the reference's ``-v`` messages (cpp:640, :662-664,
+:673-679, :683-685, :693-697, :702-704).
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TextIO, Union
+
+from quorum_intersection_tpu.backends.base import SearchBackend, get_backend
+from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph, group_sccs, tarjan_scc
+from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.timers import PhaseTimers
+
+log = get_logger("pipeline")
+
+
+@dataclass
+class SolveResult:
+    intersects: bool
+    n_sccs: int = 0
+    quorum_scc_ids: List[int] = field(default_factory=list)
+    main_scc: List[int] = field(default_factory=list)
+    q1: Optional[List[int]] = None
+    q2: Optional[List[int]] = None
+    stats: Dict[str, object] = field(default_factory=dict)
+    timers: Dict[str, float] = field(default_factory=dict)
+
+
+def print_quorum(quorum: List[int], graph: TrustGraph, out: TextIO) -> None:
+    """Verbose quorum dump — same information as the reference's
+    ``printQuorum`` (cpp:475-490): per node its name, ID, top-level threshold
+    and top-level validator IDs."""
+    for v in quorum:
+        q = graph.qsets[v]
+        names = " ".join(graph.node_ids[v2] for v2 in q.members) if q.members else ""
+        threshold = "null" if q.threshold is None else str(q.threshold)
+        out.write(
+            f"{graph.names[v]} {graph.node_ids[v]}\n"
+            f"( quorumslice: threshold = {threshold} {names}{' ' if names else ''}) \n\n"
+        )
+    out.write("\n")
+
+
+def solve_graph(
+    graph: TrustGraph,
+    *,
+    backend: Union[str, SearchBackend] = "auto",
+    verbose: bool = False,
+    out: TextIO = sys.stdout,
+    graphviz: bool = False,
+    scc_select: str = "quorum-bearing",
+    scope_to_scc: bool = False,
+    circuit: Optional[Circuit] = None,
+    timers: Optional[PhaseTimers] = None,
+) -> SolveResult:
+    """Decide quorum intersection for a built trust graph."""
+    timers = timers or PhaseTimers()
+    if isinstance(backend, str):
+        backend = get_backend(backend)
+
+    with timers.phase("scc"):
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        sccs = group_sccs(graph.n, comp, count)
+
+    if graphviz:
+        from quorum_intersection_tpu.analytics.graphviz import write_graphviz_sccs
+
+        write_graphviz_sccs(graph, sccs, out)
+
+    if verbose:
+        out.write(f"total number of strongly connected components: {count}\n")
+
+    # Per-SCC quorum scan (cpp:645-672): which SCCs, restricted to themselves,
+    # contain a quorum?  All minimal quorums live inside some SCC.
+    quorum_scc_ids: List[int] = []
+    with timers.phase("scc_scan"):
+        for sid, members in enumerate(sccs):
+            avail = [False] * graph.n
+            for v in members:
+                avail[v] = True
+            quorum = max_quorum(graph, members, avail)
+            if quorum:
+                quorum_scc_ids.append(sid)
+                if verbose:
+                    out.write("found quorum inside of a strongly connected component:\n")
+                    print_quorum(quorum, graph, out)
+
+    # "Main" SCC: the reference labels sccs.front() the main component
+    # (cpp:675-678) — that is the *sink*, not the largest (Q8).  With the Q5
+    # fix the main component is the quorum-bearing one when unique.
+    if scc_select == "front" or not quorum_scc_ids:
+        main_scc = sccs[0] if sccs else []
+    else:
+        main_scc = sccs[quorum_scc_ids[0]]
+
+    if verbose:
+        out.write(
+            f"number of strongly connected components containing some quorum: {len(quorum_scc_ids)}\n"
+        )
+        out.write(f"size of the main strongly connected component: {len(main_scc)}\n")
+        out.write(
+            "main strongly connected component (all minimal quorums are included in it; "
+            "small size means small resilience of the network):\n"
+        )
+        print_quorum(main_scc, graph, out)
+
+    if len(quorum_scc_ids) != 1:
+        # Guard (cpp:681-688): zero quorum-bearing SCCs means no quorum at all;
+        # two or more means two disjoint quorums exist across components.
+        if verbose:
+            out.write(
+                "network's configuration is broken - more than one strongly connected "
+                f"component contains a quorum - {len(quorum_scc_ids)}\n"
+            )
+        return SolveResult(
+            intersects=False,
+            n_sccs=count,
+            quorum_scc_ids=quorum_scc_ids,
+            main_scc=main_scc,
+            stats={"reason": "scc_guard"},
+            timers=timers.summary(),
+        )
+
+    # Backends that search on the host set-semantics directly (python, cpp via
+    # CSR) advertise whether they read the dense circuit; skip the O(U·n + U²)
+    # array build when nobody will consume it.
+    if circuit is None and getattr(backend, "needs_circuit", True):
+        with timers.phase("encode"):
+            circuit = encode_circuit(graph)
+
+    target_scc = sccs[0] if scc_select == "front" else sccs[quorum_scc_ids[0]]
+    with timers.phase("search"):
+        res = backend.check_scc(graph, circuit, target_scc, scope_to_scc=scope_to_scc)
+
+    if verbose:
+        if not res.intersects:
+            out.write("found two non-intersecting quorums\n")
+            out.write("first quorum:\n")
+            print_quorum(res.q1 or [], graph, out)
+            out.write("second quorum:\n")
+            print_quorum(res.q2 or [], graph, out)
+        else:
+            out.write("all quorums are intersecting\n")
+
+    return SolveResult(
+        intersects=res.intersects,
+        n_sccs=count,
+        quorum_scc_ids=quorum_scc_ids,
+        main_scc=main_scc,
+        q1=res.q1,
+        q2=res.q2,
+        stats=dict(res.stats),
+        timers=timers.summary(),
+    )
+
+
+def solve(
+    source,
+    *,
+    backend: Union[str, SearchBackend] = "auto",
+    dangling: str = "strict",
+    verbose: bool = False,
+    out: TextIO = sys.stdout,
+    graphviz: bool = False,
+    scc_select: str = "quorum-bearing",
+    scope_to_scc: bool = False,
+) -> SolveResult:
+    """Full pipeline from JSON (stream/str/list) or a parsed :class:`Fbas` —
+    parity with the reference's ``solve(istream&)`` overload (cpp:709-716)."""
+    timers = PhaseTimers()
+    with timers.phase("parse"):
+        fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+    with timers.phase("graph"):
+        graph = build_graph(fbas, dangling=dangling)
+    return solve_graph(
+        graph,
+        backend=backend,
+        verbose=verbose,
+        out=out,
+        graphviz=graphviz,
+        scc_select=scc_select,
+        scope_to_scc=scope_to_scc,
+        timers=timers,
+    )

@@ -1,0 +1,109 @@
+"""CLI contract tests — flag surface, exit codes, output modes
+(SURVEY.md §2.2, C21)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+CLI = [sys.executable, "-m", "quorum_intersection_tpu"]
+
+
+def run_cli(args, stdin_data=""):
+    return subprocess.run(
+        CLI + args, input=stdin_data, capture_output=True, text=True
+    )
+
+
+def _json(data):
+    return json.dumps(data)
+
+
+def test_true_verdict_exit_0():
+    proc = run_cli(["--backend", "python"], _json(majority_fbas(3)))
+    assert proc.stdout.strip() == "true"
+    assert proc.returncode == 0
+
+
+def test_false_verdict_exit_1():
+    proc = run_cli(["--backend", "python"], _json(majority_fbas(3, broken=True)))
+    assert proc.stdout.strip() == "false"
+    assert proc.returncode == 1
+
+
+def test_help_exit_0():
+    proc = run_cli(["-h"])
+    assert proc.returncode == 0
+    assert "usage" in proc.stdout.lower()
+
+
+def test_invalid_option_message_and_exit_1():
+    # cpp:771-775: "Invalid option!" + usage to *stdout*, exit 1.
+    proc = run_cli(["--definitely-not-a-flag"])
+    assert proc.returncode == 1
+    assert "Invalid option!" in proc.stdout
+    assert "usage" in proc.stdout.lower()
+
+
+def test_verbose_narration():
+    proc = run_cli(["-v", "--backend", "python"], _json(majority_fbas(3)))
+    assert "total number of strongly connected components" in proc.stdout
+    assert proc.stdout.rstrip().endswith("true")
+
+
+def test_graphviz_before_verdict():
+    # cpp:635-637: dot dump precedes the verdict line, which still prints.
+    proc = run_cli(["-g", "--backend", "python"], _json(majority_fbas(3)))
+    assert proc.stdout.startswith("digraph G {")
+    assert proc.stdout.rstrip().endswith("true")
+    assert proc.returncode == 0
+
+
+def test_pagerank_mode_exit_0():
+    proc = run_cli(["-p"], _json(majority_fbas(3)))
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("PageRank:")
+    assert len(proc.stdout.strip().splitlines()) == 4  # header + 3 nodes
+
+
+def test_pagerank_flags_accepted():
+    proc = run_cli(["-p", "-i", "10", "-m", "0.15", "-c", "0.001"], _json(majority_fbas(3)))
+    assert proc.returncode == 0
+
+
+def test_compat_mode():
+    proc = run_cli(["--compat", "--backend", "python"], _json(majority_fbas(3)))
+    assert proc.stdout.strip() == "true"
+
+
+def test_schema_error_reported_cleanly():
+    proc = run_cli(["--backend", "python"], '[{"name": "no-key"}]')
+    assert proc.returncode == 1
+    assert "invalid FBAS configuration" in proc.stderr
+
+
+def test_timing_flag():
+    proc = run_cli(["--timing", "--backend", "python"], _json(majority_fbas(3)))
+    assert proc.returncode == 0
+    assert "[timing]" in proc.stderr
+    assert "[stats]" in proc.stderr
+
+
+@pytest.mark.parametrize(
+    "name,expected_out,expected_code",
+    [
+        ("correct_trivial.json", "true", 0),
+        ("broken_trivial.json", "false", 1),
+        ("correct.json", "true", 0),
+        ("broken.json", "false", 1),
+    ],
+)
+def test_golden_fixture_cli_contract(ref_fixture, name, expected_out, expected_code):
+    with open(ref_fixture(name)) as f:
+        data = f.read()
+    proc = run_cli(["--backend", "python"], data)
+    assert proc.stdout.strip() == expected_out
+    assert proc.returncode == expected_code

@@ -1,0 +1,160 @@
+"""End-to-end pipeline tests: golden fixture verdicts, witness pairs, guard
+paths, policy/selection knobs, synthetic pass/fail pairs."""
+
+import io
+
+import pytest
+
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas, trivial_pair
+from quorum_intersection_tpu.pipeline import solve
+
+BACKEND = "python"
+
+
+def _solve(source, **kw):
+    kw.setdefault("backend", BACKEND)
+    return solve(source, **kw)
+
+
+class TestGoldenFixtures:
+    """Verdict parity with the reference on its own fixtures (SURVEY.md §4.1),
+    under both dangling policies and both SCC-selection rules."""
+
+    @pytest.mark.parametrize("dangling", ["strict", "alias0"])
+    @pytest.mark.parametrize("scc_select", ["quorum-bearing", "front"])
+    @pytest.mark.parametrize(
+        "name,expected",
+        [
+            ("correct_trivial.json", True),
+            ("broken_trivial.json", False),
+            ("correct.json", True),
+            ("broken.json", False),
+        ],
+    )
+    def test_verdicts(self, ref_fixture, name, expected, dangling, scc_select):
+        with open(ref_fixture(name)) as f:
+            res = _solve(f.read(), dangling=dangling, scc_select=scc_select)
+        assert res.intersects is expected
+
+    def test_broken_witness_pair(self, ref_fixture):
+        with open(ref_fixture("broken.json")) as f:
+            res = _solve(f.read())
+        assert not res.intersects
+        # Known disjoint pair: {Eno, SDF1} vs {SDF2, SDF3} (BASELINE.md).
+        assert res.q1 and res.q2
+        assert set(res.q1) & set(res.q2) == set()
+
+    def test_correct_structure(self, ref_fixture):
+        with open(ref_fixture("correct.json")) as f:
+            res = _solve(f.read())
+        assert res.intersects
+        assert res.n_sccs == 49
+        assert len(res.quorum_scc_ids) == 1
+        assert len(res.main_scc) == 4  # the SDF+Eno sink
+        assert res.stats["bnb_calls"] == 11  # SURVEY.md §6 [verified]
+
+    def test_trivial_bnb_calls(self, ref_fixture):
+        with open(ref_fixture("correct_trivial.json")) as f:
+            res = _solve(f.read())
+        assert res.stats["bnb_calls"] == 11  # SURVEY.md §6 [verified]
+
+
+class TestSyntheticPairs:
+    @pytest.mark.parametrize("n", [3, 5, 8, 11])
+    def test_majority_pair(self, n):
+        assert _solve(majority_fbas(n)).intersects is True
+        assert _solve(majority_fbas(n, broken=True)).intersects is False
+
+    def test_hierarchical_pair(self):
+        assert _solve(hierarchical_fbas(3, 3)).intersects is True
+        assert _solve(hierarchical_fbas(3, 3, broken=True)).intersects is False
+
+    def test_trivial_pair_generator(self):
+        pair = trivial_pair()
+        assert _solve(pair["correct"]).intersects is True
+        assert _solve(pair["broken"]).intersects is False
+
+    def test_witness_is_disjoint_quorum_pair(self):
+        from quorum_intersection_tpu.fbas.graph import build_graph
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+        from quorum_intersection_tpu.fbas.semantics import is_quorum
+
+        data = majority_fbas(7, broken=True)
+        res = _solve(data)
+        assert not res.intersects
+        g = build_graph(parse_fbas(data))
+        assert is_quorum(g, res.q1)
+        assert is_quorum(g, res.q2)
+        assert not (set(res.q1) & set(res.q2))
+
+
+class TestGuardPaths:
+    def test_no_quorum_anywhere_is_broken(self):
+        # Every node has an unsatisfiable slice → zero quorum-bearing SCCs.
+        data = [
+            {"publicKey": "A", "quorumSet": None},
+            {"publicKey": "B", "quorumSet": None},
+        ]
+        res = _solve(data)
+        assert not res.intersects
+        assert res.stats.get("reason") == "scc_guard"
+        assert res.quorum_scc_ids == []
+
+    def test_two_independent_quorums_is_broken(self):
+        # Two disconnected self-trusting islands → two quorum-bearing SCCs.
+        data = majority_fbas(3, prefix="LEFT") + majority_fbas(3, prefix="RIGHT")
+        res = _solve(data)
+        assert not res.intersects
+        assert res.stats.get("reason") == "scc_guard"
+        assert len(res.quorum_scc_ids) == 2
+
+    def test_non_sink_component_has_no_quorum_when_depending_down(self):
+        # A 3-majority core plus a tail node trusting the core: 2 SCCs, only
+        # the core bears a quorum; tail can never be in a minimal quorum.
+        data = majority_fbas(3) + [
+            {
+                "publicKey": "TAIL",
+                "quorumSet": {"threshold": 2, "validators": ["NODE0000", "NODE0001"]},
+            }
+        ]
+        res = _solve(data)
+        assert res.intersects
+        assert res.n_sccs == 2
+        assert len(res.quorum_scc_ids) == 1
+
+
+class TestKnobs:
+    def test_randomized_tiebreak_same_verdicts(self, ref_fixture):
+        # The reference's RNG tie-break is verdict-independent (SURVEY.md C7);
+        # so is ours, across seeds.
+        from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+
+        for seed in (0, 1, 7):
+            for name, expected in (("correct.json", True), ("broken.json", False)):
+                with open(ref_fixture(name)) as f:
+                    res = solve(f.read(), backend=PythonOracleBackend(seed=seed))
+                assert res.intersects is expected
+
+    def test_scope_to_scc_same_verdict_on_sink(self, ref_fixture):
+        # Q6: whole-graph availability is only sound because the searched SCC
+        # is a sink; scoping must not change the verdict there.
+        for name, expected in (("correct.json", True), ("broken.json", False)):
+            with open(ref_fixture(name)) as f:
+                res = _solve(f.read(), scope_to_scc=True)
+            assert res.intersects is expected
+
+    def test_verbose_narration(self):
+        buf = io.StringIO()
+        res = _solve(majority_fbas(3), verbose=True, out=buf)
+        text = buf.getvalue()
+        assert "total number of strongly connected components: 1" in text
+        assert "all quorums are intersecting" in text
+        assert res.intersects
+
+    def test_verbose_broken_narration(self):
+        buf = io.StringIO()
+        res = _solve(majority_fbas(5, broken=True), verbose=True, out=buf)
+        text = buf.getvalue()
+        assert "found two non-intersecting quorums" in text
+        assert "first quorum:" in text and "second quorum:" in text
+        assert not res.intersects
