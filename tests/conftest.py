@@ -1,20 +1,36 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
-anywhere — the TPU-native analog of a fake multi-chip backend (SURVEY.md §4.3):
-sharding/mesh tests run against 8 emulated devices without TPU hardware.
+Forces JAX onto a virtual 8-device CPU platform — the TPU-native analog of a
+fake multi-chip backend (SURVEY.md §4.3): sharding/mesh tests run against 8
+emulated devices without TPU hardware.
+
+Two mechanisms, because this image's axon sitecustomize imports jax at
+interpreter startup (so env vars alone can arrive too late):
+
+1. env vars, for clean environments where jax is not yet imported;
+2. ``jax.config.update("jax_platforms", "cpu")`` + XLA_FLAGS before the first
+   backend initialization, which still wins after an early ``import jax`` as
+   long as no devices were queried yet.
 """
 
 import os
 import pathlib
 
-# Must be set before the first `import jax` in any test module.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests default to the emulated 8-device CPU platform regardless of the
+# image's ambient JAX_PLATFORMS (this image exports =axon globally, which is
+# not a per-test choice).  Set QI_TEST_PLATFORM=tpu (or axon) to explicitly
+# run the suite against real hardware.
+_platform = os.environ.get("QI_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
 
 import pytest
 

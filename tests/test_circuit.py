@@ -1,0 +1,110 @@
+"""Threshold-circuit encoder tests: normalization, interning, multiplicity."""
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.encode.circuit import encode_circuit, node_sat_np
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+
+
+def _circuit(data):
+    g = build_graph(parse_fbas(data))
+    return g, encode_circuit(g)
+
+
+def test_roots_are_first_n_units():
+    g, c = _circuit(hierarchical_fbas(3, 3))
+    assert c.n == 9
+    assert c.n_units >= c.n
+
+
+def test_interning_shares_identical_inner_sets():
+    # 16 orgs × 16 validators: every node carries the same 16 org inner sets;
+    # interning keeps the circuit at n + 16 units instead of n + 16n.
+    g, c = _circuit(hierarchical_fbas(16, 16))
+    assert c.n == 256
+    assert c.n_units == 256 + 16
+    assert c.depth == 1
+
+
+def test_normalization_null_zero_negative_thresholds():
+    data = [
+        {"publicKey": "A", "quorumSet": None},
+        {"publicKey": "B", "quorumSet": {"threshold": 0, "validators": ["A", "B"]}},
+        {"publicKey": "C", "quorumSet": {"threshold": -3, "validators": ["A"]}},
+        {"publicKey": "D", "quorumSet": {"threshold": 1, "validators": ["D"]}},
+    ]
+    _, c = _circuit(data)
+    avail = np.ones((1, 4), dtype=bool)
+    sat = node_sat_np(c, avail)
+    # A (null), B (t=0), C (t<0) never satisfiable; D self-satisfied.
+    assert sat[0].tolist() == [False, False, False, True]
+
+
+def test_duplicate_validator_votes():
+    # B listed twice: two votes, so threshold 2 is met by B alone.
+    data = [
+        {"publicKey": "A", "quorumSet": {"threshold": 2, "validators": ["B", "B"]}},
+        {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}},
+    ]
+    _, c = _circuit(data)
+    avail = np.array([[True, True]])
+    assert node_sat_np(c, avail)[0].tolist() == [True, True]
+
+
+def test_duplicate_inner_set_votes_after_interning():
+    # The same inner set twice → interned to one unit with child count 2,
+    # so threshold 2 is met when the single shared inner set is satisfied.
+    inner = {"threshold": 1, "validators": ["B"], "innerQuorumSets": []}
+    data = [
+        {
+            "publicKey": "A",
+            "quorumSet": {"threshold": 2, "validators": [], "innerQuorumSets": [inner, dict(inner)]},
+        },
+        {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}},
+    ]
+    _, c = _circuit(data)
+    assert c.n_units == 3  # two roots + ONE interned inner unit
+    avail = np.array([[True, True]])
+    assert node_sat_np(c, avail)[0].tolist() == [True, True]
+
+
+def test_overflow_raises_not_wraps():
+    inner = {"threshold": 1, "validators": ["B"], "innerQuorumSets": []}
+    data = [
+        {
+            "publicKey": "A",
+            "quorumSet": {
+                "threshold": 1,
+                "validators": [],
+                "innerQuorumSets": [dict(inner) for _ in range(256)],
+            },
+        },
+        {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}},
+    ]
+    g = build_graph(parse_fbas(data))
+    with pytest.raises(ValueError, match="repeated"):
+        encode_circuit(g)
+    dup_validators = [
+        {"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["B"] * 256}},
+        {"publicKey": "B", "quorumSet": None},
+    ]
+    g = build_graph(parse_fbas(dup_validators))
+    with pytest.raises(ValueError, match="255"):
+        encode_circuit(g)
+
+
+def test_csr_views_roundtrip_dense():
+    g, c = _circuit(hierarchical_fbas(4, 3))
+    dense_members = np.zeros_like(c.members, dtype=np.int32)
+    for u in range(c.n_units):
+        lo, hi = c.mem_indptr[u], c.mem_indptr[u + 1]
+        dense_members[u, c.mem_indices[lo:hi]] = c.mem_counts[lo:hi]
+    np.testing.assert_array_equal(dense_members, c.members.astype(np.int32))
+    dense_child = np.zeros_like(c.child, dtype=np.int32)
+    for u in range(c.n_units):
+        lo, hi = c.child_indptr[u], c.child_indptr[u + 1]
+        dense_child[u, c.child_indices[lo:hi]] = c.child_counts[lo:hi]
+    np.testing.assert_array_equal(dense_child, c.child.astype(np.int32))

@@ -1,0 +1,83 @@
+"""Multi-device sharding tests on the emulated 8-device CPU mesh —
+the fake-backend analog for TPU pods (SURVEY.md §4.3 item 5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import is_quorum
+from quorum_intersection_tpu.fbas.synth import majority_fbas, random_fbas
+from quorum_intersection_tpu.parallel.mesh import candidate_mesh
+from quorum_intersection_tpu.pipeline import solve
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (emulated) devices"
+)
+
+
+def test_candidate_mesh_uses_all_devices():
+    mesh = candidate_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("candidates",)
+
+
+def test_candidate_mesh_prefix():
+    mesh = candidate_mesh(2)
+    assert mesh.devices.size == 2
+    with pytest.raises(ValueError):
+        candidate_mesh(10_000)
+
+
+@needs_8_devices
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_sharded_sweep_verdict_parity(n_dev):
+    mesh = candidate_mesh(n_dev)
+    for data, expected in (
+        (majority_fbas(11), True),
+        (majority_fbas(11, broken=True), False),
+    ):
+        res = solve(data, backend=TpuSweepBackend(batch=64 * n_dev, mesh=mesh))
+        assert res.intersects is expected
+
+
+@needs_8_devices
+def test_sharded_witness_is_valid_quorum_pair():
+    mesh = candidate_mesh(8)
+    data = majority_fbas(12, broken=True)
+    res = solve(data, backend=TpuSweepBackend(batch=256, mesh=mesh))
+    assert not res.intersects
+    g = build_graph(parse_fbas(data))
+    assert is_quorum(g, res.q1) and is_quorum(g, res.q2)
+    assert not (set(res.q1) & set(res.q2))
+
+
+@needs_8_devices
+def test_sharded_matches_unsharded_on_random_fbas():
+    mesh = candidate_mesh(8)
+    for seed in (0, 3, 9):
+        data = random_fbas(13, seed=seed, nested_prob=0.3, null_prob=0.1)
+        single = solve(data, backend=TpuSweepBackend(batch=256))
+        sharded = solve(data, backend=TpuSweepBackend(batch=256, mesh=mesh))
+        assert single.intersects is sharded.intersects
+
+
+@needs_8_devices
+def test_graft_dryrun_multichip():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+
+    fn, args = mod.entry()
+    hit, q_size = jax.jit(fn)(*args)
+    assert hit.shape == (256,)
+    assert q_size.shape == (256,)
+    assert not bool(np.asarray(hit).any())  # flagship problem is a safe network

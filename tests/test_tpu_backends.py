@@ -1,0 +1,151 @@
+"""TPU backend verdict tests: golden fixtures, differential vs the Python
+oracle on synthetic networks, witnesses, checkpointing, size limits."""
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+from quorum_intersection_tpu.backends.tpu.sweep import SccTooLargeError, TpuSweepBackend
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import is_quorum
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas, random_fbas
+from quorum_intersection_tpu.pipeline import solve
+
+
+@pytest.fixture(params=["tpu-sweep", "tpu-hybrid"])
+def tpu_backend(request):
+    if request.param == "tpu-sweep":
+        return TpuSweepBackend(batch=512)
+    return TpuHybridBackend(batch=128)
+
+
+class TestGoldenFixtures:
+    @pytest.mark.parametrize(
+        "name,expected",
+        [
+            ("correct_trivial.json", True),
+            ("broken_trivial.json", False),
+            ("correct.json", True),
+            ("broken.json", False),
+        ],
+    )
+    def test_verdicts(self, ref_fixture, tpu_backend, name, expected):
+        with open(ref_fixture(name)) as f:
+            res = solve(f.read(), backend=tpu_backend)
+        assert res.intersects is expected
+
+    def test_broken_witness_is_valid(self, ref_fixture, tpu_backend):
+        with open(ref_fixture("broken.json")) as f:
+            data = f.read()
+        res = solve(data, backend=tpu_backend)
+        assert not res.intersects
+        g = build_graph(parse_fbas(data))
+        assert res.q1 and res.q2
+        assert not (set(res.q1) & set(res.q2))
+        assert is_quorum(g, res.q1)
+        assert is_quorum(g, res.q2)
+
+
+class TestDifferentialVsOracle:
+    """CPU-vs-TPU differential on synthetic random FBAS — the test strategy
+    the reference never had (SURVEY.md §4.3 item 2)."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_fbas_verdict_parity(self, seed, tpu_backend):
+        data = random_fbas(
+            14, seed=seed, nested_prob=0.3, null_prob=0.1, dangling_prob=0.1
+        )
+        want = solve(data, backend="python")
+        got = solve(data, backend=tpu_backend)
+        assert got.intersects is want.intersects, f"seed={seed}"
+
+    @pytest.mark.parametrize("n", [4, 7, 10, 13])
+    def test_majority_pairs(self, n, tpu_backend):
+        assert solve(majority_fbas(n), backend=tpu_backend).intersects is True
+        assert solve(majority_fbas(n, broken=True), backend=tpu_backend).intersects is False
+
+    def test_hierarchical_pairs(self, tpu_backend):
+        assert solve(hierarchical_fbas(3, 3), backend=tpu_backend).intersects is True
+        assert (
+            solve(hierarchical_fbas(3, 3, broken=True), backend=tpu_backend).intersects
+            is False
+        )
+
+    @pytest.mark.parametrize("scope", [False, True])
+    def test_scoping_parity(self, scope, tpu_backend):
+        for seed in (2, 5):
+            data = random_fbas(12, seed=seed, null_prob=0.2)
+            want = solve(data, backend="python", scope_to_scc=scope)
+            got = solve(data, backend=tpu_backend, scope_to_scc=scope)
+            assert got.intersects is want.intersects
+
+
+class TestSweepSpecifics:
+    def test_scc_too_large_raises(self):
+        backend = TpuSweepBackend(max_bits=4)
+        data = majority_fbas(8)
+        with pytest.raises(SccTooLargeError):
+            solve(data, backend=backend)
+
+    def test_auto_falls_back_beyond_sweep_limit(self):
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+
+        backend = AutoBackend(sweep_limit=4)
+        res = solve(majority_fbas(9), backend=backend)
+        assert res.intersects is True
+        assert res.stats["backend"] in ("python", "cpp")
+
+    def test_checkpoint_resume(self, tmp_path):
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        ckpt = SweepCheckpoint(tmp_path / "sweep.json")
+        # Small batches force multiple steps on a safe network so the
+        # checkpoint records progress (broken ones exit on the first hit).
+        backend = TpuSweepBackend(batch=16, checkpoint=ckpt)
+        data = majority_fbas(9)
+        res = solve(data, backend=backend)
+        assert res.intersects
+        # finished runs clear their checkpoint
+        assert ckpt.resume_position(1 << 8) == 0
+
+        # simulate a preempted run: record a midpoint, resume skips it
+        total = 1 << 8
+        ckpt.record(128, total)
+        backend2 = TpuSweepBackend(batch=16, checkpoint=ckpt)
+        res2 = solve(data, backend=backend2)
+        assert res2.intersects
+        assert res2.stats["candidates_checked"] <= total - 128 + 16
+
+    def test_checkpoint_total_mismatch_ignored(self, tmp_path):
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        ckpt = SweepCheckpoint(tmp_path / "sweep.json")
+        ckpt.record(100, 999)
+        assert ckpt.resume_position(256) == 0
+
+    def test_single_node_scc(self):
+        data = [{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}}]
+        res = solve(data, backend=TpuSweepBackend())
+        assert res.intersects is True
+
+    def test_throughput_stats_present(self):
+        res = solve(majority_fbas(8), backend=TpuSweepBackend(batch=64))
+        for key in ("candidates_checked", "device_steps", "candidates_per_sec", "seconds"):
+            assert key in res.stats
+
+
+class TestHybridSpecifics:
+    def test_stats_present(self):
+        res = solve(majority_fbas(8), backend=TpuHybridBackend(batch=32))
+        for key in ("device_batches", "fixpoints", "bnb_states", "seconds"):
+            assert key in res.stats
+
+    def test_minimal_quorum_count_matches_oracle_on_safe_network(self):
+        # On safe networks both enumerate the complete set of minimal quorums
+        # of size ≤ half (no early exit), so counts must agree exactly.
+        data = majority_fbas(9)
+        want = solve(data, backend="python")
+        got = solve(data, backend=TpuHybridBackend(batch=64))
+        assert got.intersects and want.intersects
+        assert got.stats["minimal_quorums"] == want.stats["minimal_quorums"]

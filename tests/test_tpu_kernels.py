@@ -1,0 +1,128 @@
+"""Differential tests: JAX circuit kernels vs the NumPy specification and the
+host set semantics (SURVEY.md §4.3 items 2/4)."""
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.kernels import (
+    CircuitArrays,
+    make_batch_fixpoint,
+    subset_masks,
+)
+from quorum_intersection_tpu.encode.circuit import encode_circuit, max_quorum_np, node_sat_np
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import max_quorum, slice_satisfied
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas, random_fbas
+
+
+def _circuit(data):
+    g = build_graph(parse_fbas(data))
+    return g, encode_circuit(g)
+
+
+def _random_avail(rng, batch, n):
+    return (rng.random((batch, n)) < 0.6).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        majority_fbas(6),
+        hierarchical_fbas(3, 3),
+        random_fbas(17, seed=3, nested_prob=0.5, null_prob=0.15, dangling_prob=0.2),
+    ],
+    ids=["majority", "hierarchical", "random-nested"],
+)
+def test_node_sat_matches_host_semantics(data):
+    g, circuit = _circuit(data)
+    rng = np.random.default_rng(0)
+    avail = _random_avail(rng, 32, g.n)
+    import jax.numpy as jnp
+
+    from quorum_intersection_tpu.backends.tpu.kernels import node_sat
+
+    arrays = CircuitArrays(circuit)
+    got = np.asarray(node_sat(arrays, jnp.asarray(avail))) > 0.5
+    want_np = node_sat_np(circuit, avail.astype(bool))
+    np.testing.assert_array_equal(got, want_np)
+    # and the NumPy spec itself against the per-node host semantics
+    for b in range(avail.shape[0]):
+        av = avail[b].astype(bool).tolist()
+        for v in range(g.n):
+            assert want_np[b, v] == (av[v] and slice_satisfied(v, g.qsets[v], av))
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        majority_fbas(8),
+        hierarchical_fbas(3, 3),
+        random_fbas(20, seed=7, nested_prob=0.4, null_prob=0.1),
+    ],
+    ids=["majority", "hierarchical", "random-nested"],
+)
+def test_fixpoint_matches_host_semantics(data):
+    g, circuit = _circuit(data)
+    rng = np.random.default_rng(1)
+    avail = _random_avail(rng, 24, g.n)
+    run = make_batch_fixpoint(circuit)
+    got = run(avail)
+    want = max_quorum_np(circuit, avail.astype(bool))
+    np.testing.assert_array_equal(got, want)
+    for b in range(avail.shape[0]):
+        av = avail[b].astype(bool).tolist()
+        candidates = [v for v in range(g.n) if av[v]]
+        host = sorted(max_quorum(g, candidates, list(av)))
+        assert sorted(np.nonzero(got[b])[0].tolist()) == host
+
+
+def test_fixpoint_frozen_mask_q6_semantics():
+    # Node T (outside the "SCC") helps node A satisfy its slice but must never
+    # be filtered: frozen reproduces the reference's whole-graph availability.
+    data = [
+        {"publicKey": "A", "quorumSet": {"threshold": 2, "validators": ["A", "T"]}},
+        {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}},
+        {"publicKey": "T", "quorumSet": None},  # null qset: own slice unsatisfiable
+    ]
+    g, circuit = _circuit(data)
+    run = make_batch_fixpoint(circuit)
+    # candidates {A}: without frozen help, A's slice (needs T) fails.
+    avail = np.zeros((1, 3), dtype=np.float32)
+    avail[0, 0] = 1.0
+    assert run(avail).sum() == 0
+    # with T frozen-available, A survives even though T's own slice never can.
+    frozen = np.array([0.0, 0.0, 1.0], dtype=np.float32)
+    got = run(avail, np.broadcast_to(frozen, (1, 3)).copy())
+    assert np.nonzero(got[0])[0].tolist() == [0]
+
+
+def test_fixpoint_empty_and_full():
+    g, circuit = _circuit(majority_fbas(5))
+    run = make_batch_fixpoint(circuit)
+    batch = np.stack(
+        [np.zeros(5, np.float32), np.ones(5, np.float32)]
+    )
+    got = run(batch)
+    assert got[0].sum() == 0
+    assert got[1].sum() == 5
+
+
+def test_subset_masks_decoding():
+    import jax.numpy as jnp
+
+    bit_nodes = jnp.asarray([4, 1, 6], dtype=jnp.int32)
+    masks = np.asarray(subset_masks(jnp.int32(0), 8, bit_nodes, 8))
+    # index 5 = 0b101 → bits 0 and 2 → nodes 4 and 6
+    assert np.nonzero(masks[5])[0].tolist() == [4, 6]
+    assert np.nonzero(masks[0])[0].tolist() == []
+    assert np.nonzero(masks[7])[0].tolist() == [1, 4, 6]
+
+
+def test_subset_masks_offset():
+    import jax.numpy as jnp
+
+    bit_nodes = jnp.asarray([0, 1], dtype=jnp.int32)
+    masks = np.asarray(subset_masks(jnp.int32(2), 2, bit_nodes, 4))
+    assert np.nonzero(masks[0])[0].tolist() == [1]  # index 2 = 0b10
+    assert np.nonzero(masks[1])[0].tolist() == [0, 1]  # index 3
