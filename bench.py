@@ -63,7 +63,6 @@ def parity_gate() -> bool:
                     }
                 )
             )
-        if got is not want:
             return False
     return True
 
@@ -130,7 +129,8 @@ def cpu_baseline(graph, samples: int) -> tuple:
         avail = row.tolist()
         candidates = [v for v in range(n) if avail[v]]
         q = max_quorum(graph, candidates, avail)
-        comp_avail = [not (row[v] and v in set(q)) for v in range(n)]
+        qset = set(q)
+        comp_avail = [not (row[v] and v in qset) for v in range(n)]
         comp = [v for v in range(n) if comp_avail[v]]
         max_quorum(graph, comp, comp_avail)
     seconds = time.perf_counter() - t0

@@ -2,6 +2,6 @@
 
 import sys
 
-from quorum_intersection_tpu.cli import main
+from quorum_intersection_tpu.cli import run
 
-sys.exit(main())
+sys.exit(run())

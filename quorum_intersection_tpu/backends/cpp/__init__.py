@@ -1,0 +1,258 @@
+"""Native C++ CPU oracle backend — bindings, build, and marshalling.
+
+The exponential search core lives in ``qi_oracle.cpp`` (same directory), a
+fresh C++17 implementation of the reference's solver semantics
+(`/root/reference/quorum_intersection.cpp:90-400`; see the pinned spec in
+SURVEY.md §2.1 C4-C9).  It is compiled on demand with ``g++`` into a shared
+library cached under ``_build/`` (keyed by a source hash, so edits trigger a
+rebuild) and loaded through :mod:`ctypes` — no pybind11 dependency.
+
+Marshalling: the :class:`~quorum_intersection_tpu.fbas.graph.TrustGraph` is
+flattened once per call into plain int32 arrays — CSR successor lists plus a
+"unit pool" for the recursive quorum-set trees (one unit = threshold, a span
+of direct members, a span of inner units).  ``threshold is None`` (null qset,
+quirk Q2) is encoded as root index -1.
+
+The backend is verdict- AND statistics-identical to the pure-Python oracle
+(:mod:`quorum_intersection_tpu.backends.python_oracle`) in deterministic
+mode; ``tests/test_cpp_backend.py`` pins both.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.cpp")
+
+_SRC = Path(__file__).with_name("qi_oracle.cpp")
+_BUILD_DIR = Path(__file__).with_name("_build")
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _so_path() -> Path:
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    return _BUILD_DIR / f"qi_oracle-{digest}.so"
+
+
+def build_library(force: bool = False) -> Path:
+    """Compile ``qi_oracle.cpp`` → a content-hashed ``.so`` (idempotent)."""
+    so = _so_path()
+    if so.exists() and not force:
+        return so
+    _BUILD_DIR.mkdir(exist_ok=True)
+    tmp = so.with_suffix(f".so.tmp{os.getpid()}")
+    cmd = [
+        "g++",
+        "-std=c++17",
+        "-O3",
+        "-fPIC",
+        "-shared",
+        "-o",
+        str(tmp),
+        str(_SRC),
+    ]
+    log.info("building native oracle: %s", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native oracle build failed (exit {proc.returncode}):\n{proc.stderr}"
+        )
+    tmp.replace(so)  # atomic rename; concurrent builders use distinct tmp names
+    return so
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(str(build_library()))
+    lib.qi_check_scc.restype = ctypes.c_int32
+    lib.qi_check_scc.argtypes = [
+        ctypes.c_int32,  # n
+        _i32p, _i32p,  # succ_off, succ_tgt
+        _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
+        _i32p, ctypes.c_int32,  # scc, scc_len
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,  # scope, use_rng, seed
+        _i32p, _i32p, _i32p, _i32p,  # q1_out, q1_len, q2_out, q2_len
+        _i64p,  # stats_out[3]
+    ]
+    lib.qi_candidate_check.restype = ctypes.c_int64
+    lib.qi_candidate_check.argtypes = [
+        ctypes.c_int32,  # n
+        _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
+        _u8p, ctypes.c_int32,  # masks, batch
+    ]
+    _lib = lib
+    return lib
+
+
+class FlatGraph:
+    """Int32 flattening of a :class:`TrustGraph` for the C ABI.
+
+    Unit layout (5 ints per unit): ``threshold, member_begin, member_end,
+    inner_begin, inner_end`` — spans into the ``mem`` (node-index) and
+    ``inner`` (unit-index) pools.  A null qset flattens to root ``-1``; a
+    ``None`` threshold on an *inner* set flattens to threshold 0, which the
+    solver treats as never satisfiable — both match
+    :func:`~quorum_intersection_tpu.fbas.semantics.slice_satisfied`.
+    """
+
+    def __init__(self, graph: TrustGraph) -> None:
+        units: List[Tuple[int, int, int, int, int]] = []
+        mem: List[int] = []
+        inner: List[int] = []
+
+        def add_unit(q: IndexedQSet) -> int:
+            uid = len(units)
+            units.append((0, 0, 0, 0, 0))  # placeholder; children first
+            mb = len(mem)
+            mem.extend(q.members)
+            me = len(mem)
+            child_ids = [add_unit(iq) for iq in q.inner]
+            ib = len(inner)
+            inner.extend(child_ids)
+            ie = len(inner)
+            t = 0 if q.threshold is None else q.threshold
+            units[uid] = (t, mb, me, ib, ie)
+            return uid
+
+        roots: List[int] = []
+        for q in graph.qsets:
+            roots.append(-1 if q.threshold is None else add_unit(q))
+
+        succ_off = np.zeros(graph.n + 1, dtype=np.int32)
+        for v, targets in enumerate(graph.succ):
+            succ_off[v + 1] = succ_off[v] + len(targets)
+        succ_tgt = np.fromiter(
+            (w for targets in graph.succ for w in targets),
+            dtype=np.int32,
+            count=int(succ_off[-1]),
+        )
+
+        self.n = graph.n
+        self.succ_off = np.ascontiguousarray(succ_off)
+        self.succ_tgt = np.ascontiguousarray(succ_tgt)
+        self.roots = np.asarray(roots, dtype=np.int32)
+        self.units = np.asarray(
+            [x for unit in units for x in unit] or [0], dtype=np.int32
+        )
+        self.mem = np.asarray(mem or [0], dtype=np.int32)
+        self.inner = np.asarray(inner or [0], dtype=np.int32)
+
+    def _ptr(self, arr: np.ndarray):
+        return arr.ctypes.data_as(_i32p)
+
+
+class CppOracleBackend:
+    """Branch-and-bound disjointness search in native code (C++17 via ctypes)."""
+
+    name = "cpp"
+    needs_circuit = False  # searches on host set semantics, like the Python oracle
+
+    def __init__(self, seed: Optional[int] = None, randomized: bool = False) -> None:
+        self._use_rng = bool(randomized or seed is not None)
+        # randomized without an explicit seed means *actual* nondeterminism
+        # (matching the python backend's random.Random(None) and the
+        # reference's random_device-seeded engine, cpp:207).
+        self._seed = (
+            int.from_bytes(os.urandom(8), "little") if seed is None else int(seed)
+        )
+
+    def ensure_built(self) -> None:
+        _load()
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        lib = _load()
+        flat = FlatGraph(graph)
+        scc_arr = np.asarray(scc, dtype=np.int32)
+        q1 = np.zeros(graph.n, dtype=np.int32)
+        q2 = np.zeros(graph.n, dtype=np.int32)
+        q1_len = ctypes.c_int32(0)
+        q2_len = ctypes.c_int32(0)
+        stats = np.zeros(3, dtype=np.int64)
+
+        t0 = time.perf_counter()
+        intersects = lib.qi_check_scc(
+            flat.n,
+            flat._ptr(flat.succ_off),
+            flat._ptr(flat.succ_tgt),
+            flat._ptr(flat.roots),
+            flat._ptr(flat.units),
+            flat._ptr(flat.mem),
+            flat._ptr(flat.inner),
+            scc_arr.ctypes.data_as(_i32p),
+            len(scc),
+            int(scope_to_scc),
+            int(self._use_rng),
+            self._seed,
+            q1.ctypes.data_as(_i32p),
+            ctypes.byref(q1_len),
+            q2.ctypes.data_as(_i32p),
+            ctypes.byref(q2_len),
+            stats.ctypes.data_as(_i64p),
+        )
+        seconds = time.perf_counter() - t0
+
+        return SccCheckResult(
+            intersects=bool(intersects),
+            q1=q1[: q1_len.value].tolist() if not intersects else None,
+            q2=q2[: q2_len.value].tolist() if not intersects else None,
+            stats={
+                "backend": self.name,
+                "bnb_calls": int(stats[0]),
+                "minimal_quorums": int(stats[1]),
+                "fixpoint_calls": int(stats[2]),
+                "seconds": seconds,
+            },
+        )
+
+
+def native_candidate_check(graph: TrustGraph, masks: np.ndarray) -> Tuple[int, float]:
+    """Run the per-candidate check (fixpoint + complement probe) over a batch
+    of availability masks in native code.  Returns ``(hits, seconds)``."""
+    lib = _load()
+    flat = FlatGraph(graph)
+    m = np.ascontiguousarray(masks.astype(np.uint8))
+    batch = m.shape[0]
+    t0 = time.perf_counter()
+    hits = lib.qi_candidate_check(
+        flat.n,
+        flat._ptr(flat.roots),
+        flat._ptr(flat.units),
+        flat._ptr(flat.mem),
+        flat._ptr(flat.inner),
+        m.ctypes.data_as(_u8p),
+        batch,
+    )
+    return int(hits), time.perf_counter() - t0
+
+
+def native_candidate_rate(graph: TrustGraph, masks: np.ndarray) -> float:
+    """Single-core candidates/sec baseline for ``bench.py``."""
+    _, seconds = native_candidate_check(graph, masks)
+    return masks.shape[0] / seconds if seconds > 0 else float("inf")

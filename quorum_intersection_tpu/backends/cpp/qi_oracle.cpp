@@ -1,0 +1,327 @@
+// Native CPU oracle for the quorum-intersection framework.
+//
+// Re-implements the exponential core of the reference solver
+// (/root/reference/quorum_intersection.cpp:90-400) as a standalone C++17
+// shared library with a C ABI, written fresh against the pinned semantics in
+// SURVEY.md §2.1/§2.3 and kept in exact lockstep (verdicts AND search
+// statistics) with the pure-Python oracle in backends/python_oracle.py:
+//
+//   - slice_unit / slice_satisfied  ~ containsQuorumSlice (cpp:90-138),
+//     with quirks Q2 (null qset never satisfiable), Q3 (threshold <= 0 or
+//     threshold > members normalized to never-satisfiable) and Q4
+//     (self-availability required) pinned as in fbas/semantics.py.
+//   - max_quorum                    ~ containsQuorum greatest fixpoint
+//     (cpp:140-177), including the availability restore on exit.
+//   - is_minimal_quorum             ~ isMinimalQuorum (cpp:179-201).
+//   - find_best_node                ~ findBestNode (cpp:203-250); default
+//     tie-break is deterministic lowest-index over the argmax set, optional
+//     seeded RNG mode is uniform over the same set (verdict-independent,
+//     SURVEY.md C7 [verified]).
+//   - Search::iterate               ~ iterateMinimalQuorums (cpp:252-346)
+//     with all four prunes in the reference order.
+//   - qi_check_scc                  ~ checkMinimalQuorums (cpp:348-400):
+//     per minimal quorum Q, probe the SCC for a quorum disjoint from Q; the
+//     half-size prune (two disjoint quorums cannot both exceed |scc|/2,
+//     cpp:386-391) is the current_visitor.
+//
+// Data comes in pre-flattened from Python (see backends/cpp/__init__.py):
+// the trust graph as CSR successor lists and every quorum-set tree as a pool
+// of "units" (threshold, member span, inner-unit span).
+
+#include <algorithm>
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Graph {
+  int32_t n;
+  const int32_t* succ_off;  // CSR offsets, length n+1
+  const int32_t* succ_tgt;  // CSR targets (with multiplicity, quirk Q7)
+  const int32_t* roots;     // per-node root unit index; -1 == null qset (Q2)
+  const int32_t* units;     // 5 ints per unit: threshold, mb, me, ib, ie
+  const int32_t* mem;       // member pool (node indices)
+  const int32_t* inner;     // inner pool (unit indices)
+};
+
+// Threshold test for one (sub-)unit against the availability vector, with the
+// reference's dual early-exit counters (fail = members - threshold + 1).
+bool slice_unit(const Graph& g, int32_t u, const uint8_t* avail) {
+  const int32_t* U = g.units + 5 * u;
+  int64_t t = U[0];
+  const int32_t mb = U[1], me = U[2], ib = U[3], ie = U[4];
+  if (t <= 0) return false;  // Q3: degenerate threshold, never satisfiable
+  int64_t fail = (me - mb) + (ie - ib) - t + 1;
+  if (fail <= 0) return false;  // Q3: threshold > members
+  for (int32_t i = mb; i < me; ++i) {
+    if (avail[g.mem[i]]) {
+      if (--t == 0) return true;
+    } else if (--fail == 0) {
+      return false;
+    }
+  }
+  for (int32_t i = ib; i < ie; ++i) {
+    if (slice_unit(g, g.inner[i], avail)) {
+      if (--t == 0) return true;
+    } else if (--fail == 0) {
+      return false;
+    }
+  }
+  return false;
+}
+
+bool slice_satisfied(const Graph& g, int32_t owner, const uint8_t* avail) {
+  const int32_t root = g.roots[owner];
+  if (root < 0) return false;       // Q2: null quorumSet
+  if (!avail[owner]) return false;  // Q4: self must be available
+  return slice_unit(g, root, avail);
+}
+
+// Greatest fixpoint of f(X) = {x in X : slice(x) satisfied by X}.  `avail` is
+// narrowed during iteration and restored before returning, so callers can
+// reuse their availability vector (cpp:171-173).
+std::vector<int32_t> max_quorum(const Graph& g, std::vector<int32_t> nodes,
+                                uint8_t* avail) {
+  std::vector<int32_t> removed;
+  for (;;) {
+    const size_t before = nodes.size();
+    std::vector<int32_t> kept;
+    kept.reserve(before);
+    for (const int32_t v : nodes) {
+      if (slice_satisfied(g, v, avail)) {
+        kept.push_back(v);
+      } else if (avail[v]) {
+        avail[v] = 0;
+        removed.push_back(v);
+      }
+    }
+    nodes.swap(kept);
+    if (nodes.size() == before) break;
+  }
+  for (const int32_t v : removed) avail[v] = 1;
+  return nodes;
+}
+
+bool is_minimal_quorum(const Graph& g, const std::vector<int32_t>& nodes) {
+  std::vector<uint8_t> avail(g.n, 0);
+  for (const int32_t v : nodes) avail[v] = 1;
+  if (max_quorum(g, nodes, avail.data()).empty()) return false;
+  for (const int32_t v : nodes) {
+    avail[v] = 0;
+    if (!max_quorum(g, nodes, avail.data()).empty()) return false;
+    avail[v] = 1;
+  }
+  return true;
+}
+
+// Branch variable: a max-in-degree node within `quorum` minus `restriction`;
+// in-degree counts parallel edges and self-loops with multiplicity (Q7).
+// Deterministic mode picks the lowest index among the argmax set; RNG mode
+// picks uniformly over the same set.
+int32_t find_best_node(const Graph& g, const std::vector<int32_t>& quorum,
+                       const std::vector<int32_t>& restriction,
+                       std::mt19937_64* rng) {
+  std::vector<uint8_t> eligible(g.n, 0);
+  for (const int32_t v : quorum) eligible[v] = 1;
+  for (const int32_t v : restriction) eligible[v] = 0;
+  std::vector<int32_t> indeg(g.n, 0);
+  bool any_edge = false;
+  for (const int32_t v : quorum) {
+    for (int32_t e = g.succ_off[v]; e < g.succ_off[v + 1]; ++e) {
+      const int32_t w = g.succ_tgt[e];
+      if (eligible[w]) {
+        ++indeg[w];
+        any_edge = true;
+      }
+    }
+  }
+  if (!any_edge) return quorum[0];  // bestNode init fallback (cpp:221)
+  int32_t max_deg = 0;
+  for (const int32_t v : quorum) max_deg = std::max(max_deg, indeg[v]);
+  std::vector<int32_t> candidates;
+  for (const int32_t v : quorum) {
+    if (eligible[v] && indeg[v] == max_deg) candidates.push_back(v);
+  }
+  std::sort(candidates.begin(), candidates.end());
+  candidates.erase(std::unique(candidates.begin(), candidates.end()),
+                   candidates.end());
+  if (rng != nullptr) {
+    std::uniform_int_distribution<size_t> pick(0, candidates.size() - 1);
+    return candidates[pick(*rng)];
+  }
+  return candidates.front();
+}
+
+struct Search {
+  const Graph& g;
+  uint8_t* avail;  // disjointness availability, shared across visitor calls
+  std::vector<int32_t> scc;
+  int32_t half;
+  std::mt19937_64* rng;
+  int64_t bnb_calls = 0;
+  int64_t minimal_quorums = 0;
+  int64_t fixpoint_calls = 0;
+  bool found = false;
+  std::vector<int32_t> q1, q2;
+
+  // checkMinimalQuorums' visitor (cpp:357-384): mark Q unavailable, probe the
+  // SCC for a disjoint quorum; restore on miss.
+  bool visit(const std::vector<int32_t>& quorum) {
+    for (const int32_t v : quorum) avail[v] = 0;
+    ++fixpoint_calls;
+    std::vector<int32_t> disjoint = max_quorum(g, scc, avail);
+    if (!disjoint.empty()) {
+      found = true;
+      q1 = std::move(disjoint);
+      q2 = quorum;
+      return true;
+    }
+    for (const int32_t v : quorum) avail[v] = 1;
+    return false;
+  }
+
+  bool iterate(const std::vector<int32_t>& to_remove,
+               std::vector<int32_t>& dont_remove) {
+    ++bnb_calls;
+    // Size prune (cpp:261 via :386-391): two disjoint quorums cannot both
+    // exceed half the SCC.
+    if (static_cast<int32_t>(dont_remove.size()) > half) return false;
+    if (to_remove.empty() && dont_remove.empty()) return false;
+
+    std::vector<uint8_t> local(g.n, 0);
+    for (const int32_t v : dont_remove) local[v] = 1;
+
+    ++fixpoint_calls;
+    if (!max_quorum(g, dont_remove, local.data()).empty()) {
+      // dontRemove already contains a quorum: report iff it IS a minimal
+      // quorum; either way stop descending (cpp:281-291).
+      if (is_minimal_quorum(g, dont_remove)) {
+        ++minimal_quorums;
+        return visit(dont_remove);
+      }
+      return false;
+    }
+
+    for (const int32_t v : to_remove) local[v] = 1;
+    std::vector<int32_t> cand = dont_remove;
+    cand.insert(cand.end(), to_remove.begin(), to_remove.end());
+    ++fixpoint_calls;
+    std::vector<int32_t> quorum = max_quorum(g, cand, local.data());
+    if (quorum.empty()) return false;  // prune (cpp:303-306)
+
+    std::vector<uint8_t> in_quorum(g.n, 0);
+    for (const int32_t v : quorum) in_quorum[v] = 1;
+    for (const int32_t v : dont_remove) {
+      if (!in_quorum[v]) return false;  // prune (cpp:308-314)
+    }
+
+    const int32_t best = find_best_node(g, quorum, dont_remove, rng);
+
+    // remaining = quorum \ dontRemove; nothing left to branch on is a prune
+    // (cpp:325-328).  `quorum` has unique elements (it is a fixpoint of the
+    // unique candidate list), so no dedup is needed.
+    std::vector<uint8_t> in_dont(g.n, 0);
+    for (const int32_t v : dont_remove) in_dont[v] = 1;
+    std::vector<int32_t> remaining;
+    remaining.reserve(quorum.size());
+    for (const int32_t v : quorum) {
+      if (!in_dont[v]) remaining.push_back(v);
+    }
+    if (remaining.empty()) return false;
+
+    std::vector<int32_t> new_to_remove;
+    new_to_remove.reserve(remaining.size());
+    for (const int32_t v : remaining) {
+      if (v != best) new_to_remove.push_back(v);
+    }
+    std::sort(new_to_remove.begin(), new_to_remove.end());
+
+    // Branch: exclude best first (cpp:336), then include it (cpp:343-345).
+    if (iterate(new_to_remove, dont_remove)) return true;
+    dont_remove.push_back(best);
+    const bool hit = iterate(new_to_remove, dont_remove);
+    dont_remove.pop_back();
+    return hit;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Disjoint-quorum search within one SCC.  Returns 1 iff all quorums
+// intersect; on 0, q1/q2 (buffers of capacity n) receive the witness pair.
+// stats_out[0..2] = {bnb_calls, minimal_quorums, fixpoint_calls}.
+int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
+                     const int32_t* succ_tgt, const int32_t* roots,
+                     const int32_t* units, const int32_t* mem,
+                     const int32_t* inner, const int32_t* scc,
+                     int32_t scc_len, int32_t scope_to_scc, int32_t use_rng,
+                     uint64_t seed, int32_t* q1_out, int32_t* q1_len,
+                     int32_t* q2_out, int32_t* q2_len, int64_t* stats_out) {
+  Graph g{n, succ_off, succ_tgt, roots, units, mem, inner};
+  // Reference semantics (Q6, cpp:354): the whole graph starts available —
+  // sound for a sink SCC; scope_to_scc narrows availability to the SCC.
+  std::vector<uint8_t> avail(n, scope_to_scc ? 0 : 1);
+  std::vector<int32_t> scc_vec(scc, scc + scc_len);
+  if (scope_to_scc) {
+    for (const int32_t v : scc_vec) avail[v] = 1;
+  }
+
+  std::mt19937_64 rng_engine(seed);
+  Search search{g, avail.data(), scc_vec, scc_len / 2,
+                use_rng ? &rng_engine : nullptr};
+  std::vector<int32_t> dont;
+  search.iterate(scc_vec, dont);
+
+  stats_out[0] = search.bnb_calls;
+  stats_out[1] = search.minimal_quorums;
+  stats_out[2] = search.fixpoint_calls;
+  if (search.found) {
+    *q1_len = static_cast<int32_t>(search.q1.size());
+    std::copy(search.q1.begin(), search.q1.end(), q1_out);
+    *q2_len = static_cast<int32_t>(search.q2.size());
+    std::copy(search.q2.begin(), search.q2.end(), q2_out);
+    return 0;
+  }
+  *q1_len = 0;
+  *q2_len = 0;
+  return 1;
+}
+
+// Benchmark unit of work: for each availability mask (row of `masks`,
+// batch x n, row-major uint8), run the is-quorum greatest fixpoint and the
+// complement disjointness probe — the same per-candidate check the TPU sweep
+// performs.  Returns the number of rows where both probes found a quorum
+// (consumed so the work cannot be optimized away).
+int64_t qi_candidate_check(int32_t n, const int32_t* roots,
+                           const int32_t* units, const int32_t* mem,
+                           const int32_t* inner, const uint8_t* masks,
+                           int32_t batch) {
+  Graph g{n, nullptr, nullptr, roots, units, mem, inner};
+  int64_t hits = 0;
+  std::vector<uint8_t> avail(n);
+  std::vector<int32_t> cand;
+  for (int32_t b = 0; b < batch; ++b) {
+    const uint8_t* row = masks + static_cast<int64_t>(b) * n;
+    std::copy(row, row + n, avail.begin());
+    cand.clear();
+    for (int32_t v = 0; v < n; ++v) {
+      if (avail[v]) cand.push_back(v);
+    }
+    std::vector<int32_t> q = max_quorum(g, cand, avail.data());
+    std::vector<uint8_t> in_q(n, 0);
+    for (const int32_t v : q) in_q[v] = 1;
+    std::vector<int32_t> comp;
+    for (int32_t v = 0; v < n; ++v) {
+      avail[v] = in_q[v] ? 0 : 1;
+      if (avail[v]) comp.push_back(v);
+    }
+    std::vector<int32_t> d = max_quorum(g, comp, avail.data());
+    if (!q.empty() && !d.empty()) ++hits;
+  }
+  return hits;
+}
+
+}  // extern "C"

@@ -33,7 +33,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from quorum_intersection_tpu.backends.base import SccCheckResult
-from quorum_intersection_tpu.encode.circuit import Circuit, max_quorum_np
+from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.logging import get_logger
@@ -148,7 +148,7 @@ class TpuSweepBackend:
         for start in range(start0, total, block):
             first_hit = step(start)
             steps += 1
-            candidates += block
+            candidates += min(block, total - start)
             if first_hit < int(INT32_MAX):
                 break
             if self.checkpoint is not None:

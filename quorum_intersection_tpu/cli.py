@@ -25,6 +25,7 @@ Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -158,5 +159,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 0 if result.intersects else 1
 
 
+def run() -> int:
+    """CLI entry with downstream-pipe hygiene: a closed stdout (e.g.
+    ``… | head``) exits 1 quietly instead of dumping a traceback."""
+    try:
+        return main()
+    except BrokenPipeError:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run())

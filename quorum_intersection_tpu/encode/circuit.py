@@ -42,7 +42,7 @@ Dangling-reference policy (Q1) is resolved earlier, in
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List
 
 import numpy as np
@@ -71,9 +71,9 @@ class Circuit:
     - ``depth``       — max height; ``depth+1`` synchronous sweeps evaluate
       the circuit exactly
 
-    CSR views (``mem_indptr``/``mem_indices``/``mem_counts``,
-    ``child_indptr``/``child_indices``/``child_counts``) feed the native C++
-    backend the same circuit without densification.
+    (The native C++ backend does not read this dense encoding — it flattens
+    the quorum-set trees itself from the :class:`TrustGraph`,
+    ``backends/cpp/__init__.py`` ``FlatGraph`` — so no sparse views live here.)
     """
 
     n: int
@@ -83,12 +83,6 @@ class Circuit:
     members: np.ndarray
     child: np.ndarray
     unit_depth: np.ndarray
-    mem_indptr: np.ndarray = field(repr=False, default=None)
-    mem_indices: np.ndarray = field(repr=False, default=None)
-    mem_counts: np.ndarray = field(repr=False, default=None)
-    child_indptr: np.ndarray = field(repr=False, default=None)
-    child_indices: np.ndarray = field(repr=False, default=None)
-    child_counts: np.ndarray = field(repr=False, default=None)
 
     @property
     def lanes(self) -> int:
@@ -174,28 +168,6 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
                 )
             child[u, cu] += 1
 
-    # CSR views for the native backend (counts carry vote multiplicity for
-    # duplicated validators and duplicated-then-interned inner sets).
-    mem_lists: List[np.ndarray] = []
-    mem_count_lists: List[np.ndarray] = []
-    child_lists: List[np.ndarray] = []
-    child_count_lists: List[np.ndarray] = []
-    mem_indptr = np.zeros(n_units + 1, dtype=np.int32)
-    child_indptr = np.zeros(n_units + 1, dtype=np.int32)
-    for u in range(n_units):
-        midx = np.nonzero(members[u])[0].astype(np.int32)
-        mem_lists.append(midx)
-        mem_count_lists.append(members[u, midx].astype(np.int32))
-        cidx = np.nonzero(child[u])[0].astype(np.int32)
-        child_lists.append(cidx)
-        child_count_lists.append(child[u, cidx].astype(np.int32))
-        mem_indptr[u + 1] = mem_indptr[u] + len(midx)
-        child_indptr[u + 1] = child_indptr[u] + len(cidx)
-    mem_indices = np.concatenate(mem_lists) if mem_lists else np.zeros(0, np.int32)
-    mem_counts = np.concatenate(mem_count_lists) if mem_count_lists else np.zeros(0, np.int32)
-    child_indices = np.concatenate(child_lists) if child_lists else np.zeros(0, np.int32)
-    child_counts = np.concatenate(child_count_lists) if child_count_lists else np.zeros(0, np.int32)
-
     return Circuit(
         n=n,
         n_units=n_units,
@@ -204,12 +176,6 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
         members=members,
         child=child,
         unit_depth=unit_depth,
-        mem_indptr=mem_indptr,
-        mem_indices=mem_indices.astype(np.int32),
-        mem_counts=mem_counts.astype(np.int32),
-        child_indptr=child_indptr,
-        child_indices=child_indices.astype(np.int32),
-        child_counts=child_counts.astype(np.int32),
     )
 
 

@@ -94,17 +94,3 @@ def test_overflow_raises_not_wraps():
     g = build_graph(parse_fbas(dup_validators))
     with pytest.raises(ValueError, match="255"):
         encode_circuit(g)
-
-
-def test_csr_views_roundtrip_dense():
-    g, c = _circuit(hierarchical_fbas(4, 3))
-    dense_members = np.zeros_like(c.members, dtype=np.int32)
-    for u in range(c.n_units):
-        lo, hi = c.mem_indptr[u], c.mem_indptr[u + 1]
-        dense_members[u, c.mem_indices[lo:hi]] = c.mem_counts[lo:hi]
-    np.testing.assert_array_equal(dense_members, c.members.astype(np.int32))
-    dense_child = np.zeros_like(c.child, dtype=np.int32)
-    for u in range(c.n_units):
-        lo, hi = c.child_indptr[u], c.child_indptr[u + 1]
-        dense_child[u, c.child_indices[lo:hi]] = c.child_counts[lo:hi]
-    np.testing.assert_array_equal(dense_child, c.child.astype(np.int32))
