@@ -150,7 +150,9 @@ def main() -> int:
     if args.quick:
         n_orgs, per_org, batch, steps, samples = 4, 4, 256, 2, 10
     else:
-        n_orgs, per_org, batch, steps, samples = 16, 16, 4096, 8, 40
+        # 32k candidates/step: below that, dispatch latency (not compute)
+        # bounds throughput on a single tunneled chip.
+        n_orgs, per_org, batch, steps, samples = 16, 16, 32768, 12, 40
     batch = args.batch or batch
     steps = args.steps or steps
 

@@ -8,6 +8,11 @@ from typing import Dict, List, Optional, Protocol, runtime_checkable
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 
+# Shared miss sentinel for first-hit index reductions: device kernels return
+# this value for a clean-miss block; drivers compare against it.  Lives here
+# (jax-free) so both the device and host sides import the same constant.
+INT32_MAX = 2**31 - 1
+
 
 @dataclass
 class SccCheckResult:

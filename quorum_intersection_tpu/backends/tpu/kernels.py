@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from quorum_intersection_tpu.backends.base import INT32_MAX
 from quorum_intersection_tpu.encode.circuit import Circuit
 
 
@@ -59,12 +60,13 @@ def node_sat(arrays: CircuitArrays, avail: jnp.ndarray) -> jnp.ndarray:
     Self-availability (Q4) is the trailing elementwise product.
     """
     base = avail @ arrays.members_t  # (B, U) vote counts from direct validators
+    # First sweep: sat starts all-zero, so the child contribution is zero —
+    # evaluate leaves directly instead of multiplying a zero matrix.  The
+    # remaining `depth` sweeps propagate inner-set satisfaction up the DAG.
+    sat = (base >= arrays.thresholds).astype(jnp.float32)
     if arrays.has_inner:
-        sat = jnp.zeros(avail.shape[:-1] + (arrays.n_units,), dtype=jnp.float32)
-        for _ in range(arrays.depth + 1):
+        for _ in range(arrays.depth):
             sat = ((base + sat @ arrays.child_t) >= arrays.thresholds).astype(jnp.float32)
-    else:
-        sat = (base >= arrays.thresholds).astype(jnp.float32)
     return sat[..., : arrays.n] * avail
 
 
@@ -171,14 +173,21 @@ def sweep_step(
     return hit, q.sum(axis=-1).astype(jnp.int32)
 
 
-def make_sweep_step(
+def make_sweep_first_hit(
     circuit: Circuit,
     bit_nodes: np.ndarray,
     scc_mask: np.ndarray,
     frozen: Optional[np.ndarray],
     batch: int,
-) -> Callable[[int], Tuple[np.ndarray, np.ndarray]]:
-    """Compile a single-device sweep step over ``batch`` candidates."""
+) -> Callable[[int], jnp.ndarray]:
+    """Compile a sweep step reduced to one device scalar: the smallest hit
+    candidate index in the block, or INT32_MAX for a clean miss.
+
+    Returning a scalar (instead of the (B,) hit vector) keeps the host↔device
+    transfer per step at 4 bytes and — because the call is *asynchronous* —
+    lets the sweep driver pipeline several blocks in flight, hiding dispatch
+    latency (the measured bottleneck on a tunneled single chip).
+    """
     arrays = CircuitArrays(circuit)
     bit_nodes_j = jnp.asarray(bit_nodes, dtype=jnp.int32)
     scc_mask_j = jnp.asarray(scc_mask, dtype=jnp.float32)
@@ -190,10 +199,8 @@ def make_sweep_step(
 
     @jax.jit
     def step(start):
-        return sweep_step(arrays, start, batch, bit_nodes_j, scc_mask_j, frozen_j)
+        hit, _ = sweep_step(arrays, start, batch, bit_nodes_j, scc_mask_j, frozen_j)
+        idx = start + jnp.arange(batch, dtype=jnp.int32)
+        return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
 
-    def run(start: int) -> Tuple[np.ndarray, np.ndarray]:
-        hit, q_size = step(jnp.int32(start))
-        return np.asarray(hit), np.asarray(q_size)
-
-    return run
+    return lambda start: step(jnp.int32(start))

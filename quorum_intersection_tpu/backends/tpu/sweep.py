@@ -32,7 +32,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.backends.base import INT32_MAX, SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
@@ -40,9 +40,9 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.sweep")
 
-INT32_MAX = np.int32(2**31 - 1)
-DEFAULT_BATCH = 8192
+DEFAULT_BATCH = 32768  # dispatch latency dominates below ~32k candidates/step
 DEFAULT_MAX_BITS = 30  # 2^30 candidates ≈ 1.07e9 — the practical sweep ceiling
+MAX_INFLIGHT = 4  # device steps queued ahead of the host sync point
 
 
 class SccTooLargeError(ValueError):
@@ -129,30 +129,48 @@ class TpuSweepBackend:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
         if self.mesh is not None:
-            step, block = self._build_sharded_step(circuit, bit_nodes, scc_mask, frozen)
+            dispatch, block = self._build_sharded_step(circuit, bit_nodes, scc_mask, frozen)
         else:
-            from quorum_intersection_tpu.backends.tpu.kernels import make_sweep_step
+            from quorum_intersection_tpu.backends.tpu.kernels import make_sweep_first_hit
 
             block = min(self.batch, max(total, 1))
-            run = make_sweep_step(circuit, bit_nodes, scc_mask, frozen, block)
+            dispatch = make_sweep_first_hit(circuit, bit_nodes, scc_mask, frozen, block)
 
-            def step(start: int) -> int:
-                hit, _ = run(start)
-                if hit.any():
-                    return start + int(np.argmax(hit))
-                return int(INT32_MAX)
+        # Pipelined drive: keep up to MAX_INFLIGHT asynchronous device steps
+        # queued and sync on the *oldest* (FIFO), so host↔device round-trip
+        # latency — the measured bottleneck on a tunneled chip — overlaps
+        # with device compute.  FIFO draining preserves determinism: the
+        # first block containing a hit is processed first, and the per-block
+        # scalar is the minimum hit index, so the witness is the globally
+        # smallest hit candidate.
+        from collections import deque
 
         steps = 0
         candidates = 0
         first_hit = int(INT32_MAX)
-        for start in range(start0, total, block):
-            first_hit = step(start)
+        inflight: "deque" = deque()
+
+        def drain_one() -> bool:
+            """Sync the oldest in-flight step; True iff it contained a hit."""
+            nonlocal steps, candidates, first_hit
+            start, handle = inflight.popleft()
+            hit = int(handle)
             steps += 1
             candidates += min(block, total - start)
-            if first_hit < int(INT32_MAX):
-                break
+            if hit < int(INT32_MAX):
+                first_hit = hit
+                return True
             if self.checkpoint is not None:
                 self.checkpoint.record(start + block, total)
+            return False
+
+        for start in range(start0, total, block):
+            inflight.append((start, dispatch(start)))
+            if len(inflight) >= MAX_INFLIGHT and drain_one():
+                break
+        while first_hit >= int(INT32_MAX) and inflight:
+            if drain_one():
+                break
 
         seconds = time.perf_counter() - t0
         stats = {
@@ -217,7 +235,5 @@ class TpuSweepBackend:
             shard_map_fn(shard_fn, mesh, in_specs=P(), out_specs=P())
         )
 
-        def step(start: int) -> int:
-            return int(sharded(jnp.int32(start)))
-
-        return step, block
+        # Asynchronous dispatch: the caller syncs via int(handle).
+        return (lambda start: sharded(jnp.int32(start))), block
