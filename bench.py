@@ -77,24 +77,39 @@ def build_workload(n_orgs: int, per_org: int):
     return graph, encode_circuit(graph)
 
 
-def tpu_throughput(circuit, batch: int, steps: int) -> float:
-    """Candidates/sec through the full check (fixpoint + disjoint probe)."""
+def tpu_throughput(circuit, batch: int, steps: int, chunks: int = 32) -> float:
+    """Candidates/sec through the full check (fixpoint + disjoint probe).
+
+    Each device program evaluates ``chunks`` independent sub-batches via
+    ``fori_loop`` (amortizing the fixed per-program dispatch overhead — see
+    kernels.py module docs) and reduces to one scalar hit count; ``steps``
+    programs are dispatched asynchronously and pipelined.
+    """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, fixpoint
 
     arrays = CircuitArrays(circuit)
     n = circuit.n
-    full = jnp.ones((n,), dtype=jnp.float32)
+    full = jnp.ones((n,), dtype=arrays.dtype)
 
     @jax.jit
     def step(key):
-        masks = jax.random.bernoulli(key, 0.5, (batch, n)).astype(jnp.float32)
-        q = fixpoint(arrays, masks)
-        comp = jnp.clip(full - q, 0.0, 1.0)
-        d = fixpoint(arrays, comp)
-        return jnp.logical_and(q.sum(-1) > 0, d.sum(-1) > 0)
+        def body(i, acc):
+            masks = jax.random.bernoulli(
+                jax.random.fold_in(key, i), 0.5, (batch, n)
+            ).astype(arrays.dtype)
+            q = fixpoint(arrays, masks)
+            comp = jnp.clip(full - q, 0, 1).astype(arrays.dtype)
+            d = fixpoint(arrays, comp)
+            hits = jnp.logical_and(
+                q.sum(-1, dtype=jnp.int32) > 0, d.sum(-1, dtype=jnp.int32) > 0
+            )
+            return acc + hits.sum(dtype=jnp.int32)
+
+        return lax.fori_loop(0, chunks, body, jnp.int32(0))
 
     keys = jax.random.split(jax.random.PRNGKey(0), steps + 1)
     step(keys[0]).block_until_ready()  # compile + warm up
@@ -103,7 +118,7 @@ def tpu_throughput(circuit, batch: int, steps: int) -> float:
         hits = step(keys[i + 1])
     hits.block_until_ready()
     seconds = time.perf_counter() - t0
-    return batch * steps / seconds
+    return batch * chunks * steps / seconds
 
 
 def cpu_baseline(graph, samples: int) -> tuple:
@@ -140,24 +155,33 @@ def cpu_baseline(graph, samples: int) -> tuple:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true", help="small smoke-test shapes")
-    parser.add_argument("--batch", type=int, default=None)
-    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=None, help="candidates per block")
+    parser.add_argument("--steps", type=int, default=None, help="device programs dispatched")
+    parser.add_argument(
+        "--chunks", type=int, default=None,
+        help="blocks fused per device program (candidates/step = batch × chunks)",
+    )
     args = parser.parse_args()
 
     if not parity_gate():
         return 1
 
     if args.quick:
-        n_orgs, per_org, batch, steps, samples = 4, 4, 256, 2, 10
+        n_orgs, per_org, batch, steps, chunks, samples = 4, 4, 256, 2, 2, 10
     else:
-        # 32k candidates/step: below that, dispatch latency (not compute)
-        # bounds throughput on a single tunneled chip.
-        n_orgs, per_org, batch, steps, samples = 16, 16, 32768, 12, 40
-    batch = args.batch or batch
-    steps = args.steps or steps
+        # 32k-candidate blocks, 32 blocks per device program: one program is
+        # ~1M candidates, big enough that the fixed per-program dispatch
+        # overhead on a tunneled chip is noise (kernels.py module docs).
+        n_orgs, per_org, batch, steps, chunks, samples = 16, 16, 32768, 24, 32, 40
+    if args.batch is not None:
+        batch = args.batch
+    if args.steps is not None:
+        steps = args.steps
+    if args.chunks is not None:
+        chunks = args.chunks
 
     graph, circuit = build_workload(n_orgs, per_org)
-    tpu_rate = tpu_throughput(circuit, batch, steps)
+    tpu_rate = tpu_throughput(circuit, batch, steps, chunks)
     cpu_rate, baseline_kind = cpu_baseline(graph, samples)
 
     import jax
@@ -173,6 +197,7 @@ def main() -> int:
                 "baseline_value": round(cpu_rate, 1),
                 "workload": f"{graph.n}-node hierarchical FBAS, {circuit.n_units} circuit units",
                 "batch": batch,
+                "chunks": chunks,
                 "device": jax.devices()[0].device_kind,
                 "parity": "4/4 fixtures",
             }

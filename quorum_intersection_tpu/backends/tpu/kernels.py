@@ -3,12 +3,12 @@
 The reference's hot leaves are ``containsQuorumSlice`` / ``containsQuorum``
 (`/root/reference/quorum_intersection.cpp:90-177`) — per-node recursion with
 early exits, evaluated one candidate set at a time.  The TPU-native
-re-design evaluates **thousands of candidate sets at once** as dense linear
+re-design evaluates **millions of candidate sets at once** as dense linear
 algebra over the flattened threshold circuit (``encode/circuit.py``):
 
 - slice satisfaction for a whole batch is ``avail @ membersᵀ`` (one MXU
-  matmul) plus, for nested quorum sets, ``depth+1`` sweeps of
-  ``sat @ childᵀ`` (more matmuls) against the threshold vector;
+  matmul) plus, for nested quorum sets, ``depth`` sweeps of ``sat @ childᵀ``
+  (more matmuls) against the threshold vector;
 - the greatest-fixpoint quorum (cpp:147's ``f(X) = {x ∈ X : slice(x) ⊆ X}``)
   is a ``lax.while_loop`` that runs until **every row** of the batch is
   stable — converged rows are idempotent under the update, so batch-wide
@@ -18,9 +18,24 @@ algebra over the flattened threshold circuit (``encode/circuit.py``):
   never filtered by the fixpoint — exactly how ``containsQuorum`` never
   removes nodes outside its candidate list.
 
-Everything is float32 0/1 arithmetic: counts stay far below 2^24 so float32
-matmuls are exact, and float matmuls are the MXU fast path (int8 quantization
-would save bandwidth but caps vote counts; revisit if profiles demand it).
+Two dtype regimes, chosen per circuit:
+
+- **int8 operands, int32 accumulation** (the default): masks and vote-count
+  matrices are 0/1/small-count int8, ``lax.dot(..,
+  preferred_element_type=int32)`` rides the MXU's 8-bit path (2× bf16, ~4×
+  f32 throughput on v5e) and is *exact* — int32 accumulation cannot lose
+  counts for any n < 2^31;
+- **float32 fallback** when a vote count exceeds int8 range (a validator or
+  inner set repeated >127 times in one quorum set — pathological but legal):
+  0/1 floats with counts far below 2^24 are equally exact.
+
+Dispatch granularity matters as much as dtype on a tunneled single chip: a
+device program has a fixed multi-ms overhead regardless of content (measured:
+1 matmul ≈ 8 full sweeps per program), so :func:`sweep_program_factory` packs
+``steps_per_call`` whole sweep blocks into ONE program via ``lax.fori_loop``,
+reducing everything to a single scalar — the smallest hit index.  The sweep
+driver (sweep.py) ramps ``steps_per_call`` up as the enumeration proves
+large, amortizing the overhead to noise (measured ~40× end-to-end).
 """
 
 from __future__ import annotations
@@ -36,37 +51,68 @@ from jax import lax
 from quorum_intersection_tpu.backends.base import INT32_MAX
 from quorum_intersection_tpu.encode.circuit import Circuit
 
+# int8 operands hold vote counts ≤ 127; circuits with larger multiplicities
+# (legal but pathological input) fall back to exact float32.
+_INT8_MAX_COUNT = 127
+
 
 class CircuitArrays:
-    """Device-resident circuit constants, shared by all kernels."""
+    """Device-resident circuit constants, shared by all kernels.
+
+    ``dtype`` is the operand dtype (int8 fast path / float32 fallback);
+    ``acc`` the matmul accumulator dtype (int32 / float32); ``thresholds``
+    live in ``acc`` so threshold compares need no casts.
+    """
 
     def __init__(self, circuit: Circuit):
         self.n = circuit.n
         self.n_units = circuit.n_units
         self.depth = circuit.depth
-        self.members_t = jnp.asarray(circuit.members.T, dtype=jnp.float32)  # (n, U)
-        self.thresholds = jnp.asarray(circuit.thresholds, dtype=jnp.float32)  # (U,)
+        int8_ok = (
+            int(circuit.members.max(initial=0)) <= _INT8_MAX_COUNT
+            and int(circuit.child.max(initial=0)) <= _INT8_MAX_COUNT
+        )
+        if not int8_ok:
+            self.dtype = self.acc = jnp.float32
+        elif jax.default_backend() == "cpu":
+            # XLA's CPU backend mis-lowers int8 dots with int32 accumulation
+            # into mixed i32+i8 adds (LLVM verifier failure); int32 operands
+            # keep the exact integer semantics without the 8-bit lowering.
+            self.dtype = self.acc = jnp.int32
+        else:
+            self.dtype = jnp.int8
+            self.acc = jnp.int32
+        self.members_t = jnp.asarray(circuit.members.T, dtype=self.dtype)  # (n, U)
+        self.thresholds = jnp.asarray(circuit.thresholds, dtype=self.acc)  # (U,)
         self.has_inner = circuit.n_units > circuit.n
         if self.has_inner:
-            self.child_t = jnp.asarray(circuit.child.T, dtype=jnp.float32)  # (U, U)
+            self.child_t = jnp.asarray(circuit.child.T, dtype=self.dtype)  # (U, U)
         else:
             self.child_t = None
+
+    def dot(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """Matmul in the operands' dtype with exact accumulation."""
+        return lax.dot(a, b, preferred_element_type=self.acc)
+
+    def cast(self, x) -> jnp.ndarray:
+        return jnp.asarray(x).astype(self.dtype)
 
 
 def node_sat(arrays: CircuitArrays, avail: jnp.ndarray) -> jnp.ndarray:
     """Which nodes have a satisfied slice under ``avail``?
 
-    ``avail``: (B, n) float32 0/1.  Returns (B, n) float32 0/1.
+    ``avail``: (B, n) 0/1 in ``arrays.dtype``.  Returns (B, n) 0/1 same dtype.
     Self-availability (Q4) is the trailing elementwise product.
     """
-    base = avail @ arrays.members_t  # (B, U) vote counts from direct validators
+    base = arrays.dot(avail, arrays.members_t)  # (B, U) direct-validator votes
     # First sweep: sat starts all-zero, so the child contribution is zero —
     # evaluate leaves directly instead of multiplying a zero matrix.  The
     # remaining `depth` sweeps propagate inner-set satisfaction up the DAG.
-    sat = (base >= arrays.thresholds).astype(jnp.float32)
-    if arrays.has_inner:
-        for _ in range(arrays.depth):
-            sat = ((base + sat @ arrays.child_t) >= arrays.thresholds).astype(jnp.float32)
+    sat = (base >= arrays.thresholds).astype(arrays.dtype)
+    for _ in range(arrays.depth if arrays.has_inner else 0):
+        sat = ((base + arrays.dot(sat, arrays.child_t)) >= arrays.thresholds).astype(
+            arrays.dtype
+        )
     return sat[..., : arrays.n] * avail
 
 
@@ -77,16 +123,17 @@ def fixpoint(
 ) -> jnp.ndarray:
     """Greatest-fixpoint quorum per batch row (cpp:140-177 batched).
 
-    ``avail``: (B, n) float32 0/1 candidate sets.  ``frozen``: optional (n,)
-    float32 0/1 mask of nodes that remain available for slice satisfaction but
-    are never filtered (Q6 whole-graph availability; ``None`` ⇒ scoped).
-    Returns (B, n) float32 0/1 — the surviving quorum of each row (all-zero ⇒
-    no quorum inside that candidate set).
+    ``avail``: (B, n) 0/1 candidate sets (any numeric dtype; cast to the
+    circuit's operand dtype).  ``frozen``: optional (n,) 0/1 mask of nodes
+    that remain available for slice satisfaction but are never filtered (Q6
+    whole-graph availability; ``None`` ⇒ scoped).  Returns (B, n) 0/1 in
+    ``arrays.dtype`` — the surviving quorum of each row (all-zero ⇒ no quorum
+    inside that candidate set).
     """
     if frozen is None:
-        frozen_row = jnp.zeros((arrays.n,), dtype=jnp.float32)
+        frozen_row = jnp.zeros((arrays.n,), dtype=arrays.dtype)
     else:
-        frozen_row = frozen.astype(jnp.float32)
+        frozen_row = arrays.cast(frozen)
 
     def body(carry):
         a, _ = carry
@@ -98,12 +145,12 @@ def fixpoint(
     def cond(carry):
         return carry[1]
 
-    a0 = avail.astype(jnp.float32)
+    a0 = arrays.cast(avail)
     # Derive the initial "changed" flag from the data (it is trivially True)
     # so the carry inherits the input's manual-axis varyingness under
     # shard_map — a literal jnp.bool_(True) would be replicated and trip the
     # while_loop carry-type check on sharded meshes.
-    changed0 = jnp.any(a0 >= 0.0)
+    changed0 = jnp.any(a0 == a0)
     out, _ = lax.while_loop(cond, body, (a0, changed0))
     return out
 
@@ -119,37 +166,54 @@ def make_batch_fixpoint(
         return fixpoint(arrays, avail, frozen)
 
     def run(avail: np.ndarray, frozen: Optional[np.ndarray] = None) -> np.ndarray:
-        a = jnp.asarray(avail, dtype=jnp.float32)
+        a = arrays.cast(np.asarray(avail))
         f = (
-            jnp.zeros((arrays.n,), dtype=jnp.float32)
+            jnp.zeros((arrays.n,), dtype=arrays.dtype)
             if frozen is None
-            else jnp.asarray(frozen, dtype=jnp.float32)
+            else arrays.cast(np.asarray(frozen))
         )
-        return np.asarray(run_jit(a, f)) > 0.5
+        return np.asarray(run_jit(a, f)) != 0
 
     return run
 
 
-def subset_masks(start: jnp.ndarray, batch: int, bit_nodes: jnp.ndarray, n: int) -> jnp.ndarray:
-    """Decode candidate indices ``start + [0, batch)`` into (batch, n) 0/1
-    availability rows: bit *j* of the index toggles node ``bit_nodes[j]``.
+def bit_positions(bit_nodes: np.ndarray, n: int) -> np.ndarray:
+    """Per-node bit index for the subset decode: ``pos[bit_nodes[j]] = j``,
+    every other node 31.  Shifting a non-negative int32 index right by 31
+    yields bit 0, so non-enumerated nodes decode to "absent" with no masking.
+    """
+    pos = np.full((n,), 31, dtype=np.int32)
+    for j, v in enumerate(np.asarray(bit_nodes, dtype=np.int32)):
+        pos[int(v)] = j
+    return pos
 
-    ``bit_nodes``: (s,) int32 vertex ids — the enumeration axis.  Indices must
+
+def decode_masks(start: jnp.ndarray, batch: int, pos: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Decode candidate indices ``start + [0, batch)`` into (batch, n) 0/1
+    availability rows via per-node right-shifts (``pos`` from
+    :func:`bit_positions`) — a dense vectorized op, no scatter.  Indices must
     stay below 2^31 (callers cap the enumeration width; SURVEY.md §7.3's
     uint32-lane note — JAX has no x64 by default).
     """
-    s = bit_nodes.shape[0]
     idx = start + jnp.arange(batch, dtype=jnp.int32)  # (B,)
-    bits = ((idx[:, None] >> jnp.arange(s, dtype=jnp.int32)) & 1).astype(jnp.float32)
-    rows = jnp.zeros((batch, n), dtype=jnp.float32)
-    return rows.at[:, bit_nodes].set(bits)
+    return ((idx[:, None] >> pos[None, :]) & 1).astype(dtype)
+
+
+def subset_masks(start: jnp.ndarray, batch: int, bit_nodes: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Decode candidate indices into (batch, n) float32 0/1 rows: bit *j* of
+    the index toggles node ``bit_nodes[j]`` (test/reference surface; the
+    compiled kernels use :func:`decode_masks` with a host-built ``pos``)."""
+    pos = jnp.full((n,), 31, dtype=jnp.int32).at[bit_nodes].set(
+        jnp.arange(bit_nodes.shape[0], dtype=jnp.int32)
+    )
+    return decode_masks(start, batch, pos, jnp.float32)
 
 
 def sweep_step(
     arrays: CircuitArrays,
     start: jnp.ndarray,
     batch: int,
-    bit_nodes: jnp.ndarray,
+    pos: jnp.ndarray,
     scc_mask: jnp.ndarray,
     frozen: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -160,47 +224,67 @@ def sweep_step(
     — i.e. S exposes a disjoint quorum pair (see sweep.py for the
     verdict-equivalence argument).
 
-    Returns ``(hit, q_size)``: (B,) bool hit flags and (B,) int32 quorum sizes
-    (diagnostics).  Witness reconstruction happens on the host from the first
-    hit index.
+    ``pos``: (n,) int32 from :func:`bit_positions`; ``scc_mask``/``frozen``:
+    (n,) 0/1 in ``arrays.dtype``.  Returns ``(hit, q_size)``: (B,) bool hit
+    flags and (B,) int32 quorum sizes (diagnostics).  Witness reconstruction
+    happens on the host from the first hit index.
     """
-    avail = subset_masks(start, batch, bit_nodes, arrays.n)
+    avail = decode_masks(start, batch, pos, arrays.dtype)
     q = fixpoint(arrays, avail)
-    q_nonempty = q.sum(axis=-1) > 0
-    complement = jnp.clip(scc_mask - q, 0.0, 1.0)
+    q_size = q.sum(axis=-1, dtype=jnp.int32)
+    complement = jnp.clip(scc_mask - q, 0, 1).astype(arrays.dtype)
     d = fixpoint(arrays, complement, frozen)
-    hit = jnp.logical_and(q_nonempty, d.sum(axis=-1) > 0)
-    return hit, q.sum(axis=-1).astype(jnp.int32)
+    hit = jnp.logical_and(q_size > 0, d.sum(axis=-1, dtype=jnp.int32) > 0)
+    return hit, q_size
 
 
-def make_sweep_first_hit(
+def sweep_program_factory(
     circuit: Circuit,
     bit_nodes: np.ndarray,
     scc_mask: np.ndarray,
     frozen: Optional[np.ndarray],
     batch: int,
-) -> Callable[[int], jnp.ndarray]:
-    """Compile a sweep step reduced to one device scalar: the smallest hit
-    candidate index in the block, or INT32_MAX for a clean miss.
+) -> Callable[[int], Callable[[int], jnp.ndarray]]:
+    """Build sweep programs sharing one set of device-resident constants.
 
-    Returning a scalar (instead of the (B,) hit vector) keeps the host↔device
-    transfer per step at 4 bytes and — because the call is *asynchronous* —
-    lets the sweep driver pipeline several blocks in flight, hiding dispatch
-    latency (the measured bottleneck on a tunneled single chip).
+    ``factory(steps_per_call)`` compiles a program covering ``batch ×
+    steps_per_call`` candidates, reduced to one device scalar: the smallest
+    hit candidate index, or INT32_MAX for a clean miss.  The circuit arrays,
+    bit-position table, and masks upload once and are closed over by every
+    ramp level the driver compiles.
+
+    ``steps_per_call`` sub-blocks run inside one program via ``fori_loop``,
+    amortizing the fixed per-program dispatch overhead (module docs); the
+    scalar result keeps the host↔device transfer at 4 bytes and — because the
+    call is *asynchronous* — lets the sweep driver pipeline several programs
+    in flight, hiding the tunneled chip's round-trip latency.
     """
     arrays = CircuitArrays(circuit)
-    bit_nodes_j = jnp.asarray(bit_nodes, dtype=jnp.int32)
-    scc_mask_j = jnp.asarray(scc_mask, dtype=jnp.float32)
+    pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
+    scc_mask_j = arrays.cast(scc_mask)
     frozen_j = (
-        jnp.zeros((circuit.n,), dtype=jnp.float32)
+        jnp.zeros((circuit.n,), dtype=arrays.dtype)
         if frozen is None
-        else jnp.asarray(frozen, dtype=jnp.float32)
+        else arrays.cast(frozen)
     )
 
-    @jax.jit
-    def step(start):
-        hit, _ = sweep_step(arrays, start, batch, bit_nodes_j, scc_mask_j, frozen_j)
+    def block_min_hit(start):
+        hit, _ = sweep_step(arrays, start, batch, pos_j, scc_mask_j, frozen_j)
         idx = start + jnp.arange(batch, dtype=jnp.int32)
         return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
 
-    return lambda start: step(jnp.int32(start))
+    def factory(steps_per_call: int) -> Callable[[int], jnp.ndarray]:
+        @jax.jit
+        def step(start0):
+            if steps_per_call == 1:
+                return block_min_hit(start0)
+
+            def body(i, best):
+                return jnp.minimum(best, block_min_hit(start0 + i * batch))
+
+            return lax.fori_loop(0, steps_per_call, body, jnp.int32(INT32_MAX))
+
+        return lambda start: step(jnp.int32(start))
+
+    return factory
+

@@ -43,6 +43,12 @@ log = get_logger("backends.tpu.sweep")
 DEFAULT_BATCH = 32768  # dispatch latency dominates below ~32k candidates/step
 DEFAULT_MAX_BITS = 30  # 2^30 candidates ≈ 1.07e9 — the practical sweep ceiling
 MAX_INFLIGHT = 4  # device steps queued ahead of the host sync point
+# A device program has a fixed multi-ms overhead regardless of content
+# (kernels.py module docs), so as the enumeration proves large the driver
+# ramps the number of sweep blocks packed per program through these values —
+# small sweeps never pay the compile time of the big shapes, exhaustive
+# sweeps amortize dispatch to noise (measured ~40× end-to-end on 2^30).
+STEPS_RAMP = (1, 8, 64, 256)
 
 
 class SccTooLargeError(ValueError):
@@ -129,43 +135,76 @@ class TpuSweepBackend:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
         if self.mesh is not None:
-            dispatch, block = self._build_sharded_step(circuit, bit_nodes, scc_mask, frozen)
+            base_block, make_dispatch = self._build_sharded_step(
+                circuit, bit_nodes, scc_mask, frozen
+            )
         else:
-            from quorum_intersection_tpu.backends.tpu.kernels import make_sweep_first_hit
+            from quorum_intersection_tpu.backends.tpu.kernels import sweep_program_factory
 
-            block = min(self.batch, max(total, 1))
-            dispatch = make_sweep_first_hit(circuit, bit_nodes, scc_mask, frozen, block)
+            base_block = min(self.batch, max(total, 1))
+            # Device constants upload once; each ramp level only compiles.
+            make_dispatch = sweep_program_factory(
+                circuit, bit_nodes, scc_mask, frozen, base_block
+            )
 
-        # Pipelined drive: keep up to MAX_INFLIGHT asynchronous device steps
-        # queued and sync on the *oldest* (FIFO), so host↔device round-trip
-        # latency — the measured bottleneck on a tunneled chip — overlaps
-        # with device compute.  FIFO draining preserves determinism: the
-        # first block containing a hit is processed first, and the per-block
-        # scalar is the minimum hit index, so the witness is the globally
-        # smallest hit candidate.
+        # Pipelined drive: keep up to MAX_INFLIGHT asynchronous device
+        # programs queued and sync on the *oldest* (FIFO), so host↔device
+        # round-trip latency — the measured bottleneck on a tunneled chip —
+        # overlaps with device compute.  FIFO draining preserves determinism:
+        # the first program containing a hit is processed first, and the
+        # per-program scalar is the minimum hit index, so the witness is the
+        # globally smallest hit candidate.  Program size ramps through
+        # STEPS_RAMP as the sweep proves large (shape cache: one compile per
+        # ramp level actually reached).
         from collections import deque
 
         steps = 0
         candidates = 0
         first_hit = int(INT32_MAX)
         inflight: "deque" = deque()
+        dispatchers = {}
+
+        def dispatch(start: int, steps_per_call: int):
+            fn = dispatchers.get(steps_per_call)
+            if fn is None:
+                fn = dispatchers[steps_per_call] = make_dispatch(steps_per_call)
+            return fn(start)
 
         def drain_one() -> bool:
-            """Sync the oldest in-flight step; True iff it contained a hit."""
+            """Sync the oldest in-flight program; True iff it hit."""
             nonlocal steps, candidates, first_hit
-            start, handle = inflight.popleft()
+            start, coverage, handle = inflight.popleft()
             hit = int(handle)
             steps += 1
-            candidates += min(block, total - start)
+            candidates += min(coverage, total - start)
             if hit < int(INT32_MAX):
                 first_hit = hit
                 return True
             if self.checkpoint is not None:
-                self.checkpoint.record(start + block, total)
+                # The last program may overshoot `total` (ramped coverage is
+                # not a divisor of it); clamp or resume_position would reject
+                # the record and restart the whole sweep.
+                self.checkpoint.record(min(start + coverage, total), total)
             return False
 
-        for start in range(start0, total, block):
-            inflight.append((start, dispatch(start)))
+        start = start0
+        ramp_ix = 0
+        dispatched = 0
+        while start < total:
+            # Grow the program only once the remaining work would keep the
+            # pipeline full at the next size (never compile shapes a small
+            # sweep won't use).
+            while (
+                ramp_ix + 1 < len(STEPS_RAMP)
+                and dispatched >= MAX_INFLIGHT
+                and total - start
+                >= STEPS_RAMP[ramp_ix + 1] * base_block * MAX_INFLIGHT
+            ):
+                ramp_ix += 1
+            coverage = STEPS_RAMP[ramp_ix] * base_block
+            inflight.append((start, coverage, dispatch(start, STEPS_RAMP[ramp_ix])))
+            dispatched += 1
+            start += coverage
             if len(inflight) >= MAX_INFLIGHT and drain_one():
                 break
         while first_hit >= int(INT32_MAX) and inflight:
@@ -199,41 +238,62 @@ class TpuSweepBackend:
     # ---- sharded step ----------------------------------------------------
 
     def _build_sharded_step(self, circuit, bit_nodes, scc_mask, frozen):
-        """Mesh-sharded sweep step: each device takes a contiguous sub-block,
-        hit indices combine with one pmin collective."""
+        """Mesh-sharded sweep step: each device takes a contiguous sub-block
+        (``steps_per_call`` of them per program), hit indices combine with one
+        pmin collective.  Returns ``(base_block, make_dispatch)`` matching the
+        single-device path's contract."""
         import jax
         import jax.numpy as jnp
         from jax import lax
 
-        from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, sweep_step
+        from quorum_intersection_tpu.backends.tpu.kernels import (
+            CircuitArrays,
+            bit_positions,
+            sweep_step,
+        )
         from quorum_intersection_tpu.parallel.mesh import P, shard_map_fn
 
         mesh = self.mesh
         axis = mesh.axis_names[0]
         n_dev = mesh.devices.size
         per_dev = max(self.batch // n_dev, 1)
-        block = per_dev * n_dev
+        base_block = per_dev * n_dev
 
         arrays = CircuitArrays(circuit)
-        bit_nodes_j = jnp.asarray(bit_nodes, dtype=jnp.int32)
-        scc_mask_j = jnp.asarray(scc_mask, dtype=jnp.float32)
+        pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
+        scc_mask_j = arrays.cast(scc_mask)
         frozen_j = (
-            jnp.zeros((circuit.n,), dtype=jnp.float32)
+            jnp.zeros((circuit.n,), dtype=arrays.dtype)
             if frozen is None
-            else jnp.asarray(frozen, dtype=jnp.float32)
+            else arrays.cast(frozen)
         )
 
-        def shard_fn(start):
-            rank = lax.axis_index(axis)
-            my_start = start + rank.astype(jnp.int32) * per_dev
-            hit, _ = sweep_step(arrays, my_start, per_dev, bit_nodes_j, scc_mask_j, frozen_j)
-            idx = my_start + jnp.arange(per_dev, dtype=jnp.int32)
-            hit_idx = jnp.where(hit, idx, jnp.int32(INT32_MAX))
-            return lax.pmin(hit_idx.min(), axis)
+        def make_dispatch(steps_per_call: int):
+            def shard_fn(start):
+                rank = lax.axis_index(axis)
 
-        sharded = jax.jit(
-            shard_map_fn(shard_fn, mesh, in_specs=P(), out_specs=P())
-        )
+                # Device r takes sub-block r of every consecutive block, so
+                # the program's coverage stays one contiguous index range.
+                def block_min_hit(block_start):
+                    my_start = block_start + rank.astype(jnp.int32) * per_dev
+                    hit, _ = sweep_step(
+                        arrays, my_start, per_dev, pos_j, scc_mask_j, frozen_j
+                    )
+                    idx = my_start + jnp.arange(per_dev, dtype=jnp.int32)
+                    return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
 
-        # Asynchronous dispatch: the caller syncs via int(handle).
-        return (lambda start: sharded(jnp.int32(start))), block
+                def body(i, best):
+                    return jnp.minimum(best, block_min_hit(start + i * base_block))
+
+                # Seed the carry from `rank` so it is manual-axis-varying —
+                # a literal init would be replicated and trip the fori_loop
+                # carry-type check under shard_map (cf. kernels.fixpoint).
+                init = jnp.int32(INT32_MAX) + rank * jnp.int32(0)
+                local = lax.fori_loop(0, steps_per_call, body, init)
+                return lax.pmin(local, axis)
+
+            sharded = jax.jit(shard_map_fn(shard_fn, mesh, in_specs=P(), out_specs=P()))
+            # Asynchronous dispatch: the caller syncs via int(handle).
+            return lambda start: sharded(jnp.int32(start))
+
+        return base_block, make_dispatch

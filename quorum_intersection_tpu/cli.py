@@ -25,7 +25,6 @@ Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 from typing import List, Optional
 
@@ -160,14 +159,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def run() -> int:
-    """CLI entry with downstream-pipe hygiene: a closed stdout (e.g.
-    ``… | head``) exits 1 quietly instead of dumping a traceback."""
-    try:
-        return main()
-    except BrokenPipeError:
-        devnull = os.open(os.devnull, os.O_WRONLY)
-        os.dup2(devnull, sys.stdout.fileno())
-        return 1
+    from quorum_intersection_tpu.utils.pipes import run_with_pipe_hygiene
+
+    return run_with_pipe_hygiene(main)
 
 
 if __name__ == "__main__":

@@ -143,5 +143,11 @@ def main(argv: Optional[Iterable[str]] = None) -> int:
     return 0
 
 
+def run() -> int:
+    from quorum_intersection_tpu.utils.pipes import run_with_pipe_hygiene
+
+    return run_with_pipe_hygiene(main)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run())
