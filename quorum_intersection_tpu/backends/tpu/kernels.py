@@ -238,6 +238,26 @@ def sweep_step(
     return hit, q_size
 
 
+def sweep_constants(
+    circuit: Circuit,
+    bit_nodes: np.ndarray,
+    scc_mask: np.ndarray,
+    frozen: Optional[np.ndarray],
+) -> Tuple[CircuitArrays, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Upload the device-resident constants every sweep program closes over:
+    ``(arrays, pos, scc_mask, frozen)`` — shared by the single-device
+    program factory and the mesh-sharded step builder (sweep.py)."""
+    arrays = CircuitArrays(circuit)
+    pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
+    scc_mask_j = arrays.cast(scc_mask)
+    frozen_j = (
+        jnp.zeros((circuit.n,), dtype=arrays.dtype)
+        if frozen is None
+        else arrays.cast(frozen)
+    )
+    return arrays, pos_j, scc_mask_j, frozen_j
+
+
 def sweep_program_factory(
     circuit: Circuit,
     bit_nodes: np.ndarray,
@@ -259,13 +279,8 @@ def sweep_program_factory(
     call is *asynchronous* — lets the sweep driver pipeline several programs
     in flight, hiding the tunneled chip's round-trip latency.
     """
-    arrays = CircuitArrays(circuit)
-    pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
-    scc_mask_j = arrays.cast(scc_mask)
-    frozen_j = (
-        jnp.zeros((circuit.n,), dtype=arrays.dtype)
-        if frozen is None
-        else arrays.cast(frozen)
+    arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
+        circuit, bit_nodes, scc_mask, frozen
     )
 
     def block_min_hit(start):

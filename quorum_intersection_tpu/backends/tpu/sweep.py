@@ -189,21 +189,25 @@ class TpuSweepBackend:
 
         start = start0
         ramp_ix = 0
-        dispatched = 0
+        since_ramp = 0  # dispatches at the current level: one full pipeline
+        # of programs must run at each level before growing to the next, so
+        # the ramp is gradual (1 → 8 → 64 → …) and an early hit or crash
+        # near the start never has to sync/lose a maximum-size program.
         while start < total:
             # Grow the program only once the remaining work would keep the
             # pipeline full at the next size (never compile shapes a small
             # sweep won't use).
-            while (
+            if (
                 ramp_ix + 1 < len(STEPS_RAMP)
-                and dispatched >= MAX_INFLIGHT
+                and since_ramp >= MAX_INFLIGHT
                 and total - start
                 >= STEPS_RAMP[ramp_ix + 1] * base_block * MAX_INFLIGHT
             ):
                 ramp_ix += 1
+                since_ramp = 0
             coverage = STEPS_RAMP[ramp_ix] * base_block
             inflight.append((start, coverage, dispatch(start, STEPS_RAMP[ramp_ix])))
-            dispatched += 1
+            since_ramp += 1
             start += coverage
             if len(inflight) >= MAX_INFLIGHT and drain_one():
                 break
@@ -247,8 +251,7 @@ class TpuSweepBackend:
         from jax import lax
 
         from quorum_intersection_tpu.backends.tpu.kernels import (
-            CircuitArrays,
-            bit_positions,
+            sweep_constants,
             sweep_step,
         )
         from quorum_intersection_tpu.parallel.mesh import P, shard_map_fn
@@ -259,13 +262,8 @@ class TpuSweepBackend:
         per_dev = max(self.batch // n_dev, 1)
         base_block = per_dev * n_dev
 
-        arrays = CircuitArrays(circuit)
-        pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
-        scc_mask_j = arrays.cast(scc_mask)
-        frozen_j = (
-            jnp.zeros((circuit.n,), dtype=arrays.dtype)
-            if frozen is None
-            else arrays.cast(frozen)
+        arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
+            circuit, bit_nodes, scc_mask, frozen
         )
 
         def make_dispatch(steps_per_call: int):
