@@ -37,15 +37,17 @@ class AutoBackend:
         sweep_limit: int = DEFAULT_SWEEP_LIMIT,
         seed: Optional[int] = None,
         randomized: bool = False,
+        checkpoint=None,
     ) -> None:
         self.prefer_tpu = prefer_tpu
         self.sweep_limit = sweep_limit
+        self.checkpoint = checkpoint  # forwarded to the sweep backend only
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
     def _sweep(self):
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
-        return TpuSweepBackend()
+        return TpuSweepBackend(checkpoint=self.checkpoint)
 
     def _hybrid(self):
         from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
@@ -80,6 +82,13 @@ class AutoBackend:
                 return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
             except Exception as exc:  # noqa: BLE001
                 log.info("sweep backend unavailable (%s); falling back", exc)
+        if self.checkpoint is not None:
+            # Only the sweep records progress; honor the user's expectation
+            # loudly instead of silently running an all-or-nothing search.
+            log.warning(
+                "checkpoint not honored: |scc|=%d routed to a non-sweep backend "
+                "(no progress will be recorded)", len(scc),
+            )
         if self.prefer_tpu:
             try:
                 backend = self._hybrid()

@@ -129,8 +129,18 @@ class TpuSweepBackend:
 
         total = 1 << bits if bits > 0 else 1
         start0 = 0
+        fingerprint = None
         if self.checkpoint is not None:
-            start0 = self.checkpoint.resume_position(total)
+            from quorum_intersection_tpu.utils.checkpoint import sweep_fingerprint
+
+            # Ties the file to this exact enumeration: a stale checkpoint
+            # from a different FBAS with an equal-size SCC must not be
+            # resumed (it would skip candidates and could flip the verdict).
+            fingerprint = sweep_fingerprint(
+                circuit.members, circuit.child, circuit.thresholds,
+                bit_nodes, scc_mask, frozen,
+            )
+            start0 = self.checkpoint.resume_position(total, fingerprint)
             if start0:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
@@ -184,7 +194,7 @@ class TpuSweepBackend:
                 # The last program may overshoot `total` (ramped coverage is
                 # not a divisor of it); clamp or resume_position would reject
                 # the record and restart the whole sweep.
-                self.checkpoint.record(min(start + coverage, total), total)
+                self.checkpoint.record(min(start + coverage, total), total, fingerprint)
             return False
 
         start = start0

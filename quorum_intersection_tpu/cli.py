@@ -19,7 +19,8 @@ Contract parity (SURVEY.md §2.2):
 Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 ``--scc-select``, ``--scope-scc``, ``--seed``, ``--randomized``, ``--compat``
 (reference-bug-compatible shorthand: alias0 dangling + front SCC selection),
-``--timing``.
+``--timing``, ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
+profiler trace).
 """
 
 from __future__ import annotations
@@ -85,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compat", action="store_true",
                    help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
     p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
+    p.add_argument("--checkpoint", metavar="PATH", default=None,
+                   help="checkpoint file for long sweeps: progress is recorded there and "
+                        "an interrupted run resumes instead of restarting")
+    p.add_argument("--profile-dir", metavar="DIR", default=None,
+                   help="record a jax profiler trace of the solve into DIR "
+                        "(open with TensorBoard/XProf)")
     return p
 
 
@@ -132,21 +139,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.seed is not None or args.randomized
     ):
         backend_options = {"seed": args.seed, "randomized": True}
+    if args.checkpoint is not None:
+        if args.backend not in ("auto", "tpu", "tpu-sweep"):
+            sys.stderr.write("--checkpoint requires a sweep-capable backend (auto/tpu/tpu-sweep)\n")
+            return 1
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        backend_options["checkpoint"] = SweepCheckpoint(args.checkpoint)
     try:
         backend = get_backend(args.backend, **backend_options)
     except (ImportError, ValueError) as exc:
         sys.stderr.write(f"backend {args.backend!r} unavailable: {exc}\n")
         return 1
 
-    result = solve_graph(
-        graph,
-        backend=backend,
-        verbose=args.verbose,
-        out=sys.stdout,
-        graphviz=args.graph,
-        scc_select=scc_select,
-        scope_to_scc=args.scope_scc,
-    )
+    from quorum_intersection_tpu.utils.profiling import profile_trace
+
+    with profile_trace(args.profile_dir):
+        result = solve_graph(
+            graph,
+            backend=backend,
+            verbose=args.verbose,
+            out=sys.stdout,
+            graphviz=args.graph,
+            scc_select=scc_select,
+            scope_to_scc=args.scope_scc,
+        )
 
     if args.timing:
         for name, seconds in result.timers.items():

@@ -5,24 +5,45 @@ Sharded candidate sweeps over 2^30 subsets run for minutes; checkpointing the
 sweep frontier lets a preempted run resume instead of restarting (the
 TPU-pod-world equivalent of training-step checkpointing).
 
-The checkpoint is deliberately tiny — a JSON ``{position, total}`` pair —
-because the sweep is deterministic: position fully describes progress.
-Written atomically (tmp + rename) so a crash mid-write never corrupts it.
-A stale file whose ``total`` disagrees with the current enumeration is
-ignored: it belongs to a different problem.
+The checkpoint is deliberately tiny — a JSON ``{position, total,
+fingerprint}`` triple — because the sweep is deterministic: position fully
+describes progress *for a given problem*.  The fingerprint is a hash of the
+exact enumeration (circuit tables, bit-node order, masks), so a stale file
+from a *different* FBAS that happens to share the same enumeration size is
+never resumed — resuming it would silently skip candidates ``[0, position)``
+and could flip the verdict.  Written atomically (tmp + rename) so a crash
+mid-write never corrupts it.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Union
+from typing import Optional, Union
+
+import numpy as np
 
 from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("utils.checkpoint")
+
+
+def sweep_fingerprint(*arrays) -> str:
+    """Stable hash of the enumeration identity: feed the circuit tables,
+    bit-node order, and availability masks; any difference ⇒ new problem."""
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"\x00none")
+            continue
+        arr = np.ascontiguousarray(a)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:32]
 
 
 @dataclass
@@ -32,7 +53,7 @@ class SweepCheckpoint:
     def __post_init__(self) -> None:
         self.path = Path(self.path)
 
-    def resume_position(self, total: int) -> int:
+    def resume_position(self, total: int, fingerprint: Optional[str] = None) -> int:
         """Last recorded block-aligned position, or 0 if absent/mismatched."""
         try:
             data = json.loads(self.path.read_text())
@@ -41,12 +62,18 @@ class SweepCheckpoint:
         if data.get("total") != total:
             log.info("checkpoint total %s != current %d; ignoring", data.get("total"), total)
             return 0
+        if fingerprint is not None and data.get("fingerprint") != fingerprint:
+            log.info("checkpoint belongs to a different problem; ignoring")
+            return 0
         pos = int(data.get("position", 0))
         return pos if 0 <= pos <= total else 0
 
-    def record(self, position: int, total: int) -> None:
+    def record(self, position: int, total: int, fingerprint: Optional[str] = None) -> None:
         tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps({"position": position, "total": total}))
+        payload = {"position": position, "total": total}
+        if fingerprint is not None:
+            payload["fingerprint"] = fingerprint
+        tmp.write_text(json.dumps(payload))
         os.replace(tmp, self.path)
 
     def clear(self) -> None:

@@ -1,0 +1,32 @@
+"""Profiler hook — structured device traces for the solver phases.
+
+The reference's only observability is boost::log trace spew
+(`/root/reference/quorum_intersection.cpp:735-742`); the TPU-native
+equivalent (SURVEY.md §5 "tracing/profiling") is a `jax.profiler` trace the
+user can open in TensorBoard/XProf: device kernel timelines and HBM usage.
+
+Usage: ``with profile_trace(dir):`` around any solve; no-op when ``dir`` is
+falsy, so callers can pass the CLI flag straight through.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("utils.profiling")
+
+
+@contextmanager
+def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Record a ``jax.profiler`` trace into ``trace_dir`` (no-op if falsy)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    log.info("recording jax profiler trace to %s", trace_dir)
+    with jax.profiler.trace(str(trace_dir)):
+        yield

@@ -107,3 +107,37 @@ def test_golden_fixture_cli_contract(ref_fixture, name, expected_out, expected_c
     proc = run_cli(["--backend", "python"], data)
     assert proc.stdout.strip() == expected_out
     assert proc.returncode == expected_code
+
+
+def test_checkpoint_flag(tmp_path):
+    # A completed sweep clears its checkpoint; the flag must round-trip
+    # through backend construction without disturbing the verdict.
+    ck = tmp_path / "sweep.ckpt"
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--checkpoint", str(ck)],
+        _json(majority_fbas(5)),
+    )
+    assert proc.stdout.strip() == "true"
+    assert proc.returncode == 0
+    assert not ck.exists()  # cleared on completion
+
+
+def test_checkpoint_flag_requires_sweep_backend(tmp_path):
+    proc = run_cli(
+        ["--backend", "python", "--checkpoint", str(tmp_path / "x")],
+        _json(majority_fbas(3)),
+    )
+    assert proc.returncode == 1
+    assert "sweep-capable" in proc.stderr
+
+
+def test_profile_dir_flag(tmp_path):
+    trace = tmp_path / "trace"
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--profile-dir", str(trace)],
+        _json(majority_fbas(5)),
+    )
+    assert proc.stdout.strip() == "true"
+    assert proc.returncode == 0
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the trace dir
+    assert any(trace.rglob("*.xplane.pb"))

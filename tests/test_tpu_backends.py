@@ -99,23 +99,46 @@ class TestSweepSpecifics:
     def test_checkpoint_resume(self, tmp_path):
         from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
 
-        ckpt = SweepCheckpoint(tmp_path / "sweep.json")
+        class RecordingCkpt(SweepCheckpoint):
+            """Keeps every record() payload so the test can learn the real
+            problem fingerprint (cleared files don't survive completion)."""
+
+            def __post_init__(self):
+                super().__post_init__()
+                self.history = []
+
+            def record(self, position, total, fingerprint=None):
+                self.history.append((position, total, fingerprint))
+                super().record(position, total, fingerprint)
+
+        ckpt = RecordingCkpt(tmp_path / "sweep.json")
         # Small batches force multiple steps on a safe network so the
         # checkpoint records progress (broken ones exit on the first hit).
         backend = TpuSweepBackend(batch=16, checkpoint=ckpt)
         data = majority_fbas(9)
         res = solve(data, backend=backend)
         assert res.intersects
+        assert ckpt.history
         # finished runs clear their checkpoint
         assert ckpt.resume_position(1 << 8) == 0
 
-        # simulate a preempted run: record a midpoint, resume skips it
+        # simulate a preempted run: re-record a midpoint with the true
+        # fingerprint; the resumed sweep skips the prefix
         total = 1 << 8
-        ckpt.record(128, total)
+        fingerprint = ckpt.history[-1][2]
+        ckpt.record(128, total, fingerprint)
         backend2 = TpuSweepBackend(batch=16, checkpoint=ckpt)
         res2 = solve(data, backend=backend2)
         assert res2.intersects
         assert res2.stats["candidates_checked"] <= total - 128 + 16
+
+        # a checkpoint from a DIFFERENT problem with the same enumeration
+        # size must be ignored — resuming it could skip the witness
+        ckpt.record(128, total, "bogus-fingerprint")
+        backend3 = TpuSweepBackend(batch=16, checkpoint=ckpt)
+        res3 = solve(data, backend=backend3)
+        assert res3.intersects
+        assert res3.stats["candidates_checked"] >= total
 
     def test_checkpoint_total_mismatch_ignored(self, tmp_path):
         from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
@@ -123,6 +146,16 @@ class TestSweepSpecifics:
         ckpt = SweepCheckpoint(tmp_path / "sweep.json")
         ckpt.record(100, 999)
         assert ckpt.resume_position(256) == 0
+
+    def test_checkpoint_fingerprint_mismatch_ignored(self, tmp_path):
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        ckpt = SweepCheckpoint(tmp_path / "sweep.json")
+        ckpt.record(100, 256, "aaaa")
+        assert ckpt.resume_position(256, "bbbb") == 0
+        assert ckpt.resume_position(256, "aaaa") == 100
+        # legacy/fingerprint-free lookups still work
+        assert ckpt.resume_position(256) == 100
 
     def test_single_node_scc(self):
         data = [{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}}]
