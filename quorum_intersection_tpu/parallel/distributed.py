@@ -1,0 +1,146 @@
+"""Multi-host (multi-process) execution — the DCN-scale half of the
+communication backend.
+
+The reference is a single process with no distributed backend at all
+(SURVEY.md §5); its NCCL/MPI-shaped obligation maps here to JAX's runtime
+collectives over a global device mesh:
+
+- **within a slice** the candidate-sweep's one collective (a scalar
+  ``pmin`` of first-hit indices per program, ``backends/tpu/sweep.py``)
+  rides ICI;
+- **across slices/hosts** the same collective crosses DCN — it is one int32
+  per device program, so DCN latency is irrelevant to throughput; candidate
+  blocks themselves never move between hosts (each device decodes its own
+  indices locally — zero-byte sharding of the enumeration axis).
+
+Multi-host SPMD contract of the sweep driver (why it is safe to reuse
+unchanged): every process runs the identical deterministic dispatch loop
+(same block schedule, same ramp), all processes enqueue the same programs in
+the same order, and each program's result is a *replicated* scalar
+(``out_specs=P()``), addressable by every process — so the host-side
+``int(handle)`` sync and the FIFO drain agree everywhere without any extra
+host-level coordination.
+
+Usage on a TPU pod/multi-slice job (one process per host)::
+
+    from quorum_intersection_tpu.parallel import distributed
+    distributed.initialize()            # env-driven on TPU pods
+    mesh = distributed.global_candidate_mesh()
+    backend = TpuSweepBackend(mesh=mesh, batch=1 << 20)
+
+Single-process runs (including the CPU host-platform emulation used in
+tests) are the degenerate case: ``initialize`` is a no-op and the global
+mesh equals the local one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from quorum_intersection_tpu.parallel.mesh import CANDIDATE_AXIS, candidate_mesh
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.distributed")
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join the multi-process JAX runtime (idempotent).
+
+    With no arguments, relies on the TPU pod environment (JAX autodetects
+    coordinator/process topology on Cloud TPU); arguments override for
+    manual GPU/CPU multi-process setups.  A second call, or a call in a
+    plainly single-process environment, is a no-op — so library code can
+    call this unconditionally.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if jax.process_count() > 1:  # some launcher already initialized the runtime
+        _initialized = True
+        return
+    if coordinator_address is None and num_processes is None:
+        import os
+
+        # No explicit topology and no multi-host pod environment ⇒ single
+        # process.  TPU_WORKER_HOSTNAMES counts only with >1 entry (tunneled
+        # single-chip images export it as "localhost").
+        workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multihost_env = len([w for w in workers.split(",") if w.strip()]) > 1 or any(
+            k in os.environ
+            for k in ("MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+        )
+        if not multihost_env:
+            log.debug("single-process environment; distributed init skipped")
+            _initialized = True
+            return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError as exc:
+        # Most common cause: the XLA backend was already touched (device
+        # query / computation) before init.  Proceeding single-process is
+        # the only option left; make it loud.
+        log.warning("distributed init unavailable (%s); continuing single-process", exc)
+    _initialized = True
+    log.info(
+        "distributed runtime up: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_candidate_mesh(axis_name: str = CANDIDATE_AXIS):
+    """1-D mesh over ALL global devices, ordered host-major.
+
+    Host-major order (`jax.devices()` is already process-grouped) keeps each
+    host's contiguous run of candidate blocks on its own local devices — the
+    index→device mapping never makes DCN carry anything except the final
+    scalar reduction.
+    """
+    import jax
+
+    return candidate_mesh(devices=list(jax.devices()), axis_name=axis_name)
+
+
+def hybrid_candidate_mesh(axis_name: str = CANDIDATE_AXIS):
+    """Like :func:`global_candidate_mesh` but orders devices via
+    ``mesh_utils.create_hybrid_device_mesh`` (ICI-adjacent within a slice,
+    DCN across slices) before flattening into the single candidate axis.
+    Falls back to the plain global mesh when topology metadata is
+    unavailable (CPU emulation, single slice)."""
+    import jax
+
+    try:
+        from jax.experimental import mesh_utils
+
+        devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(len(jax.local_devices()),),
+            dcn_mesh_shape=(jax.process_count(),),
+            devices=jax.devices(),
+        )
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(devs).reshape(-1), axis_names=(axis_name,))
+    except Exception as exc:  # noqa: BLE001 - topology metadata absent
+        log.debug("hybrid mesh unavailable (%s); using global mesh", exc)
+        return global_candidate_mesh(axis_name)

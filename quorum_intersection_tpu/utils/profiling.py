@@ -25,7 +25,12 @@ def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
     if not trace_dir:
         yield
         return
-    import jax
+    try:
+        import jax
+    except ImportError as exc:  # jax-less install + pure-CPU backend
+        log.warning("profiling disabled: jax unavailable (%s)", exc)
+        yield
+        return
 
     log.info("recording jax profiler trace to %s", trace_dir)
     with jax.profiler.trace(str(trace_dir)):

@@ -81,3 +81,40 @@ def test_graft_dryrun_multichip():
     assert hit.shape == (256,)
     assert q_size.shape == (256,)
     assert not bool(np.asarray(hit).any())  # flagship problem is a safe network
+
+
+class TestDistributed:
+    """Single-process degenerate behavior of the multi-host helpers (the
+    multi-process paths need a real pod; these pin the contracts that hold
+    everywhere)."""
+
+    def test_initialize_noop_single_process(self):
+        from quorum_intersection_tpu.parallel import distributed
+
+        distributed.initialize()  # must not raise or block
+        assert distributed.is_multihost() is False
+        distributed.initialize()  # idempotent
+
+    def test_global_mesh_covers_all_devices(self):
+        from quorum_intersection_tpu.parallel import distributed
+
+        mesh = distributed.global_candidate_mesh()
+        assert mesh.devices.size == len(jax.devices())
+        assert mesh.axis_names == ("candidates",)
+
+    @needs_8_devices
+    def test_hybrid_mesh_falls_back_cleanly(self):
+        from quorum_intersection_tpu.parallel import distributed
+
+        mesh = distributed.hybrid_candidate_mesh()
+        assert mesh.devices.size == len(jax.devices())
+
+    @needs_8_devices
+    def test_sweep_on_global_mesh(self):
+        from quorum_intersection_tpu.parallel import distributed
+
+        backend = TpuSweepBackend(batch=64, mesh=distributed.global_candidate_mesh())
+        assert solve(majority_fbas(9), backend=backend).intersects is True
+        backend = TpuSweepBackend(batch=64, mesh=distributed.global_candidate_mesh())
+        res = solve(majority_fbas(9, broken=True), backend=backend)
+        assert res.intersects is False
