@@ -121,6 +121,28 @@ def tpu_throughput(circuit, batch: int, steps: int, chunks: int = 32) -> float:
     return batch * chunks * steps / seconds
 
 
+def sweep_verdict(n_nodes: int) -> dict:
+    """Time-to-verdict for a FULL exhaustive sweep of a safe n-node majority
+    FBAS (2^(n-1) candidates) through the production sweep backend — the
+    headline end-to-end number.  The Python re-model of the reference's B&B
+    timed out (>110 s) at n=24 (BASELINE.md); this sweeps n=31's 1.07e9
+    candidates exhaustively in seconds."""
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    t0 = time.perf_counter()
+    res = solve(majority_fbas(n_nodes), backend=TpuSweepBackend())
+    seconds = time.perf_counter() - t0
+    assert res.intersects is True
+    return {
+        "sweep_nodes": n_nodes,
+        "sweep_candidates": res.stats["candidates_checked"],
+        "sweep_seconds": round(seconds, 2),
+        "sweep_device_cand_per_sec": round(res.stats["candidates_per_sec"], 1),
+    }
+
+
 def cpu_baseline(graph, samples: int) -> tuple:
     """Single-core candidates/sec through the same check on the host oracle.
 
@@ -152,7 +174,27 @@ def cpu_baseline(graph, samples: int) -> tuple:
     return samples / seconds, "python-single-core"
 
 
+def _honor_platform_env() -> None:
+    """Respect a user-set JAX_PLATFORMS that excludes axon.
+
+    This image's sitecustomize force-appends the axon platform to
+    jax.config.jax_platforms at interpreter start, which would silently
+    override ``JAX_PLATFORMS=cpu python bench.py --quick`` (and hang if the
+    tunnel is down).  Re-pin before the first backend query.
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want or "axon" in want:
+        return
+    import jax
+
+    if "axon" in (jax.config.jax_platforms or ""):
+        jax.config.update("jax_platforms", want)
+
+
 def main() -> int:
+    _honor_platform_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true", help="small smoke-test shapes")
     parser.add_argument("--batch", type=int, default=None, help="candidates per block")
@@ -168,11 +210,15 @@ def main() -> int:
 
     if args.quick:
         n_orgs, per_org, batch, steps, chunks, samples = 4, 4, 256, 2, 2, 10
+        sweep_nodes = 13
     else:
-        # 32k-candidate blocks, 32 blocks per device program: one program is
-        # ~1M candidates, big enough that the fixed per-program dispatch
-        # overhead on a tunneled chip is noise (kernels.py module docs).
-        n_orgs, per_org, batch, steps, chunks, samples = 16, 16, 32768, 24, 32, 40
+        # 32k-candidate blocks, 128 blocks per device program: one program is
+        # ~4M candidates, big enough that the fixed per-program dispatch
+        # overhead on a tunneled chip is noise (kernels.py module docs);
+        # all `steps` programs dispatch asynchronously so the tunnel RTT
+        # overlaps with device compute (sweep.py MAX_INFLIGHT rationale).
+        n_orgs, per_org, batch, steps, chunks, samples = 16, 16, 32768, 24, 128, 40
+        sweep_nodes = 31
     if args.batch is not None:
         batch = args.batch
     if args.steps is not None:
@@ -183,6 +229,7 @@ def main() -> int:
     graph, circuit = build_workload(n_orgs, per_org)
     tpu_rate = tpu_throughput(circuit, batch, steps, chunks)
     cpu_rate, baseline_kind = cpu_baseline(graph, samples)
+    sweep_stats = sweep_verdict(sweep_nodes)
 
     import jax
 
@@ -200,6 +247,7 @@ def main() -> int:
                 "chunks": chunks,
                 "device": jax.devices()[0].device_kind,
                 "parity": "4/4 fixtures",
+                **sweep_stats,
             }
         )
     )

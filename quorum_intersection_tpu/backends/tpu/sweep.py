@@ -40,19 +40,54 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.sweep")
 
-DEFAULT_BATCH = 32768  # dispatch latency dominates below ~32k candidates/step
+DEFAULT_BATCH = None  # adaptive: see _auto_batch (dispatch latency dominates
+# below ~32k candidates/step; small circuits sustain much larger blocks)
 DEFAULT_MAX_BITS = 30  # 2^30 candidates ≈ 1.07e9 — the practical sweep ceiling
-MAX_INFLIGHT = 4  # device steps queued ahead of the host sync point
+# Deep pipeline: the tunneled chip's round-trip latency is ~100 ms while a
+# full-ramp program's device time is ~10-35 ms, so the queue must hold many
+# programs to keep the device busy (measured: 4 in flight → ~68M cand/s on a
+# 2^30 sweep; 32 in flight → near device-saturation ~1G cand/s on a 31-node
+# circuit).  Cost of depth: on a hit, up to max_inflight programs of device
+# work are discarded, and a preempted run resumes from the oldest undrained
+# program — both bounded by ~1 s of device work at full ramp.
+MAX_INFLIGHT = 32
 # A device program has a fixed multi-ms overhead regardless of content
 # (kernels.py module docs), so as the enumeration proves large the driver
 # ramps the number of sweep blocks packed per program through these values —
 # small sweeps never pay the compile time of the big shapes, exhaustive
-# sweeps amortize dispatch to noise (measured ~40× end-to-end on 2^30).
-STEPS_RAMP = (1, 8, 64, 256)
+# sweeps amortize dispatch to noise.
+STEPS_RAMP = (1, 8, 64, 256, 1024)
+# Dispatches at the current ramp level before growing to the next: keeps the
+# ramp gradual (fine checkpoint granularity early) without tying it to the
+# pipeline depth.
+RAMP_DISPATCHES = 4
 
 
 class SccTooLargeError(ValueError):
     """Raised when the SCC exceeds the sweep's enumeration width."""
+
+
+def _pallas_ok(circuit: Circuit) -> bool:
+    """Pallas engine eligibility; ineligible circuits (int8-overflowing vote
+    counts) fall back to the XLA path as pallas_sweep's docs promise."""
+    from quorum_intersection_tpu.backends.tpu import pallas_sweep
+
+    if pallas_sweep.pallas_supported(circuit):
+        return True
+    log.warning("pallas engine unsupported for this circuit; using XLA path")
+    return False
+
+
+def _auto_batch(n: int) -> int:
+    """Candidates per sweep block, scaled to the circuit's lane width.
+
+    Small circuits (n ≤ 128 → one 128-lane tile) sustain 512k-row blocks
+    (measured 2.6× over 32k rows on a 31-node 2^30 sweep — per-block fixed
+    costs amortize); wider circuits shrink the row count to keep the
+    per-sweep working set roughly constant.
+    """
+    lanes = 128 * ((max(n, 1) + 127) // 128)
+    return min(1 << 19, max(1 << 15, (1 << 26) // lanes))
 
 
 class TpuSweepBackend:
@@ -63,15 +98,23 @@ class TpuSweepBackend:
 
     def __init__(
         self,
-        batch: int = DEFAULT_BATCH,
+        batch: Optional[int] = DEFAULT_BATCH,
         max_bits: int = DEFAULT_MAX_BITS,
         mesh=None,
         checkpoint=None,
+        max_inflight: int = MAX_INFLIGHT,
+        engine: str = "xla",
     ) -> None:
-        self.batch = batch
+        self.batch = batch  # None ⇒ _auto_batch(circuit.n) at check time
         self.max_bits = max_bits
         self.mesh = mesh
         self.checkpoint = checkpoint  # utils.checkpoint.SweepCheckpoint or None
+        self.max_inflight = max_inflight
+        # "xla" (default — measured fastest end-to-end, see pallas_sweep
+        # module docs) or "pallas" (fused single-kernel engine).
+        if engine not in ("xla", "pallas"):
+            raise ValueError(f"unknown sweep engine {engine!r}")
+        self.engine = engine
 
     # ---- host-side witness reconstruction -------------------------------
 
@@ -111,6 +154,9 @@ class TpuSweepBackend:
     ) -> SccCheckResult:
         if circuit is None:
             raise ValueError("sweep backend requires the encoded circuit")
+        from quorum_intersection_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         s = len(scc)
         bits = s - 1
         if bits > self.max_bits:
@@ -144,14 +190,22 @@ class TpuSweepBackend:
             if start0:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
+        batch = self.batch if self.batch is not None else _auto_batch(circuit.n)
         if self.mesh is not None:
             base_block, make_dispatch = self._build_sharded_step(
-                circuit, bit_nodes, scc_mask, frozen
+                circuit, bit_nodes, scc_mask, frozen, batch
+            )
+        elif self.engine == "pallas" and _pallas_ok(circuit):
+            from quorum_intersection_tpu.backends.tpu import pallas_sweep
+
+            base_block, _ = pallas_sweep.plan_batch(min(batch, max(total, 1)))
+            make_dispatch = pallas_sweep.pallas_sweep_program_factory(
+                circuit, bit_nodes, scc_mask, frozen, base_block
             )
         else:
             from quorum_intersection_tpu.backends.tpu.kernels import sweep_program_factory
 
-            base_block = min(self.batch, max(total, 1))
+            base_block = min(batch, max(total, 1))
             # Device constants upload once; each ramp level only compiles.
             make_dispatch = sweep_program_factory(
                 circuit, bit_nodes, scc_mask, frozen, base_block
@@ -199,19 +253,18 @@ class TpuSweepBackend:
 
         start = start0
         ramp_ix = 0
-        since_ramp = 0  # dispatches at the current level: one full pipeline
-        # of programs must run at each level before growing to the next, so
-        # the ramp is gradual (1 → 8 → 64 → …) and an early hit or crash
-        # near the start never has to sync/lose a maximum-size program.
+        since_ramp = 0  # dispatches at the current level: RAMP_DISPATCHES
+        # programs must run at each level before growing to the next, so the
+        # ramp is gradual (1 → 8 → 64 → …) and an early hit or crash near
+        # the start never has to sync/lose a maximum-size program.
         while start < total:
-            # Grow the program only once the remaining work would keep the
-            # pipeline full at the next size (never compile shapes a small
-            # sweep won't use).
+            # Grow the program only once the remaining work would fill at
+            # least a couple of programs at the next size (never compile
+            # shapes a small sweep won't use).
             if (
                 ramp_ix + 1 < len(STEPS_RAMP)
-                and since_ramp >= MAX_INFLIGHT
-                and total - start
-                >= STEPS_RAMP[ramp_ix + 1] * base_block * MAX_INFLIGHT
+                and since_ramp >= RAMP_DISPATCHES
+                and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
             ):
                 ramp_ix += 1
                 since_ramp = 0
@@ -219,7 +272,7 @@ class TpuSweepBackend:
             inflight.append((start, coverage, dispatch(start, STEPS_RAMP[ramp_ix])))
             since_ramp += 1
             start += coverage
-            if len(inflight) >= MAX_INFLIGHT and drain_one():
+            if len(inflight) >= self.max_inflight and drain_one():
                 break
         while first_hit >= int(INT32_MAX) and inflight:
             if drain_one():
@@ -251,7 +304,7 @@ class TpuSweepBackend:
 
     # ---- sharded step ----------------------------------------------------
 
-    def _build_sharded_step(self, circuit, bit_nodes, scc_mask, frozen):
+    def _build_sharded_step(self, circuit, bit_nodes, scc_mask, frozen, batch):
         """Mesh-sharded sweep step: each device takes a contiguous sub-block
         (``steps_per_call`` of them per program), hit indices combine with one
         pmin collective.  Returns ``(base_block, make_dispatch)`` matching the
@@ -269,7 +322,7 @@ class TpuSweepBackend:
         mesh = self.mesh
         axis = mesh.axis_names[0]
         n_dev = mesh.devices.size
-        per_dev = max(self.batch // n_dev, 1)
+        per_dev = max(batch // n_dev, 1)
         base_block = per_dev * n_dev
 
         arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(

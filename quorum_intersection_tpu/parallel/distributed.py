@@ -66,9 +66,17 @@ def initialize(
         return
     import jax
 
-    if jax.process_count() > 1:  # some launcher already initialized the runtime
-        _initialized = True
-        return
+    # Probe whether a launcher already brought the distributed runtime up
+    # WITHOUT touching the XLA backend: jax.process_count() would initialize
+    # backends and then guarantee jax.distributed.initialize() below raises.
+    try:
+        from jax._src.distributed import global_state as _dist_state
+
+        if getattr(_dist_state, "client", None) is not None:
+            _initialized = True
+            return
+    except ImportError:  # pragma: no cover - private module moved
+        pass
     if coordinator_address is None and num_processes is None:
         import os
 

@@ -1,0 +1,49 @@
+"""Persistent XLA compilation cache.
+
+A sweep run compiles one program per ramp level it reaches
+(`backends/tpu/sweep.py` STEPS_RAMP) — several seconds each on a tunneled
+chip, re-paid on every fresh process because jit caches die with it.  The
+persistent cache amortizes those compiles across processes/runs: warm-cache
+time-to-verdict on a 2^30 sweep drops by the full compile budget.
+
+Opt-out with ``QI_NO_COMPILE_CACHE=1``; relocate with
+``JAX_COMPILATION_CACHE_DIR`` (jax's own env var, which jax reads itself —
+we only install a default when the user hasn't chosen).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("utils.compile_cache")
+
+_installed = False
+
+
+def enable_compilation_cache() -> None:
+    """Install a persistent compilation cache (idempotent, best-effort)."""
+    global _installed
+    if _installed or os.environ.get("QI_NO_COMPILE_CACHE"):
+        return
+    _installed = True
+    try:
+        import jax
+
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            return  # user configured jax directly; nothing to do
+        cache_dir = Path(
+            os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
+        ) / "quorum_intersection_tpu" / "jax_cache"
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # Cache every kernel: sweep programs are few and large-ish, and the
+        # default min-entry/compile-time thresholds would skip the small
+        # early-ramp programs that gate a resumed run's first results.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        log.debug("persistent compilation cache at %s", cache_dir)
+    except Exception as exc:  # noqa: BLE001 - cache is an optimization only
+        log.info("compilation cache unavailable: %s", exc)

@@ -1,0 +1,116 @@
+"""Differential tests: the fused Pallas sweep engine must agree with the XLA
+sweep path program-for-program (same min-hit-index contract) and end-to-end.
+
+On CPU the kernel runs in pallas interpret mode (pallas_sweep auto-detects
+the backend), so these tests validate the kernel logic without TPU hardware —
+the TPU-side compile is exercised by the benchmarks on the real chip.
+"""
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends.tpu import pallas_sweep
+from quorum_intersection_tpu.backends.tpu.kernels import sweep_program_factory
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.encode.circuit import encode_circuit
+from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+from quorum_intersection_tpu.pipeline import solve
+
+
+def _sweep_inputs(data):
+    graph = build_graph(parse_fbas(data))
+    circuit = encode_circuit(graph)
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    sccs = group_sccs(graph.n, comp, count)
+    scc = next(
+        m
+        for m in sccs
+        if max_quorum(graph, m, [v in set(m) for v in range(graph.n)])
+    )
+    n = circuit.n
+    scc_mask = np.zeros(n, dtype=np.float32)
+    scc_mask[scc] = 1.0
+    frozen = 1.0 - scc_mask
+    bit_nodes = np.asarray(scc[1:], dtype=np.int32)
+    return circuit, bit_nodes, scc_mask, frozen
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        majority_fbas(9),
+        majority_fbas(10, broken=True),
+        hierarchical_fbas(4, 3),  # nested inner sets (depth ≥ 1)
+        hierarchical_fbas(3, 3, broken=True),
+    ],
+    ids=["maj-safe", "maj-broken", "hier-safe", "hier-broken"],
+)
+def test_program_parity_with_xla(data):
+    circuit, bit_nodes, scc_mask, frozen = _sweep_inputs(data)
+    total = 1 << len(bit_nodes)
+    batch, _ = pallas_sweep.plan_batch(min(total, 128))
+    xla = sweep_program_factory(circuit, bit_nodes, scc_mask, frozen, batch)(1)
+    pal = pallas_sweep.pallas_sweep_program_factory(
+        circuit, bit_nodes, scc_mask, frozen, batch
+    )(1)
+    for start in range(0, total, batch):
+        assert int(xla(start)) == int(pal(start)), f"divergence at start={start}"
+
+
+def test_program_parity_multi_step():
+    circuit, bit_nodes, scc_mask, frozen = _sweep_inputs(majority_fbas(11, broken=True))
+    batch, _ = pallas_sweep.plan_batch(64)
+    xla = sweep_program_factory(circuit, bit_nodes, scc_mask, frozen, batch)(4)
+    pal = pallas_sweep.pallas_sweep_program_factory(
+        circuit, bit_nodes, scc_mask, frozen, batch
+    )(4)
+    assert int(xla(0)) == int(pal(0))
+
+
+@pytest.mark.parametrize("broken", [False, True])
+def test_backend_end_to_end(broken):
+    data = majority_fbas(9, broken=broken)
+    res = solve(data, backend=TpuSweepBackend(batch=64, engine="pallas"))
+    assert res.intersects is (not broken)
+    if broken:
+        assert res.q1 and res.q2
+        assert not set(res.q1) & set(res.q2)
+
+
+def test_unsupported_circuit_rejected():
+    # >127 repeats of one validator would overflow int8 votes
+    data = [
+        {
+            "publicKey": "A",
+            "quorumSet": {"threshold": 1, "validators": ["A"] * 130},
+        }
+    ]
+    graph = build_graph(parse_fbas(data))
+    circuit = encode_circuit(graph)
+    assert not pallas_sweep.pallas_supported(circuit)
+    with pytest.raises(ValueError):
+        pallas_sweep.pallas_sweep_program_factory(
+            circuit, np.asarray([], dtype=np.int32), np.ones(1, np.float32), None, 32
+        )
+
+
+def test_plan_batch_contract():
+    for req in (1, 16, 32, 100, 1024, 5000, 32768):
+        batch, block = pallas_sweep.plan_batch(req)
+        assert batch % block == 0
+        assert block % 32 == 0
+        assert batch >= req
+
+
+def test_engine_falls_back_for_unsupported_circuit():
+    # backend-level contract: engine="pallas" still solves int8-overflow
+    # circuits by degrading to the XLA path
+    data = [
+        {"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"] * 130 + ["B"]}},
+        {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["A"]}},
+    ]
+    res = solve(data, backend=TpuSweepBackend(engine="pallas"))
+    assert res.intersects is True
