@@ -174,27 +174,10 @@ def cpu_baseline(graph, samples: int) -> tuple:
     return samples / seconds, "python-single-core"
 
 
-def _honor_platform_env() -> None:
-    """Respect a user-set JAX_PLATFORMS that excludes axon.
-
-    This image's sitecustomize force-appends the axon platform to
-    jax.config.jax_platforms at interpreter start, which would silently
-    override ``JAX_PLATFORMS=cpu python bench.py --quick`` (and hang if the
-    tunnel is down).  Re-pin before the first backend query.
-    """
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if not want or "axon" in want:
-        return
-    import jax
-
-    if "axon" in (jax.config.jax_platforms or ""):
-        jax.config.update("jax_platforms", want)
-
-
 def main() -> int:
-    _honor_platform_env()
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true", help="small smoke-test shapes")
     parser.add_argument("--batch", type=int, default=None, help="candidates per block")

@@ -52,31 +52,40 @@ def _so_path() -> Path:
     return _BUILD_DIR / f"qi_oracle-{digest}.so"
 
 
-def build_library(force: bool = False) -> Path:
-    """Compile ``qi_oracle.cpp`` → a content-hashed ``.so`` (idempotent)."""
-    so = _so_path()
-    if so.exists() and not force:
-        return so
+def _compile(out: Path, sources, flags, what: str, force: bool) -> Path:
+    """Shared g++ driver: idempotent content-hashed artifact, tmp-file +
+    atomic rename (concurrent builders use distinct tmp names)."""
+    if out.exists() and not force:
+        return out
     _BUILD_DIR.mkdir(exist_ok=True)
-    tmp = so.with_suffix(f".so.tmp{os.getpid()}")
-    cmd = [
-        "g++",
-        "-std=c++17",
-        "-O3",
-        "-fPIC",
-        "-shared",
-        "-o",
-        str(tmp),
-        str(_SRC),
-    ]
-    log.info("building native oracle: %s", " ".join(cmd))
+    tmp = out.with_name(out.name + f".tmp{os.getpid()}")
+    cmd = ["g++", "-std=c++17", *flags, "-o", str(tmp), *map(str, sources)]
+    log.info("building %s: %s", what, " ".join(cmd))
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
-        raise RuntimeError(
-            f"native oracle build failed (exit {proc.returncode}):\n{proc.stderr}"
-        )
-    tmp.replace(so)  # atomic rename; concurrent builders use distinct tmp names
-    return so
+        raise RuntimeError(f"{what} build failed (exit {proc.returncode}):\n{proc.stderr}")
+    tmp.replace(out)
+    return out
+
+
+def build_library(force: bool = False) -> Path:
+    """Compile ``qi_oracle.cpp`` → a content-hashed ``.so`` (idempotent)."""
+    return _compile(
+        _so_path(), [_SRC], ["-O3", "-fPIC", "-shared"], "native oracle", force
+    )
+
+
+_CLI_SRC = Path(__file__).with_name("qi_native.cpp")
+
+
+def build_native_cli(force: bool = False) -> Path:
+    """Compile the standalone native CLI (``qi_native.cpp`` + the oracle) →
+    a content-hashed binary, the framework's equivalent of the reference's
+    single-binary deployment (`/root/reference/quorum_intersection.cpp`
+    main, C21).  Idempotent; returns the binary path."""
+    digest = hashlib.sha256(_CLI_SRC.read_bytes() + _SRC.read_bytes()).hexdigest()[:16]
+    exe = _BUILD_DIR / f"qi_native-{digest}"
+    return _compile(exe, [_CLI_SRC, _SRC], ["-O2"], "native CLI", force)
 
 
 def _load() -> ctypes.CDLL:

@@ -290,6 +290,21 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
   return 1;
 }
 
+// Greatest-fixpoint quorum over `nodes` given an availability vector
+// (restored on return).  Exposed for the native CLI's per-SCC quorum scan
+// (pipeline parity with cpp:645-672) and for bindings that need the bare
+// fixpoint.  Returns the surviving-quorum length written to `out`.
+int32_t qi_max_quorum(int32_t n, const int32_t* roots, const int32_t* units,
+                      const int32_t* mem, const int32_t* inner,
+                      const int32_t* nodes, int32_t nodes_len, uint8_t* avail,
+                      int32_t* out) {
+  Graph g{n, nullptr, nullptr, roots, units, mem, inner};
+  std::vector<int32_t> vec(nodes, nodes + nodes_len);
+  std::vector<int32_t> q = max_quorum(g, std::move(vec), avail);
+  std::copy(q.begin(), q.end(), out);
+  return static_cast<int32_t>(q.size());
+}
+
 // Benchmark unit of work: for each availability mask (row of `masks`,
 // batch x n, row-major uint8), run the is-quorum greatest fixpoint and the
 // complement disjointness probe — the same per-candidate check the TPU sweep

@@ -96,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
 

@@ -1,0 +1,27 @@
+"""Platform selection hygiene.
+
+This image's sitecustomize force-appends the axon TPU platform to
+``jax.config.jax_platforms`` at interpreter start, which silently overrides a
+user-set ``JAX_PLATFORMS=cpu`` (axon wins priority and grabs the tunneled
+chip — or hangs when the tunnel is down).  Call :func:`honor_platform_env`
+before the first backend query to make the env var mean what it says.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-pin jax onto the platforms named by ``JAX_PLATFORMS`` when the
+    ambient config would override them (no-op otherwise; safe pre-query)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want or "axon" in want:
+        return
+    try:
+        import jax
+
+        if "axon" in (jax.config.jax_platforms or ""):
+            jax.config.update("jax_platforms", want)
+    except ImportError:  # pure-CPU installs have nothing to pin
+        pass

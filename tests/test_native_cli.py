@@ -1,0 +1,182 @@
+"""Standalone native CLI (`backends/cpp/qi_native.cpp`) — golden fixtures,
+exit-code contract, and byte-level differential against the Python CLI
+(which the rest of the suite pins to the reference contract, C21/C14-C16)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.backends.cpp import build_native_cli
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+
+
+@pytest.fixture(scope="module")
+def native():
+    try:
+        return str(build_native_cli())
+    except Exception as exc:  # pragma: no cover - g++ missing
+        pytest.skip(f"native CLI unavailable: {exc}")
+
+
+def run_native(native, args, stdin_data=""):
+    return subprocess.run(
+        [native] + args, input=stdin_data, capture_output=True, text=True
+    )
+
+
+def run_python(args, stdin_data=""):
+    return subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--backend", "python"]
+        + args,
+        input=stdin_data,
+        capture_output=True,
+        text=True,
+    )
+
+
+GOLDEN = [
+    ("correct_trivial.json", "true", 0),
+    ("broken_trivial.json", "false", 1),
+    ("correct.json", "true", 0),
+    ("broken.json", "false", 1),
+]
+
+
+@pytest.mark.parametrize("name,expected_out,expected_code", GOLDEN)
+def test_golden_fixtures(native, ref_fixture, name, expected_out, expected_code):
+    data = ref_fixture(name).read_text()
+    proc = run_native(native, [], data)
+    assert proc.stdout.strip() == expected_out
+    assert proc.returncode == expected_code
+
+
+def test_exit_code_contract(native):
+    assert run_native(native, ["-h"]).returncode == 0
+    bad = run_native(native, ["--definitely-not-a-flag"])
+    assert bad.returncode == 1
+    assert "Invalid option!" in bad.stdout
+    assert run_native(native, [], "not json").returncode == 1
+    # PageRank mode always exits 0
+    assert (
+        run_native(native, ["-p"], json.dumps(majority_fbas(3, broken=True))).returncode
+        == 0
+    )
+
+
+@pytest.mark.parametrize("name", [n for n, _, _ in GOLDEN])
+def test_verbose_matches_python_cli(native, ref_fixture, name):
+    data = ref_fixture(name).read_text()
+    n = run_native(native, ["-v"], data)
+    p = run_python(["-v"], data)
+    assert n.stdout == p.stdout
+    assert n.returncode == p.returncode
+
+
+def test_compat_mode_matches_python_cli(native, ref_fixture):
+    data = ref_fixture("correct.json").read_text()
+    n = run_native(native, ["-v", "--compat"], data)
+    p = run_python(["-v", "--compat"], data)
+    assert n.stdout == p.stdout
+
+
+def test_graphviz_matches_python_cli(native, ref_fixture):
+    data = ref_fixture("correct_trivial.json").read_text()
+    n = run_native(native, ["-g"], data)
+    p = run_python(["-g"], data)
+    assert n.stdout == p.stdout
+
+
+def test_pagerank_matches_python_numerically(native, ref_fixture):
+    data = ref_fixture("correct.json").read_text()
+    n = run_native(native, ["-p"], data)
+    p = run_python(["-p"], data)
+
+    def parse(out):
+        ranks = {}
+        for line in out.splitlines()[1:]:
+            label, _, value = line.rpartition(": ")
+            ranks[label] = float(value)
+        return ranks
+
+    rn, rp = parse(n.stdout), parse(p.stdout)
+    assert rn.keys() == rp.keys()
+    for k in rn:
+        assert rn[k] == pytest.approx(rp[k], rel=1e-4, abs=1e-7)
+
+
+@pytest.mark.parametrize(
+    "data,expected",
+    [
+        (majority_fbas(7), "true"),
+        (majority_fbas(7, broken=True), "false"),
+        (hierarchical_fbas(3, 3), "true"),
+        (hierarchical_fbas(3, 3, broken=True), "false"),
+    ],
+    ids=["maj-safe", "maj-broken", "hier-safe", "hier-broken"],
+)
+def test_synthetic_verdicts(native, data, expected):
+    proc = run_native(native, [], json.dumps(data))
+    assert proc.stdout.strip() == expected
+
+
+def test_randomized_tiebreak_verdict_stable(native, ref_fixture):
+    data = ref_fixture("broken.json").read_text()
+    for seed in (0, 1, 12345):
+        proc = run_native(native, ["--seed", str(seed)], data)
+        assert proc.stdout.strip() == "false"
+        assert proc.returncode == 1
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        '[]true',  # trailing garbage
+        json.dumps([{"publicKey": "A", "quorumSet": {"validators": ["A"]}}]),  # missing threshold
+        json.dumps([{"publicKey": "A", "quorumSet": {"threshold": "x", "validators": ["A"]}}]),
+        json.dumps([{"publicKey": "A", "quorumSet": {"threshold": 1.5, "validators": ["A"]}}]),
+        json.dumps([{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": [3]}}]),
+        json.dumps(
+            [
+                {"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}},
+                {"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}},
+            ]
+        ),  # duplicate publicKey
+    ],
+    ids=["trailing", "no-threshold", "str-threshold", "float-threshold",
+         "nonstr-validator", "dup-key"],
+)
+def test_rejects_what_python_rejects(native, payload):
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert n.returncode == 1
+    assert p.returncode == 1
+    assert "invalid FBAS configuration" in n.stderr
+    assert "invalid FBAS configuration" in p.stderr
+
+
+def test_accepts_numeric_string_threshold_like_python(native):
+    payload = json.dumps(
+        [{"publicKey": "A", "quorumSet": {"threshold": "1", "validators": ["A"]}}]
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode) == ("true\n", 0)
+
+
+def test_graphviz_escapes_label(native):
+    payload = json.dumps(
+        [{"publicKey": "A", "name": 'say "hi"',
+          "quorumSet": {"threshold": 1, "validators": ["A"]}}]
+    )
+    n = run_native(native, ["-g"], payload)
+    p = run_python(["-g"], payload)
+    assert n.stdout == p.stdout
+    assert '\\"hi\\"' in n.stdout
+
+
+def test_bad_numeric_flag_is_usage_error(native):
+    proc = run_native(native, ["-p", "-i", "abc"], "[]")
+    assert proc.returncode == 1
+    assert "Invalid option!" in proc.stdout
