@@ -69,8 +69,9 @@ struct JValue {
   std::vector<std::pair<std::string, JPtr>> obj;  // order-preserving
 
   const JValue* get(const std::string& key) const {
-    for (const auto& kv : obj) {
-      if (kv.first == key) return kv.second.get();
+    // Last occurrence wins on duplicate keys, like Python's json.loads.
+    for (auto it = obj.rbegin(); it != obj.rend(); ++it) {
+      if (it->first == key) return it->second.get();
     }
     return nullptr;
   }
@@ -208,6 +209,9 @@ struct JsonParser {
     std::string out;
     while (p < end && *p != '"') {
       char c = *p++;
+      if (static_cast<unsigned char>(c) < 0x20) {
+        fail("raw control character in string");  // json.loads parity
+      }
       if (c != '\\') {
         out.push_back(c);
         continue;
@@ -478,9 +482,13 @@ int32_t flatten_qset(const QSet& q, FlatGraph& g,
   const int32_t ie = static_cast<int32_t>(g.inner.size());
   int32_t* U = g.units.data() + 5 * unit;
   // Q3 normalization (fbas/semantics.py contract): threshold <= 0 ⇒ never
-  // satisfiable (members + inners + 1 can never be reached).
+  // satisfiable (members + inners + 1 can never be reached).  Thresholds
+  // above the member count are equally unsatisfiable — clamping them to the
+  // same sentinel also keeps huge int64 values exact in the int32 unit
+  // table (a raw cast would truncate and could flip the verdict).
   const int64_t m_count = (me - mb) + (ie - ib);
-  U[0] = static_cast<int32_t>(q.threshold <= 0 ? m_count + 1 : q.threshold);
+  const int64_t t = q.threshold;
+  U[0] = static_cast<int32_t>((t <= 0 || t > m_count) ? m_count + 1 : t);
   U[1] = mb;
   U[2] = me;
   U[3] = ib;
@@ -705,8 +713,10 @@ int main(int argc, char** argv) {
     const std::string a = argv[i];
     auto next = [&](const char* what) -> const char* {
       if (i + 1 >= argc) {
-        std::cerr << "missing value for " << what << "\n";
-        std::exit(1);
+        // Same surface as argparse's missing-value error (stdout, usage,
+        // exit 1 — _RefCompatParser contract).
+        (void)what;
+        std::exit(invalid_option());
       }
       return argv[++i];
     };

@@ -216,6 +216,7 @@ def sweep_step(
     pos: jnp.ndarray,
     scc_mask: jnp.ndarray,
     frozen: jnp.ndarray,
+    hi_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Evaluate one contiguous block of candidate subsets.
 
@@ -225,11 +226,16 @@ def sweep_step(
     verdict-equivalence argument).
 
     ``pos``: (n,) int32 from :func:`bit_positions`; ``scc_mask``/``frozen``:
-    (n,) 0/1 in ``arrays.dtype``.  Returns ``(hit, q_size)``: (B,) bool hit
-    flags and (B,) int32 quorum sizes (diagnostics).  Witness reconstruction
-    happens on the host from the first hit index.
+    (n,) 0/1 in ``arrays.dtype``.  ``hi_mask``: optional (n,) 0/1 row of
+    additionally-available nodes — the *high bits* of a wide (>2^31)
+    enumeration, constant across the block (sweep.py two-level decode).
+    Returns ``(hit, q_size)``: (B,) bool hit flags and (B,) int32 quorum
+    sizes (diagnostics).  Witness reconstruction happens on the host from
+    the first hit index.
     """
     avail = decode_masks(start, batch, pos, arrays.dtype)
+    if hi_mask is not None:
+        avail = jnp.maximum(avail, hi_mask)
     q = fixpoint(arrays, avail)
     q_size = q.sum(axis=-1, dtype=jnp.int32)
     complement = jnp.clip(scc_mask - q, 0, 1).astype(arrays.dtype)
@@ -282,24 +288,33 @@ def sweep_program_factory(
     arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
         circuit, bit_nodes, scc_mask, frozen
     )
+    zeros_hi = jnp.zeros((circuit.n,), dtype=arrays.dtype)
 
-    def block_min_hit(start):
-        hit, _ = sweep_step(arrays, start, batch, pos_j, scc_mask_j, frozen_j)
+    def block_min_hit(start, hi_mask):
+        hit, _ = sweep_step(
+            arrays, start, batch, pos_j, scc_mask_j, frozen_j, hi_mask
+        )
         idx = start + jnp.arange(batch, dtype=jnp.int32)
         return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
 
-    def factory(steps_per_call: int) -> Callable[[int], jnp.ndarray]:
+    def factory(steps_per_call: int) -> Callable[..., jnp.ndarray]:
         @jax.jit
-        def step(start0):
+        def step(start0, hi_mask):
             if steps_per_call == 1:
-                return block_min_hit(start0)
+                return block_min_hit(start0, hi_mask)
 
             def body(i, best):
-                return jnp.minimum(best, block_min_hit(start0 + i * batch))
+                return jnp.minimum(best, block_min_hit(start0 + i * batch, hi_mask))
 
             return lax.fori_loop(0, steps_per_call, body, jnp.int32(INT32_MAX))
 
-        return lambda start: step(jnp.int32(start))
+        def dispatch(start: int, hi_mask=None):
+            # hi_mask: (n,) 0/1 np row of high-bit nodes for wide sweeps
+            # (one device upload per outer chunk; same compiled program).
+            hi = zeros_hi if hi_mask is None else arrays.cast(hi_mask)
+            return step(jnp.int32(start), hi)
+
+        return dispatch
 
     return factory
 

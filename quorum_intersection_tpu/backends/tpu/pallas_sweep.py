@@ -230,7 +230,7 @@ def pallas_sweep_program_factory(
         start2d = jnp.reshape(start, (1, 1)).astype(jnp.int32)
         return jnp.min(call(start2d, *operands))
 
-    def factory(steps_per_call: int) -> Callable[[int], jnp.ndarray]:
+    def factory(steps_per_call: int) -> Callable[..., jnp.ndarray]:
         @jax.jit
         def step(start0):
             if steps_per_call == 1:
@@ -241,6 +241,12 @@ def pallas_sweep_program_factory(
 
             return lax.fori_loop(0, steps_per_call, body, jnp.int32(INT32_MAX))
 
-        return lambda start: step(jnp.int32(start))
+        def dispatch(start: int, hi_mask=None):
+            # The sweep driver routes wide (two-level) enumerations to the
+            # XLA engine; this kernel only serves the narrow case.
+            assert hi_mask is None, "pallas engine does not take a hi mask"
+            return step(jnp.int32(start))
+
+        return dispatch
 
     return factory

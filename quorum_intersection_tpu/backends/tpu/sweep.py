@@ -42,7 +42,13 @@ log = get_logger("backends.tpu.sweep")
 
 DEFAULT_BATCH = None  # adaptive: see _auto_batch (dispatch latency dominates
 # below ~32k candidates/step; small circuits sustain much larger blocks)
-DEFAULT_MAX_BITS = 30  # 2^30 candidates ≈ 1.07e9 — the practical sweep ceiling
+# Two-level enumeration: the low LO_BITS index bits decode on-device
+# (kernels.decode_masks is int32-bound); the remaining high bits are a
+# per-program constant availability row, so one compiled program serves
+# every outer chunk.  2^44 ≈ 1.8e13 candidates ≈ 10 h at the measured
+# ~500M cand/s — the practical ceiling (checkpointing makes it survivable).
+LO_BITS = 30
+DEFAULT_MAX_BITS = 44
 # Deep pipeline: the tunneled chip's round-trip latency is ~100 ms while a
 # full-ramp program's device time is ~10-35 ms, so the queue must hold many
 # programs to keep the device busy (measured: 4 in flight → ~68M cand/s on a
@@ -104,9 +110,11 @@ class TpuSweepBackend:
         checkpoint=None,
         max_inflight: int = MAX_INFLIGHT,
         engine: str = "xla",
+        lo_bits: int = LO_BITS,
     ) -> None:
         self.batch = batch  # None ⇒ _auto_batch(circuit.n) at check time
         self.max_bits = max_bits
+        self.lo_bits = lo_bits  # inner-chunk width of the two-level decode
         self.mesh = mesh
         self.checkpoint = checkpoint  # utils.checkpoint.SweepCheckpoint or None
         self.max_inflight = max_inflight
@@ -173,6 +181,14 @@ class TpuSweepBackend:
             frozen = np.ones(n, dtype=np.float32) - scc_mask
         bit_nodes = np.asarray(scc[1:], dtype=np.int32)
 
+        # Two-level decode: index bit j < lo_bits toggles bit_nodes[j]
+        # on-device; bit j >= lo_bits toggles hi_nodes[j - lo_bits] via a
+        # per-program constant mask row (same global bit→node mapping as a
+        # flat decode, so witness reconstruction below is unchanged).
+        lo_bits = min(bits, self.lo_bits)
+        lo_total = 1 << lo_bits if lo_bits > 0 else 1
+        hi_nodes = scc[1 + lo_bits :]
+
         total = 1 << bits if bits > 0 else 1
         start0 = 0
         fingerprint = None
@@ -191,24 +207,33 @@ class TpuSweepBackend:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
         batch = self.batch if self.batch is not None else _auto_batch(circuit.n)
+        if hi_nodes:
+            # Power-of-two blocks make chunk tails exact (no aliased
+            # overshoot work); correctness does not depend on it — the
+            # dispatch loop advances/records only to the chunk boundary and
+            # the drain masks aliased hit indices.
+            batch = 1 << (min(batch, lo_total).bit_length() - 1)
+        lo_nodes = np.asarray(scc[1 : 1 + lo_bits], dtype=np.int32)
         if self.mesh is not None:
             base_block, make_dispatch = self._build_sharded_step(
-                circuit, bit_nodes, scc_mask, frozen, batch
+                circuit, lo_nodes, scc_mask, frozen, batch
             )
-        elif self.engine == "pallas" and _pallas_ok(circuit):
+        elif self.engine == "pallas" and not hi_nodes and _pallas_ok(circuit):
+            # (wide sweeps use the XLA path: the pallas kernel has no
+            # hi-mask input and wide enumerations are its weak spot anyway)
             from quorum_intersection_tpu.backends.tpu import pallas_sweep
 
             base_block, _ = pallas_sweep.plan_batch(min(batch, max(total, 1)))
             make_dispatch = pallas_sweep.pallas_sweep_program_factory(
-                circuit, bit_nodes, scc_mask, frozen, base_block
+                circuit, lo_nodes, scc_mask, frozen, base_block
             )
         else:
             from quorum_intersection_tpu.backends.tpu.kernels import sweep_program_factory
 
-            base_block = min(batch, max(total, 1))
+            base_block = min(batch, max(lo_total, 1))
             # Device constants upload once; each ramp level only compiles.
             make_dispatch = sweep_program_factory(
-                circuit, bit_nodes, scc_mask, frozen, base_block
+                circuit, lo_nodes, scc_mask, frozen, base_block
             )
 
         # Pipelined drive: keep up to MAX_INFLIGHT asynchronous device
@@ -224,25 +249,43 @@ class TpuSweepBackend:
 
         steps = 0
         candidates = 0
-        first_hit = int(INT32_MAX)
+        found = False
+        first_hit = 0
         inflight: "deque" = deque()
         dispatchers = {}
+        hi_cache = [-1, None]  # last built (hi value, mask row)
 
-        def dispatch(start: int, steps_per_call: int):
+        def hi_row(hi: int):
+            """Availability row for the high index bits (None when narrow)."""
+            if not hi_nodes:
+                return None
+            if hi_cache[0] != hi:
+                row = np.zeros(n, dtype=np.float32)
+                for j, v in enumerate(hi_nodes):
+                    if (hi >> j) & 1:
+                        row[v] = 1.0
+                hi_cache[0], hi_cache[1] = hi, row
+            return hi_cache[1]
+
+        def dispatch(lo: int, hi: int, steps_per_call: int):
             fn = dispatchers.get(steps_per_call)
             if fn is None:
                 fn = dispatchers[steps_per_call] = make_dispatch(steps_per_call)
-            return fn(start)
+            return fn(lo, hi_row(hi))
 
         def drain_one() -> bool:
             """Sync the oldest in-flight program; True iff it hit."""
-            nonlocal steps, candidates, first_hit
-            start, coverage, handle = inflight.popleft()
+            nonlocal steps, candidates, first_hit, found
+            start, coverage, hi_base, handle = inflight.popleft()
             hit = int(handle)
             steps += 1
             candidates += min(coverage, total - start)
             if hit < int(INT32_MAX):
-                first_hit = hit
+                found = True
+                # Chunk-tail programs may report an aliased (wrapped) index;
+                # decode is periodic in 2^lo_bits, so masking recovers the
+                # true in-chunk position.
+                first_hit = (hi_base << lo_bits) | (hit & (lo_total - 1))
                 return True
             if self.checkpoint is not None:
                 # The last program may overshoot `total` (ramped coverage is
@@ -268,13 +311,27 @@ class TpuSweepBackend:
             ):
                 ramp_ix += 1
                 since_ramp = 0
+            hi, lo = start >> lo_bits, start & (lo_total - 1)
             coverage = STEPS_RAMP[ramp_ix] * base_block
-            inflight.append((start, coverage, dispatch(start, STEPS_RAMP[ramp_ix])))
+            spc = STEPS_RAMP[ramp_ix]
+            if lo + coverage > lo_total:
+                # Chunk tail: dispatch the smallest program that covers the
+                # remainder, but ADVANCE/RECORD only to the chunk boundary.
+                # The overshot indices decode as aliases of this same
+                # chunk's prefix (bit lo_bits+ shifts hit pos 31) — already
+                # evaluated, so harmless duplicates — while the recorded
+                # position never claims the NEXT chunk's candidates (whose
+                # hi mask differs).  This also makes checkpoint positions
+                # independent of batch/lo_bits choices across resumes.
+                rem = lo_total - lo
+                spc = next(r for r in STEPS_RAMP if r * base_block >= rem)
+                coverage = rem
+            inflight.append((start, coverage, hi, dispatch(lo, hi, spc)))
             since_ramp += 1
             start += coverage
             if len(inflight) >= self.max_inflight and drain_one():
                 break
-        while first_hit >= int(INT32_MAX) and inflight:
+        while not found and inflight:
             if drain_one():
                 break
 
@@ -287,7 +344,7 @@ class TpuSweepBackend:
             "seconds": seconds,
             "candidates_per_sec": candidates / seconds if seconds > 0 else 0.0,
         }
-        if first_hit >= int(INT32_MAX):
+        if not found:
             if self.checkpoint is not None:
                 self.checkpoint.clear()
             return SccCheckResult(intersects=True, stats=stats)
@@ -328,9 +385,10 @@ class TpuSweepBackend:
         arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
             circuit, bit_nodes, scc_mask, frozen
         )
+        zeros_hi = jnp.zeros((circuit.n,), dtype=arrays.dtype)
 
         def make_dispatch(steps_per_call: int):
-            def shard_fn(start):
+            def shard_fn(start, hi_mask):
                 rank = lax.axis_index(axis)
 
                 # Device r takes sub-block r of every consecutive block, so
@@ -338,7 +396,8 @@ class TpuSweepBackend:
                 def block_min_hit(block_start):
                     my_start = block_start + rank.astype(jnp.int32) * per_dev
                     hit, _ = sweep_step(
-                        arrays, my_start, per_dev, pos_j, scc_mask_j, frozen_j
+                        arrays, my_start, per_dev, pos_j, scc_mask_j, frozen_j,
+                        hi_mask,
                     )
                     idx = my_start + jnp.arange(per_dev, dtype=jnp.int32)
                     return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
@@ -353,8 +412,15 @@ class TpuSweepBackend:
                 local = lax.fori_loop(0, steps_per_call, body, init)
                 return lax.pmin(local, axis)
 
-            sharded = jax.jit(shard_map_fn(shard_fn, mesh, in_specs=P(), out_specs=P()))
+            sharded = jax.jit(
+                shard_map_fn(shard_fn, mesh, in_specs=(P(), P()), out_specs=P())
+            )
+
             # Asynchronous dispatch: the caller syncs via int(handle).
-            return lambda start: sharded(jnp.int32(start))
+            def run(start: int, hi_mask=None):
+                hi = zeros_hi if hi_mask is None else arrays.cast(hi_mask)
+                return sharded(jnp.int32(start), hi)
+
+            return run
 
         return base_block, make_dispatch

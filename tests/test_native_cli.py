@@ -180,3 +180,39 @@ def test_bad_numeric_flag_is_usage_error(native):
     proc = run_native(native, ["-p", "-i", "abc"], "[]")
     assert proc.returncode == 1
     assert "Invalid option!" in proc.stdout
+
+
+def test_huge_threshold_parity(native):
+    # int64 thresholds must not truncate into satisfiability
+    payload = json.dumps(
+        [{"publicKey": "A", "quorumSet": {"threshold": 4294967297, "validators": ["A"]}}]
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode) == ("false\n", 1)
+
+
+def test_control_char_rejected_like_python(native):
+    payload = '[{"publicKey": "A\tB", "quorumSet": {"threshold": 1, "validators": ["A\tB"]}}]'
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert n.returncode == p.returncode == 1
+
+
+def test_duplicate_json_key_last_wins(native):
+    # json.loads keeps the LAST occurrence of a duplicate object key
+    payload = (
+        '[{"publicKey": "A", '
+        '"quorumSet": {"threshold": 99, "validators": ["A"]}, '
+        '"quorumSet": {"threshold": 1, "validators": ["A"]}}]'
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode) == ("true\n", 0)
+
+
+def test_missing_flag_value_is_usage_error(native):
+    proc = run_native(native, ["-i"], "[]")
+    assert proc.returncode == 1
+    assert "Invalid option!" in proc.stdout
+    assert proc.stderr == ""
