@@ -25,7 +25,22 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.auto")
 
-DEFAULT_SWEEP_LIMIT = 24
+# Exhaustive-sweep cutoffs by platform: the sweep is exact and fastest while
+# 2^(|scc|-1) stays cheap.  Measured rates: ~0.5-1G cand/s on a v5e chip
+# (2^32 ≈ a few seconds) vs ~0.5M/s on the CPU emulation fallback.
+SWEEP_LIMIT_TPU = 33
+SWEEP_LIMIT_CPU = 24
+DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
+
+
+def _platform_sweep_limit() -> int:
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - no jax ⇒ no sweep at all
+        return 0
+    return SWEEP_LIMIT_CPU if backend == "cpu" else SWEEP_LIMIT_TPU
 
 
 class AutoBackend:
@@ -34,7 +49,7 @@ class AutoBackend:
     def __init__(
         self,
         prefer_tpu: bool = False,
-        sweep_limit: int = DEFAULT_SWEEP_LIMIT,
+        sweep_limit: Optional[int] = DEFAULT_SWEEP_LIMIT,
         seed: Optional[int] = None,
         randomized: bool = False,
         checkpoint=None,
@@ -75,7 +90,8 @@ class AutoBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
-        if len(scc) <= self.sweep_limit:
+        limit = self.sweep_limit if self.sweep_limit is not None else _platform_sweep_limit()
+        if len(scc) <= limit:
             try:
                 backend = self._sweep()
                 log.debug("auto: sweep backend for |scc|=%d", len(scc))

@@ -99,10 +99,8 @@ class TpuHybridBackend:
             m[nodes] = 1.0
             return m
 
-        # LIFO worklist of states awaiting their next fixpoint result, and a
-        # parallel queue of device requests.
+        # LIFO worklist of pending device requests (LIFO ≈ depth-first).
         pending: List[_Request] = []
-        stack: List[_State] = []
 
         def push_state(state: _State) -> None:
             # Prune 1 (size, cpp:386-391) and prune 2 (empty, cpp:266-268).
@@ -192,11 +190,20 @@ class TpuHybridBackend:
                 )
                 return
 
-        from quorum_intersection_tpu.backends.tpu.kernels import make_batch_fixpoint
+        import jax
 
-        runner = make_batch_fixpoint(circuit)  # jit caches one trace per shape
+        from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, fixpoint
+
+        arrays = CircuitArrays(circuit)
+
+        @jax.jit
+        def run_jit(avail, frozen):
+            return fixpoint(arrays, avail, frozen)
+
         zeros = np.zeros(n, dtype=np.float32)
-        while pending and found["q1"] is None:
+
+        def launch():
+            """Pop up to `batch` requests and dispatch them asynchronously."""
             take = pending[-self.batch :]
             del pending[-len(take) :]
             # Bucket the padded batch to powers of two: a handful of compiled
@@ -209,9 +216,25 @@ class TpuHybridBackend:
             for i, req in enumerate(take):
                 masks[i] = req.mask
                 frozens[i] = req.frozen if req.frozen is not None else zeros
-            results = runner(masks, frozens)
+            # NB stats count DISPATCHED work: an early witness exit may leave
+            # one inflight batch whose results are never drained.
             stats["device_batches"] += 1
             stats["fixpoints"] += len(take)
+            return take, run_jit(arrays.cast(masks), arrays.cast(frozens))
+
+        # Double-buffered drive: while one batch's results cross the (slow)
+        # host↔device link, the next batch from the existing backlog is
+        # already on the device.  Handling order across batches is
+        # correctness-irrelevant: states' phase transitions are counted, not
+        # ordered, and any disjoint pair is a valid witness.
+        from collections import deque
+
+        inflight: "deque" = deque()
+        while (pending or inflight) and found["q1"] is None:
+            while pending and len(inflight) < 2:
+                inflight.append(launch())
+            take, device_out = inflight.popleft()
+            results = np.asarray(device_out) != 0  # sync point
             for i, req in enumerate(take):
                 handle(req, results[i])
                 if found["q1"] is not None:
