@@ -143,6 +143,24 @@ def sweep_verdict(n_nodes: int) -> dict:
     }
 
 
+def snapshot_verdict(quick: bool = False) -> dict:
+    """Time-to-verdict on a stellarbeat-snapshot-shaped ~150-validator
+    network (BASELINE.json north-star config), auto backend."""
+    from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    data = stellar_like_fbas(n_core_orgs=5, n_watchers=30) if quick else stellar_like_fbas()
+    t0 = time.perf_counter()
+    res = solve(data, backend="auto")
+    seconds = time.perf_counter() - t0
+    assert res.intersects is True
+    return {
+        "snapshot_nodes": len(data),
+        "snapshot_verdict_seconds": round(seconds, 3),
+        "snapshot_backend": res.stats.get("backend", "scc-guard"),
+    }
+
+
 def cpu_baseline(graph, samples: int) -> tuple:
     """Single-core candidates/sec through the same check on the host oracle.
 
@@ -213,6 +231,7 @@ def main() -> int:
     tpu_rate = tpu_throughput(circuit, batch, steps, chunks)
     cpu_rate, baseline_kind = cpu_baseline(graph, samples)
     sweep_stats = sweep_verdict(sweep_nodes)
+    sweep_stats.update(snapshot_verdict(quick=args.quick))
 
     import jax
 

@@ -84,6 +84,52 @@ def trivial_pair() -> Dict[str, List[Dict]]:
     }
 
 
+def stellar_like_fbas(
+    n_core_orgs: int = 7,
+    per_org: int = 3,
+    n_watchers: int = 100,
+    n_null: int = 28,
+    n_dangling: int = 7,
+    *,
+    broken: bool = False,
+    seed: int = 0,
+) -> List[Dict]:
+    """Stellarbeat-snapshot-shaped network (~150 validators with defaults).
+
+    Mirrors the structural statistics of the bundled `correct.json` snapshot
+    scaled up (SURVEY.md §4.1): a small strongly-connected core of
+    organizations (the quorum-bearing sink SCC), a long tail of watcher
+    nodes that trust the core but are not trusted back (many singleton
+    SCCs), a block of null-quorumSet nodes, and a sprinkle of dangling
+    validator references.  ``broken=True`` turns one knob in the core —
+    org 0's validators drop their org-majority threshold to 1-of-{orgs}
+    (trust edges unchanged, so the core SCC stays intact), making the org-0
+    trio a quorum disjoint from the quorum of the remaining orgs: the
+    search inside the SCC, not the SCC guard, must find it.
+    """
+    rng = random.Random(seed)
+    org_keys = [keys(per_org, f"CORE{o}N") for o in range(n_core_orgs)]
+    core_flat = [k for ok in org_keys for k in ok]
+    inner = [_qset(per_org // 2 + 1, list(ok)) for ok in org_keys]
+    t_orgs = n_core_orgs // 2 + 1
+    nodes: List[Dict] = []
+    for o in range(n_core_orgs):
+        for i, key in enumerate(org_keys[o]):
+            t = 1 if (broken and o == 0) else t_orgs
+            nodes.append(_node(key, f"core{o}-v{i}", _qset(t, [], list(inner))))
+    for w in range(n_watchers):
+        trusted = rng.sample(core_flat, min(len(core_flat), rng.randint(3, 7)))
+        extra = []
+        if w < n_dangling:  # dangling refs concentrated in early watchers
+            extra = [f"GONE{w:04d}"]
+        t = len(trusted) * 2 // 3 + 1
+        nodes.append(_node(f"WATCH{w:04d}", f"w{w}", _qset(t, trusted + extra)))
+    for z in range(n_null):
+        nodes.append(_node(f"NULLQ{z:04d}", f"z{z}", None))
+    rng.shuffle(nodes)  # snapshot order is arbitrary; vertex 0 ≠ core
+    return nodes
+
+
 def random_fbas(
     n: int,
     *,

@@ -158,3 +158,42 @@ class TestKnobs:
         assert "found two non-intersecting quorums" in text
         assert "first quorum:" in text and "second quorum:" in text
         assert not res.intersects
+
+
+class TestStellarLike:
+    """Snapshot-shaped workload (BASELINE north star: time-to-verdict on a
+    ~150-validator stellarbeat snapshot)."""
+
+    def test_structure(self):
+        from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        g = build_graph(parse_fbas(stellar_like_fbas()))
+        assert g.n == 149  # 7*3 core + 100 watchers + 28 null
+        assert g.dangling_refs == 7
+        count, comp = tarjan_scc(g.n, g.succ)
+        sccs = group_sccs(g.n, comp, count)
+        assert max(len(s) for s in sccs) == 21  # the core
+
+    def test_pair_verdicts_oracle(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        assert solve(stellar_like_fbas(), backend="python").intersects is True
+        res = solve(stellar_like_fbas(broken=True), backend="python")
+        assert res.intersects is False
+        # broken by an in-SCC disjoint pair, not the SCC guard
+        assert res.q1 and res.q2
+        assert not set(res.q1) & set(res.q2)
+
+    def test_pair_verdicts_auto_small(self):
+        # auto backend on the bench's quick-size snapshot (15-node core —
+        # a 2^14 sweep keeps this fast on the CPU test platform; the full
+        # 21-node core runs on real TPU via bench.py)
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        small = dict(n_core_orgs=5, n_watchers=30)
+        assert solve(stellar_like_fbas(**small), backend="auto").intersects is True
+        res = solve(stellar_like_fbas(broken=True, **small), backend="auto")
+        assert res.intersects is False
+        assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
