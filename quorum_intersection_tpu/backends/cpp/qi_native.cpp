@@ -21,12 +21,14 @@
 //   g++ -O2 -std=c++17 qi_native.cpp qi_oracle.cpp -o qi_native
 
 #include <algorithm>
+#include <cctype>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <iostream>
+#include <limits>
 #include <memory>
 #include <random>
 #include <sstream>
@@ -348,12 +350,27 @@ QSet parse_qset(const JValue* v, const std::string& where) {
     size_t pos = 0;
     try {
       q.threshold = std::stoll(s, &pos);
+    } catch (const std::out_of_range&) {
+      // Python's arbitrary-precision int() accepts magnitudes beyond int64;
+      // any such threshold is unsatisfiable either way (non-positive hits
+      // the Q3 sentinel, huge positive exceeds every member count), so
+      // clamp to the matching int64 extreme instead of rejecting the
+      // snapshot — keeps stdout parity with the Python CLI.
+      size_t i = 0;
+      while (i < s.size() && std::isspace(static_cast<unsigned char>(s[i]))) ++i;
+      const bool neg = i < s.size() && s[i] == '-';
+      if (i < s.size() && (s[i] == '-' || s[i] == '+')) ++i;
+      const size_t digits_start = i;
+      while (i < s.size() && s[i] >= '0' && s[i] <= '9') ++i;
+      pos = (i > digits_start) ? i : std::string::npos;
+      q.threshold = neg ? std::numeric_limits<int64_t>::min()
+                        : std::numeric_limits<int64_t>::max();
     } catch (...) {
       pos = std::string::npos;
     }
     // Python's int() also tolerates surrounding whitespace.
     while (pos != std::string::npos && pos < s.size() &&
-           (s[pos] == ' ' || s[pos] == '\t' || s[pos] == '\n')) {
+           std::isspace(static_cast<unsigned char>(s[pos]))) {
       ++pos;
     }
     if (pos != s.size()) {

@@ -84,6 +84,23 @@ def _pallas_ok(circuit: Circuit) -> bool:
     return False
 
 
+def clamp_batch_to_index_ceiling(batch: int, lo_total: int) -> int:
+    """int32 decode ceiling: the largest index a program can touch is
+    ``lo_total + STEPS_RAMP[-1]·base_block`` (chunk-tail overshoot decodes as
+    in-chunk aliases, but only while it stays below 2^31).  Clamp
+    user-supplied batches that would cross it rather than wrap negative —
+    a wrapped index decodes every non-enumerated node as available and could
+    silently flip the verdict (see also the host witness recheck)."""
+    max_block = max(1, ((1 << 31) - lo_total) // STEPS_RAMP[-1])
+    if batch > max_block:
+        log.warning(
+            "batch %d would cross the 2^31 int32 index ceiling; clamping to %d",
+            batch, max_block,
+        )
+        return max_block
+    return batch
+
+
 def _auto_batch(n: int) -> int:
     """Candidates per sweep block, scaled to the circuit's lane width.
 
@@ -123,6 +140,12 @@ class TpuSweepBackend:
         if engine not in ("xla", "pallas"):
             raise ValueError(f"unknown sweep engine {engine!r}")
         self.engine = engine
+        # Device index math is int32 (kernels.decode_masks): lo_bits > 30
+        # would let chunk-tail overshoot indices wrap negative, where
+        # idx >> 31 decodes every non-enumerated node as available and can
+        # silently flip the verdict.
+        if lo_bits > LO_BITS:
+            raise ValueError(f"lo_bits={lo_bits} exceeds the int32 decode ceiling {LO_BITS}")
 
     # ---- host-side witness reconstruction -------------------------------
 
@@ -207,6 +230,7 @@ class TpuSweepBackend:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 
         batch = self.batch if self.batch is not None else _auto_batch(circuit.n)
+        batch = clamp_batch_to_index_ceiling(batch, lo_total)
         if hi_nodes:
             # Power-of-two blocks make chunk tails exact (no aliased
             # overshoot work); correctness does not depend on it — the
@@ -214,6 +238,13 @@ class TpuSweepBackend:
             # the drain masks aliased hit indices.
             batch = 1 << (min(batch, lo_total).bit_length() - 1)
         lo_nodes = np.asarray(scc[1 : 1 + lo_bits], dtype=np.int32)
+        if self.engine == "pallas" and self.mesh is not None:
+            log.warning("pallas engine requested but mesh sharding uses the XLA path")
+        elif self.engine == "pallas" and hi_nodes:
+            log.warning(
+                "pallas engine requested but wide (>2^%d) sweeps use the XLA path",
+                lo_bits,
+            )
         if self.mesh is not None:
             base_block, make_dispatch = self._build_sharded_step(
                 circuit, lo_nodes, scc_mask, frozen, batch
@@ -352,6 +383,14 @@ class TpuSweepBackend:
         # Decode the winning subset and rebuild the witness pair on the host.
         subset = [int(bit_nodes[j]) for j in range(bits) if (first_hit >> j) & 1]
         q, disjoint = self._witness(graph, scc, subset, scope_to_scc)
+        if not q or not disjoint:
+            # Defense in depth: the host recheck uses the exact reference
+            # semantics, so an empty member here means the device decode lied
+            # (e.g. an index-wrap bug) — fail loudly, never flip the verdict.
+            raise RuntimeError(
+                f"sweep decode error: device hit index {first_hit} failed the "
+                f"host witness recheck (|q|={len(q)}, |disjoint|={len(disjoint)})"
+            )
         if self.checkpoint is not None:
             self.checkpoint.clear()
         stats["hit_index"] = first_hit

@@ -34,16 +34,21 @@ def enable_compilation_cache() -> None:
 
         if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             return  # user configured jax directly; nothing to do
+        if jax.default_backend() == "cpu":
+            # CPU AOT artifacts encode the compile host's machine features;
+            # reloading them on a different host risks SIGILL (observed via
+            # cpu_aot_loader warnings), and CPU compiles are sub-second —
+            # the cache only pays for itself on the accelerator path.
+            return
         cache_dir = Path(
             os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
         ) / "quorum_intersection_tpu" / "jax_cache"
         cache_dir.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        # Cache every kernel: sweep programs are few and large-ish, and the
-        # default min-entry/compile-time thresholds would skip the small
-        # early-ramp programs that gate a resumed run's first results.
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # JAX's default thresholds (min compile time ~1 s) are kept: every
+        # ramp program on a real chip compiles for multiple seconds and is
+        # cached, while the sub-second kernels test suites churn through are
+        # skipped — bounding cache growth across runs.
         log.debug("persistent compilation cache at %s", cache_dir)
     except Exception as exc:  # noqa: BLE001 - cache is an optimization only
         log.info("compilation cache unavailable: %s", exc)

@@ -216,3 +216,42 @@ def test_missing_flag_value_is_usage_error(native):
     assert proc.returncode == 1
     assert "Invalid option!" in proc.stdout
     assert proc.stderr == ""
+
+
+def test_huge_threshold_matches_python(native):
+    # Thresholds beyond int64 range: Python's arbitrary-precision int()
+    # accepts them (an absurdly large threshold is just unsatisfiable);
+    # the native CLI clamps to the int64 extremes instead of rejecting.
+    huge = "9" * 30
+    payload = (
+        f'[{{"publicKey": "A", "quorumSet": {{"threshold": "{huge}", '
+        '"validators": ["A"]}}, '
+        '{"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}}]'
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode)
+
+    neg = f'[{{"publicKey": "A", "quorumSet": {{"threshold": "-{huge}", "validators": ["A"]}}}}]'
+    n = run_native(native, [], neg)
+    p = run_python([], neg)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode)
+
+    junk = f'[{{"publicKey": "A", "quorumSet": {{"threshold": "{huge}x", "validators": ["A"]}}}}]'
+    n = run_native(native, [], junk)
+    p = run_python([], junk)
+    assert n.returncode == p.returncode == 1
+
+
+def test_whitespace_padded_huge_threshold_matches_python(native):
+    # \v-prefixed over-int64 threshold: std::stoll skips \v per isspace and
+    # throws out_of_range; the clamp handler must skip the same whitespace
+    # set or the two CLIs diverge (Python int() accepts it).
+    huge = "9" * 30
+    payload = (
+        f'[{{"publicKey": "A", "quorumSet": {{"threshold": "\\u000b{huge} ", '
+        '"validators": ["A"]}}]'
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert (n.stdout, n.returncode) == (p.stdout, p.returncode)

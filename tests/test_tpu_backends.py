@@ -242,3 +242,34 @@ class TestWideSweep:
         res = solve(data, backend=TpuSweepBackend(batch=32, lo_bits=5, mesh=mesh))
         assert res.intersects is False
         assert res.q1 and res.q2
+
+
+class TestIndexCeilingGuards:
+    """int32 decode-ceiling hardening (advisor finding): user-supplied
+    batch/lo_bits must never let device indices wrap past 2^31."""
+
+    def test_lo_bits_over_ceiling_rejected(self):
+        with pytest.raises(ValueError, match="int32 decode ceiling"):
+            TpuSweepBackend(lo_bits=31)
+
+    def test_batch_clamp_arithmetic(self):
+        from quorum_intersection_tpu.backends.tpu.sweep import (
+            STEPS_RAMP,
+            clamp_batch_to_index_ceiling,
+        )
+
+        lo_total = 1 << 30
+        clamped = clamp_batch_to_index_ceiling(1 << 22, lo_total)
+        # largest possible program must stay below 2^31
+        assert lo_total + STEPS_RAMP[-1] * clamped <= 1 << 31
+        # in-range batches pass through untouched
+        assert clamp_batch_to_index_ceiling(1 << 19, lo_total) == 1 << 19
+        assert clamp_batch_to_index_ceiling(64, 1 << 11) == 64
+
+    def test_oversized_batch_still_correct(self):
+        # A batch beyond the ceiling is clamped, not wrapped: verdict and
+        # witness stay correct.
+        data = majority_fbas(12, broken=True)
+        res = solve(data, backend=TpuSweepBackend(batch=1 << 22))
+        assert res.intersects is False
+        assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
