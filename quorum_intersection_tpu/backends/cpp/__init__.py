@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
+import logging
 import os
 import subprocess
 import time
@@ -100,8 +101,16 @@ def _load() -> ctypes.CDLL:
         _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
         _i32p, ctypes.c_int32,  # scc, scc_len
         ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,  # scope, use_rng, seed
+        ctypes.c_int32,  # trace (per-call stderr narration)
         _i32p, _i32p, _i32p, _i32p,  # q1_out, q1_len, q2_out, q2_len
         _i64p,  # stats_out[3]
+    ]
+    lib.qi_max_quorum.restype = ctypes.c_int32
+    lib.qi_max_quorum.argtypes = [
+        ctypes.c_int32,  # n
+        _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
+        _i32p, ctypes.c_int32,  # nodes, nodes_len
+        _u8p, _i32p,  # avail (restored on return), out
     ]
     lib.qi_candidate_check.restype = ctypes.c_int64
     lib.qi_candidate_check.argtypes = [
@@ -129,13 +138,21 @@ class FlatGraph:
         mem: List[int] = []
         inner: List[int] = []
 
-        def add_unit(q: IndexedQSet) -> int:
+        from quorum_intersection_tpu.fbas.schema import MAX_QSET_DEPTH
+
+        def add_unit(q: IndexedQSet, depth: int = 0) -> int:
+            if depth > MAX_QSET_DEPTH:
+                # Graphs from parse_fbas are pre-capped; this guards
+                # programmatic construction like encode/circuit.py does.
+                raise ValueError(
+                    f"quorumSet nesting exceeds depth {MAX_QSET_DEPTH}"
+                )
             uid = len(units)
             units.append((0, 0, 0, 0, 0))  # placeholder; children first
             mb = len(mem)
             mem.extend(q.members)
             me = len(mem)
-            child_ids = [add_unit(iq) for iq in q.inner]
+            child_ids = [add_unit(iq, depth + 1) for iq in q.inner]
             ib = len(inner)
             inner.extend(child_ids)
             ie = len(inner)
@@ -219,6 +236,7 @@ class CppOracleBackend:
             int(scope_to_scc),
             int(self._use_rng),
             self._seed,
+            int(log.isEnabledFor(logging.DEBUG)),  # -t routes here via set_trace
             q1.ctypes.data_as(_i32p),
             ctypes.byref(q1_len),
             q2.ctypes.data_as(_i32p),
@@ -239,6 +257,36 @@ class CppOracleBackend:
                 "seconds": seconds,
             },
         )
+
+
+def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]:
+    """Per-SCC max-quorum scan via ``qi_max_quorum`` — the native analog of
+    the pipeline's quorum-bearing-SCC detection (cpp:645-672), used for big
+    snapshots where N interpreted-Python fixpoints dominate the solve
+    (VERDICT r1 §weak-7).  Returns one (possibly empty) quorum per SCC, in
+    the same member order as the Python scan."""
+    lib = _load()
+    flat = FlatGraph(graph)
+    avail = np.zeros(graph.n, dtype=np.uint8)
+    out = np.zeros(graph.n, dtype=np.int32)
+    quorums: List[List[int]] = []
+    for members in sccs:
+        arr = np.asarray(members, dtype=np.int32)
+        avail[arr] = 1
+        qlen = lib.qi_max_quorum(
+            flat.n,
+            flat._ptr(flat.roots),
+            flat._ptr(flat.units),
+            flat._ptr(flat.mem),
+            flat._ptr(flat.inner),
+            arr.ctypes.data_as(_i32p),
+            len(members),
+            avail.ctypes.data_as(_u8p),
+            out.ctypes.data_as(_i32p),
+        )
+        avail[arr] = 0
+        quorums.append(out[:qlen].tolist())
+    return quorums
 
 
 def native_candidate_check(graph: TrustGraph, masks: np.ndarray) -> Tuple[int, float]:

@@ -44,8 +44,9 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
                      const int32_t* units, const int32_t* mem,
                      const int32_t* inner, const int32_t* scc,
                      int32_t scc_len, int32_t scope_to_scc, int32_t use_rng,
-                     uint64_t seed, int32_t* q1_out, int32_t* q1_len,
-                     int32_t* q2_out, int32_t* q2_len, int64_t* stats_out);
+                     uint64_t seed, int32_t trace, int32_t* q1_out,
+                     int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
+                     int64_t* stats_out);
 int32_t qi_max_quorum(int32_t n, const int32_t* roots, const int32_t* units,
                       const int32_t* mem, const int32_t* inner,
                       const int32_t* nodes, int32_t nodes_len, uint8_t* avail,
@@ -79,9 +80,17 @@ struct JValue {
   }
 };
 
+// Hostile-input hardening: caps keep recursive descent (JSON values, quorum
+// sets) inside the native stack instead of overflowing on crafted input.
+// kMaxQSetDepth matches schema.py MAX_QSET_DEPTH so both CLIs reject the
+// same snapshots with the same clean diagnostic.
+constexpr int kMaxJsonDepth = 512;
+constexpr int kMaxQSetDepth = 128;
+
 struct JsonParser {
   const char* p;
   const char* end;
+  int depth = 0;
   explicit JsonParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
 
   [[noreturn]] void fail(const std::string& why) {
@@ -263,11 +272,13 @@ struct JsonParser {
   }
 
   JPtr parse_array() {
+    if (++depth > kMaxJsonDepth) fail("nesting too deep");
     expect('[');
     auto v = std::make_unique<JValue>();
     v->kind = JValue::Arr;
     if (peek() == ']') {
       ++p;
+      --depth;
       return v;
     }
     for (;;) {
@@ -279,6 +290,7 @@ struct JsonParser {
       }
       if (c == ']') {
         ++p;
+        --depth;
         return v;
       }
       fail("expected ',' or ']'");
@@ -286,11 +298,13 @@ struct JsonParser {
   }
 
   JPtr parse_object() {
+    if (++depth > kMaxJsonDepth) fail("nesting too deep");
     expect('{');
     auto v = std::make_unique<JValue>();
     v->kind = JValue::Obj;
     if (peek() == '}') {
       ++p;
+      --depth;
       return v;
     }
     for (;;) {
@@ -304,6 +318,7 @@ struct JsonParser {
       }
       if (c == '}') {
         ++p;
+        --depth;
         return v;
       }
       fail("expected ',' or '}'");
@@ -329,7 +344,11 @@ struct Node {
 // Same validation rules as fbas/schema.py:_parse_qset — the native binary
 // must reject exactly what the Python CLI rejects, or verdicts diverge on
 // malformed snapshots.
-QSet parse_qset(const JValue* v, const std::string& where) {
+QSet parse_qset(const JValue* v, const std::string& where, int depth = 0) {
+  if (depth > kMaxQSetDepth) {
+    throw std::runtime_error(where + ": quorumSet nesting exceeds depth " +
+                             std::to_string(kMaxQSetDepth));
+  }
   QSet q;
   if (v == nullptr || v->kind == JValue::Null) return q;
   if (v->kind != JValue::Obj) {
@@ -402,7 +421,7 @@ QSet parse_qset(const JValue* v, const std::string& where) {
       for (size_t i = 0; i < in->arr.size(); ++i) {
         q.inner.push_back(parse_qset(
             in->arr[i].get(),
-            where + ".innerQuorumSets[" + std::to_string(i) + "]"));
+            where + ".innerQuorumSets[" + std::to_string(i) + "]", depth + 1));
       }
     }
   }
@@ -469,7 +488,14 @@ struct FlatGraph {
 
 int32_t flatten_qset(const QSet& q, FlatGraph& g,
                      const std::unordered_map<std::string, int32_t>& index,
-                     bool alias0, std::vector<int32_t>& out_edges) {
+                     bool alias0, std::vector<int32_t>& out_edges,
+                     int depth = 0) {
+  // Parsed qsets are already capped at kMaxQSetDepth; this guards
+  // programmatic construction the same way encode/circuit.py does.
+  if (depth > kMaxQSetDepth) {
+    throw std::runtime_error("quorumSet nesting exceeds depth " +
+                             std::to_string(kMaxQSetDepth));
+  }
   if (q.null) return -1;
   const int32_t unit = static_cast<int32_t>(g.units.size() / 5);
   g.units.insert(g.units.end(), {0, 0, 0, 0, 0});  // placeholder
@@ -489,7 +515,7 @@ int32_t flatten_qset(const QSet& q, FlatGraph& g,
   }
   std::vector<int32_t> inner_units;
   for (const QSet& iq : q.inner) {
-    inner_units.push_back(flatten_qset(iq, g, index, alias0, out_edges));
+    inner_units.push_back(flatten_qset(iq, g, index, alias0, out_edges, depth + 1));
   }
   const int32_t mb = static_cast<int32_t>(g.mem.size());
   g.mem.insert(g.mem.end(), members.begin(), members.end());
@@ -694,7 +720,7 @@ void usage(std::ostream& os) {
         "  -h, --help             produce help message\n"
         "  -v, --verbose          print info about the analyzed configuration\n"
         "  -g, --graph            print graphviz representation\n"
-        "  -t, --trace            (accepted for parity; no trace spew)\n"
+        "  -t, --trace            trace-level search narration on stderr\n"
         "  -p, --pagerank         compute PageRank instead\n"
         "  -i, --max_iterations N PageRank iteration cap (default 100000)\n"
         "  -m, --dangling_factor F  PageRank dangling factor (default 0.0001)\n"
@@ -709,6 +735,7 @@ void usage(std::ostream& os) {
 
 struct Options {
   bool verbose = false, graph = false, pagerank = false, scope_scc = false;
+  bool trace = false;
   bool alias0 = false, front = false, randomized = false;
   uint64_t max_iterations = 100000, seed = 0;
   bool has_seed = false;
@@ -761,7 +788,7 @@ int main(int argc, char** argv) {
     } else if (a == "-g" || a == "--graph") {
       opt.graph = true;
     } else if (a == "-t" || a == "--trace") {
-      // parity no-op
+      opt.trace = true;
     } else if (a == "-p" || a == "--pagerank") {
       opt.pagerank = true;
     } else if (a == "-i" || a == "--max_iterations") {
@@ -820,6 +847,10 @@ int main(int argc, char** argv) {
   }
 
   // Per-SCC quorum scan (cpp:645-672).
+  if (opt.trace) {
+    std::fprintf(stderr, "trace: %zu strongly connected components; scanning for quorums\n",
+                 sccs.size());
+  }
   std::vector<int32_t> quorum_sccs;
   std::vector<uint8_t> avail(g.n, 0);
   std::vector<int32_t> qbuf(g.n);
@@ -833,6 +864,10 @@ int main(int argc, char** argv) {
     for (const int32_t v : sccs[s]) avail[v] = 0;
     if (qlen > 0) {
       quorum_sccs.push_back(static_cast<int32_t>(s));
+      if (opt.trace) {
+        std::fprintf(stderr, "trace: scc %zu (size %zu) contains a quorum (size %d)\n",
+                     s, sccs[s].size(), qlen);
+      }
       if (opt.verbose) {
         std::cout << "found quorum inside of a strongly connected component:\n";
         print_quorum(g, std::vector<int32_t>(qbuf.begin(), qbuf.begin() + qlen));
@@ -875,8 +910,8 @@ int main(int argc, char** argv) {
         g.units.data(), g.mem.data(), g.inner.data(), main_scc.data(),
         static_cast<int32_t>(main_scc.size()), opt.scope_scc ? 1 : 0,
         opt.randomized ? 1 : 0,
-        opt.has_seed ? opt.seed : std::random_device{}(), q1b.data(), &q1l,
-        q2b.data(), &q2l, stats);
+        opt.has_seed ? opt.seed : std::random_device{}(), opt.trace ? 1 : 0,
+        q1b.data(), &q1l, q2b.data(), &q2l, stats);
     intersects = ok == 1;
     q1.assign(q1b.begin(), q1b.begin() + q1l);
     q2.assign(q2b.begin(), q2b.begin() + q2l);

@@ -30,6 +30,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <random>
 #include <vector>
 
@@ -159,6 +160,11 @@ struct Search {
   std::vector<int32_t> scc;
   int32_t half;
   std::mt19937_64* rng;
+  // Per-call trace narration to stderr — the native analog of the
+  // reference's BOOST_LOG_TRIVIAL(trace) spew + static call counter
+  // (cpp:258-259); message content matches backends/python_oracle.py so
+  // both CLIs show the same search trajectory under -t.
+  bool trace = false;
   int64_t bnb_calls = 0;
   int64_t minimal_quorums = 0;
   int64_t fixpoint_calls = 0;
@@ -172,10 +178,20 @@ struct Search {
     ++fixpoint_calls;
     std::vector<int32_t> disjoint = max_quorum(g, scc, avail);
     if (!disjoint.empty()) {
+      if (trace) {
+        std::fprintf(stderr,
+                     "trace: disjointness probe: FOUND disjoint quorum "
+                     "(size %zu) — stopping\n",
+                     disjoint.size());
+      }
       found = true;
       q1 = std::move(disjoint);
       q2 = quorum;
       return true;
+    }
+    if (trace) {
+      std::fprintf(stderr,
+                   "trace: disjointness probe: no disjoint quorum; continuing\n");
     }
     for (const int32_t v : quorum) avail[v] = 1;
     return false;
@@ -184,9 +200,20 @@ struct Search {
   bool iterate(const std::vector<int32_t>& to_remove,
                std::vector<int32_t>& dont_remove) {
     ++bnb_calls;
+    if (trace) {
+      std::fprintf(stderr, "trace: B&B call %lld: |toRemove|=%zu |dontRemove|=%zu\n",
+                   static_cast<long long>(bnb_calls), to_remove.size(),
+                   dont_remove.size());
+    }
     // Size prune (cpp:261 via :386-391): two disjoint quorums cannot both
     // exceed half the SCC.
-    if (static_cast<int32_t>(dont_remove.size()) > half) return false;
+    if (static_cast<int32_t>(dont_remove.size()) > half) {
+      if (trace) {
+        std::fprintf(stderr, "trace: prune: |dontRemove|=%zu exceeds size bound\n",
+                     dont_remove.size());
+      }
+      return false;
+    }
     if (to_remove.empty() && dont_remove.empty()) return false;
 
     std::vector<uint8_t> local(g.n, 0);
@@ -198,7 +225,16 @@ struct Search {
       // quorum; either way stop descending (cpp:281-291).
       if (is_minimal_quorum(g, dont_remove)) {
         ++minimal_quorums;
+        if (trace) {
+          std::fprintf(stderr, "trace: minimal quorum #%lld found (size %zu)\n",
+                       static_cast<long long>(minimal_quorums),
+                       dont_remove.size());
+        }
         return visit(dont_remove);
+      }
+      if (trace) {
+        std::fprintf(stderr,
+                     "trace: prune: dontRemove contains a non-minimal quorum\n");
       }
       return false;
     }
@@ -253,13 +289,16 @@ extern "C" {
 // Disjoint-quorum search within one SCC.  Returns 1 iff all quorums
 // intersect; on 0, q1/q2 (buffers of capacity n) receive the witness pair.
 // stats_out[0..2] = {bnb_calls, minimal_quorums, fixpoint_calls}.
+// `trace` != 0 narrates every B&B call / prune / probe to stderr (the
+// reference's -t trace spew, cpp:258-259).
 int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
                      const int32_t* succ_tgt, const int32_t* roots,
                      const int32_t* units, const int32_t* mem,
                      const int32_t* inner, const int32_t* scc,
                      int32_t scc_len, int32_t scope_to_scc, int32_t use_rng,
-                     uint64_t seed, int32_t* q1_out, int32_t* q1_len,
-                     int32_t* q2_out, int32_t* q2_len, int64_t* stats_out) {
+                     uint64_t seed, int32_t trace, int32_t* q1_out,
+                     int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
+                     int64_t* stats_out) {
   Graph g{n, succ_off, succ_tgt, roots, units, mem, inner};
   // Reference semantics (Q6, cpp:354): the whole graph starts available —
   // sound for a sink SCC; scope_to_scc narrows availability to the SCC.
@@ -271,10 +310,18 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
 
   std::mt19937_64 rng_engine(seed);
   Search search{g, avail.data(), scc_vec, scc_len / 2,
-                use_rng ? &rng_engine : nullptr};
+                use_rng ? &rng_engine : nullptr, trace != 0};
   std::vector<int32_t> dont;
   search.iterate(scc_vec, dont);
 
+  if (trace != 0) {
+    std::fprintf(stderr,
+                 "trace: search done: %lld B&B calls, %lld minimal quorums, "
+                 "%lld fixpoints\n",
+                 static_cast<long long>(search.bnb_calls),
+                 static_cast<long long>(search.minimal_quorums),
+                 static_cast<long long>(search.fixpoint_calls));
+  }
   stats_out[0] = search.bnb_calls;
   stats_out[1] = search.minimal_quorums;
   stats_out[2] = search.fixpoint_calls;

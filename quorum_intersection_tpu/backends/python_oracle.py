@@ -23,6 +23,7 @@ pinned spec in SURVEY.md §2.1 C6-C9:
 
 from __future__ import annotations
 
+import logging
 import random
 import sys
 import time
@@ -83,14 +84,20 @@ def is_minimal_quorum(nodes: Sequence[int], graph: TrustGraph) -> bool:
 
 
 class _SearchState:
-    """Mutable search bookkeeping shared across the recursion."""
+    """Mutable search bookkeeping shared across the recursion.
 
-    __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls")
+    ``trace`` mirrors the reference's per-call trace spew (its static call
+    counter + BOOST_LOG_TRIVIAL(trace) narration, cpp:258-259): captured once
+    so the hot recursion pays a single attribute check when tracing is off.
+    """
+
+    __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls", "trace")
 
     def __init__(self) -> None:
         self.bnb_calls = 0
         self.minimal_quorums = 0
         self.fixpoint_calls = 0
+        self.trace = log.isEnabledFor(logging.DEBUG)
 
 
 def iterate_minimal_quorums(
@@ -121,7 +128,14 @@ def iterate_minimal_quorums(
     (cpp:343-345).
     """
     state.bnb_calls += 1
+    if state.trace:
+        log.debug(
+            "B&B call %d: |toRemove|=%d |dontRemove|=%d",
+            state.bnb_calls, len(to_remove), len(dont_remove),
+        )
     if current_visitor(dont_remove):
+        if state.trace:
+            log.debug("prune: |dontRemove|=%d exceeds size bound", len(dont_remove))
         return False
     if not to_remove and not dont_remove:
         return False
@@ -134,7 +148,14 @@ def iterate_minimal_quorums(
     if max_quorum(graph, dont_remove, avail):
         if is_minimal_quorum(dont_remove, graph):
             state.minimal_quorums += 1
+            if state.trace:
+                log.debug(
+                    "minimal quorum #%d found (size %d): %s",
+                    state.minimal_quorums, len(dont_remove), dont_remove,
+                )
             return visitor(list(dont_remove))
+        if state.trace:
+            log.debug("prune: dontRemove contains a non-minimal quorum")
         return False
 
     for v in to_remove:
@@ -205,10 +226,17 @@ class PythonOracleBackend:
             state.fixpoint_calls += 1
             disjoint = max_quorum(graph, scc, avail)
             if disjoint:
+                if state.trace:
+                    log.debug(
+                        "disjointness probe: FOUND disjoint quorum (size %d) — stopping",
+                        len(disjoint),
+                    )
                 outcome["intersects"] = False
                 outcome["q1"] = disjoint
                 outcome["q2"] = list(quorum)
                 return True
+            if state.trace:
+                log.debug("disjointness probe: no disjoint quorum; continuing")
             for v in quorum:
                 avail[v] = True
             return False
@@ -234,6 +262,11 @@ class PythonOracleBackend:
                 sys.setrecursionlimit(old_limit)
 
         seconds = time.perf_counter() - t0
+        if state.trace:
+            log.debug(
+                "search done: %d B&B calls, %d minimal quorums, %d fixpoints in %.3fs",
+                state.bnb_calls, state.minimal_quorums, state.fixpoint_calls, seconds,
+            )
         return SccCheckResult(
             intersects=bool(outcome["intersects"]),
             q1=outcome["q1"],

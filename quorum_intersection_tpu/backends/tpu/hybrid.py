@@ -220,6 +220,12 @@ class TpuHybridBackend:
             # one inflight batch whose results are never drained.
             stats["device_batches"] += 1
             stats["fixpoints"] += len(take)
+            log.debug(
+                "hybrid batch %d: %d fixpoint rows (padded to %d), backlog %d, "
+                "B&B states %d, minimal quorums %d",
+                stats["device_batches"], len(take), b, len(pending),
+                stats["bnb_states"], stats["minimal_quorums"],
+            )
             return take, run_jit(arrays.cast(masks), arrays.cast(frozens))
 
         # Double-buffered drive: while one batch's results cross the (slow)

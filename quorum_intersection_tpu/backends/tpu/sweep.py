@@ -27,6 +27,7 @@ but are never filtered — so verdicts match the oracle under either scoping.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import List, Optional, Tuple
 
@@ -304,6 +305,8 @@ class TpuSweepBackend:
                 fn = dispatchers[steps_per_call] = make_dispatch(steps_per_call)
             return fn(lo, hi_row(hi))
 
+        trace = log.isEnabledFor(logging.DEBUG)  # cached for the hot loop
+
         def drain_one() -> bool:
             """Sync the oldest in-flight program; True iff it hit."""
             nonlocal steps, candidates, first_hit, found
@@ -311,6 +314,11 @@ class TpuSweepBackend:
             hit = int(handle)
             steps += 1
             candidates += min(coverage, total - start)
+            if trace:
+                log.debug(
+                    "sweep program %d: start=%d coverage=%d checked=%d/%d hit=%s",
+                    steps, start, coverage, candidates, total, hit < int(INT32_MAX),
+                )
             if hit < int(INT32_MAX):
                 found = True
                 # Chunk-tail programs may report an aliased (wrapped) index;

@@ -90,6 +90,24 @@ class Circuit:
         return (self.n + 31) // 32
 
 
+def _check_qset_depth(qsets) -> None:
+    """Iterative depth guard: the interning recursion below (and the frozen
+    dataclass hashes it triggers) must never see a tree deeper than the
+    schema-level cap — graphs built through ``parse_fbas`` are pre-capped,
+    but programmatically constructed ones are not."""
+    from quorum_intersection_tpu.fbas.schema import MAX_QSET_DEPTH
+
+    for root in qsets:
+        stack = [(root, 0)]
+        while stack:
+            q, d = stack.pop()
+            if d > MAX_QSET_DEPTH:
+                raise ValueError(
+                    f"quorumSet nesting exceeds depth {MAX_QSET_DEPTH}"
+                )
+            stack.extend((iq, d + 1) for iq in q.inner)
+
+
 def encode_circuit(graph: TrustGraph) -> Circuit:
     """Encode every node's quorum set into one shared threshold circuit.
 
@@ -101,6 +119,7 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
     stored per unit in ``unit_depth`` with ``depth = max height``.
     """
     n = graph.n
+    _check_qset_depth(graph.qsets)
 
     thresholds_l: List[int] = []
     member_rows: List[dict] = []  # unit → {vertex: vote count}

@@ -130,15 +130,28 @@ def main(argv: Optional[Iterable[str]] = None) -> int:
     )
     args = parser.parse_args(list(argv) if argv is not None else None)
 
-    data = json.load(sys.stdin)
-    if args.report_dangling:
-        for ref in sorted(dangling_refs(data)):
-            print(f"dangling validator reference: {ref}", file=sys.stderr)
-    out = sanitize(
-        data,
-        compat=args.compat,
-        flag_zero_threshold=args.flag_zero_threshold,
-    )
+    try:
+        data = json.load(sys.stdin)
+        if not isinstance(data, list):
+            raise ValueError(f"top level must be a JSON array, got {type(data).__name__}")
+        if args.report_dangling:
+            for ref in sorted(dangling_refs(data)):
+                print(f"dangling validator reference: {ref}", file=sys.stderr)
+        out = sanitize(
+            data,
+            compat=args.compat,
+            flag_zero_threshold=args.flag_zero_threshold,
+        )
+    except RecursionError:
+        # Deep nesting can surface in the json C scanner or in the recursive
+        # sanity walks; either way the input is hostile, not a crash.
+        sys.stderr.write("invalid FBAS configuration: JSON nesting too deep\n")
+        return 1
+    except (ValueError, AttributeError, TypeError) as exc:
+        # Clean diagnostic + exit 1 on malformed stdin (the reference's
+        # 21-line sanitizer tracebacks here).
+        sys.stderr.write(f"invalid FBAS configuration: {exc}\n")
+        return 1
     json.dump(out, sys.stdout)
     return 0
 

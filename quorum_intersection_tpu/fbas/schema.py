@@ -32,6 +32,16 @@ class FbasSchemaError(ValueError):
     """Raised when the input JSON does not satisfy the FBAS schema."""
 
 
+# Hostile-input hardening: a quorum set nested deeper than this is rejected
+# with a clean schema error instead of exhausting the interpreter stack (the
+# reference would crash on such input, cpp:402-418).  Real stellarbeat
+# snapshots nest 1-2 levels; 128 is far beyond any legitimate FBAS while
+# keeping every downstream recursion (graph indexing, circuit interning,
+# native flattening — all capped to the same constant) well inside default
+# stack budgets.
+MAX_QSET_DEPTH = 128
+
+
 @dataclass(frozen=True)
 class QSet:
     """A (possibly nested) quorum set.
@@ -117,7 +127,11 @@ class Fbas:
         return node.name if node.name else node.public_key
 
 
-def _parse_qset(value, where: str) -> QSet:
+def _parse_qset(value, where: str, depth: int = 0) -> QSet:
+    if depth > MAX_QSET_DEPTH:
+        raise FbasSchemaError(
+            f"{where}: quorumSet nesting exceeds depth {MAX_QSET_DEPTH}"
+        )
     if value is None:
         return NULL_QSET
     if not isinstance(value, Mapping):
@@ -150,7 +164,10 @@ def _parse_qset(value, where: str) -> QSet:
         inner_raw = ()
     if not isinstance(inner_raw, Sequence) or isinstance(inner_raw, (str, bytes)):
         raise FbasSchemaError(f"{where}: innerQuorumSets must be an array")
-    inner = tuple(_parse_qset(q, f"{where}.innerQuorumSets[{i}]") for i, q in enumerate(inner_raw))
+    inner = tuple(
+        _parse_qset(q, f"{where}.innerQuorumSets[{i}]", depth + 1)
+        for i, q in enumerate(inner_raw)
+    )
     return QSet(threshold=threshold, validators=tuple(validators), inner=inner)
 
 
@@ -161,12 +178,17 @@ def parse_fbas(source: Union[str, bytes, IO, list]) -> Fbas:
     stdin, matching the reference's stdin-only contract, cpp:791), or an
     already-decoded list.
     """
-    if isinstance(source, (str, bytes)):
-        data = json.loads(source)
-    elif isinstance(source, list):
-        data = source
-    else:
-        data = json.load(source)
+    try:
+        if isinstance(source, (str, bytes)):
+            data = json.loads(source)
+        elif isinstance(source, list):
+            data = source
+        else:
+            data = json.load(source)
+    except RecursionError:
+        # json's C scanner recurses per nesting level; surface the same clean
+        # diagnostic as any other malformed input instead of a traceback.
+        raise FbasSchemaError("JSON nesting too deep") from None
     if not isinstance(data, list):
         raise FbasSchemaError(f"top level must be a JSON array, got {type(data).__name__}")
 

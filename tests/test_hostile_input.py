@@ -1,0 +1,159 @@
+"""Hostile-input hardening: deep nesting, junk bytes, and truncation must
+produce the clean ``invalid FBAS configuration`` diagnostic (exit 1) in both
+CLIs — never a traceback, RecursionError, or native stack overflow.  The
+reference crashes on all of these (`/root/reference/quorum_intersection.cpp:
+402-418` recurses uncapped; its sanitizer tracebacks on malformed stdin).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.fbas.schema import (
+    MAX_QSET_DEPTH,
+    FbasSchemaError,
+    parse_fbas,
+)
+
+
+def nested_qset_node(depth: int) -> str:
+    """One node whose quorumSet nests ``depth`` innerQuorumSets levels."""
+    qset = '{"threshold": 1, "validators": ["A"]}'
+    for _ in range(depth):
+        qset = '{"threshold": 1, "validators": ["A"], "innerQuorumSets": [' + qset + "]}"
+    return '[{"publicKey": "A", "quorumSet": ' + qset + "}]"
+
+
+def run_cli(stdin_data: str, *args: str):
+    return subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", *args],
+        input=stdin_data, capture_output=True, text=True, timeout=120,
+    )
+
+
+def run_sanitizer(stdin_data: str, *args: str):
+    return subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu.fbas.sanitize", *args],
+        input=stdin_data, capture_output=True, text=True, timeout=120,
+    )
+
+
+def assert_clean_rejection(proc) -> None:
+    assert proc.returncode == 1
+    assert "invalid FBAS configuration" in proc.stderr
+    assert "Traceback" not in proc.stderr
+    assert "RecursionError" not in proc.stderr
+
+
+class TestLibraryDepthCap:
+    def test_within_cap_parses(self):
+        fbas = parse_fbas(nested_qset_node(MAX_QSET_DEPTH - 1))
+        assert len(fbas) == 1
+        assert fbas[0].qset.max_depth() == MAX_QSET_DEPTH - 1
+
+    def test_beyond_cap_rejected(self):
+        with pytest.raises(FbasSchemaError, match="nesting exceeds depth"):
+            parse_fbas(nested_qset_node(MAX_QSET_DEPTH + 10))
+
+    def test_deep_json_array_clean_error(self):
+        deep = "[" * 4000 + "]" * 4000
+        with pytest.raises(FbasSchemaError):
+            parse_fbas(deep)
+
+    def test_encode_guard_on_programmatic_graph(self):
+        from quorum_intersection_tpu.encode.circuit import encode_circuit
+        from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
+
+        q = IndexedQSet(threshold=1, members=(0,))
+        for _ in range(MAX_QSET_DEPTH + 10):
+            q = IndexedQSet(threshold=1, members=(0,), inner=(q,))
+        graph = TrustGraph(n=1, succ=[[0]], qsets=[q], node_ids=["A"], names=[""])
+        with pytest.raises(ValueError, match="nesting exceeds depth"):
+            encode_circuit(graph)
+
+
+class TestPythonCliHostileInput:
+    def test_deep_qset_nesting(self):
+        assert_clean_rejection(run_cli(nested_qset_node(400)))
+
+    def test_deep_json_arrays(self):
+        assert_clean_rejection(run_cli("[" * 6000 + "]" * 6000))
+
+    def test_junk_unicode(self):
+        assert_clean_rejection(run_cli("你好퟿ \x00\x01 {]["))
+
+    @pytest.mark.parametrize("frac", [0.1, 0.5, 0.9])
+    def test_truncated_fixture(self, ref_fixture, frac):
+        data = ref_fixture("correct.json").read_text()
+        cut = data[: int(len(data) * frac)]
+        assert_clean_rejection(run_cli(cut))
+
+
+class TestSanitizerHostileInput:
+    def test_malformed_json(self):
+        proc = run_sanitizer("not json at all")
+        assert_clean_rejection(proc)
+
+    def test_deep_json(self):
+        proc = run_sanitizer("[" * 6000 + "]" * 6000)
+        assert_clean_rejection(proc)
+
+    def test_non_array_top_level(self):
+        proc = run_sanitizer('{"publicKey": "A"}')
+        assert_clean_rejection(proc)
+
+    def test_still_filters_valid_input(self):
+        data = [
+            {"publicKey": "A", "quorumSet": {"threshold": 99, "validators": ["A"]}},
+            {"publicKey": "B", "quorumSet": {"threshold": 1, "validators": ["B"]}},
+        ]
+        proc = run_sanitizer(json.dumps(data))
+        assert proc.returncode == 0
+        assert [n["publicKey"] for n in json.loads(proc.stdout)] == ["B"]
+
+
+class TestNativeCliHostileInput:
+    @pytest.fixture(scope="class")
+    def native(self):
+        from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+        try:
+            return str(build_native_cli())
+        except Exception as exc:  # pragma: no cover - g++ missing
+            pytest.skip(f"native CLI unavailable: {exc}")
+
+    def run_native(self, native, stdin_data: str):
+        return subprocess.run(
+            [native], input=stdin_data, capture_output=True, text=True, timeout=120
+        )
+
+    def test_deep_qset_nesting_matches_python(self, native):
+        payload = nested_qset_node(400)
+        n = self.run_native(native, payload)
+        p = run_cli(payload)
+        assert n.returncode == p.returncode == 1
+        assert "invalid FBAS configuration" in n.stderr
+
+    def test_deep_json_arrays(self, native):
+        n = self.run_native(native, "[" * 6000 + "]" * 6000)
+        assert n.returncode == 1
+        assert "invalid FBAS configuration" in n.stderr
+
+    def test_deep_json_objects(self, native):
+        deep = '{"a":' * 6000 + "1" + "}" * 6000
+        n = self.run_native(native, deep)
+        assert n.returncode == 1
+        assert "invalid FBAS configuration" in n.stderr
+
+    def test_junk_unicode(self, native):
+        n = self.run_native(native, "你好 \x01 {][")
+        assert n.returncode == 1
+        assert "invalid FBAS configuration" in n.stderr
+
+    def test_within_cap_depth_agrees_with_python(self, native):
+        payload = nested_qset_node(MAX_QSET_DEPTH - 1)
+        n = self.run_native(native, payload)
+        p = run_cli(payload)
+        assert (n.stdout, n.returncode) == (p.stdout, p.returncode)

@@ -103,3 +103,52 @@ def test_empty_graph():
     g = _graph([])
     assert pagerank_np(g).shape == (0,)
     assert pagerank(g).shape == (0,)
+
+
+class TestSparseRepresentation:
+    """CSR/COO segment-sum path: O(E) memory above DENSE_LIMIT, parity with
+    the dense matvec to float32 tolerance (VERDICT r1 §missing-4)."""
+
+    def test_sparse_matches_dense_np(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        g = _graph(stellar_like_fbas(n_watchers=300))
+        d = pagerank_np(g, dense=True)
+        s = pagerank_np(g, dense=False)
+        np.testing.assert_allclose(s, d, rtol=2e-4, atol=2e-6)
+
+    def test_sparse_jax_matches_np(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        g = _graph(stellar_like_fbas(n_watchers=300))
+        s_np = pagerank_np(g, dense=False)
+        s_jax = pagerank(g, dense=False)
+        np.testing.assert_allclose(s_jax, s_np, rtol=2e-4, atol=2e-6)
+
+    def test_auto_selects_sparse_above_limit(self):
+        from quorum_intersection_tpu.analytics.pagerank import DENSE_LIMIT, edge_arrays
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        data = stellar_like_fbas(n_watchers=DENSE_LIMIT + 100)
+        g = _graph(data)
+        assert g.n > DENSE_LIMIT
+        src, dst, outdeg = edge_arrays(g)
+        # O(E): edge arrays, not an (N, N) matrix
+        assert src.shape == dst.shape == (g.n_edges,)
+        assert outdeg.sum() == g.n_edges
+        r = pagerank_np(g)  # auto → sparse; must converge and normalize
+        assert r.shape == (g.n,)
+        assert abs(float(r.sum()) - 1.0) < 1e-3
+
+    def test_5k_node_snapshot_scales(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        data = stellar_like_fbas(n_watchers=4800, n_null=100)
+        g = _graph(data)
+        assert g.n >= 4900
+        r_np = pagerank_np(g)
+        r_jax = pagerank(g)
+        assert r_np.shape == (g.n,)
+        np.testing.assert_allclose(r_jax, r_np, rtol=2e-3, atol=2e-6)
+        top = sorted_ranks(g, r_np)[0][0]
+        assert top.startswith("core")  # the trusted core outranks watchers

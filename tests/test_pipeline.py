@@ -197,3 +197,59 @@ class TestStellarLike:
         res = solve(stellar_like_fbas(broken=True, **small), backend="auto")
         assert res.intersects is False
         assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
+
+
+class TestSccScan:
+    """Native vs Python per-SCC quorum scan: identical quorums, and the big
+    snapshot routes to the native path (VERDICT r1 §weak-7)."""
+
+    def test_native_scan_matches_python(self):
+        from quorum_intersection_tpu.backends.cpp import native_scc_scan
+        from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+        from quorum_intersection_tpu.fbas.semantics import max_quorum
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        g = build_graph(parse_fbas(stellar_like_fbas(n_watchers=300)))
+        count, comp = tarjan_scc(g.n, g.succ)
+        sccs = group_sccs(g.n, comp, count)
+        try:
+            native = native_scc_scan(g, sccs)
+        except Exception as exc:  # pragma: no cover - g++ missing
+            pytest.skip(f"native oracle unavailable: {exc}")
+        for members, nq in zip(sccs, native):
+            avail = [False] * g.n
+            for v in members:
+                avail[v] = True
+            assert nq == max_quorum(g, members, avail)
+
+    def test_big_snapshot_scan_fast_and_correct(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        try:
+            from quorum_intersection_tpu.backends.cpp import build_library
+
+            build_library()  # outside the timed phase: compile ≠ scan time
+        except Exception as exc:  # pragma: no cover - g++ missing
+            pytest.skip(f"native oracle unavailable: {exc}")
+        data = stellar_like_fbas(n_watchers=1500)
+        res = solve(data, backend="cpp")
+        assert res.intersects is True
+        # ~1500 singleton SCCs: the native scan keeps this well under a second
+        assert res.timers["scc_scan"] < 2.0
+
+    def test_explicit_python_backend_stays_interpreted(self, monkeypatch):
+        # --backend python is a no-native-code promise: the scan must not
+        # compile or call the C++ oracle even on large graphs.
+        import quorum_intersection_tpu.pipeline as pl
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        def boom(*a, **k):  # pragma: no cover - called means failure
+            raise AssertionError("native scan used under --backend python")
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.backends.cpp.native_scc_scan", boom
+        )
+        monkeypatch.setattr(pl, "NATIVE_SCAN_LIMIT", 8)
+        res = solve(stellar_like_fbas(n_core_orgs=3, n_watchers=10), backend="python")
+        assert res.intersects is True
