@@ -1,0 +1,104 @@
+"""Hybrid-vs-native-oracle crossover benchmark (VERDICT r1 §next-2).
+
+Measures end-to-end time-to-verdict of the batched-device hybrid search
+against the native C++ oracle on the pruned-search workloads where the
+exhaustive sweep no longer applies: safe hierarchical networks at
+|SCC| = 36/48/64 and safe majority networks (the B&B worst case) at
+16/20 nodes.  Emits a markdown table (for the README) and a JSON line per
+row.
+
+The verdicts must agree row-by-row or the row is marked INVALID — a perf
+number for a wrong answer is worthless.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/hybrid_crossover.py --quick  # smoke
+    python benchmarks/hybrid_crossover.py                            # real chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def workloads(quick: bool):
+    """Safe networks where the full minimal-quorum enumeration is tractable.
+
+    NB the search cost on safe networks grows exponentially with the SCC —
+    a safe 36-node hierarchical network already enumerates ~129k minimal
+    quorums at ~16 fixpoints each and takes the NATIVE oracle minutes
+    (measured: hier-6x4 = 1M B&B calls = 1.4 s single-core; each +1 org
+    multiplies by ~9).  These sizes keep both sides within CI budgets; the
+    crossover story extrapolates from the per-fixpoint costs they expose.
+    """
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+
+    rows = [
+        ("majority-14", majority_fbas(14), 14),
+        ("hier-5x3 (scc 15)", hierarchical_fbas(5, 3), 15),
+    ]
+    if not quick:
+        rows += [
+            ("majority-16", majority_fbas(16), 16),
+            ("majority-18", majority_fbas(18), 18),
+            ("hier-6x4 (scc 24)", hierarchical_fbas(6, 4), 24),
+        ]
+    return rows
+
+
+def time_solve(data, backend) -> tuple:
+    from quorum_intersection_tpu.pipeline import solve
+
+    t0 = time.perf_counter()
+    res = solve(data, backend=backend)
+    return time.perf_counter() - t0, res
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--batch", type=int, default=1024)
+    args = parser.parse_args()
+
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+
+    from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+
+    device = jax.devices()[0].device_kind
+    print(f"device: {device}\n")
+    print("| workload | native C++ (s) | hybrid (s) | speedup | hybrid fixpoints | cache hits | wasted rows |")
+    print("|---|---|---|---|---|---|---|")
+    for name, data, scc in workloads(args.quick):
+        cpp_s, cpp_res = time_solve(data, CppOracleBackend())
+        hy_s, hy_res = time_solve(data, TpuHybridBackend(batch=args.batch))
+        ok = cpp_res.intersects == hy_res.intersects
+        speed = cpp_s / hy_s if hy_s > 0 else float("inf")
+        flag = "" if ok else " **INVALID: verdict mismatch**"
+        print(
+            f"| {name} | {cpp_s:.3f} | {hy_s:.3f} | {speed:.2f}x{flag} | "
+            f"{hy_res.stats.get('fixpoints')} | {hy_res.stats.get('cache_hits')} | "
+            f"{hy_res.stats.get('wasted_rows')} |"
+        )
+        print(json.dumps({
+            "workload": name, "scc": scc, "device": device,
+            "cpp_seconds": round(cpp_s, 4), "hybrid_seconds": round(hy_s, 4),
+            "speedup": round(speed, 3), "verdict_ok": ok,
+            "hybrid_stats": {k: v for k, v in hy_res.stats.items() if k != "backend"},
+            "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,13 +34,9 @@ DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 
 
 def _platform_sweep_limit() -> int:
-    try:
-        import jax
+    from quorum_intersection_tpu.utils.platform import is_cpu_platform
 
-        backend = jax.default_backend()
-    except Exception:  # noqa: BLE001 - no jax ⇒ no sweep at all
-        return 0
-    return SWEEP_LIMIT_CPU if backend == "cpu" else SWEEP_LIMIT_TPU
+    return SWEEP_LIMIT_CPU if is_cpu_platform() else SWEEP_LIMIT_TPU
 
 
 class AutoBackend:
@@ -67,7 +63,8 @@ class AutoBackend:
     def _hybrid(self):
         from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
-        return TpuHybridBackend()
+        # Same seeded/randomized tie-break contract as the host oracles.
+        return TpuHybridBackend(**self._oracle_options)
 
     def _cpu_oracle(self):
         try:
@@ -106,12 +103,24 @@ class AutoBackend:
                 "(no progress will be recorded)", len(scc),
             )
         if self.prefer_tpu:
-            try:
-                backend = self._hybrid()
-                log.debug("auto: hybrid backend for |scc|=%d", len(scc))
-                return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
-            except Exception as exc:  # noqa: BLE001
-                log.info("hybrid backend unavailable (%s); falling back", exc)
+            # Measured (benchmarks/hybrid_crossover.py): on the CPU
+            # emulation the hybrid's per-row cost is ~100× the native
+            # oracle's per-fixpoint cost, so it loses at every tractable
+            # size — only route to it when a real accelerator is attached.
+            from quorum_intersection_tpu.utils.platform import is_cpu_platform
+
+            if is_cpu_platform():
+                log.info(
+                    "hybrid skipped on CPU platform (native oracle measured "
+                    "faster at every tractable size); using host oracle"
+                )
+            else:
+                try:
+                    backend = self._hybrid()
+                    log.debug("auto: hybrid backend for |scc|=%d", len(scc))
+                    return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
+                except Exception as exc:  # noqa: BLE001
+                    log.info("hybrid backend unavailable (%s); falling back", exc)
         backend = self._cpu_oracle()
         log.debug("auto: %s backend for |scc|=%d", backend.name, len(scc))
         return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)

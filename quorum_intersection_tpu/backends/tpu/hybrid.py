@@ -10,12 +10,26 @@ fixpoint the search needs into a row of a batched device evaluation:
 - the search is an explicit LIFO worklist of (toRemove, dontRemove) states
   (LIFO ≈ depth-first, keeping the frontier from ballooning the way a strict
   BFS would);
-- each round pops up to ``batch`` pending fixpoint *requests* — branch
-  feasibility checks, minimality probes (|Q|+1 per candidate, cpp:184-198),
-  and disjointness probes (cpp:364-378, with the Q6 frozen mask) — pads them
-  into one (B, n) matrix, and runs a single jitted batch fixpoint;
-- results route back to per-state continuations on the host, which apply the
-  prunes and push children.
+- each round pops up to ``batch`` pending fixpoint *requests*, pads them
+  into one (B, n) matrix, and runs a single jitted batch fixpoint; results
+  route back to per-state continuations on the host, which apply the prunes
+  and push children.
+
+Three devices-hate-round-trips optimizations (r2, after VERDICT r1 flagged
+the un-benchmarked frontier as too narrow to fill batches):
+
+- **speculative dispatch**: a state's ``dont`` and ``all`` fixpoints launch
+  together (the ``all`` result is needed whenever ``dont`` holds no quorum —
+  the common case), and the disjointness probe launches alongside the
+  minimality rows instead of after them; a state needs ~2 device rounds
+  instead of ~4, and wasted rows are counted in ``stats["wasted_rows"]``;
+- **fixpoint memoization**: the exclude-branch child shares its parent's
+  ``dontRemove`` set, so its ``dont`` fixpoint is a guaranteed repeat; a
+  host-side mask→result cache short-circuits those rows
+  (``stats["cache_hits"]``);
+- **deep dispatch pipeline**: several batches stay in flight so the
+  host↔device round-trip latency overlaps with device compute (the same
+  measured bottleneck the sweep pipeline hides, sweep.py MAX_INFLIGHT).
 
 Enumeration order differs from the serial recursion (branches interleave),
 but the enumerated *set* of minimal quorums is identical — the recursion tree
@@ -28,9 +42,10 @@ Batch sizes are bucketed to powers of two so XLA compiles a handful of shapes.
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -42,35 +57,68 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.hybrid")
 
-DEFAULT_BATCH = 1024
+DEFAULT_BATCH = None  # platform-adaptive: see _auto_batch
+# A real chip amortizes its fixed per-program dispatch cost best with big
+# row blocks (the sweep's measured lesson, sweep.py module docs); the CPU
+# emulation's per-row cost dominates instead, so smaller blocks keep
+# latency-to-first-result low without hurting throughput.
+BATCH_TPU = 32768
+BATCH_CPU = 2048
+MAX_INFLIGHT = 4
+# Memoized fixpoint results are ~n bytes each; bound the cache so a
+# pathological search cannot exhaust host memory.
+CACHE_LIMIT = 1 << 17
 
 
 @dataclass
 class _State:
-    """One node of the branch-and-bound tree."""
+    """One node of the branch-and-bound tree.
+
+    Result routing is order-independent: ``dont``/``all`` results may land
+    in either order (they are dispatched speculatively together), as may the
+    minimality rows and the speculative disjointness probe.
+    """
 
     to_remove: List[int]
     dont_remove: List[int]
-    phase: str = "check_dont"  # check_dont → check_all → branch | minimality → probe
-    fq_dont: Optional[List[int]] = None
+    dont_done: bool = False
+    dont_has_quorum: bool = False
+    all_done: bool = False
+    all_cached: bool = False
+    all_survivors: Optional[List[int]] = None
     minimality_pending: int = 0
     minimality_failed: bool = False
+    probe_done: bool = False
+    probe_cached: bool = False
+    probe_wasted: bool = False
+    probe_survivors: List[int] = field(default_factory=list)
 
 
 @dataclass
 class _Request:
     mask: np.ndarray  # (n,) float32 candidate availability
-    frozen: Optional[np.ndarray]  # (n,) float32 or None
+    frozen: bool  # True: apply the Q6 frozen mask (disjointness probes)
     state: _State
     kind: str  # "dont" | "all" | "minimal" | "probe"
+    cached: bool = False  # served from the memo, no device row occupied
 
 
 class TpuHybridBackend:
     name = "tpu-hybrid"
     needs_circuit = True
 
-    def __init__(self, batch: int = DEFAULT_BATCH) -> None:
-        self.batch = batch
+    def __init__(
+        self,
+        batch: Optional[int] = DEFAULT_BATCH,
+        seed: Optional[int] = None,
+        randomized: bool = False,
+        max_inflight: int = MAX_INFLIGHT,
+    ) -> None:
+        self.batch = batch  # None ⇒ platform-adaptive at check time
+        self.max_inflight = max_inflight
+        # Same contract as the host oracles: deterministic tie-break by
+        # default, seeded-uniform over the same argmax set otherwise.
+        self._rng = random.Random(seed) if (randomized or seed is not None) else None
 
     def check_scc(
         self,
@@ -82,6 +130,11 @@ class TpuHybridBackend:
     ) -> SccCheckResult:
         if circuit is None:
             raise ValueError("hybrid backend requires the encoded circuit")
+        from quorum_intersection_tpu.utils.platform import is_cpu_platform
+
+        batch = self.batch
+        if batch is None:
+            batch = BATCH_CPU if is_cpu_platform() else BATCH_TPU
         t0 = time.perf_counter()
         n = graph.n
         half = len(scc) // 2
@@ -91,7 +144,14 @@ class TpuHybridBackend:
             np.zeros(n, dtype=np.float32) if scope_to_scc else 1.0 - scc_mask
         )
 
-        stats = {"device_batches": 0, "fixpoints": 0, "bnb_states": 0, "minimal_quorums": 0}
+        stats = {
+            "device_batches": 0,
+            "fixpoints": 0,
+            "bnb_states": 0,
+            "minimal_quorums": 0,
+            "cache_hits": 0,
+            "wasted_rows": 0,
+        }
         found: Dict[str, Optional[List[int]]] = {"q1": None, "q2": None}
 
         def mask_of(nodes: List[int]) -> np.ndarray:
@@ -101,6 +161,19 @@ class TpuHybridBackend:
 
         # LIFO worklist of pending device requests (LIFO ≈ depth-first).
         pending: List[_Request] = []
+        # mask-bytes → survivor list; keyed separately for frozen probes.
+        cache: Dict[bytes, List[int]] = {}
+
+        def submit(req: _Request) -> None:
+            """Dispatch a request, short-circuiting through the cache."""
+            key = (b"f" if req.frozen else b"p") + req.mask.tobytes()
+            hit = cache.get(key)
+            if hit is not None:
+                stats["cache_hits"] += 1
+                req.cached = True
+                handle(req, hit)
+            else:
+                pending.append(req)
 
         def push_state(state: _State) -> None:
             # Prune 1 (size, cpp:386-391) and prune 2 (empty, cpp:266-268).
@@ -109,102 +182,142 @@ class TpuHybridBackend:
             if not state.to_remove and not state.dont_remove:
                 return
             stats["bnb_states"] += 1
-            pending.append(
-                _Request(mask_of(state.dont_remove), None, state, "dont")
+            # Speculative pair: `all` is consumed whenever `dont` holds no
+            # quorum — the overwhelmingly common case in the tree interior.
+            submit(_Request(mask_of(state.dont_remove), False, state, "dont"))
+            submit(
+                _Request(
+                    mask_of(state.dont_remove + state.to_remove), False, state, "all"
+                )
             )
 
-        root = _State(to_remove=list(scc), dont_remove=[])
-        push_state(root)
+        def branch(state: _State) -> None:
+            """Prunes 4-6 then branch (cpp:301-345); needs dont (no quorum)
+            AND the speculative `all` result."""
+            survivors = state.all_survivors or []
+            if not survivors:
+                return
+            quorum_set = set(survivors)
+            if any(v not in quorum_set for v in state.dont_remove):
+                return
+            best = find_best_node(survivors, state.dont_remove, graph, self._rng)
+            remaining = quorum_set - set(state.dont_remove)
+            if not remaining:
+                return
+            new_to_remove = sorted(v for v in remaining if v != best)
+            # Include-branch pushed first so the LIFO explores the
+            # exclude-branch first, like the serial order (cpp:336, :343).
+            push_state(
+                _State(
+                    to_remove=list(new_to_remove),
+                    dont_remove=state.dont_remove + [best],
+                )
+            )
+            push_state(
+                _State(
+                    to_remove=list(new_to_remove),
+                    dont_remove=list(state.dont_remove),
+                )
+            )
 
-        def handle(req: _Request, result: np.ndarray) -> None:
+        def minimal_confirmed(state: _State) -> None:
+            stats["minimal_quorums"] += 1
+            if state.probe_done:
+                finish_probe(state)
+            # else: the speculative probe result will arrive and route here.
+
+        def waste_probe(state: _State) -> None:
+            """Count a discarded speculative probe: once per state, device
+            rows only (cache hits never occupied a row)."""
+            if state.probe_done and not state.probe_wasted and not state.probe_cached:
+                state.probe_wasted = True
+                stats["wasted_rows"] += 1
+
+        def finish_probe(state: _State) -> None:
+            if state.probe_survivors:
+                found["q1"] = state.probe_survivors
+                found["q2"] = list(state.dont_remove)
+
+        def handle(req: _Request, survivors: List[int]) -> None:
             """Route one fixpoint result back into the search."""
             state = req.state
-            survivors = [v for v in np.nonzero(result)[0].tolist()]
 
             if req.kind == "dont":
+                state.dont_done = True
                 if survivors:
                     # dontRemove already contains a quorum (cpp:281-291):
-                    # minimal iff every single-node removal kills it.
-                    state.fq_dont = survivors
-                    state.phase = "minimality"
+                    # minimal iff every single-node removal kills it.  The
+                    # speculative `all` row becomes dead weight (a wasted
+                    # DEVICE row only if it wasn't served from the memo).
+                    state.dont_has_quorum = True
+                    if state.all_done and not state.all_cached:
+                        stats["wasted_rows"] += 1
                     members = state.dont_remove
                     state.minimality_pending = len(members)
-                    state.minimality_failed = False
                     if not members:
                         return
                     for v in members:
                         m = mask_of(members)
                         m[v] = 0.0
-                        pending.append(_Request(m, None, state, "minimal"))
-                else:
-                    state.phase = "check_all"
-                    pending.append(
-                        _Request(
-                            mask_of(state.dont_remove + state.to_remove),
-                            None,
-                            state,
-                            "all",
-                        )
-                    )
+                        submit(_Request(m, False, state, "minimal"))
+                    # Speculative disjointness probe (cpp:357-384), valid
+                    # only if minimality confirms; wasted otherwise.
+                    probe = np.clip(scc_mask - mask_of(members), 0.0, 1.0)
+                    submit(_Request(probe, True, state, "probe"))
+                elif state.all_done:
+                    branch(state)
+                return
+
+            if req.kind == "all":
+                state.all_done = True
+                state.all_cached = req.cached
+                state.all_survivors = survivors
+                if state.dont_done:
+                    if state.dont_has_quorum:
+                        if not req.cached:
+                            stats["wasted_rows"] += 1
+                    else:
+                        branch(state)
                 return
 
             if req.kind == "minimal":
                 state.minimality_pending -= 1
                 if survivors:
                     state.minimality_failed = True
-                if state.minimality_pending == 0 and not state.minimality_failed:
-                    # Minimal quorum found → disjointness probe (cpp:357-384).
-                    stats["minimal_quorums"] += 1
-                    probe = np.clip(scc_mask - mask_of(state.dont_remove), 0.0, 1.0)
-                    pending.append(_Request(probe, frozen_probe, state, "probe"))
+                    waste_probe(state)
+                elif state.minimality_pending == 0 and not state.minimality_failed:
+                    minimal_confirmed(state)
                 return
 
             if req.kind == "probe":
-                if survivors:
-                    found["q1"] = survivors
-                    found["q2"] = list(state.dont_remove)
+                state.probe_done = True
+                state.probe_cached = req.cached
+                state.probe_survivors = survivors
+                if state.minimality_failed:
+                    waste_probe(state)
+                elif state.minimality_pending == 0:
+                    # Minimality already confirmed; deliver the probe.
+                    finish_probe(state)
                 return
 
-            if req.kind == "all":
-                # Prunes 4-6 then branch (cpp:301-345).
-                if not survivors:
-                    return
-                quorum_set = set(survivors)
-                if any(v not in quorum_set for v in state.dont_remove):
-                    return
-                best = find_best_node(survivors, state.dont_remove, graph, None)
-                remaining = quorum_set - set(state.dont_remove)
-                if not remaining:
-                    return
-                new_to_remove = sorted(v for v in remaining if v != best)
-                # Include-branch pushed first so the LIFO explores the
-                # exclude-branch first, like the serial order (cpp:336, :343).
-                push_state(
-                    _State(
-                        to_remove=list(new_to_remove),
-                        dont_remove=state.dont_remove + [best],
-                    )
-                )
-                push_state(
-                    _State(to_remove=list(new_to_remove), dont_remove=list(state.dont_remove))
-                )
-                return
+        root = _State(to_remove=list(scc), dont_remove=[])
+        push_state(root)
 
         import jax
 
         from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, fixpoint
 
         arrays = CircuitArrays(circuit)
+        frozen_row = arrays.cast(frozen_probe)
 
         @jax.jit
-        def run_jit(avail, frozen):
-            return fixpoint(arrays, avail, frozen)
-
-        zeros = np.zeros(n, dtype=np.float32)
+        def run_jit(avail, frozen_flags):
+            # Per-row frozen selection: probes get the Q6 mask, others zero.
+            return fixpoint(arrays, avail, frozen_flags[:, None] * frozen_row)
 
         def launch():
             """Pop up to `batch` requests and dispatch them asynchronously."""
-            take = pending[-self.batch :]
+            take = pending[-batch:]
             del pending[-len(take) :]
             # Bucket the padded batch to powers of two: a handful of compiled
             # shapes instead of one per frontier size.
@@ -212,39 +325,45 @@ class TpuHybridBackend:
             while b < len(take):
                 b *= 2
             masks = np.zeros((b, n), dtype=np.float32)
-            frozens = np.zeros((b, n), dtype=np.float32)
+            flags = np.zeros((b,), dtype=np.float32)
             for i, req in enumerate(take):
                 masks[i] = req.mask
-                frozens[i] = req.frozen if req.frozen is not None else zeros
+                flags[i] = 1.0 if req.frozen else 0.0
             # NB stats count DISPATCHED work: an early witness exit may leave
-            # one inflight batch whose results are never drained.
+            # inflight batches whose results are never drained.
             stats["device_batches"] += 1
             stats["fixpoints"] += len(take)
             log.debug(
                 "hybrid batch %d: %d fixpoint rows (padded to %d), backlog %d, "
-                "B&B states %d, minimal quorums %d",
+                "B&B states %d, minimal quorums %d, cache hits %d",
                 stats["device_batches"], len(take), b, len(pending),
-                stats["bnb_states"], stats["minimal_quorums"],
+                stats["bnb_states"], stats["minimal_quorums"], stats["cache_hits"],
             )
-            return take, run_jit(arrays.cast(masks), arrays.cast(frozens))
+            return take, run_jit(arrays.cast(masks), arrays.cast(flags))
 
-        # Double-buffered drive: while one batch's results cross the (slow)
-        # host↔device link, the next batch from the existing backlog is
-        # already on the device.  Handling order across batches is
-        # correctness-irrelevant: states' phase transitions are counted, not
-        # ordered, and any disjoint pair is a valid witness.
+        def record(take, results) -> None:
+            for i, req in enumerate(take):
+                survivors = np.nonzero(results[i])[0].tolist()
+                key = (b"f" if req.frozen else b"p") + req.mask.tobytes()
+                if len(cache) >= CACHE_LIMIT:
+                    cache.clear()
+                cache[key] = survivors
+                handle(req, survivors)
+                if found["q1"] is not None:
+                    return
+
+        # Pipelined drive: several batches in flight so the host↔device
+        # round-trip overlaps with device compute.  Handling order across
+        # batches is correctness-irrelevant: states' phase transitions are
+        # counted, not ordered, and any disjoint pair is a valid witness.
         from collections import deque
 
         inflight: "deque" = deque()
         while (pending or inflight) and found["q1"] is None:
-            while pending and len(inflight) < 2:
+            while pending and len(inflight) < self.max_inflight:
                 inflight.append(launch())
             take, device_out = inflight.popleft()
-            results = np.asarray(device_out) != 0  # sync point
-            for i, req in enumerate(take):
-                handle(req, results[i])
-                if found["q1"] is not None:
-                    break
+            record(take, np.asarray(device_out) != 0)  # sync point
 
         seconds = time.perf_counter() - t0
         stats.update({"backend": self.name, "seconds": seconds})

@@ -138,7 +138,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from quorum_intersection_tpu.pipeline import solve_graph
 
     backend_options = {}
-    if args.backend in ("python", "cpp", "auto", "tpu") and (
+    if args.backend in ("python", "cpp", "auto", "tpu", "tpu-hybrid") and (
         args.seed is not None or args.randomized
     ):
         backend_options = {"seed": args.seed, "randomized": True}

@@ -69,14 +69,12 @@ def initialize(
     # Probe whether a launcher already brought the distributed runtime up
     # WITHOUT touching the XLA backend: jax.process_count() would initialize
     # backends and then guarantee jax.distributed.initialize() below raises.
-    try:
-        from jax._src.distributed import global_state as _dist_state
-
-        if getattr(_dist_state, "client", None) is not None:
+    # Public API (jax ≥ 0.4.15); older jaxes fall through to the try/except
+    # around initialize below, which degrades loudly rather than silently.
+    if getattr(jax.distributed, "is_initialized", None) is not None:
+        if jax.distributed.is_initialized():
             _initialized = True
             return
-    except ImportError:  # pragma: no cover - private module moved
-        pass
     if coordinator_address is None and num_processes is None:
         import os
 

@@ -12,6 +12,21 @@ from __future__ import annotations
 import os
 
 
+def is_cpu_platform() -> bool:
+    """True when JAX's default backend is the CPU (or JAX is absent/broken).
+
+    The single shared probe for platform-dependent tuning (sweep limits,
+    hybrid batch sizes, hybrid routing) — callers must not re-implement it,
+    or their exception policies drift apart.
+    """
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 - no jax ⇒ no accelerator either
+        return True
+
+
 def honor_platform_env() -> None:
     """Re-pin jax onto the platforms named by ``JAX_PLATFORMS`` when the
     ambient config would override them (no-op otherwise; safe pre-query)."""

@@ -1,0 +1,60 @@
+"""Worker half of the two-process `jax.distributed` test (not a test module;
+launched as a subprocess by tests/test_distributed.py).
+
+Each of the two processes brings up 4 emulated CPU devices, joins the
+distributed runtime through a localhost coordinator, builds the 8-device
+global candidate mesh, and runs the sharded sweep on a safe and a broken
+majority FBAS.  Results print as one JSON line for the parent to compare —
+across processes and against a single-process solve.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon sitecustomize repin
+
+    from quorum_intersection_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert distributed.is_multihost()
+
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    mesh = distributed.global_candidate_mesh()
+    out = {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "global_devices": int(mesh.devices.size),
+    }
+    for broken in (False, True):
+        res = solve(
+            majority_fbas(11, broken=broken),
+            backend=TpuSweepBackend(batch=64, mesh=mesh),
+        )
+        out["broken" if broken else "safe"] = {
+            "intersects": res.intersects,
+            "q1": res.q1,
+            "q2": res.q2,
+            "candidates_checked": res.stats.get("candidates_checked"),
+        }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Two-process `jax.distributed` execution: the multi-host path actually
+runs (process_count == 2), the sharded sweep on the global candidate mesh
+produces verdicts in both processes, and they match the single-process
+result (VERDICT r1 §missing-3 / SURVEY.md §5 distributed-backend
+obligation).  CPU emulation: 2 processes × 4 emulated devices each."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).with_name("_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def two_process_results():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    repo_root = str(WORKER.parent.parent)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("two-process run timed out (coordinator deadlock?)")
+    results = []
+    for rc, out, err in outs:
+        if rc != 0:
+            tail = "\n".join(err.strip().splitlines()[-12:])
+            pytest.fail(f"worker exited {rc}:\n{tail}")
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    return results
+
+
+def test_both_processes_joined(two_process_results):
+    r0, r1 = two_process_results
+    assert r0["process_count"] == r1["process_count"] == 2
+    assert {r0["process_index"], r1["process_index"]} == {0, 1}
+    assert r0["global_devices"] == r1["global_devices"] == 8
+
+
+def test_verdicts_agree_across_processes(two_process_results):
+    r0, r1 = two_process_results
+    assert r0["safe"] == r1["safe"]
+    assert r0["broken"] == r1["broken"]
+
+
+def test_verdict_parity_with_single_process(two_process_results):
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    r0 = two_process_results[0]
+    assert r0["safe"]["intersects"] is True
+    assert r0["broken"]["intersects"] is False
+    single = solve(majority_fbas(11, broken=True), backend=TpuSweepBackend(batch=64))
+    assert single.intersects is False
+    # Same deterministic enumeration order ⇒ same first-hit witness pair.
+    assert r0["broken"]["q1"] == single.q1
+    assert r0["broken"]["q2"] == single.q2
+    assert not set(r0["broken"]["q1"]) & set(r0["broken"]["q2"])
+    # The sharded run must have counted the full enumeration on the safe net.
+    assert r0["safe"]["candidates_checked"] >= 1 << 10

@@ -255,3 +255,17 @@ def test_whitespace_padded_huge_threshold_matches_python(native):
     n = run_native(native, [], payload)
     p = run_python([], payload)
     assert (n.stdout, n.returncode) == (p.stdout, p.returncode)
+
+
+def test_duplicate_publickey_rejected_both_clis(native):
+    # Deviation D1 (docs/PARITY.md): the reference silently aliases edge
+    # targets to the last duplicate (cpp:445); both CLIs here reject.
+    payload = (
+        '[{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}}, '
+        '{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}}]'
+    )
+    n = run_native(native, [], payload)
+    p = run_python([], payload)
+    assert n.returncode == p.returncode == 1
+    assert "duplicate publicKey" in n.stderr
+    assert "duplicate publicKey" in p.stderr
