@@ -26,10 +26,15 @@ from quorum_intersection_tpu.utils.logging import get_logger
 log = get_logger("backends.auto")
 
 # Exhaustive-sweep cutoffs by platform: the sweep is exact and fastest while
-# 2^(|scc|-1) stays cheap.  Measured rates: ~0.5-1G cand/s on a v5e chip
-# (2^32 ≈ a few seconds) vs ~0.5M/s on the CPU emulation fallback.
+# 2^(|scc|-1) stays cheap.  Measured:
+# - v5e chip: ~0.5-1G cand/s → 2^32 ≈ a few seconds ⇒ limit 33;
+# - CPU emulation: ~0.45M cand/s (bench.py throughput phase) while the
+#   native oracle runs ~0.7 µs/B&B-call (benchmarks/hybrid_crossover.py:
+#   majority-18 = 185k calls = 0.13 s) — the oracle beats an exhaustive
+#   2^(n-1) sweep at every measured size, so on CPU the sweep is only kept
+#   where its worst case is sub-second: 2^17/0.45M ≈ 0.3 s ⇒ limit 18.
 SWEEP_LIMIT_TPU = 33
-SWEEP_LIMIT_CPU = 24
+SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 
 

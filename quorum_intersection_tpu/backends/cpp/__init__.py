@@ -79,12 +79,21 @@ def build_library(force: bool = False) -> Path:
 _CLI_SRC = Path(__file__).with_name("qi_native.cpp")
 
 
-def build_native_cli(force: bool = False) -> Path:
+def build_native_cli(force: bool = False, sanitize: bool = False) -> Path:
     """Compile the standalone native CLI (``qi_native.cpp`` + the oracle) →
     a content-hashed binary, the framework's equivalent of the reference's
     single-binary deployment (`/root/reference/quorum_intersection.cpp`
-    main, C21).  Idempotent; returns the binary path."""
+    main, C21).  Idempotent; returns the binary path.
+
+    ``sanitize=True`` builds an ASan+UBSan instrumented binary (separate
+    cache entry) — the UB-hygiene check the reference never had (its own
+    uninitialized-threshold read, SURVEY §2.3-Q2, would trip MSan); the
+    test suite runs the golden fixtures and hostile inputs through it."""
     digest = hashlib.sha256(_CLI_SRC.read_bytes() + _SRC.read_bytes()).hexdigest()[:16]
+    if sanitize:
+        exe = _BUILD_DIR / f"qi_native-asan-{digest}"
+        flags = ["-O1", "-g", "-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
+        return _compile(exe, [_CLI_SRC, _SRC], flags, "sanitized native CLI", force)
     exe = _BUILD_DIR / f"qi_native-{digest}"
     return _compile(exe, [_CLI_SRC, _SRC], ["-O2"], "native CLI", force)
 

@@ -1,0 +1,80 @@
+"""ASan/UBSan hygiene of the framework's own C++ (qi_oracle + qi_native).
+
+The reference ships latent UB (the uninitialized-threshold read of SURVEY
+§2.3-Q2) and never runs a sanitizer (CMakeLists.txt:1-15).  Here the whole
+native surface — JSON parsing, graph build, Tarjan, the B&B search, PageRank
+and Graphviz — runs under `-fsanitize=address,undefined` with recovery
+disabled, over the golden fixtures AND the hostile-input corpus, so any UB
+or memory error aborts the binary and fails the test."""
+
+import subprocess
+
+import pytest
+
+from tests.test_hostile_input import nested_qset_node
+
+
+@pytest.fixture(scope="module")
+def asan_cli():
+    from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+    try:
+        return str(build_native_cli(sanitize=True))
+    except Exception as exc:  # pragma: no cover - g++/libasan missing
+        pytest.skip(f"sanitized build unavailable: {exc}")
+
+
+def run(cli, args, stdin_data=""):
+    return subprocess.run(
+        [cli, *args], input=stdin_data, capture_output=True, text=True, timeout=300
+    )
+
+
+def assert_no_sanitizer_report(proc):
+    for stream in (proc.stderr, proc.stdout):
+        assert "ERROR: AddressSanitizer" not in stream
+        assert "runtime error:" not in stream  # UBSan
+    assert proc.returncode in (0, 1)  # verdict or clean rejection, not abort
+
+
+GOLDEN = [
+    ("correct_trivial.json", 0),
+    ("broken_trivial.json", 1),
+    ("correct.json", 0),
+    ("broken.json", 1),
+]
+
+
+@pytest.mark.parametrize("name,code", GOLDEN)
+def test_fixtures_clean_under_sanitizers(asan_cli, ref_fixture, name, code):
+    proc = run(asan_cli, ["-v"], ref_fixture(name).read_text())
+    assert proc.returncode == code
+    assert_no_sanitizer_report(proc)
+
+
+def test_pagerank_and_graphviz_clean(asan_cli, ref_fixture):
+    data = ref_fixture("correct.json").read_text()
+    assert_no_sanitizer_report(run(asan_cli, ["-p"], data))
+    assert_no_sanitizer_report(run(asan_cli, ["-g"], data))
+
+
+def test_compat_and_randomized_paths_clean(asan_cli, ref_fixture):
+    data = ref_fixture("broken.json").read_text()
+    assert_no_sanitizer_report(run(asan_cli, ["--compat", "-v"], data))
+    assert_no_sanitizer_report(run(asan_cli, ["--seed", "7", "-t"], data))
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        "",  # empty stdin
+        "not json",
+        "[" * 2000 + "]" * 2000,  # deep arrays (capped parser)
+        nested_qset_node(400),  # deep qsets (capped flattener)
+        '[{"publicKey": "A", "quorumSet": {"threshold": "' + "9" * 30 + '", "validators": ["A"]}}]',
+        '[{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["\\u0000"]}}]',
+    ],
+)
+def test_hostile_inputs_clean_under_sanitizers(asan_cli, payload):
+    proc = run(asan_cli, [], payload)
+    assert_no_sanitizer_report(proc)
