@@ -1,0 +1,47 @@
+"""The benchmark contract: `python bench.py` must ALWAYS end its stdout with
+a parseable headline JSON line (driver contract — BENCH_r01.json died with
+rc=124/parsed:null; the r2 bench is built to make that impossible).  Run
+CPU-pinned so the test never touches the hang-prone tunnel."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+BENCH = Path(__file__).parent.parent / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def quick_run():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--quick", "--budget-seconds", "420"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(BENCH.parent),
+    )
+    return proc
+
+
+def test_exits_zero(quick_run):
+    assert quick_run.returncode == 0
+
+
+def test_every_stdout_line_is_a_full_headline(quick_run):
+    lines = [ln for ln in quick_run.stdout.strip().splitlines() if ln.strip()]
+    assert lines, "no output at all"
+    for ln in lines:
+        d = json.loads(ln)  # every emitted line must parse
+        assert d["metric"] == "candidate_quorums_checked_per_sec_per_chip"
+        assert "unit" in d and "vs_baseline" in d and "phases" in d
+
+
+def test_final_line_has_real_number_and_parity(quick_run):
+    d = json.loads(quick_run.stdout.strip().splitlines()[-1])
+    assert d["value"] > 0
+    assert d["parity"] == "4/4 fixtures"
+    assert d["baseline_value"] > 0
+    assert d["phases"].get("throughput") == "ok"
