@@ -487,7 +487,18 @@ def main() -> int:
 
     if args.phase is not None:
         return child_main(args)
-    return orchestrate(args)
+    try:
+        return orchestrate(args)
+    except Exception as exc:  # noqa: BLE001 — the driver must ALWAYS get a line
+        print(json.dumps({
+            "metric": HEADLINE_METRIC,
+            "value": 0,
+            "unit": "candidates/s",
+            "vs_baseline": 0,
+            "device": "unknown",
+            "error": f"orchestrator crashed: {type(exc).__name__}: {exc}",
+        }), flush=True)
+        return 0
 
 
 if __name__ == "__main__":

@@ -8,7 +8,9 @@ Strategy (SURVEY.md §7.2 step 4 rationale):
 - **large SCC**: the pruned search is the only tractable option — prefer the
   native C++ oracle, falling back to the pure-Python oracle; the TPU hybrid
   (host frontier + batched device fixpoints) is selected with
-  ``prefer_tpu=True``.
+  ``prefer_tpu=True`` **and only on accelerator platforms** — the measured
+  crossover (benchmarks/hybrid_crossover.py, README table) shows the native
+  oracle winning at every tractable size on the CPU emulation.
 
 Every selection is logged; failures to import/compile an accelerator backend
 degrade gracefully to the next option so the CLI always yields a verdict.

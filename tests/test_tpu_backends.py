@@ -336,3 +336,23 @@ class TestHybridOptions:
         res = solve(majority_fbas(9), backend=auto)
         assert res.intersects is True
         assert called  # host oracle used, not the hybrid
+
+    def test_auto_on_accelerator_prefers_hybrid(self, monkeypatch):
+        # Pretend an accelerator is attached: prefer_tpu must route large
+        # SCCs to the hybrid (the complement of the CPU-platform gate).
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: False
+        )
+        auto = AutoBackend(prefer_tpu=True, sweep_limit=4)
+        called = []
+        real_hybrid = auto._hybrid
+
+        def spy():
+            called.append(True)
+            return real_hybrid()
+
+        monkeypatch.setattr(auto, "_hybrid", spy)
+        res = solve(majority_fbas(9), backend=auto)
+        assert called and res.intersects is True
