@@ -35,18 +35,37 @@ jax.config.update("jax_platforms", _platform)
 import pytest
 
 REFERENCE_DIR = pathlib.Path("/root/reference")
+VENDORED_DIR = pathlib.Path(__file__).resolve().parent.parent / "fixtures"
 
 
 def reference_fixture(name: str) -> pathlib.Path:
     """Path to a bundled reference fixture, skipping if unavailable.
 
     The four golden JSON fixtures are loaded straight from the read-only
-    reference checkout rather than copied into this repo.
+    reference checkout rather than copied into this repo; the self-contained
+    corpus under ``fixtures/`` (see ``vendored_fixture``) keeps the suite
+    meaningful when the checkout is absent.
     """
     path = REFERENCE_DIR / name
     if not path.exists():
         pytest.skip(f"reference fixture {name} not available")
     return path
+
+
+def vendored_fixture_text(name: str) -> str:
+    """JSON text of a vendored fixture from ``fixtures/`` (handles .gz)."""
+    path = VENDORED_DIR / name
+    if name.endswith(".gz"):
+        import gzip
+
+        return gzip.decompress(path.read_bytes()).decode()
+    return path.read_text()
+
+
+def vendored_manifest() -> dict:
+    import json
+
+    return json.loads((VENDORED_DIR / "MANIFEST.json").read_text())
 
 
 @pytest.fixture
