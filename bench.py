@@ -66,6 +66,7 @@ TIMEOUTS = {
     "throughput": (600, 240),
     "sweep": (420, 240),
     "snapshot": (360, 240),
+    "pagerank": (240, 120),
 }
 
 
@@ -190,6 +191,47 @@ def phase_snapshot(quick: bool) -> dict:
         "snapshot_nodes": len(data),
         "snapshot_verdict_seconds": round(seconds, 3),
         "snapshot_backend": res.stats.get("backend", "scc-guard"),
+    }
+
+
+def phase_pagerank(quick: bool) -> dict:
+    """Device PageRank on a dump-scale (~3k-node) trust graph: the sparse
+    scatter-add power iteration (`analytics/pagerank.py:pagerank`) vs the
+    NumPy re-model, with L∞ parity checked (the C15 semantics pins)."""
+    import numpy as np
+
+    from quorum_intersection_tpu.analytics.pagerank import pagerank, pagerank_np
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+    data = (
+        stellar_like_fbas(n_watchers=300, seed=7) if quick
+        else stellar_like_fbas(n_watchers=2800, n_null=150, n_dangling=40, seed=7)
+    )
+    graph = build_graph(parse_fbas(data))
+
+    import jax
+
+    t0 = time.perf_counter()
+    ranks_jax = pagerank(graph)  # includes compile
+    jax_first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ranks_jax = pagerank(graph)  # warm
+    jax_warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ranks_np = pagerank_np(graph)
+    np_seconds = time.perf_counter() - t0
+    linf = float(np.max(np.abs(ranks_jax - ranks_np))) if graph.n else 0.0
+    assert linf < 1e-4, f"device/NumPy PageRank diverged: linf={linf}"
+    return {
+        "pagerank_nodes": graph.n,
+        "pagerank_edges": graph.n_edges,
+        "pagerank_jax_seconds": round(jax_warm, 3),
+        "pagerank_jax_first_seconds": round(jax_first, 3),
+        "pagerank_np_seconds": round(np_seconds, 3),
+        "pagerank_linf_vs_np": linf,
+        "pagerank_device": jax.devices()[0].platform,
     }
 
 
@@ -459,6 +501,15 @@ def orchestrate(args) -> int:
         phases["snapshot"] = "ok"
         headline.update(snap)
     emit(headline)
+
+    # 7. Device PageRank on a dump-scale graph (differential vs NumPy).
+    pr = run_child("pagerank", deadline, tmo["pagerank"], quick_flag, platform)
+    if "error" in pr:
+        phases["pagerank"] = pr["error"]
+    else:
+        phases["pagerank"] = "ok"
+        headline.update(pr)
+    emit(headline)
     return 0
 
 
@@ -476,6 +527,8 @@ def child_main(args) -> int:
         out = phase_sweep(args.sweep_nodes)
     elif args.phase == "snapshot":
         out = phase_snapshot(args.quick)
+    elif args.phase == "pagerank":
+        out = phase_pagerank(args.quick)
     else:
         raise SystemExit(f"unknown phase {args.phase!r}")
     print(json.dumps(out), flush=True)
@@ -494,7 +547,8 @@ def main() -> int:
         help="blocks fused per device program (candidates/step = batch × chunks)",
     )
     # Internal: child-phase dispatch (run_child invokes bench.py --phase …).
-    parser.add_argument("--phase", choices=("probe", "throughput", "sweep", "snapshot"),
+    parser.add_argument("--phase",
+                        choices=("probe", "throughput", "sweep", "snapshot", "pagerank"),
                         default=None, help=argparse.SUPPRESS)
     parser.add_argument("--n-orgs", type=int, default=FULL["n_orgs"], help=argparse.SUPPRESS)
     parser.add_argument("--per-org", type=int, default=FULL["per_org"], help=argparse.SUPPRESS)

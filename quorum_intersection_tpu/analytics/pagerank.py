@@ -113,6 +113,59 @@ def pagerank_np(
     return rank
 
 
+def _jitted_power_loops():
+    """Module-cached jitted loops (dense and sparse): compiled once per
+    array *shape*, so repeat calls — and equal-size graphs — reuse the
+    executable instead of re-tracing (a fresh ``jax.jit(lambda …)`` per
+    call would recompile every time)."""
+    global _POWER_LOOPS
+    if _POWER_LOOPS is not None:
+        return _POWER_LOOPS
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def loop(matvec, outdeg_j, mf, conv, max_iterations, n):
+        inv_out = jnp.where(outdeg_j > 0, 1.0 / jnp.maximum(outdeg_j, 1.0), 0.0)
+        base = mf / n
+
+        def cond(carry):
+            rank, diff, it = carry
+            return jnp.logical_and(diff > conv, it < max_iterations)
+
+        def body(carry):
+            rank, _, it = carry
+            send = (1 - mf) * inv_out * rank
+            tmp = base + matvec(send)
+            total = mf + jnp.sum(outdeg_j * send)
+            diff = jnp.sum(jnp.abs(tmp - rank))
+            return tmp / total, diff, it + 1
+
+        rank0 = jnp.zeros(n, dtype=jnp.float32).at[0].set(1.0)
+        rank, _, _ = lax.while_loop(cond, body, (rank0, conv + 1, jnp.int32(0)))
+        return rank
+
+    @jax.jit
+    def dense(a, mf, conv, max_iterations):
+        return loop(lambda s: a.T @ s, a.sum(axis=1), mf, conv,
+                    max_iterations, a.shape[0])
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def sparse(src, dst, outdeg_j, mf, conv, max_iterations, n):
+        def matvec(send):
+            return jnp.zeros(n, dtype=jnp.float32).at[dst].add(send[src])
+
+        return loop(matvec, outdeg_j, mf, conv, max_iterations, n)
+
+    _POWER_LOOPS = (dense, sparse)
+    return _POWER_LOOPS
+
+
+_POWER_LOOPS = None
+
+
 def pagerank(
     graph: TrustGraph,
     m: float = 0.0001,
@@ -128,47 +181,54 @@ def pagerank(
     n = graph.n
     if n == 0:
         return np.zeros(0, dtype=np.float32)
-    import jax
     import jax.numpy as jnp
-    from jax import lax
 
+    dense_fn, sparse_fn = _jitted_power_loops()
+    mf = jnp.float32(m)
+    conv = jnp.float32(convergence)
+    max_it = jnp.int32(max_iterations)
     if _use_dense(graph, dense):
-        a = jnp.asarray(adjacency_counts(graph))
-        outdeg_j = a.sum(axis=1)
-
-        def matvec(send):
-            return a.T @ send
+        rank = dense_fn(jnp.asarray(adjacency_counts(graph)), mf, conv, max_it)
     else:
         src_np, dst_np, outdeg_np = edge_arrays(graph)
-        src = jnp.asarray(src_np)
-        dst = jnp.asarray(dst_np)
-        outdeg_j = jnp.asarray(outdeg_np)
-
-        def matvec(send):
-            return jnp.zeros(n, dtype=jnp.float32).at[dst].add(send[src])
-
-    has_out = outdeg_j > 0
-    inv_out = jnp.where(has_out, 1.0 / jnp.maximum(outdeg_j, 1.0), 0.0)
-    mf = jnp.float32(m)
-    base = mf / n
-    conv = jnp.float32(convergence)
-
-    def cond(carry):
-        rank, diff, it = carry
-        return jnp.logical_and(diff > conv, it < max_iterations)
-
-    def body(carry):
-        rank, _, it = carry
-        send = (1 - mf) * inv_out * rank
-        tmp = base + matvec(send)
-        total = mf + jnp.sum(outdeg_j * send)
-        diff = jnp.sum(jnp.abs(tmp - rank))
-        return tmp / total, diff, it + 1
-
-    rank0 = jnp.zeros(n, dtype=jnp.float32).at[0].set(1.0)
-    init = (rank0, conv + 1, jnp.int32(0))
-    rank, _, _ = jax.jit(lambda c: lax.while_loop(cond, body, c))(init)
+        rank = sparse_fn(
+            jnp.asarray(src_np), jnp.asarray(dst_np), jnp.asarray(outdeg_np),
+            mf, conv, max_it, n,
+        )
     return np.asarray(rank)
+
+
+
+# Product-path engine selection: on the CPU platform the NumPy loop wins
+# below this vertex count (no compile latency, sub-ms iterations); above it
+# the compiled sparse matvec amortizes its ~1 s compile.  On an accelerator
+# platform the JAX path is always chosen — that is the point of it.
+JAX_CPU_LIMIT = 1024
+
+
+def pagerank_auto(
+    graph: TrustGraph,
+    m: float = 0.0001,
+    convergence: float = 0.0001,
+    max_iterations: int = 100000,
+) -> Tuple[np.ndarray, str]:
+    """Platform/size-aware selection for the product path (CLI, bench):
+    the device power iteration (:func:`pagerank`) on accelerator platforms
+    or large graphs, the NumPy re-model otherwise; device failures degrade
+    to NumPy so ``--pagerank`` always yields output.  Returns
+    ``(ranks, engine)`` with engine in {"jax", "numpy"}."""
+    from quorum_intersection_tpu.utils.platform import is_cpu_platform
+
+    if not is_cpu_platform() or graph.n > JAX_CPU_LIMIT:
+        try:
+            return pagerank(graph, m, convergence, max_iterations), "jax"
+        except Exception as exc:  # noqa: BLE001 — no jax / device init failure
+            from quorum_intersection_tpu.utils.logging import get_logger
+
+            get_logger("analytics.pagerank").warning(
+                "device PageRank unavailable (%s); degrading to NumPy", exc
+            )
+    return pagerank_np(graph, m, convergence, max_iterations), "numpy"
 
 
 def sorted_ranks(graph: TrustGraph, ranks: np.ndarray) -> List[Tuple[str, float]]:

@@ -59,7 +59,7 @@ class AutoBackend:
     ) -> None:
         self.prefer_tpu = prefer_tpu
         self.sweep_limit = sweep_limit
-        self.checkpoint = checkpoint  # forwarded to the sweep backend only
+        self.checkpoint = checkpoint  # forwarded to the sweep/hybrid backends
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
     def _sweep(self):
@@ -71,7 +71,15 @@ class AutoBackend:
         from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
         # Same seeded/randomized tie-break contract as the host oracles.
-        return TpuHybridBackend(**self._oracle_options)
+        options = dict(self._oracle_options)
+        if self.checkpoint is not None:
+            # The user handed a sweep-format checkpoint (path-per-problem);
+            # the hybrid stores its frontier at the same path in its own
+            # format — the fingerprints keep the two from cross-resuming.
+            from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+            options["checkpoint"] = HybridCheckpoint(self.checkpoint.path)
+        return TpuHybridBackend(**options)
 
     def _cpu_oracle(self):
         try:
@@ -102,13 +110,6 @@ class AutoBackend:
                 return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
             except Exception as exc:  # noqa: BLE001
                 log.info("sweep backend unavailable (%s); falling back", exc)
-        if self.checkpoint is not None:
-            # Only the sweep records progress; honor the user's expectation
-            # loudly instead of silently running an all-or-nothing search.
-            log.warning(
-                "checkpoint not honored: |scc|=%d routed to a non-sweep backend "
-                "(no progress will be recorded)", len(scc),
-            )
         if self.prefer_tpu:
             # Measured (benchmarks/hybrid_crossover.py): on the CPU
             # emulation the hybrid's per-row cost is ~100× the native
@@ -128,6 +129,13 @@ class AutoBackend:
                     return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
                 except Exception as exc:  # noqa: BLE001
                     log.info("hybrid backend unavailable (%s); falling back", exc)
+        if self.checkpoint is not None:
+            # Host oracles are all-or-nothing; honor the user's expectation
+            # loudly instead of silently dropping progress recording.
+            log.warning(
+                "checkpoint not honored: |scc|=%d routed to a host oracle "
+                "(no progress will be recorded)", len(scc),
+            )
         backend = self._cpu_oracle()
         log.debug("auto: %s backend for |scc|=%d", backend.name, len(scc))
         return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)

@@ -38,6 +38,13 @@ on broken networks the witness pair found first may differ (any disjoint
 pair is a valid witness, cpp's own witness already varies with its RNG).
 
 Batch sizes are bucketed to powers of two so XLA compiles a handful of shapes.
+
+Checkpoint/resume (r3): the worklist is explicit, so preemption survival is
+a frontier snapshot — every unresolved state has at least one request in the
+pending/in-flight queues (phase transitions are synchronous on the host), so
+persisting those states' (toRemove, dontRemove) pairs and re-pushing them on
+resume reproduces exactly the unfinished part of the search.  Same
+fingerprint discipline as the sweep (utils/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -68,6 +75,15 @@ MAX_INFLIGHT = 4
 # Memoized fixpoint results are ~n bytes each; bound the cache so a
 # pathological search cannot exhaust host memory.
 CACHE_LIMIT = 1 << 17
+# Seconds between checkpoint writes (when a checkpoint is attached): the
+# frontier snapshot is O(states × n) JSON, so writes are rate-limited
+# instead of per-batch.
+CHECKPOINT_INTERVAL_S = 5.0
+
+
+class HybridSearchInterrupted(RuntimeError):
+    """Raised by the preemption-simulation hook after writing a checkpoint
+    (``interrupt_after_batches``); production runs never see it."""
 
 
 @dataclass
@@ -113,9 +129,17 @@ class TpuHybridBackend:
         seed: Optional[int] = None,
         randomized: bool = False,
         max_inflight: int = MAX_INFLIGHT,
+        checkpoint=None,
+        checkpoint_interval_s: float = CHECKPOINT_INTERVAL_S,
+        interrupt_after_batches: Optional[int] = None,
     ) -> None:
         self.batch = batch  # None ⇒ platform-adaptive at check time
         self.max_inflight = max_inflight
+        self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
+        self.checkpoint_interval_s = checkpoint_interval_s
+        # Preemption simulation for kill/resume tests: after draining this
+        # many batches, force a checkpoint write and raise.
+        self.interrupt_after_batches = interrupt_after_batches
         # Same contract as the host oracles: deterministic tie-break by
         # default, seeded-uniform over the same argmax set otherwise.
         self._rng = random.Random(seed) if (randomized or seed is not None) else None
@@ -300,8 +324,26 @@ class TpuHybridBackend:
                     finish_probe(state)
                 return
 
-        root = _State(to_remove=list(scc), dont_remove=[])
-        push_state(root)
+        fingerprint = None
+        resumed = None
+        if self.checkpoint is not None:
+            from quorum_intersection_tpu.utils.checkpoint import sweep_fingerprint
+
+            fingerprint = sweep_fingerprint(
+                circuit.members, circuit.child, circuit.thresholds,
+                np.asarray(scc, dtype=np.int32), scc_mask, frozen_probe,
+            )
+            resumed = self.checkpoint.resume_states(fingerprint)
+
+        if resumed:
+            # The saved frontier replaces the root: re-pushing exactly the
+            # unresolved states reproduces the remainder of the search
+            # (resolved states are not in the file and are never re-expanded).
+            stats["resumed_states"] = len(resumed)
+            for to_remove, dont_remove in resumed:
+                push_state(_State(to_remove=list(to_remove), dont_remove=list(dont_remove)))
+        else:
+            push_state(_State(to_remove=list(scc), dont_remove=[]))
 
         import jax
 
@@ -359,14 +401,51 @@ class TpuHybridBackend:
         from collections import deque
 
         inflight: "deque" = deque()
+
+        def frontier_snapshot() -> List:
+            """(to_remove, dont_remove) of every state with unfinished work —
+            exactly the states referenced by a pending or in-flight request
+            (the invariant HybridCheckpoint documents)."""
+            seen: Dict[int, _State] = {}
+            for req in pending:
+                seen[id(req.state)] = req.state
+            for take, _ in inflight:
+                for req in take:
+                    seen[id(req.state)] = req.state
+            return [
+                [list(s.to_remove), list(s.dont_remove)] for s in seen.values()
+            ]
+
+        last_write = time.monotonic()
+        drained = 0
         while (pending or inflight) and found["q1"] is None:
             while pending and len(inflight) < self.max_inflight:
                 inflight.append(launch())
             take, device_out = inflight.popleft()
             record(take, np.asarray(device_out) != 0)  # sync point
+            drained += 1
+            # Never write once a witness is found: the witness-bearing state
+            # is resolved and thus absent from the frontier snapshot, so a
+            # post-witness write followed by a kill could resume into a
+            # witness-free remainder and flip the verdict.
+            if self.checkpoint is not None and found["q1"] is None:
+                if (
+                    self.interrupt_after_batches is not None
+                    and drained >= self.interrupt_after_batches
+                    and (pending or inflight)
+                ):
+                    self.checkpoint.record(frontier_snapshot(), fingerprint)
+                    raise HybridSearchInterrupted(
+                        f"simulated preemption after {drained} batches"
+                    )
+                if time.monotonic() - last_write >= self.checkpoint_interval_s:
+                    self.checkpoint.record(frontier_snapshot(), fingerprint)
+                    last_write = time.monotonic()
 
         seconds = time.perf_counter() - t0
         stats.update({"backend": self.name, "seconds": seconds})
+        if self.checkpoint is not None:
+            self.checkpoint.clear()  # either verdict: the search is complete
         if found["q1"] is not None:
             return SccCheckResult(
                 intersects=False, q1=found["q1"], q2=found["q2"], stats=stats

@@ -87,8 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
     p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
-                   help="checkpoint file for long sweeps: progress is recorded there and "
-                        "an interrupted run resumes instead of restarting")
+                   help="checkpoint file for long searches (sweep position or hybrid "
+                        "frontier): progress is recorded there and an interrupted run "
+                        "resumes instead of restarting")
     p.add_argument("--profile-dir", metavar="DIR", default=None,
                    help="record a jax profiler trace of the solve into DIR "
                         "(open with TensorBoard/XProf)")
@@ -123,14 +124,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     graph = build_graph(fbas, dangling=dangling)
 
     if args.pagerank:
-        from quorum_intersection_tpu.analytics.pagerank import format_pagerank, pagerank_np
+        from quorum_intersection_tpu.analytics.pagerank import format_pagerank, pagerank_auto
 
-        ranks = pagerank_np(
+        ranks, engine = pagerank_auto(
             graph,
             m=args.dangling_factor,
             convergence=args.convergence,
             max_iterations=args.max_iterations,
         )
+        log.debug("pagerank engine: %s", engine)
+        if args.timing:
+            sys.stderr.write(f"[stats] pagerank_engine: {engine}\n")
         sys.stdout.write(format_pagerank(graph, ranks))
         return 0  # PageRank mode always exits 0 (cpp:787)
 
@@ -143,12 +147,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ):
         backend_options = {"seed": args.seed, "randomized": True}
     if args.checkpoint is not None:
-        if args.backend not in ("auto", "tpu", "tpu-sweep"):
-            sys.stderr.write("--checkpoint requires a sweep-capable backend (auto/tpu/tpu-sweep)\n")
+        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid"):
+            sys.stderr.write(
+                "--checkpoint requires a checkpoint-capable backend "
+                "(auto/tpu/tpu-sweep/tpu-hybrid)\n"
+            )
             return 1
-        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import (
+            HybridCheckpoint,
+            SweepCheckpoint,
+        )
 
-        backend_options["checkpoint"] = SweepCheckpoint(args.checkpoint)
+        backend_options["checkpoint"] = (
+            HybridCheckpoint(args.checkpoint)
+            if args.backend == "tpu-hybrid"
+            else SweepCheckpoint(args.checkpoint)
+        )
     try:
         backend = get_backend(args.backend, **backend_options)
     except (ImportError, ValueError) as exc:

@@ -81,3 +81,53 @@ class SweepCheckpoint:
             self.path.unlink()
         except OSError:
             pass
+
+
+@dataclass
+class HybridCheckpoint:
+    """Checkpoint/resume for the hybrid branch-and-bound search.
+
+    Unlike the sweep, hybrid progress is not a scalar position: it is the
+    explicit worklist of unresolved branch-and-bound states.  The invariant
+    that makes this sound: every unresolved state always has at least one
+    request in the pending/in-flight queues (phase transitions happen
+    synchronously on the host), so the set of states referenced there IS the
+    resume frontier — re-pushing exactly those states reproduces the rest of
+    the search; states fully resolved before the write are never re-expanded.
+
+    Same fingerprint discipline as :class:`SweepCheckpoint`: the file is tied
+    to the exact problem (circuit tables, SCC, scoping); anything else is
+    ignored rather than resumed.
+    """
+
+    path: Union[str, Path]
+
+    def __post_init__(self) -> None:
+        self.path = Path(self.path)
+
+    def resume_states(self, fingerprint: str):
+        """Saved frontier [(to_remove, dont_remove), ...], or None."""
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        if data.get("fingerprint") != fingerprint:
+            log.info("hybrid checkpoint belongs to a different problem; ignoring")
+            return None
+        states = data.get("states") or None
+        if states:
+            log.info("resuming hybrid search from %d frontier states", len(states))
+        return states
+
+    def record(self, states, fingerprint: str) -> None:
+        if not states:
+            return  # an empty frontier means the search is finishing anyway
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"fingerprint": fingerprint, "states": states}))
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        try:
+            self.path.unlink()
+        except OSError:
+            pass

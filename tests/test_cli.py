@@ -128,7 +128,7 @@ def test_checkpoint_flag_requires_sweep_backend(tmp_path):
         _json(majority_fbas(3)),
     )
     assert proc.returncode == 1
-    assert "sweep-capable" in proc.stderr
+    assert "checkpoint-capable" in proc.stderr
 
 
 def test_profile_dir_flag(tmp_path):

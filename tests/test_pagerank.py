@@ -152,3 +152,72 @@ class TestSparseRepresentation:
         np.testing.assert_allclose(r_jax, r_np, rtol=2e-3, atol=2e-6)
         top = sorted_ranks(g, r_np)[0][0]
         assert top.startswith("core")  # the trusted core outranks watchers
+
+
+class TestAutoEngine:
+    """Product-path engine selection (VERDICT r2 §weak-4): `--pagerank`
+    reaches the device power iteration on accelerator platforms and on
+    large graphs, with NumPy as the degradation path."""
+
+    # The package re-exports the `pagerank` function under the same name as
+    # the module, so fetch the module itself for attribute monkeypatching.
+    import importlib
+
+    pr = importlib.import_module("quorum_intersection_tpu.analytics.pagerank")
+
+    def test_small_graph_on_cpu_uses_numpy(self, monkeypatch):
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: True
+        )
+        ranks, engine = self.pr.pagerank_auto(_graph(majority_fbas(5)))
+        assert engine == "numpy"
+        np.testing.assert_allclose(ranks, pagerank_np(_graph(majority_fbas(5))))
+
+    def test_accelerator_platform_uses_jax(self, monkeypatch):
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: False
+        )
+        g = _graph(majority_fbas(5))
+        ranks, engine = self.pr.pagerank_auto(g)
+        assert engine == "jax"
+        np.testing.assert_allclose(ranks, pagerank_np(g), rtol=1e-4, atol=1e-6)
+
+    def test_large_graph_uses_jax_even_on_cpu(self, monkeypatch):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: True
+        )
+        g = _graph(stellar_like_fbas(n_watchers=1100))
+        assert g.n > self.pr.JAX_CPU_LIMIT
+        ranks, engine = self.pr.pagerank_auto(g)
+        assert engine == "jax"
+        np.testing.assert_allclose(ranks, pagerank_np(g), rtol=2e-3, atol=2e-6)
+
+    def test_jax_failure_degrades_to_numpy(self, monkeypatch):
+
+        monkeypatch.setattr(
+            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: False
+        )
+        def boom(*a, **k):
+            raise RuntimeError("device init failed")
+        monkeypatch.setattr(self.pr, "pagerank", boom)
+        ranks, engine = self.pr.pagerank_auto(_graph(majority_fbas(5)))
+        assert engine == "numpy"
+        assert ranks.shape == (5,)
+
+    def test_cli_reports_engine_with_timing(self):
+        import json
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "quorum_intersection_tpu", "-p", "--timing"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        assert proc.stdout.startswith("PageRank:")
+        assert "pagerank_engine:" in proc.stderr
