@@ -132,9 +132,13 @@ class TpuHybridBackend:
         checkpoint=None,
         checkpoint_interval_s: float = CHECKPOINT_INTERVAL_S,
         interrupt_after_batches: Optional[int] = None,
+        mesh=None,
     ) -> None:
         self.batch = batch  # None ⇒ platform-adaptive at check time
         self.max_inflight = max_inflight
+        # Optional jax.sharding.Mesh: fixpoint rows shard across devices
+        # (embarrassingly parallel — no collective; results gather on host).
+        self.mesh = mesh
         self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
         self.checkpoint_interval_s = checkpoint_interval_s
         # Preemption simulation for kill/resume tests: after draining this
@@ -352,20 +356,38 @@ class TpuHybridBackend:
         arrays = CircuitArrays(circuit)
         frozen_row = arrays.cast(frozen_probe)
 
-        @jax.jit
-        def run_jit(avail, frozen_flags):
+        def _fix(avail, frozen_flags):
             # Per-row frozen selection: probes get the Q6 mask, others zero.
             return fixpoint(arrays, avail, frozen_flags[:, None] * frozen_row)
+
+        n_dev = 1
+        if self.mesh is not None:
+            # Row-sharded batch fixpoint: each device evaluates B/n_dev rows
+            # independently; the closed-over circuit constants replicate.
+            from quorum_intersection_tpu.parallel.mesh import P, shard_map_fn
+
+            axis = self.mesh.axis_names[0]
+            n_dev = self.mesh.devices.size
+            run_jit = jax.jit(shard_map_fn(
+                _fix, self.mesh,
+                in_specs=(P(axis, None), P(axis)),
+                out_specs=P(axis, None),
+            ))
+        else:
+            run_jit = jax.jit(_fix)
 
         def launch():
             """Pop up to `batch` requests and dispatch them asynchronously."""
             take = pending[-batch:]
             del pending[-len(take) :]
             # Bucket the padded batch to powers of two: a handful of compiled
-            # shapes instead of one per frontier size.
+            # shapes instead of one per frontier size.  A mesh additionally
+            # needs the row axis divisible by (and at least) the device count.
             b = 1
             while b < len(take):
                 b *= 2
+            b = max(b, n_dev)
+            b = ((b + n_dev - 1) // n_dev) * n_dev
             masks = np.zeros((b, n), dtype=np.float32)
             flags = np.zeros((b,), dtype=np.float32)
             for i, req in enumerate(take):

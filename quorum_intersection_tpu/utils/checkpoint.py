@@ -115,6 +115,20 @@ class HybridCheckpoint:
             log.info("hybrid checkpoint belongs to a different problem; ignoring")
             return None
         states = data.get("states") or None
+        if states is not None and not (
+            isinstance(states, list)
+            and all(
+                isinstance(s, list) and len(s) == 2
+                and all(isinstance(part, list) for part in s)
+                and all(isinstance(v, int) for part in s for v in part)
+                for s in states
+            )
+        ):
+            # Malformed/foreign schema: the contract is "ignored, never
+            # crashed into" — a checkpoint must not break the run it was
+            # meant to rescue.
+            log.info("hybrid checkpoint states malformed; ignoring")
+            return None
         if states:
             log.info("resuming hybrid search from %d frontier states", len(states))
         return states
