@@ -1,12 +1,18 @@
-"""Automatic backend selection.
+"""Automatic backend selection — latency-aware (VERDICT r2 §next-3).
 
-Strategy (SURVEY.md §7.2 step 4 rationale):
+Strategy, optimizing **time-to-verdict** (the BASELINE.json north-star
+metric), not TPU-nativeness for its own sake:
 
-- **small SCC** (≤ ``sweep_limit`` nodes): the TPU exhaustive subset sweep is
-  exact, embarrassingly parallel, and fastest — candidate space 2^|scc| is
-  bounded;
-- **large SCC**: the pruned search is the only tractable option — prefer the
-  native C++ oracle, falling back to the pure-Python oracle; the TPU hybrid
+- **small SCC** (≤ ``sweep_limit`` nodes): run the pruned host oracle FIRST
+  with a B&B **call budget** equal to the estimated cost of the exhaustive
+  sweep.  On real topologies the pruned search finishes in microseconds-to-
+  milliseconds (the bundled snapshots need ~10 calls, SURVEY.md §6), so the
+  verdict lands ~1000× sooner than paying the sweep's compile+dispatch
+  overhead.  If the search proves pathological and burns the budget
+  (``OracleBudgetExceeded``), fall back to the sweep — exact and bounded at
+  2^(|scc|-1)/rate.  Worst case ≈ 2× the sweep cost; typical case ≈ free.
+- **large SCC** (> ``sweep_limit``): the pruned search is the only tractable
+  option — native C++ oracle, falling back to pure Python; the TPU hybrid
   (host frontier + batched device fixpoints) is selected with
   ``prefer_tpu=True`` **and only on accelerator platforms** — the measured
   crossover (benchmarks/hybrid_crossover.py, README table) shows the native
@@ -38,6 +44,21 @@ log = get_logger("backends.auto")
 SWEEP_LIMIT_TPU = 33
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
+
+# Cost model for the oracle-first budget (measured this repo, on the
+# record):
+# - native oracle ≈ 0.7 µs/B&B call single-core
+#   (benchmarks/results/crossover_cpu_r2.txt: majority-18 = 185k calls in
+#   0.13 s); pure Python ≈ 30 µs/call (BASELINE.md: n=16 → 48.6k calls,
+#   1.1 s);
+# - sweep ≈ fixed overhead (device probe + compile) + 2^(|scc|-1)/rate;
+#   rates from BENCH_r02.json (end-to-end 96.5M cand/s on the chip, ~0.5M/s
+#   CPU emulation) — deliberately conservative so the budget errs toward
+#   giving the oracle MORE room, never less than MIN_ORACLE_BUDGET.
+ORACLE_SECONDS_PER_CALL = {"cpp": 0.7e-6, "python": 3e-5}
+SWEEP_RATE = {"cpu": 5e5, "accel": 9e7}
+SWEEP_OVERHEAD_S = {"cpu": 1.0, "accel": 5.0}
+MIN_ORACLE_BUDGET = 50_000
 
 
 def _platform_sweep_limit() -> int:
@@ -81,18 +102,62 @@ class AutoBackend:
             options["checkpoint"] = HybridCheckpoint(self.checkpoint.path)
         return TpuHybridBackend(**options)
 
-    def _cpu_oracle(self):
+    def _cpu_oracle(self, budget_s: Optional[float] = None):
+        """Native oracle, degrading to pure Python; with ``budget_s``, the
+        instance carries a B&B call budget sized per engine speed."""
         try:
             from quorum_intersection_tpu.backends.cpp import CppOracleBackend
 
-            backend = CppOracleBackend(**self._oracle_options)
+            options = dict(self._oracle_options)
+            if budget_s is not None:
+                options["budget_calls"] = max(
+                    int(budget_s / ORACLE_SECONDS_PER_CALL["cpp"]), MIN_ORACLE_BUDGET
+                )
+            backend = CppOracleBackend(**options)
             backend.ensure_built()
             return backend
         except Exception as exc:  # noqa: BLE001 — degrade to pure Python
             log.info("native C++ oracle unavailable (%s); using Python oracle", exc)
             from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
 
-            return PythonOracleBackend(**self._oracle_options)
+            options = dict(self._oracle_options)
+            if budget_s is not None:
+                options["budget_calls"] = max(
+                    int(budget_s / ORACLE_SECONDS_PER_CALL["python"]), MIN_ORACLE_BUDGET
+                )
+            return PythonOracleBackend(**options)
+
+    def _estimated_sweep_seconds(self, s: int) -> float:
+        """Probe-free budget: the MIN of the per-platform sweep estimates.
+
+        Deliberately platform-blind — probing would touch the device backend
+        (utils/platform.py: a hung tunnel blocks there), and the happy path
+        (oracle finishes under budget) should never contact a device at all.
+        min() keeps the budget honest on both platforms: at small |scc| the
+        CPU estimate dominates the bound; at large |scc| the accelerator
+        estimate stops a pathological oracle within ~the on-chip sweep cost.
+        """
+        space = float(1 << max(s - 1, 0))
+        return min(
+            SWEEP_OVERHEAD_S["cpu"] + space / SWEEP_RATE["cpu"],
+            SWEEP_OVERHEAD_S["accel"] + space / SWEEP_RATE["accel"],
+        )
+
+    def _budgeted_oracle(self, graph, circuit, scc, scope_to_scc, budget_s):
+        """Oracle-first attempt: returns a result, or None meaning 'fall
+        back to the sweep' (budget burned)."""
+        from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+
+        backend = self._cpu_oracle(budget_s=budget_s)
+        try:
+            log.debug(
+                "auto: oracle-first (%s) for |scc|=%d, budget ~%.1fs of calls",
+                backend.name, len(scc), budget_s,
+            )
+            return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
+        except OracleBudgetExceeded as exc:
+            log.info("oracle budget burned (%s); switching to the exhaustive sweep", exc)
+            return None
 
     def check_scc(
         self,
@@ -102,14 +167,46 @@ class AutoBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
-        limit = self.sweep_limit if self.sweep_limit is not None else _platform_sweep_limit()
-        if len(scc) <= limit:
-            try:
-                backend = self._sweep()
-                log.debug("auto: sweep backend for |scc|=%d", len(scc))
-                return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
-            except Exception as exc:  # noqa: BLE001
-                log.info("sweep backend unavailable (%s); falling back", exc)
+        # Optimistic limit first (no device probe): oracle-first applies to
+        # every SCC a sweep could possibly handle on any platform; whether
+        # the sweep fallback is actually viable is only decided — with a
+        # real platform probe — once the budget has burned.  If that probe
+        # then rules the sweep out (CPU platform mid-range SCC, or no jax),
+        # the burned budget is lost and the unbudgeted oracle restarts: the
+        # documented worst case is 'sweep estimate + unbounded search', paid
+        # only on pathological inputs — the trade for a device-free happy
+        # path.  A checkpoint file WITH recorded progress skips oracle-first
+        # entirely: re-burning the budget on every resume of a preempted
+        # sweep would tax exactly the long runs checkpoints exist for.
+        import pathlib
+
+        resumable = (
+            self.checkpoint is not None
+            and getattr(self.checkpoint, "path", None) is not None
+            and pathlib.Path(self.checkpoint.path).exists()
+        )
+        optimistic = self.sweep_limit if self.sweep_limit is not None else SWEEP_LIMIT_TPU
+        if len(scc) <= optimistic:
+            if not resumable:
+                res = self._budgeted_oracle(
+                    graph, circuit, scc, scope_to_scc,
+                    self._estimated_sweep_seconds(len(scc)),
+                )
+                if res is not None:
+                    return res
+            limit = (
+                self.sweep_limit if self.sweep_limit is not None
+                else _platform_sweep_limit()
+            )
+            if len(scc) <= limit:
+                try:
+                    backend = self._sweep()
+                    log.debug("auto: sweep backend for |scc|=%d", len(scc))
+                    return backend.check_scc(
+                        graph, circuit, scc, scope_to_scc=scope_to_scc
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    log.info("sweep backend unavailable (%s); falling back", exc)
         if self.prefer_tpu:
             # Measured (benchmarks/hybrid_crossover.py): on the CPU
             # emulation the hybrid's per-row cost is ~100× the native

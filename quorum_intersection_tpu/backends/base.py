@@ -14,6 +14,13 @@ from quorum_intersection_tpu.fbas.graph import TrustGraph
 INT32_MAX = 2**31 - 1
 
 
+class OracleBudgetExceeded(RuntimeError):
+    """A budgeted host oracle search exceeded its B&B call budget before
+    reaching a verdict.  Raised (never returned as a verdict) so the caller
+    — the auto router's latency-aware oracle-first strategy — falls back to
+    an exhaustive engine whose cost the budget was derived from."""
+
+
 @dataclass
 class SccCheckResult:
     """Outcome of the disjoint-quorum search inside one SCC.

@@ -103,14 +103,15 @@ def _load() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     lib = ctypes.CDLL(str(build_library()))
-    lib.qi_check_scc.restype = ctypes.c_int32
-    lib.qi_check_scc.argtypes = [
+    lib.qi_check_scc_budget.restype = ctypes.c_int32
+    lib.qi_check_scc_budget.argtypes = [
         ctypes.c_int32,  # n
         _i32p, _i32p,  # succ_off, succ_tgt
         _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
         _i32p, ctypes.c_int32,  # scc, scc_len
         ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,  # scope, use_rng, seed
         ctypes.c_int32,  # trace (per-call stderr narration)
+        ctypes.c_int64,  # budget_calls (0 = unlimited; -2 return on overrun)
         _i32p, _i32p, _i32p, _i32p,  # q1_out, q1_len, q2_out, q2_len
         _i64p,  # stats_out[3]
     ]
@@ -202,7 +203,12 @@ class CppOracleBackend:
     name = "cpp"
     needs_circuit = False  # searches on host set semantics, like the Python oracle
 
-    def __init__(self, seed: Optional[int] = None, randomized: bool = False) -> None:
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        randomized: bool = False,
+        budget_calls: Optional[int] = None,
+    ) -> None:
         self._use_rng = bool(randomized or seed is not None)
         # randomized without an explicit seed means *actual* nondeterminism
         # (matching the python backend's random.Random(None) and the
@@ -210,6 +216,10 @@ class CppOracleBackend:
         self._seed = (
             int.from_bytes(os.urandom(8), "little") if seed is None else int(seed)
         )
+        # Optional B&B call budget: check_scc raises OracleBudgetExceeded
+        # instead of running an unbounded exponential search (the auto
+        # router's latency-aware oracle-first strategy).
+        self._budget_calls = 0 if budget_calls is None else int(budget_calls)
 
     def ensure_built(self) -> None:
         _load()
@@ -232,7 +242,7 @@ class CppOracleBackend:
         stats = np.zeros(3, dtype=np.int64)
 
         t0 = time.perf_counter()
-        intersects = lib.qi_check_scc(
+        intersects = lib.qi_check_scc_budget(
             flat.n,
             flat._ptr(flat.succ_off),
             flat._ptr(flat.succ_tgt),
@@ -246,6 +256,7 @@ class CppOracleBackend:
             int(self._use_rng),
             self._seed,
             int(log.isEnabledFor(logging.DEBUG)),  # -t routes here via set_trace
+            self._budget_calls,
             q1.ctypes.data_as(_i32p),
             ctypes.byref(q1_len),
             q2.ctypes.data_as(_i32p),
@@ -253,6 +264,14 @@ class CppOracleBackend:
             stats.ctypes.data_as(_i64p),
         )
         seconds = time.perf_counter() - t0
+
+        if intersects == -2:
+            from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+
+            raise OracleBudgetExceeded(
+                f"native oracle exceeded {self._budget_calls} B&B calls "
+                f"on |scc|={len(scc)} after {seconds:.2f}s"
+            )
 
         return SccCheckResult(
             intersects=bool(intersects),

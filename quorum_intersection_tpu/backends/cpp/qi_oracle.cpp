@@ -168,6 +168,12 @@ struct Search {
   int64_t bnb_calls = 0;
   int64_t minimal_quorums = 0;
   int64_t fixpoint_calls = 0;
+  // Optional call budget (0 = unlimited): lets a caller race this pruned
+  // search against an exhaustive engine without threads or processes — the
+  // search aborts deterministically once it has proven more expensive than
+  // the alternative (backends/auto.py latency-aware routing).
+  int64_t budget_calls = 0;
+  bool budget_exceeded = false;
   bool found = false;
   std::vector<int32_t> q1, q2;
 
@@ -200,6 +206,12 @@ struct Search {
   bool iterate(const std::vector<int32_t>& to_remove,
                std::vector<int32_t>& dont_remove) {
     ++bnb_calls;
+    if (budget_calls > 0 && bnb_calls > budget_calls) {
+      // Abort the whole recursion (true unwinds like a hit); the caller
+      // distinguishes via budget_exceeded, never via the verdict.
+      budget_exceeded = true;
+      return true;
+    }
     if (trace) {
       std::fprintf(stderr, "trace: B&B call %lld: |toRemove|=%zu |dontRemove|=%zu\n",
                    static_cast<long long>(bnb_calls), to_remove.size(),
@@ -287,18 +299,21 @@ struct Search {
 extern "C" {
 
 // Disjoint-quorum search within one SCC.  Returns 1 iff all quorums
-// intersect; on 0, q1/q2 (buffers of capacity n) receive the witness pair.
+// intersect; on 0, q1/q2 (buffers of capacity n) receive the witness pair;
+// -2 iff `budget_calls` > 0 and the search exceeded it (verdict unknown —
+// the caller falls back to another engine; backends/auto.py).
 // stats_out[0..2] = {bnb_calls, minimal_quorums, fixpoint_calls}.
 // `trace` != 0 narrates every B&B call / prune / probe to stderr (the
 // reference's -t trace spew, cpp:258-259).
-int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
-                     const int32_t* succ_tgt, const int32_t* roots,
-                     const int32_t* units, const int32_t* mem,
-                     const int32_t* inner, const int32_t* scc,
-                     int32_t scc_len, int32_t scope_to_scc, int32_t use_rng,
-                     uint64_t seed, int32_t trace, int32_t* q1_out,
-                     int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
-                     int64_t* stats_out) {
+int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
+                            const int32_t* succ_tgt, const int32_t* roots,
+                            const int32_t* units, const int32_t* mem,
+                            const int32_t* inner, const int32_t* scc,
+                            int32_t scc_len, int32_t scope_to_scc,
+                            int32_t use_rng, uint64_t seed, int32_t trace,
+                            int64_t budget_calls, int32_t* q1_out,
+                            int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
+                            int64_t* stats_out) {
   Graph g{n, succ_off, succ_tgt, roots, units, mem, inner};
   // Reference semantics (Q6, cpp:354): the whole graph starts available —
   // sound for a sink SCC; scope_to_scc narrows availability to the SCC.
@@ -311,6 +326,7 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
   std::mt19937_64 rng_engine(seed);
   Search search{g, avail.data(), scc_vec, scc_len / 2,
                 use_rng ? &rng_engine : nullptr, trace != 0};
+  search.budget_calls = budget_calls;
   std::vector<int32_t> dont;
   search.iterate(scc_vec, dont);
 
@@ -325,6 +341,11 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
   stats_out[0] = search.bnb_calls;
   stats_out[1] = search.minimal_quorums;
   stats_out[2] = search.fixpoint_calls;
+  if (search.budget_exceeded) {
+    *q1_len = 0;
+    *q2_len = 0;
+    return -2;
+  }
   if (search.found) {
     *q1_len = static_cast<int32_t>(search.q1.size());
     std::copy(search.q1.begin(), search.q1.end(), q1_out);
@@ -335,6 +356,21 @@ int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
   *q1_len = 0;
   *q2_len = 0;
   return 1;
+}
+
+// Unbudgeted entry point (original ABI): kept for the native CLI and any
+// binding that predates the budgeted variant.
+int32_t qi_check_scc(int32_t n, const int32_t* succ_off,
+                     const int32_t* succ_tgt, const int32_t* roots,
+                     const int32_t* units, const int32_t* mem,
+                     const int32_t* inner, const int32_t* scc,
+                     int32_t scc_len, int32_t scope_to_scc, int32_t use_rng,
+                     uint64_t seed, int32_t trace, int32_t* q1_out,
+                     int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
+                     int64_t* stats_out) {
+  return qi_check_scc_budget(n, succ_off, succ_tgt, roots, units, mem, inner,
+                             scc, scc_len, scope_to_scc, use_rng, seed, trace,
+                             0, q1_out, q1_len, q2_out, q2_len, stats_out);
 }
 
 // Greatest-fixpoint quorum over `nodes` given an availability vector

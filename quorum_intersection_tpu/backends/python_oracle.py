@@ -91,13 +91,18 @@ class _SearchState:
     so the hot recursion pays a single attribute check when tracing is off.
     """
 
-    __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls", "trace")
+    __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls", "trace",
+                 "budget_calls", "budget_exceeded")
 
-    def __init__(self) -> None:
+    def __init__(self, budget_calls: int = 0) -> None:
         self.bnb_calls = 0
         self.minimal_quorums = 0
         self.fixpoint_calls = 0
         self.trace = log.isEnabledFor(logging.DEBUG)
+        # 0 = unlimited; otherwise the search aborts (budget_exceeded) once
+        # bnb_calls passes the budget — see base.OracleBudgetExceeded.
+        self.budget_calls = budget_calls
+        self.budget_exceeded = False
 
 
 def iterate_minimal_quorums(
@@ -128,6 +133,11 @@ def iterate_minimal_quorums(
     (cpp:343-345).
     """
     state.bnb_calls += 1
+    if state.budget_calls and state.bnb_calls > state.budget_calls:
+        # Abort the whole recursion (True unwinds like a hit); the caller
+        # distinguishes via budget_exceeded, never via the verdict.
+        state.budget_exceeded = True
+        return True
     if state.trace:
         log.debug(
             "B&B call %d: |toRemove|=%d |dontRemove|=%d",
@@ -192,8 +202,14 @@ class PythonOracleBackend:
     name = "python"
     needs_circuit = False  # works on TrustGraph set semantics directly
 
-    def __init__(self, seed: Optional[int] = None, randomized: bool = False) -> None:
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        randomized: bool = False,
+        budget_calls: Optional[int] = None,
+    ) -> None:
         self._rng = random.Random(seed) if (randomized or seed is not None) else None
+        self._budget_calls = 0 if budget_calls is None else int(budget_calls)
 
     def check_scc(
         self,
@@ -204,7 +220,7 @@ class PythonOracleBackend:
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
         t0 = time.perf_counter()
-        state = _SearchState()
+        state = _SearchState(budget_calls=self._budget_calls)
 
         if scope_to_scc:
             avail = [False] * graph.n
@@ -262,6 +278,13 @@ class PythonOracleBackend:
                 sys.setrecursionlimit(old_limit)
 
         seconds = time.perf_counter() - t0
+        if state.budget_exceeded:
+            from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+
+            raise OracleBudgetExceeded(
+                f"python oracle exceeded {self._budget_calls} B&B calls "
+                f"on |scc|={len(scc)} after {seconds:.2f}s"
+            )
         if state.trace:
             log.debug(
                 "search done: %d B&B calls, %d minimal quorums, %d fixpoints in %.3fs",

@@ -434,3 +434,114 @@ class TestHybridCheckpoint:
         hybrid = auto._hybrid()
         assert hybrid.checkpoint is not None
         assert str(hybrid.checkpoint.path) == "/tmp/x.ckpt"
+
+
+class TestLatencyAwareRouting:
+    """Oracle-first auto routing (VERDICT r2 §next-3): small SCCs get the
+    pruned oracle with a sweep-cost call budget; budget burns fall back to
+    the exhaustive sweep; verdicts never change, only latency."""
+
+    def test_small_scc_routes_to_oracle_first(self):
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+
+        res = solve(majority_fbas(9), backend=AutoBackend())
+        assert res.intersects is True
+        assert res.stats["backend"] in ("cpp", "python")
+
+    def test_snapshot_time_to_verdict_is_oracle_fast(self):
+        import time
+
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        data = stellar_like_fbas()  # ~150 validators, 21-node core SCC
+        t0 = time.perf_counter()
+        res = solve(data, backend=AutoBackend())
+        seconds = time.perf_counter() - t0
+        assert res.intersects is True
+        assert res.stats["backend"] in ("cpp", "python")
+        # The whole point: no sweep compile/dispatch on the verdict path.
+        assert seconds < 5, f"snapshot verdict took {seconds:.1f}s"
+
+    def test_budget_burn_falls_back_to_sweep(self, monkeypatch):
+        import quorum_intersection_tpu.backends.auto as auto_mod
+
+        monkeypatch.setattr(auto_mod, "MIN_ORACLE_BUDGET", 1)
+        monkeypatch.setattr(
+            auto_mod.AutoBackend, "_estimated_sweep_seconds", lambda self, s: 0.0
+        )
+        backend = auto_mod.AutoBackend()
+        res = solve(majority_fbas(9), backend=backend)
+        assert res.intersects is True
+        assert res.stats["backend"] == "tpu-sweep"
+        res = solve(majority_fbas(9, broken=True), backend=backend)
+        assert res.intersects is False
+        assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
+
+    def test_budgeted_oracle_verdict_identical_under_budget(self):
+        # A generous budget must not perturb the search at all: stats
+        # lockstep with the unbudgeted oracle.
+        from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+
+        data = majority_fbas(10)
+        plain = solve(data, backend=PythonOracleBackend())
+        budgeted = solve(data, backend=PythonOracleBackend(budget_calls=10**9))
+        assert plain.intersects is budgeted.intersects is True
+        assert plain.stats["bnb_calls"] == budgeted.stats["bnb_calls"]
+
+    def test_python_oracle_budget_exceeded_raises(self):
+        from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+        from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+
+        with pytest.raises(OracleBudgetExceeded):
+            solve(majority_fbas(12), backend=PythonOracleBackend(budget_calls=5))
+
+    def test_cpp_oracle_budget_exceeded_raises(self):
+        from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+        from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+
+        backend = CppOracleBackend(budget_calls=5)
+        try:
+            backend.ensure_built()
+        except Exception as exc:  # noqa: BLE001
+            pytest.skip(f"native oracle unavailable: {exc}")
+        with pytest.raises(OracleBudgetExceeded):
+            solve(majority_fbas(12), backend=backend)
+
+    def test_existing_checkpoint_skips_oracle_first(self, tmp_path):
+        # A preempted sweep's progress must resume directly — re-burning
+        # the oracle budget on every restart would tax exactly the long
+        # runs checkpoints exist for.
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        data = majority_fbas(9)
+        ck = SweepCheckpoint(tmp_path / "sweep.ckpt")
+        ck.record(0, 1 << 8)  # any on-disk progress file
+        res = solve(data, backend=AutoBackend(checkpoint=ck))
+        assert res.intersects is True
+        assert res.stats["backend"] == "tpu-sweep"  # not the oracle
+
+    def test_malformed_hybrid_checkpoint_ignored(self, tmp_path):
+        import json as _json
+
+        from quorum_intersection_tpu.backends.tpu.hybrid import (
+            HybridSearchInterrupted,
+            TpuHybridBackend,
+        )
+        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+        data = majority_fbas(12)
+        ck = HybridCheckpoint(tmp_path / "hybrid.ckpt")
+        with pytest.raises(HybridSearchInterrupted):
+            solve(data, backend=TpuHybridBackend(
+                batch=64, max_inflight=1, checkpoint=ck,
+                interrupt_after_batches=4))
+        # Corrupt the states while keeping the fingerprint valid: the file
+        # must be ignored (fresh search), never crash the run.
+        payload = _json.loads(ck.path.read_text())
+        payload["states"] = [["not-a-pair"]]
+        ck.path.write_text(_json.dumps(payload))
+        res = solve(data, backend=TpuHybridBackend(batch=64, checkpoint=ck))
+        assert res.intersects is True
+        assert "resumed_states" not in res.stats
