@@ -218,7 +218,7 @@ def phase_hybrid(quick: bool) -> dict:
         [("hier-5x3", hierarchical_fbas(5, 3))] if quick
         else [("majority-18", majority_fbas(18)), ("hier-6x4", hierarchical_fbas(6, 4))]
     )
-    out = {"hybrid_device": jax.devices()[0].device_kind}
+    out = {"hybrid_device": jax.devices()[0].device_kind, "hybrid_verdicts_ok": True}
     for name, data in rows:
         t0 = time.perf_counter()
         cpp_res = solve(data, backend=CppOracleBackend())
@@ -235,7 +235,11 @@ def phase_hybrid(quick: bool) -> dict:
             "fixpoints": hy_res.stats.get("fixpoints"),
             "device_batches": hy_res.stats.get("device_batches"),
         }
-        assert ok, f"verdict mismatch on {name}"
+        if not ok:
+            # Emit the row (identifying WHICH workload diverged) instead of
+            # crashing the phase — a perf number for a wrong answer is
+            # worthless, but the evidence of the divergence is not.
+            out["hybrid_verdicts_ok"] = False
     return out
 
 

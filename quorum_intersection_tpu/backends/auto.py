@@ -159,6 +159,29 @@ class AutoBackend:
             log.info("oracle budget burned (%s); switching to the exhaustive sweep", exc)
             return None
 
+    def _has_recorded_progress(self, scc: List[int]) -> bool:
+        """Does the attached checkpoint hold progress plausibly belonging to
+        THIS problem?  Cheap shape checks only (sweep: position>0 with the
+        matching enumeration total; hybrid: non-empty frontier) — the full
+        fingerprint check stays inside the backends, which ignore foreign
+        files anyway; a false positive here merely skips oracle-first once."""
+        if self.checkpoint is None:
+            return False
+        import json
+        import pathlib
+
+        path = getattr(self.checkpoint, "path", None)
+        if path is None:
+            return False
+        try:
+            data = json.loads(pathlib.Path(path).read_text())
+        except (OSError, ValueError):
+            return False
+        total = 1 << max(len(scc) - 1, 0)
+        if data.get("total") == total and int(data.get("position", 0)) > 0:
+            return True  # sweep-format progress for this enumeration size
+        return bool(data.get("states"))  # hybrid-format frontier
+
     def check_scc(
         self,
         graph: TrustGraph,
@@ -178,13 +201,7 @@ class AutoBackend:
         # path.  A checkpoint file WITH recorded progress skips oracle-first
         # entirely: re-burning the budget on every resume of a preempted
         # sweep would tax exactly the long runs checkpoints exist for.
-        import pathlib
-
-        resumable = (
-            self.checkpoint is not None
-            and getattr(self.checkpoint, "path", None) is not None
-            and pathlib.Path(self.checkpoint.path).exists()
-        )
+        resumable = self._has_recorded_progress(scc)
         optimistic = self.sweep_limit if self.sweep_limit is not None else SWEEP_LIMIT_TPU
         if len(scc) <= optimistic:
             if not resumable:

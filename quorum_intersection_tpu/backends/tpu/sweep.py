@@ -64,10 +64,17 @@ MAX_INFLIGHT = 32
 # small sweeps never pay the compile time of the big shapes, exhaustive
 # sweeps amortize dispatch to noise.
 STEPS_RAMP = (1, 8, 64, 256, 1024)
-# Dispatches at the current ramp level before growing to the next: keeps the
-# ramp gradual (fine checkpoint granularity early) without tying it to the
-# pipeline depth.
-RAMP_DISPATCHES = 4
+# Dispatches before the ramp may grow.  After one small validation program,
+# growth JUMPS straight to the largest level the remaining work can fill —
+# intermediate levels get zero programs (r3, after instrumenting the r2
+# gap): the old walk — 4 dispatches at every intermediate level — pushed
+# >60% of a 2^30 enumeration through sub-maximal programs and compiled
+# every intermediate shape on the critical path.  Jumping compiles 2 shapes
+# instead of 4-5; the FIRST program still stays small, so broken networks
+# keep their fast first result, at the cost of coarser checkpoint/early-hit
+# granularity from the second program onward (bounded by one max-size
+# program of device work).
+RAMP_DISPATCHES = 1
 
 
 class SccTooLargeError(ValueError):
@@ -295,6 +302,7 @@ class TpuSweepBackend:
         compile_seconds = 0.0
         t_first_dispatch = None
         drain_log: list = []  # (monotonic_time, coverage, steps_per_call)
+        compile_log: list = []  # (end_time, duration) per synchronous compile
 
         def hi_row(hi: int):
             """Availability row for the high index bits (None when narrow)."""
@@ -320,7 +328,9 @@ class TpuSweepBackend:
                 fn = dispatchers[steps_per_call] = make_dispatch(steps_per_call)
                 tc = time.monotonic()
                 out = fn(lo, hi_row(hi))
-                compile_seconds += time.monotonic() - tc
+                te = time.monotonic()
+                compile_seconds += te - tc
+                compile_log.append((te, te - tc))
                 return out
             return fn(lo, hi_row(hi))
 
@@ -355,20 +365,24 @@ class TpuSweepBackend:
 
         start = start0
         ramp_ix = 0
-        since_ramp = 0  # dispatches at the current level: RAMP_DISPATCHES
-        # programs must run at each level before growing to the next, so the
-        # ramp is gradual (1 → 8 → 64 → …) and an early hit or crash near
-        # the start never has to sync/lose a maximum-size program.
+        since_ramp = 0  # dispatches since the last ramp change: the first
+        # (small) program must run before the jump, so an early hit or crash
+        # right at the start never has to sync/lose a maximum-size program.
         while start < total:
             # Grow the program only once the remaining work would fill at
             # least a couple of programs at the next size (never compile
-            # shapes a small sweep won't use).
+            # shapes a small sweep won't use) — and then jump straight to
+            # the largest such level, skipping the intermediate shapes.
             if (
                 ramp_ix + 1 < len(STEPS_RAMP)
                 and since_ramp >= RAMP_DISPATCHES
                 and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
             ):
-                ramp_ix += 1
+                while (
+                    ramp_ix + 1 < len(STEPS_RAMP)
+                    and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
+                ):
+                    ramp_ix += 1
                 since_ramp = 0
             hi, lo = start >> lo_bits, start & (lo_total - 1)
             coverage = STEPS_RAMP[ramp_ix] * base_block
@@ -404,7 +418,7 @@ class TpuSweepBackend:
             "candidates_per_sec": candidates / seconds if seconds > 0 else 0.0,
         }
         stats.update(self._time_breakdown(
-            t0_monotonic, t_first_dispatch, compile_seconds, drain_log
+            t0_monotonic, t_first_dispatch, compile_seconds, drain_log, compile_log
         ))
         if not found:
             if self.checkpoint is not None:
@@ -430,12 +444,15 @@ class TpuSweepBackend:
         return SccCheckResult(intersects=False, q1=disjoint, q2=q, stats=stats)
 
     @staticmethod
-    def _time_breakdown(t0, t_first_dispatch, compile_seconds, drain_log) -> dict:
+    def _time_breakdown(t0, t_first_dispatch, compile_seconds, drain_log,
+                        compile_log=()) -> dict:
         """Wall-clock decomposition for §next-2: setup (constants upload +
         program factory), synchronous compiles, and a per-ramp-level drain
         profile with the steady-state rate = throughput at the largest
         program size actually reached (drain-to-drain elapsed, so pipelined
-        dispatch latency is inside, not hidden)."""
+        dispatch latency is inside, not hidden).  Compile time landing
+        inside a drain interval is subtracted from that interval so it is
+        never double-counted into a level's rate."""
         out = {"compile_seconds": round(compile_seconds, 3)}
         if t_first_dispatch is not None:
             out["setup_seconds"] = round(t_first_dispatch - t0, 3)
@@ -444,8 +461,10 @@ class TpuSweepBackend:
         profile = {}
         prev_t = t_first_dispatch if t_first_dispatch is not None else drain_log[0][0]
         for t, cand, spc in drain_log:
+            interval = t - prev_t
+            interval -= sum(dur for te, dur in compile_log if prev_t < te <= t)
             cand_sum, sec_sum = profile.get(spc, (0, 0.0))
-            profile[spc] = (cand_sum + cand, sec_sum + (t - prev_t))
+            profile[spc] = (cand_sum + cand, sec_sum + max(interval, 0.0))
             prev_t = t
         out["ramp_profile"] = {
             str(spc): {

@@ -517,7 +517,7 @@ class TestLatencyAwareRouting:
 
         data = majority_fbas(9)
         ck = SweepCheckpoint(tmp_path / "sweep.ckpt")
-        ck.record(0, 1 << 8)  # any on-disk progress file
+        ck.record(16, 1 << 8)  # recorded progress for this enumeration size
         res = solve(data, backend=AutoBackend(checkpoint=ck))
         assert res.intersects is True
         assert res.stats["backend"] == "tpu-sweep"  # not the oracle
