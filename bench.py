@@ -566,7 +566,9 @@ def orchestrate(args) -> int:
     if "error" in hy:
         phases["hybrid"] = hy["error"]
     else:
-        phases["hybrid"] = "ok"
+        # Per-row verdict agreement gates the phase status: a perf number
+        # for a wrong answer must not read as a healthy benchmark.
+        phases["hybrid"] = "ok" if hy.get("hybrid_verdicts_ok", True) else "verdict-mismatch"
         headline.update(hy)
     emit(headline)
     return 0

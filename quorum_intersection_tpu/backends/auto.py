@@ -161,26 +161,17 @@ class AutoBackend:
 
     def _has_recorded_progress(self, scc: List[int]) -> bool:
         """Does the attached checkpoint hold progress plausibly belonging to
-        THIS problem?  Cheap shape checks only (sweep: position>0 with the
-        matching enumeration total; hybrid: non-empty frontier) — the full
-        fingerprint check stays inside the backends, which ignore foreign
-        files anyway; a false positive here merely skips oracle-first once."""
-        if self.checkpoint is None:
-            return False
-        import json
-        import pathlib
-
-        path = getattr(self.checkpoint, "path", None)
-        if path is None:
+        THIS problem?  Delegated to the checkpoint class (which owns the
+        on-disk format) — the full fingerprint check stays inside the
+        backends, which ignore foreign files anyway; a false positive here
+        merely skips oracle-first once."""
+        probe = getattr(self.checkpoint, "has_progress", None)
+        if probe is None:
             return False
         try:
-            data = json.loads(pathlib.Path(path).read_text())
-        except (OSError, ValueError):
+            return bool(probe(1 << max(len(scc) - 1, 0)))
+        except Exception:  # noqa: BLE001 — a broken probe must not block solves
             return False
-        total = 1 << max(len(scc) - 1, 0)
-        if data.get("total") == total and int(data.get("position", 0)) > 0:
-            return True  # sweep-format progress for this enumeration size
-        return bool(data.get("states"))  # hybrid-format frontier
 
     def check_scc(
         self,

@@ -37,7 +37,10 @@ is the same, only traversal order changes — so verdicts match the oracle;
 on broken networks the witness pair found first may differ (any disjoint
 pair is a valid witness, cpp's own witness already varies with its RNG).
 
-Batch sizes are bucketed to powers of two so XLA compiles a handful of shapes.
+Batch shapes: on accelerators every batch pads to the fixed ``batch`` row
+count — exactly one compiled program per problem (padding is free on the MXU
+tile); the CPU emulation buckets to powers of two instead, since its cost is
+per-row and its compiles are cheap.
 
 Checkpoint/resume (r3): the worklist is explicit, so preemption survival is
 a frontier snapshot — every unresolved state has at least one request in the
@@ -160,9 +163,10 @@ class TpuHybridBackend:
             raise ValueError("hybrid backend requires the encoded circuit")
         from quorum_intersection_tpu.utils.platform import is_cpu_platform
 
+        on_cpu = is_cpu_platform()
         batch = self.batch
         if batch is None:
-            batch = BATCH_CPU if is_cpu_platform() else BATCH_TPU
+            batch = BATCH_CPU if on_cpu else BATCH_TPU
         t0 = time.perf_counter()
         n = graph.n
         half = len(scc) // 2
@@ -380,12 +384,21 @@ class TpuHybridBackend:
             """Pop up to `batch` requests and dispatch them asynchronously."""
             take = pending[-batch:]
             del pending[-len(take) :]
-            # Bucket the padded batch to powers of two: a handful of compiled
-            # shapes instead of one per frontier size.  A mesh additionally
-            # needs the row axis divisible by (and at least) the device count.
-            b = 1
-            while b < len(take):
-                b *= 2
+            # Accelerators get ONE padded shape per problem: every batch
+            # pads to `batch` rows, so exactly one program compiles (r3;
+            # the r2 power-of-two bucketing compiled up to log2(batch)
+            # shapes — each a multi-second stall through the tunnel) and the
+            # padding waste is free on the MXU tile.  The CPU emulation
+            # pays per-row compute instead of per-tile, so it keeps the
+            # power-of-two bucketing (its compiles are sub-second).  A mesh
+            # additionally needs the row axis divisible by (and at least)
+            # the device count, which the rounding below preserves.
+            if on_cpu:
+                b = 1
+                while b < len(take):
+                    b *= 2
+            else:
+                b = batch
             b = max(b, n_dev)
             b = ((b + n_dev - 1) // n_dev) * n_dev
             masks = np.zeros((b, n), dtype=np.float32)

@@ -41,6 +41,12 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.sweep")
 
+# Seam for deterministic ramp-jump tests: an inline/failing fake replaces
+# real threads so the jump state machine is exercised without timing races.
+import threading as _threading  # noqa: E402
+
+_thread_factory = _threading.Thread
+
 DEFAULT_BATCH = None  # adaptive: see _auto_batch (dispatch latency dominates
 # below ~32k candidates/step; small circuits sustain much larger blocks)
 # Two-level enumeration: the low LO_BITS index bits decode on-device
@@ -363,6 +369,39 @@ class TpuSweepBackend:
                 self.checkpoint.record(min(start + coverage, total), total, fingerprint)
             return False
 
+        async_compile = {"thread": None, "target": None, "seconds": 0.0}
+
+        def start_async_compile(target: int) -> None:
+            """Build + AOT-compile the target shape off-thread; the main
+            loop keeps the device busy with current-level programs and only
+            switches once the compiled program is ready (dispatchers[target]
+            is assigned LAST, so the main thread never blocks on the lock)."""
+            def work():
+                tc = time.monotonic()
+                try:
+                    fn = make_dispatch(target)
+                    precompile = getattr(fn, "precompile", None)
+                    if precompile is None:
+                        # Engine without AOT support (e.g. pallas): leave the
+                        # dispatcher unregistered so the jump's inline
+                        # compile is charged to compile_log, not silently
+                        # folded into a drain interval.
+                        return
+                    precompile()
+                    dispatchers[target] = fn
+                except Exception as exc:  # noqa: BLE001 — fall back to sync
+                    log.info("async ramp compile failed (%s); will compile inline", exc)
+                finally:
+                    async_compile["seconds"] += time.monotonic() - tc
+            # Non-daemon: on an early-hit return the verdict is produced
+            # immediately and only interpreter EXIT waits for the compile —
+            # a daemon thread hard-killed inside native XLA compile aborts
+            # the process ('FATAL: exception not rethrown').
+            t = _thread_factory(target=work)
+            async_compile["thread"] = t
+            async_compile["target"] = target
+            t.start()
+
         start = start0
         ramp_ix = 0
         since_ramp = 0  # dispatches since the last ramp change: the first
@@ -373,17 +412,41 @@ class TpuSweepBackend:
             # least a couple of programs at the next size (never compile
             # shapes a small sweep won't use) — and then jump straight to
             # the largest such level, skipping the intermediate shapes.
+            # The jump-target shape compiles in a background thread while
+            # the current level keeps sweeping; the switch happens only when
+            # the compiled program is ready (or inline if the thread died).
             if (
                 ramp_ix + 1 < len(STEPS_RAMP)
                 and since_ramp >= RAMP_DISPATCHES
                 and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
             ):
-                while (
-                    ramp_ix + 1 < len(STEPS_RAMP)
-                    and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
+                ct = async_compile["target"]
+                thread = async_compile["thread"]
+                if (
+                    ct is not None
+                    and ct in dispatchers
+                    and total - start >= ct * base_block
                 ):
-                    ramp_ix += 1
-                since_ramp = 0
+                    # The in-flight compile landed and still fits: jump.
+                    ramp_ix, since_ramp = STEPS_RAMP.index(ct), 0
+                    async_compile["target"] = None
+                elif thread is None or not thread.is_alive():
+                    target_ix = ramp_ix
+                    while (
+                        target_ix + 1 < len(STEPS_RAMP)
+                        and total - start >= STEPS_RAMP[target_ix + 1] * base_block * 2
+                    ):
+                        target_ix += 1
+                    if ct == STEPS_RAMP[target_ix] and ct not in dispatchers:
+                        # Thread finished without registering: compile
+                        # failed; jump anyway, dispatch() compiles inline.
+                        ramp_ix, since_ramp = target_ix, 0
+                        async_compile["target"] = None
+                    else:
+                        start_async_compile(STEPS_RAMP[target_ix])
+                # else: a compile is still in flight — keep sweeping at the
+                # current level; the target is re-validated against the
+                # remaining work at jump time, never re-chosen mid-compile.
             hi, lo = start >> lo_bits, start & (lo_total - 1)
             coverage = STEPS_RAMP[ramp_ix] * base_block
             spc = STEPS_RAMP[ramp_ix]
@@ -397,7 +460,19 @@ class TpuSweepBackend:
                 # hi mask differs).  This also makes checkpoint positions
                 # independent of batch/lo_bits choices across resumes.
                 rem = lo_total - lo
-                spc = next(r for r in STEPS_RAMP if r * base_block >= rem)
+                # Prefer the smallest ALREADY-COMPILED shape that covers the
+                # remainder (overshoot aliases are free duplicates): the
+                # jump skips intermediate levels, so a fresh `next(...)`
+                # pick here could stall the pipeline on a synchronous
+                # compile of a shape used exactly once per chunk tail.
+                compiled_ok = [
+                    r for r in STEPS_RAMP
+                    if r * base_block >= rem and r in dispatchers
+                ]
+                spc = (
+                    min(compiled_ok) if compiled_ok
+                    else next(r for r in STEPS_RAMP if r * base_block >= rem)
+                )
                 coverage = rem
             inflight.append((start, coverage, hi, spc, dispatch(lo, hi, spc)))
             since_ramp += 1
@@ -407,6 +482,11 @@ class TpuSweepBackend:
         while not found and inflight:
             if drain_one():
                 break
+
+        # No join here: the compile thread is non-daemon, so an early-hit
+        # verdict returns immediately and only interpreter exit waits for
+        # any still-running compile (bounded by one compile; ~instant when
+        # the persistent cache is warm).
 
         seconds = time.perf_counter() - t0
         stats = {
@@ -420,6 +500,10 @@ class TpuSweepBackend:
         stats.update(self._time_breakdown(
             t0_monotonic, t_first_dispatch, compile_seconds, drain_log, compile_log
         ))
+        if async_compile["seconds"]:
+            # Overlapped with device work — reported separately, never
+            # subtracted from drain intervals like the blocking compiles.
+            stats["async_compile_seconds"] = round(async_compile["seconds"], 3)
         if not found:
             if self.checkpoint is not None:
                 self.checkpoint.clear()
@@ -538,11 +622,28 @@ class TpuSweepBackend:
                 shard_map_fn(shard_fn, mesh, in_specs=(P(), P()), out_specs=P())
             )
 
+            # Same AOT hook as the single-device factory (kernels.py): the
+            # ramp jump precompiles the big shape off-thread.
+            import threading
+
+            state: dict = {}
+            lock = threading.Lock()
+
+            def precompile():
+                with lock:
+                    if "compiled" not in state:
+                        state["compiled"] = sharded.lower(
+                            jax.ShapeDtypeStruct((), jnp.int32),
+                            jax.ShapeDtypeStruct(zeros_hi.shape, zeros_hi.dtype),
+                        ).compile()
+                return state["compiled"]
+
             # Asynchronous dispatch: the caller syncs via int(handle).
             def run(start: int, hi_mask=None):
                 hi = zeros_hi if hi_mask is None else arrays.cast(hi_mask)
-                return sharded(jnp.int32(start), hi)
+                return precompile()(jnp.int32(start), hi)
 
+            run.precompile = precompile
             return run
 
         return base_block, make_dispatch

@@ -53,11 +53,27 @@ class SweepCheckpoint:
     def __post_init__(self) -> None:
         self.path = Path(self.path)
 
-    def resume_position(self, total: int, fingerprint: Optional[str] = None) -> int:
-        """Last recorded block-aligned position, or 0 if absent/mismatched."""
+    def has_progress(self, total: int) -> bool:
+        """Cheap probe: does the file hold recorded progress for an
+        enumeration of this size?  (No fingerprint check — resume_position
+        still guards the actual resume; callers like the auto router only
+        need 'plausibly this problem' to decide routing.)"""
+        data = self._read()
+        return data is not None and data.get("total") == total and int(
+            data.get("position", 0) or 0
+        ) > 0
+
+    def _read(self) -> Optional[dict]:
         try:
             data = json.loads(self.path.read_text())
         except (OSError, json.JSONDecodeError):
+            return None
+        return data if isinstance(data, dict) else None
+
+    def resume_position(self, total: int, fingerprint: Optional[str] = None) -> int:
+        """Last recorded block-aligned position, or 0 if absent/mismatched."""
+        data = self._read()
+        if data is None:
             return 0
         if data.get("total") != total:
             log.info("checkpoint total %s != current %d; ignoring", data.get("total"), total)
@@ -105,11 +121,23 @@ class HybridCheckpoint:
     def __post_init__(self) -> None:
         self.path = Path(self.path)
 
-    def resume_states(self, fingerprint: str):
-        """Saved frontier [(to_remove, dont_remove), ...], or None."""
+    def has_progress(self, total: int = 0) -> bool:
+        """Cheap probe: a non-empty saved frontier (``total`` accepted for
+        signature parity with :meth:`SweepCheckpoint.has_progress`)."""
+        data = self._read()
+        return data is not None and bool(data.get("states"))
+
+    def _read(self) -> Optional[dict]:
         try:
             data = json.loads(self.path.read_text())
         except (OSError, json.JSONDecodeError):
+            return None
+        return data if isinstance(data, dict) else None
+
+    def resume_states(self, fingerprint: str):
+        """Saved frontier [(to_remove, dont_remove), ...], or None."""
+        data = self._read()
+        if data is None:
             return None
         if data.get("fingerprint") != fingerprint:
             log.info("hybrid checkpoint belongs to a different problem; ignoring")

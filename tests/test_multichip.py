@@ -192,3 +192,18 @@ def test_mesh_scaling_benchmark_smoke(tmp_path):
     for n_dev in (1, 2, 4, 8):
         assert f"\n{n_dev:>5}  " in table
     assert "speedup 8-dev vs 1-dev" in table
+
+
+@needs_8_devices
+def test_mesh_sweep_ramp_jump(monkeypatch):
+    """The sharded factory's precompile hook: the jump engages on a mesh
+    (deterministic inline fake thread) with verdict parity."""
+    import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+    from tests.test_tpu_backends import TestRampJump
+
+    monkeypatch.setattr(sweep_mod, "_thread_factory", TestRampJump._InlineThread)
+    mesh = candidate_mesh(8)
+    res = solve(majority_fbas(15), backend=TpuSweepBackend(batch=64, mesh=mesh))
+    assert res.intersects is True
+    assert res.stats["steady_level"] > 1
+    assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]

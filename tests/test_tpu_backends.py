@@ -545,3 +545,63 @@ class TestLatencyAwareRouting:
         res = solve(data, backend=TpuHybridBackend(batch=64, checkpoint=ck))
         assert res.intersects is True
         assert "resumed_states" not in res.stats
+
+
+class TestRampJump:
+    """Deterministic coverage for the async ramp-jump state machine
+    (sweep.py): inline and dead fake threads replace the compile thread so
+    every branch — jump-on-landed, failed-compile inline fallback — runs
+    without timing races."""
+
+    class _InlineThread:
+        """start() runs the work synchronously; the next loop iteration
+        sees the registered dispatcher and jumps."""
+
+        def __init__(self, *a, **k):
+            self._target = k.get("target")
+
+        def start(self):
+            self._target()
+
+        def is_alive(self):
+            return False
+
+    class _DeadThread:
+        """Never runs the work: simulates a failed async compile — the
+        driver must jump anyway and compile inline."""
+
+        def __init__(self, *a, **k):
+            pass
+
+        def start(self):
+            pass
+
+        def is_alive(self):
+            return False
+
+    def test_jump_engages_with_verdict_parity(self, monkeypatch):
+        import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+
+        monkeypatch.setattr(sweep_mod, "_thread_factory", self._InlineThread)
+        res = solve(majority_fbas(15), backend=TpuSweepBackend(batch=64))
+        assert res.intersects is True
+        assert res.stats["steady_level"] > 1
+        assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]
+
+    def test_jump_broken_network_witness(self, monkeypatch):
+        import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+
+        monkeypatch.setattr(sweep_mod, "_thread_factory", self._InlineThread)
+        data = majority_fbas(15, broken=True)
+        single = solve(data, backend=TpuSweepBackend(batch=64))
+        assert single.intersects is False
+        assert single.q1 and single.q2 and not set(single.q1) & set(single.q2)
+
+    def test_failed_async_compile_jumps_inline(self, monkeypatch):
+        import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+
+        monkeypatch.setattr(sweep_mod, "_thread_factory", self._DeadThread)
+        res = solve(majority_fbas(15), backend=TpuSweepBackend(batch=64))
+        assert res.intersects is True
+        assert res.stats["steady_level"] > 1  # sync jump still happened
+        assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]
