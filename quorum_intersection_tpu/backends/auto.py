@@ -77,22 +77,26 @@ class AutoBackend:
         seed: Optional[int] = None,
         randomized: bool = False,
         checkpoint=None,
+        mesh=None,
     ) -> None:
         self.prefer_tpu = prefer_tpu
         self.sweep_limit = sweep_limit
         self.checkpoint = checkpoint  # forwarded to the sweep/hybrid backends
+        self.mesh = mesh  # forwarded to the device backends (sweep/hybrid)
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
     def _sweep(self):
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
-        return TpuSweepBackend(checkpoint=self.checkpoint)
+        return TpuSweepBackend(checkpoint=self.checkpoint, mesh=self.mesh)
 
     def _hybrid(self):
         from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
         # Same seeded/randomized tie-break contract as the host oracles.
         options = dict(self._oracle_options)
+        if self.mesh is not None:
+            options["mesh"] = self.mesh
         if self.checkpoint is not None:
             # The user handed a sweep-format checkpoint (path-per-problem);
             # the hybrid stores its frontier at the same path in its own

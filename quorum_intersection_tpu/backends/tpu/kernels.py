@@ -308,33 +308,40 @@ def sweep_program_factory(
 
             return lax.fori_loop(0, steps_per_call, body, jnp.int32(INT32_MAX))
 
-        # AOT-compile once and dispatch through the Compiled object: the
-        # sweep driver's ramp jump precompiles the big shape in a BACKGROUND
-        # thread while small programs keep the device busy (sweep.py), so
-        # the compile never idles the chip.  A lock makes a concurrent
-        # precompile + first dispatch compile exactly once.
-        import threading
-
-        state: dict = {}
-        lock = threading.Lock()
-
-        def precompile():
-            with lock:
-                if "compiled" not in state:
-                    state["compiled"] = step.lower(
-                        jax.ShapeDtypeStruct((), jnp.int32),
-                        jax.ShapeDtypeStruct(zeros_hi.shape, zeros_hi.dtype),
-                    ).compile()
-            return state["compiled"]
-
-        def dispatch(start: int, hi_mask=None):
-            # hi_mask: (n,) 0/1 np row of high-bit nodes for wide sweeps
-            # (one device upload per outer chunk; same compiled program).
-            hi = zeros_hi if hi_mask is None else arrays.cast(hi_mask)
-            return precompile()(jnp.int32(start), hi)
-
-        dispatch.precompile = precompile
-        return dispatch
+        # hi_mask: (n,) 0/1 np row of high-bit nodes for wide sweeps (one
+        # device upload per outer chunk; same compiled program).
+        return make_aot_dispatch(step, zeros_hi, arrays.cast)
 
     return factory
+
+
+def make_aot_dispatch(step, zeros_hi: jnp.ndarray, cast) -> Callable:
+    """Wrap a jitted ``step(start, hi_mask)`` into a dispatch function that
+    AOT-compiles once and calls the Compiled object.
+
+    The ``.precompile`` attribute is the sweep driver's ramp-jump hook: the
+    big shape compiles in a BACKGROUND thread while small programs keep the
+    device busy (sweep.py), so the compile never idles the chip.  A lock
+    makes a concurrent precompile + first dispatch compile exactly once.
+    Shared by the single-device and mesh-sharded program factories."""
+    import threading
+
+    state: dict = {}
+    lock = threading.Lock()
+
+    def precompile():
+        with lock:
+            if "compiled" not in state:
+                state["compiled"] = step.lower(
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct(zeros_hi.shape, zeros_hi.dtype),
+                ).compile()
+        return state["compiled"]
+
+    def dispatch(start: int, hi_mask=None):
+        hi = zeros_hi if hi_mask is None else cast(hi_mask)
+        return precompile()(jnp.int32(start), hi)
+
+    dispatch.precompile = precompile
+    return dispatch
 

@@ -622,28 +622,10 @@ class TpuSweepBackend:
                 shard_map_fn(shard_fn, mesh, in_specs=(P(), P()), out_specs=P())
             )
 
-            # Same AOT hook as the single-device factory (kernels.py): the
-            # ramp jump precompiles the big shape off-thread.
-            import threading
+            # Same AOT ramp-jump hook as the single-device factory; dispatch
+            # is asynchronous — the caller syncs via int(handle).
+            from quorum_intersection_tpu.backends.tpu.kernels import make_aot_dispatch
 
-            state: dict = {}
-            lock = threading.Lock()
-
-            def precompile():
-                with lock:
-                    if "compiled" not in state:
-                        state["compiled"] = sharded.lower(
-                            jax.ShapeDtypeStruct((), jnp.int32),
-                            jax.ShapeDtypeStruct(zeros_hi.shape, zeros_hi.dtype),
-                        ).compile()
-                return state["compiled"]
-
-            # Asynchronous dispatch: the caller syncs via int(handle).
-            def run(start: int, hi_mask=None):
-                hi = zeros_hi if hi_mask is None else arrays.cast(hi_mask)
-                return precompile()(jnp.int32(start), hi)
-
-            run.precompile = precompile
-            return run
+            return make_aot_dispatch(sharded, zeros_hi, arrays.cast)
 
         return base_block, make_dispatch

@@ -93,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", metavar="DIR", default=None,
                    help="record a jax profiler trace of the solve into DIR "
                         "(open with TensorBoard/XProf)")
+    p.add_argument("--mesh", metavar="N", default=None,
+                   help="shard the device search across N devices ('all' = every "
+                        "visible device); applies to auto/tpu/tpu-sweep/tpu-hybrid")
     return p
 
 
@@ -163,6 +166,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.backend == "tpu-hybrid"
             else SweepCheckpoint(args.checkpoint)
         )
+    if args.mesh is not None:
+        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid"):
+            sys.stderr.write("--mesh requires a device backend (auto/tpu/tpu-sweep/tpu-hybrid)\n")
+            return 1
+        try:
+            n_dev = None if args.mesh == "all" else int(args.mesh)
+        except ValueError:
+            sys.stderr.write(f"--mesh expects a device count or 'all', got {args.mesh!r}\n")
+            return 1
+        if n_dev is not None and n_dev < 1:
+            sys.stderr.write(f"--mesh expects a positive device count, got {n_dev}\n")
+            return 1
+        try:
+            from quorum_intersection_tpu.parallel.mesh import candidate_mesh
+
+            backend_options["mesh"] = candidate_mesh(n_dev)
+        except (ImportError, ValueError) as exc:
+            # ValueError: more devices requested than visible; ImportError:
+            # no jax — same clean one-line contract as backend construction.
+            sys.stderr.write(f"--mesh {args.mesh}: {exc}\n")
+            return 1
     try:
         backend = get_backend(args.backend, **backend_options)
     except (ImportError, ValueError) as exc:

@@ -57,11 +57,18 @@ class SweepCheckpoint:
         """Cheap probe: does the file hold recorded progress for an
         enumeration of this size?  (No fingerprint check — resume_position
         still guards the actual resume; callers like the auto router only
-        need 'plausibly this problem' to decide routing.)"""
+        need 'plausibly this problem' to decide routing.)
+
+        Also recognizes a hybrid-format frontier at the same path: the auto
+        router converts this checkpoint to a :class:`HybridCheckpoint` when
+        it routes to the hybrid, so the on-disk file may legitimately hold
+        either format mid-run."""
         data = self._read()
-        return data is not None and data.get("total") == total and int(
-            data.get("position", 0) or 0
-        ) > 0
+        if data is None:
+            return False
+        if data.get("total") == total and int(data.get("position", 0) or 0) > 0:
+            return True
+        return bool(data.get("states"))
 
     def _read(self) -> Optional[dict]:
         try:

@@ -141,3 +141,51 @@ def test_profile_dir_flag(tmp_path):
     assert proc.returncode == 0
     # jax writes plugins/profile/<ts>/*.xplane.pb under the trace dir
     assert any(trace.rglob("*.xplane.pb"))
+
+
+def test_mesh_flag_sharded_sweep():
+    # conftest pins JAX_PLATFORMS=cpu with 8 emulated devices; the child
+    # CLI inherits that env, so --mesh 2 builds a real 2-device mesh.
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--mesh", "2"], _json(majority_fbas(9))
+    )
+    assert proc.stdout.strip() == "true"
+    assert proc.returncode == 0
+
+
+def test_mesh_flag_all_devices_broken_network():
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--mesh", "all"],
+        _json(majority_fbas(9, broken=True)),
+    )
+    assert proc.stdout.strip() == "false"
+    assert proc.returncode == 1
+
+
+def test_mesh_flag_requires_device_backend():
+    proc = run_cli(
+        ["--backend", "python", "--mesh", "2"], _json(majority_fbas(3))
+    )
+    assert proc.returncode == 1
+    assert "--mesh requires a device backend" in proc.stderr
+
+
+def test_mesh_flag_bad_values():
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--mesh", "lots"], _json(majority_fbas(3))
+    )
+    assert proc.returncode == 1
+    assert "device count or 'all'" in proc.stderr
+    proc = run_cli(
+        ["--backend", "tpu-sweep", "--mesh", "999"], _json(majority_fbas(3))
+    )
+    assert proc.returncode == 1
+
+
+def test_mesh_flag_rejects_nonpositive():
+    for value in ("0", "-2"):
+        proc = run_cli(
+            ["--backend", "tpu-sweep", "--mesh", value], _json(majority_fbas(3))
+        )
+        assert proc.returncode == 1
+        assert "positive device count" in proc.stderr

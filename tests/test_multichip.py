@@ -207,3 +207,14 @@ def test_mesh_sweep_ramp_jump(monkeypatch):
     assert res.intersects is True
     assert res.stats["steady_level"] > 1
     assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]
+
+
+@needs_8_devices
+def test_auto_backend_forwards_mesh():
+    from quorum_intersection_tpu.backends.auto import AutoBackend
+
+    mesh = candidate_mesh(4)
+    auto = AutoBackend(mesh=mesh)
+    assert auto._sweep().mesh is mesh
+    auto2 = AutoBackend(prefer_tpu=True, mesh=mesh)
+    assert auto2._hybrid().mesh is mesh
