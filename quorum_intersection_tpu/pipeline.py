@@ -122,6 +122,7 @@ def solve_graph(
     # Per-SCC quorum scan (cpp:645-672): which SCCs, restricted to themselves,
     # contain a quorum?  All minimal quorums live inside some SCC.
     quorum_scc_ids: List[int] = []
+    scc_quorums: Dict[int, List[int]] = {}
     log.debug("%d strongly connected components; scanning for quorums", count)
     allow_native_scan = getattr(backend, "name", "") != "python"
     with timers.phase("scc_scan"):
@@ -130,6 +131,7 @@ def solve_graph(
         ):
             if quorum:
                 quorum_scc_ids.append(sid)
+                scc_quorums[sid] = quorum
                 log.debug(
                     "scc %d (size %d) contains a quorum (size %d)",
                     sid, len(sccs[sid]), len(quorum),
@@ -165,11 +167,22 @@ def solve_graph(
                 "network's configuration is broken - more than one strongly connected "
                 f"component contains a quorum - {len(quorum_scc_ids)}\n"
             )
+        # The reference only narrates here (cpp:683-685); the API can do
+        # better: with ≥2 quorum-bearing SCCs the per-SCC quorums are a
+        # valid witness pair (SCCs are vertex-disjoint and the scan
+        # restricts availability to members).  Zero quorum-bearing SCCs
+        # means no quorum exists at all — no witness is possible.
+        q1 = q2 = None
+        if len(quorum_scc_ids) >= 2:
+            q1 = scc_quorums[quorum_scc_ids[0]]
+            q2 = scc_quorums[quorum_scc_ids[1]]
         return SolveResult(
             intersects=False,
             n_sccs=count,
             quorum_scc_ids=quorum_scc_ids,
             main_scc=main_scc,
+            q1=q1,
+            q2=q2,
             stats={"reason": "scc_guard"},
             timers=timers.summary(),
         )

@@ -253,3 +253,32 @@ class TestSccScan:
         monkeypatch.setattr(pl, "NATIVE_SCAN_LIMIT", 8)
         res = solve(stellar_like_fbas(n_core_orgs=3, n_watchers=10), backend="python")
         assert res.intersects is True
+
+
+def test_scc_guard_two_quorum_sccs_yields_witness_pair():
+    """With >= 2 quorum-bearing SCCs the guard verdict (cpp:681-688) now
+    also surfaces a witness pair via the API: one per-SCC quorum each,
+    disjoint by construction (the reference only narrates here)."""
+    from quorum_intersection_tpu.fbas.semantics import is_quorum as _isq
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+    from quorum_intersection_tpu.fbas.graph import build_graph as _bg
+    from quorum_intersection_tpu.fbas.schema import parse_fbas as _pf
+
+    data = majority_fbas(3, prefix="ISLA") + majority_fbas(3, prefix="ISLB")
+    res = solve(data, backend="python")
+    assert res.intersects is False
+    assert res.stats["reason"] == "scc_guard"
+    assert len(res.quorum_scc_ids) == 2
+    g = _bg(_pf(data))
+    assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
+    assert _isq(g, res.q1) and _isq(g, res.q2)
+
+
+def test_scc_guard_no_quorum_anywhere_has_no_witness():
+    # All nodes null-qset: zero quorum-bearing SCCs — broken, no witness.
+    data = [{"publicKey": f"N{i}", "name": "", "quorumSet": None} for i in range(3)]
+    res = solve(data, backend="python")
+    assert res.intersects is False
+    assert res.quorum_scc_ids == []
+    assert res.q1 is None and res.q2 is None

@@ -1,0 +1,107 @@
+"""Property-based differential testing (hypothesis).
+
+The seeded differential loops elsewhere in the suite check fixed samples;
+these properties let hypothesis search the FBAS space for divergence
+between the engines and for metamorphic invariants the reference pins:
+
+- python oracle ⇔ exhaustive sweep verdict equality (the sweep's
+  verdict-equivalence proof, sweep.py module docstring, exercised on
+  adversarial instances rather than seeds);
+- witness validity: a False verdict always carries two disjoint quorums
+  (each a fixpoint-verified quorum, cpp:351-352 out-param contract);
+- sanitizer idempotence (fix_quorum_configurations.py:11-15 analog);
+- verdict monotonicity under the one-knob methodology (SURVEY.md §4.1):
+  raising one node's top-level threshold never creates a *new* disjoint
+  pair on a previously-safe symmetric network.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import is_quorum
+from quorum_intersection_tpu.fbas.synth import random_fbas
+from quorum_intersection_tpu.pipeline import solve
+
+# Device-touching properties keep example counts small: each example runs
+# two full solves (one jit-compiled); the value is the SEARCH, not volume.
+COMMON = dict(
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+fbas_params = st.fixed_dictionaries(
+    {
+        "n": st.integers(min_value=3, max_value=12),
+        "seed": st.integers(min_value=0, max_value=10**6),
+        "nested_prob": st.sampled_from([0.0, 0.3, 0.7]),
+        "null_prob": st.sampled_from([0.0, 0.2]),
+        "dangling_prob": st.sampled_from([0.0, 0.2]),
+    }
+)
+
+
+@settings(max_examples=25, **COMMON)
+@given(params=fbas_params)
+def test_oracle_and_sweep_verdicts_agree(params):
+    data = random_fbas(**params)
+    oracle = solve(data, backend="python")
+    sweep = solve(data, backend=TpuSweepBackend(batch=256))
+    assert oracle.intersects is sweep.intersects
+
+
+@settings(max_examples=25, **COMMON)
+@given(params=fbas_params)
+def test_false_verdict_carries_valid_disjoint_witness(params):
+    data = random_fbas(**params)
+    res = solve(data, backend="python")
+    if res.intersects:
+        return
+    if res.stats.get("reason") == "scc_guard" and len(res.quorum_scc_ids) == 0:
+        # No quorum exists anywhere — no witness pair is possible.
+        assert res.q1 is None and res.q2 is None
+        return
+    graph = build_graph(parse_fbas(data))
+    assert res.q1 and res.q2
+    assert not set(res.q1) & set(res.q2)
+    assert is_quorum(graph, res.q1)
+    assert is_quorum(graph, res.q2)
+
+
+@settings(max_examples=50, **COMMON)
+@given(params=fbas_params)
+def test_sanitizer_idempotent_and_parse_clean(params):
+    from quorum_intersection_tpu.fbas.sanitize import sanitize
+
+    data = random_fbas(**params)
+    once = sanitize(data)
+    twice = sanitize(once)
+    assert once == twice
+    parse_fbas(once)  # sanitized output must always parse
+
+
+@settings(max_examples=20, **COMMON)
+@given(
+    n=st.integers(min_value=3, max_value=9),
+    bump=st.integers(min_value=0, max_value=3),
+    victim=st.integers(min_value=0, max_value=8),
+)
+def test_raising_a_threshold_never_breaks_a_safe_majority(n, bump, victim):
+    """One-knob metamorphic property: on a safe symmetric majority network,
+    RAISING any single node's threshold (more agreement required) cannot
+    create a disjoint quorum pair — only lowering can (the broken twins'
+    knob, `broken_trivial.json:20` lowers 2→1)."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+    data = majority_fbas(n)
+    victim %= n
+    q = data[victim]["quorumSet"]
+    q["threshold"] = min(q["threshold"] + bump, n)
+    res = solve(data, backend="python")
+    assert res.intersects is True
