@@ -605,3 +605,21 @@ class TestRampJump:
         assert res.intersects is True
         assert res.stats["steady_level"] > 1  # sync jump still happened
         assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]
+
+    def test_wide_chunked_sweep_with_jump_and_tails(self):
+        """Two-level decode with many outer chunks (small lo_bits) through
+        the new jump/tail-shape selection: verdict parity on both twins and
+        vs the oracle on random nets."""
+        from quorum_intersection_tpu.fbas.synth import random_fbas as _rf
+
+        for data, want in (
+            (majority_fbas(13), True),
+            (majority_fbas(13, broken=True), False),
+        ):
+            res = solve(data, backend=TpuSweepBackend(batch=16, lo_bits=6))
+            assert res.intersects is want
+        for seed in (2, 11):
+            data = _rf(12, seed=seed, nested_prob=0.4)
+            a = solve(data, backend="python").intersects
+            b = solve(data, backend=TpuSweepBackend(batch=16, lo_bits=5)).intersects
+            assert a is b
