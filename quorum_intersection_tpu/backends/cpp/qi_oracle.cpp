@@ -79,80 +79,42 @@ bool slice_satisfied(const Graph& g, int32_t owner, const uint8_t* avail) {
   return slice_unit(g, root, avail);
 }
 
-// Greatest fixpoint of f(X) = {x in X : slice(x) satisfied by X}.  `avail` is
-// narrowed during iteration and restored before returning, so callers can
-// reuse their availability vector (cpp:171-173).
+// Greatest fixpoint of f(X) = {x in X : slice(x) satisfied by X}, in place:
+// `nodes` is compacted to the surviving quorum (keeping relative order) and
+// `avail` is narrowed during iteration but restored before returning, so
+// callers can reuse their availability vector (cpp:171-173).
+// `removed_scratch` is caller-provided so the hot search allocates nothing.
+void max_quorum_inplace(const Graph& g, std::vector<int32_t>& nodes,
+                        uint8_t* avail, std::vector<int32_t>& removed_scratch) {
+  removed_scratch.clear();
+  for (;;) {
+    const size_t before = nodes.size();
+    size_t w = 0;
+    for (size_t i = 0; i < before; ++i) {
+      const int32_t v = nodes[i];
+      if (slice_satisfied(g, v, avail)) {
+        nodes[w++] = v;
+      } else if (avail[v]) {
+        avail[v] = 0;
+        removed_scratch.push_back(v);
+      }
+    }
+    nodes.resize(w);
+    if (nodes.size() == before) break;
+  }
+  for (const int32_t v : removed_scratch) avail[v] = 1;
+}
+
+// Value-returning convenience wrapper (cold paths: SCC scan, candidate
+// check, bindings).
 std::vector<int32_t> max_quorum(const Graph& g, std::vector<int32_t> nodes,
                                 uint8_t* avail) {
   std::vector<int32_t> removed;
-  for (;;) {
-    const size_t before = nodes.size();
-    std::vector<int32_t> kept;
-    kept.reserve(before);
-    for (const int32_t v : nodes) {
-      if (slice_satisfied(g, v, avail)) {
-        kept.push_back(v);
-      } else if (avail[v]) {
-        avail[v] = 0;
-        removed.push_back(v);
-      }
-    }
-    nodes.swap(kept);
-    if (nodes.size() == before) break;
-  }
-  for (const int32_t v : removed) avail[v] = 1;
+  max_quorum_inplace(g, nodes, avail, removed);
   return nodes;
 }
 
-bool is_minimal_quorum(const Graph& g, const std::vector<int32_t>& nodes) {
-  std::vector<uint8_t> avail(g.n, 0);
-  for (const int32_t v : nodes) avail[v] = 1;
-  if (max_quorum(g, nodes, avail.data()).empty()) return false;
-  for (const int32_t v : nodes) {
-    avail[v] = 0;
-    if (!max_quorum(g, nodes, avail.data()).empty()) return false;
-    avail[v] = 1;
-  }
-  return true;
-}
 
-// Branch variable: a max-in-degree node within `quorum` minus `restriction`;
-// in-degree counts parallel edges and self-loops with multiplicity (Q7).
-// Deterministic mode picks the lowest index among the argmax set; RNG mode
-// picks uniformly over the same set.
-int32_t find_best_node(const Graph& g, const std::vector<int32_t>& quorum,
-                       const std::vector<int32_t>& restriction,
-                       std::mt19937_64* rng) {
-  std::vector<uint8_t> eligible(g.n, 0);
-  for (const int32_t v : quorum) eligible[v] = 1;
-  for (const int32_t v : restriction) eligible[v] = 0;
-  std::vector<int32_t> indeg(g.n, 0);
-  bool any_edge = false;
-  for (const int32_t v : quorum) {
-    for (int32_t e = g.succ_off[v]; e < g.succ_off[v + 1]; ++e) {
-      const int32_t w = g.succ_tgt[e];
-      if (eligible[w]) {
-        ++indeg[w];
-        any_edge = true;
-      }
-    }
-  }
-  if (!any_edge) return quorum[0];  // bestNode init fallback (cpp:221)
-  int32_t max_deg = 0;
-  for (const int32_t v : quorum) max_deg = std::max(max_deg, indeg[v]);
-  std::vector<int32_t> candidates;
-  for (const int32_t v : quorum) {
-    if (eligible[v] && indeg[v] == max_deg) candidates.push_back(v);
-  }
-  std::sort(candidates.begin(), candidates.end());
-  candidates.erase(std::unique(candidates.begin(), candidates.end()),
-                   candidates.end());
-  if (rng != nullptr) {
-    std::uniform_int_distribution<size_t> pick(0, candidates.size() - 1);
-    return candidates[pick(*rng)];
-  }
-  return candidates.front();
-}
 
 struct Search {
   const Graph& g;
@@ -177,12 +139,91 @@ struct Search {
   bool found = false;
   std::vector<int32_t> q1, q2;
 
+  // Reusable per-frame scratch (hot-path allocation elimination, r3): every
+  // buffer is fully consumed BEFORE the recursive calls in iterate(), so
+  // sharing one set across the whole recursion is safe.  ~10 heap
+  // allocations per B&B call become zero; O(n) clears remain (cheap).
+  std::vector<uint8_t> s_local;       // availability for dont/cand fixpoints
+  std::vector<uint8_t> s_avail_min;   // is_minimal_quorum availability
+  std::vector<uint8_t> s_mark;        // in_quorum / eligible marks
+  std::vector<int32_t> s_removed;     // max_quorum_inplace restore list
+  std::vector<int32_t> s_nodes;       // dont-check fixpoint workspace
+  std::vector<int32_t> s_quorum;      // cand fixpoint workspace
+  std::vector<int32_t> s_min_nodes;   // is_minimal fixpoint workspace
+  std::vector<int32_t> s_probe;       // disjointness-probe workspace
+  std::vector<int32_t> s_indeg;       // find_best_node in-degrees
+  std::vector<int32_t> s_candidates;  // find_best_node argmax set
+
+  void init_scratch() {
+    s_local.assign(g.n, 0);
+    s_avail_min.assign(g.n, 0);
+    s_mark.assign(g.n, 0);
+    s_indeg.assign(g.n, 0);
+  }
+
+  // is_minimal_quorum (cpp:179-201) on scratch: candidate is a quorum AND
+  // removing any single node kills all quorums inside it.
+  bool minimal_on_scratch(const std::vector<int32_t>& nodes) {
+    std::fill(s_avail_min.begin(), s_avail_min.end(), 0);
+    for (const int32_t v : nodes) s_avail_min[v] = 1;
+    s_min_nodes.assign(nodes.begin(), nodes.end());
+    max_quorum_inplace(g, s_min_nodes, s_avail_min.data(), s_removed);
+    if (s_min_nodes.empty()) return false;
+    for (const int32_t v : nodes) {
+      s_avail_min[v] = 0;
+      s_min_nodes.assign(nodes.begin(), nodes.end());
+      max_quorum_inplace(g, s_min_nodes, s_avail_min.data(), s_removed);
+      if (!s_min_nodes.empty()) return false;
+      s_avail_min[v] = 1;
+    }
+    return true;
+  }
+
+  // find_best_node (cpp:203-250) on scratch; semantics identical to the
+  // free function (max in-degree with multiplicity, lowest-index or
+  // seeded-uniform tie-break).
+  int32_t best_on_scratch(const std::vector<int32_t>& quorum,
+                          const std::vector<int32_t>& restriction) {
+    std::fill(s_mark.begin(), s_mark.end(), 0);
+    for (const int32_t v : quorum) s_mark[v] = 1;
+    for (const int32_t v : restriction) s_mark[v] = 0;
+    std::fill(s_indeg.begin(), s_indeg.end(), 0);
+    bool any_edge = false;
+    for (const int32_t v : quorum) {
+      for (int32_t e = g.succ_off[v]; e < g.succ_off[v + 1]; ++e) {
+        const int32_t w = g.succ_tgt[e];
+        if (s_mark[w]) {
+          ++s_indeg[w];
+          any_edge = true;
+        }
+      }
+    }
+    if (!any_edge) return quorum[0];  // bestNode init fallback (cpp:221)
+    int32_t max_deg = 0;
+    for (const int32_t v : quorum) max_deg = std::max(max_deg, s_indeg[v]);
+    s_candidates.clear();
+    for (const int32_t v : quorum) {
+      if (s_mark[v] && s_indeg[v] == max_deg) s_candidates.push_back(v);
+    }
+    std::sort(s_candidates.begin(), s_candidates.end());
+    s_candidates.erase(
+        std::unique(s_candidates.begin(), s_candidates.end()),
+        s_candidates.end());
+    if (rng != nullptr) {
+      std::uniform_int_distribution<size_t> pick(0, s_candidates.size() - 1);
+      return s_candidates[pick(*rng)];
+    }
+    return s_candidates.front();
+  }
+
   // checkMinimalQuorums' visitor (cpp:357-384): mark Q unavailable, probe the
   // SCC for a disjoint quorum; restore on miss.
   bool visit(const std::vector<int32_t>& quorum) {
     for (const int32_t v : quorum) avail[v] = 0;
     ++fixpoint_calls;
-    std::vector<int32_t> disjoint = max_quorum(g, scc, avail);
+    s_probe.assign(scc.begin(), scc.end());
+    max_quorum_inplace(g, s_probe, avail, s_removed);
+    std::vector<int32_t>& disjoint = s_probe;
     if (!disjoint.empty()) {
       if (trace) {
         std::fprintf(stderr,
@@ -191,7 +232,7 @@ struct Search {
                      disjoint.size());
       }
       found = true;
-      q1 = std::move(disjoint);
+      q1.assign(disjoint.begin(), disjoint.end());
       q2 = quorum;
       return true;
     }
@@ -228,14 +269,17 @@ struct Search {
     }
     if (to_remove.empty() && dont_remove.empty()) return false;
 
-    std::vector<uint8_t> local(g.n, 0);
+    std::fill(s_local.begin(), s_local.end(), 0);
+    uint8_t* local = s_local.data();
     for (const int32_t v : dont_remove) local[v] = 1;
 
     ++fixpoint_calls;
-    if (!max_quorum(g, dont_remove, local.data()).empty()) {
+    s_nodes.assign(dont_remove.begin(), dont_remove.end());
+    max_quorum_inplace(g, s_nodes, local, s_removed);
+    if (!s_nodes.empty()) {
       // dontRemove already contains a quorum: report iff it IS a minimal
       // quorum; either way stop descending (cpp:281-291).
-      if (is_minimal_quorum(g, dont_remove)) {
+      if (minimal_on_scratch(dont_remove)) {
         ++minimal_quorums;
         if (trace) {
           std::fprintf(stderr, "trace: minimal quorum #%lld found (size %zu)\n",
@@ -252,37 +296,38 @@ struct Search {
     }
 
     for (const int32_t v : to_remove) local[v] = 1;
-    std::vector<int32_t> cand = dont_remove;
-    cand.insert(cand.end(), to_remove.begin(), to_remove.end());
+    s_quorum.assign(dont_remove.begin(), dont_remove.end());
+    s_quorum.insert(s_quorum.end(), to_remove.begin(), to_remove.end());
     ++fixpoint_calls;
-    std::vector<int32_t> quorum = max_quorum(g, cand, local.data());
+    max_quorum_inplace(g, s_quorum, local, s_removed);
+    const std::vector<int32_t>& quorum = s_quorum;
     if (quorum.empty()) return false;  // prune (cpp:303-306)
 
-    std::vector<uint8_t> in_quorum(g.n, 0);
-    for (const int32_t v : quorum) in_quorum[v] = 1;
+    std::fill(s_mark.begin(), s_mark.end(), 0);
+    for (const int32_t v : quorum) s_mark[v] = 1;
     for (const int32_t v : dont_remove) {
-      if (!in_quorum[v]) return false;  // prune (cpp:308-314)
+      if (!s_mark[v]) return false;  // prune (cpp:308-314)
     }
 
-    const int32_t best = find_best_node(g, quorum, dont_remove, rng);
+    const int32_t best = best_on_scratch(quorum, dont_remove);
 
     // remaining = quorum \ dontRemove; nothing left to branch on is a prune
     // (cpp:325-328).  `quorum` has unique elements (it is a fixpoint of the
-    // unique candidate list), so no dedup is needed.
-    std::vector<uint8_t> in_dont(g.n, 0);
-    for (const int32_t v : dont_remove) in_dont[v] = 1;
-    std::vector<int32_t> remaining;
-    remaining.reserve(quorum.size());
-    for (const int32_t v : quorum) {
-      if (!in_dont[v]) remaining.push_back(v);
-    }
-    if (remaining.empty()) return false;
-
+    // unique candidate list), so no dedup is needed.  `new_to_remove` is a
+    // REAL per-frame vector: it must survive across the first recursive
+    // call for the second — the only allocation left in the hot path.
+    std::fill(s_mark.begin(), s_mark.end(), 0);
+    for (const int32_t v : dont_remove) s_mark[v] = 1;
     std::vector<int32_t> new_to_remove;
-    new_to_remove.reserve(remaining.size());
-    for (const int32_t v : remaining) {
-      if (v != best) new_to_remove.push_back(v);
+    new_to_remove.reserve(quorum.size());
+    bool any_remaining = false;
+    for (const int32_t v : quorum) {
+      if (!s_mark[v]) {
+        any_remaining = true;
+        if (v != best) new_to_remove.push_back(v);
+      }
     }
+    if (!any_remaining) return false;
     std::sort(new_to_remove.begin(), new_to_remove.end());
 
     // Branch: exclude best first (cpp:336), then include it (cpp:343-345).
@@ -327,6 +372,7 @@ int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
   Search search{g, avail.data(), scc_vec, scc_len / 2,
                 use_rng ? &rng_engine : nullptr, trace != 0};
   search.budget_calls = budget_calls;
+  search.init_scratch();
   std::vector<int32_t> dont;
   search.iterate(scc_vec, dont);
 
@@ -400,24 +446,28 @@ int64_t qi_candidate_check(int32_t n, const int32_t* roots,
   Graph g{n, nullptr, nullptr, roots, units, mem, inner};
   int64_t hits = 0;
   std::vector<uint8_t> avail(n);
-  std::vector<int32_t> cand;
+  std::vector<uint8_t> in_q(n);
+  std::vector<int32_t> work, removed;  // loop-invariant scratch: zero
+  work.reserve(n);                     // allocations in the per-row loop
+  removed.reserve(n);
   for (int32_t b = 0; b < batch; ++b) {
     const uint8_t* row = masks + static_cast<int64_t>(b) * n;
     std::copy(row, row + n, avail.begin());
-    cand.clear();
+    work.clear();
     for (int32_t v = 0; v < n; ++v) {
-      if (avail[v]) cand.push_back(v);
+      if (avail[v]) work.push_back(v);
     }
-    std::vector<int32_t> q = max_quorum(g, cand, avail.data());
-    std::vector<uint8_t> in_q(n, 0);
-    for (const int32_t v : q) in_q[v] = 1;
-    std::vector<int32_t> comp;
+    max_quorum_inplace(g, work, avail.data(), removed);
+    const bool q_nonempty = !work.empty();
+    std::fill(in_q.begin(), in_q.end(), 0);
+    for (const int32_t v : work) in_q[v] = 1;
+    work.clear();
     for (int32_t v = 0; v < n; ++v) {
       avail[v] = in_q[v] ? 0 : 1;
-      if (avail[v]) comp.push_back(v);
+      if (avail[v]) work.push_back(v);
     }
-    std::vector<int32_t> d = max_quorum(g, comp, avail.data());
-    if (!q.empty() && !d.empty()) ++hits;
+    max_quorum_inplace(g, work, avail.data(), removed);
+    if (q_nonempty && !work.empty()) ++hits;
   }
   return hits;
 }
