@@ -133,7 +133,7 @@ class TpuHybridBackend:
         randomized: bool = False,
         max_inflight: int = MAX_INFLIGHT,
         checkpoint=None,
-        checkpoint_interval_s: float = CHECKPOINT_INTERVAL_S,
+        checkpoint_interval_s: Optional[float] = None,
         interrupt_after_batches: Optional[int] = None,
         mesh=None,
     ) -> None:
@@ -143,6 +143,14 @@ class TpuHybridBackend:
         # (embarrassingly parallel — no collective; results gather on host).
         self.mesh = mesh
         self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
+        if checkpoint_interval_s is None:
+            # Env override for ops/tests (e.g. frequent writes under a
+            # preemption-heavy scheduler, or a deterministic kill window).
+            import os
+
+            checkpoint_interval_s = float(
+                os.environ.get("QI_HYBRID_CKPT_INTERVAL_S", CHECKPOINT_INTERVAL_S)
+            )
         self.checkpoint_interval_s = checkpoint_interval_s
         # Preemption simulation for kill/resume tests: after draining this
         # many batches, force a checkpoint write and raise.
