@@ -96,6 +96,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", metavar="N", default=None,
                    help="shard the device search across N devices ('all' = every "
                         "visible device); applies to auto/tpu/tpu-sweep/tpu-hybrid")
+    p.add_argument("--blocking-set", action="store_true",
+                   help="liveness-resilience mode: print a minimal blocking set of "
+                        "the quorum-bearing SCC (node failures that halt consensus) "
+                        "instead of the intersection verdict")
     return p
 
 
@@ -140,6 +144,48 @@ def main(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write(f"[stats] pagerank_engine: {engine}\n")
         sys.stdout.write(format_pagerank(graph, ranks))
         return 0  # PageRank mode always exits 0 (cpp:787)
+
+    if args.blocking_set:
+        from quorum_intersection_tpu.analytics.resilience import (
+            EXACT_LIMIT,
+            minimal_blocking_set,
+            minimum_blocking_size,
+        )
+        from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
+        from quorum_intersection_tpu.pipeline import scan_scc_quorums
+
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        sccs = group_sccs(graph.n, comp, count)
+        quorum_sccs = [
+            sid for sid, q in enumerate(scan_scc_quorums(graph, sccs)) if q
+        ]
+        if not quorum_sccs:
+            sys.stdout.write("blocking set: none needed (no quorum exists)\n")
+            return 0
+        # Quorums in different SCCs are independent: halting the WHOLE
+        # network means blocking every quorum-bearing SCC, so the minimal
+        # set is the union of per-SCC minimal sets and the minimum size is
+        # the sum of per-SCC minimums.
+        blocking: list = []
+        minimum_total: Optional[int] = 0
+        for sid in quorum_sccs:
+            scc = sccs[sid]
+            part = minimal_blocking_set(graph, scc)
+            blocking.extend(part)
+            minimum = minimum_blocking_size(graph, scc, upper=len(part))
+            minimum_total = (
+                None if (minimum is None or minimum_total is None)
+                else minimum_total + minimum
+            )
+        labels = " ".join(graph.label(v) for v in blocking)
+        sys.stdout.write(f"minimal blocking set ({len(blocking)} nodes): {labels}\n")
+        if minimum_total is not None:
+            sys.stdout.write(f"minimum blocking size: {minimum_total}\n")
+        else:
+            sys.stdout.write(
+                f"minimum blocking size: not computed (|scc| > {EXACT_LIMIT})\n"
+            )
+        return 0
 
     from quorum_intersection_tpu.backends.base import get_backend
     from quorum_intersection_tpu.pipeline import solve_graph

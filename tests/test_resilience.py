@@ -1,0 +1,111 @@
+"""Blocking-set (liveness resilience) analytics tests."""
+
+import pytest
+
+from quorum_intersection_tpu.analytics.resilience import (
+    is_blocking,
+    minimal_blocking_set,
+    minimum_blocking_size,
+)
+from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+
+
+def _scc_of(data):
+    graph = build_graph(parse_fbas(data))
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    sccs = group_sccs(graph.n, comp, count)
+    for members in sccs:
+        avail = [v in set(members) for v in range(graph.n)]
+        if max_quorum(graph, members, avail):
+            return graph, members
+    return graph, sccs[0]
+
+
+def test_majority_blocking_number():
+    # k-of-n majority (k = n//2 + 1): any n - k + 1 failures block every
+    # quorum; fewer cannot (the survivors still hold a k-majority).
+    for n in (3, 5, 7):
+        graph, scc = _scc_of(majority_fbas(n))
+        k = n // 2 + 1
+        expect = n - k + 1
+        assert minimum_blocking_size(graph, scc) == expect
+        minimal = minimal_blocking_set(graph, scc)
+        assert is_blocking(graph, scc, minimal)
+        # inclusion-minimality: no single member can be dropped
+        for v in minimal:
+            assert not is_blocking(graph, scc, [w for w in minimal if w != v])
+
+
+def test_hierarchical_blocking_set():
+    # 5 orgs x 3 validators, 3-of-5 orgs with 2-of-3 inner sets: killing 2
+    # validators in each of 3 orgs (6 nodes) blocks; the minimum is 6.
+    graph, scc = _scc_of(hierarchical_fbas(5, 3))
+    assert len(scc) == 15
+    minimal = minimal_blocking_set(graph, scc)
+    assert is_blocking(graph, scc, minimal)
+    assert minimum_blocking_size(graph, scc) == 6
+
+
+def test_no_quorum_scc_blocked_by_nothing():
+    data = [{"publicKey": f"N{i}", "name": "", "quorumSet": None} for i in range(3)]
+    graph = build_graph(parse_fbas(data))
+    assert minimal_blocking_set(graph, [0, 1, 2]) == []
+    assert minimum_blocking_size(graph, [0, 1, 2]) == 0
+
+
+def test_exact_search_cap():
+    graph, scc = _scc_of(majority_fbas(5))
+    assert minimum_blocking_size(graph, scc, limit=3) is None  # |scc|=5 > 3
+
+
+def test_cli_blocking_set_mode(ref_fixture):
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--blocking-set"],
+        input=ref_fixture("correct.json").read_text(),
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("minimal blocking set (2 nodes):")
+    assert "minimum blocking size: 2" in proc.stdout
+
+
+def test_cli_blocking_set_no_quorum():
+    import json
+    import subprocess
+    import sys
+
+    data = json.dumps(
+        [{"publicKey": f"N{i}", "name": "", "quorumSet": None} for i in range(3)]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--blocking-set"],
+        input=data, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "none needed" in proc.stdout
+
+
+def test_cli_blocking_set_covers_every_quorum_scc():
+    """Two independent quorum-bearing SCCs: halting the network requires
+    blocking both — the union set and the summed minimum."""
+    import json
+    import subprocess
+    import sys
+
+    data = json.dumps(
+        majority_fbas(3, prefix="ISLA") + majority_fbas(3, prefix="ISLB")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--blocking-set"],
+        input=data, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    # 2-of-3 majority per island: 2 failures block each, 4 total.
+    assert "minimal blocking set (4 nodes):" in proc.stdout
+    assert "minimum blocking size: 4" in proc.stdout

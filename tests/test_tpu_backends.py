@@ -645,23 +645,30 @@ def test_hybrid_real_sigkill_resume(tmp_path):
         cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, env=env,
     )
-    proc.stdin.write(data)
-    proc.stdin.close()
-    deadline = _time.monotonic() + 120
-    while _time.monotonic() < deadline:
-        if ck.exists():
-            break
-        if proc.poll() is not None:
-            break
-        _time.sleep(0.05)
-    if proc.poll() is not None:
-        # Finished before any checkpoint landed (machine too fast): still a
-        # valid run — verdict parity is all we can assert.
+    try:
+        proc.stdin.write(data)
+        proc.stdin.close()
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if ck.exists():
+                break
+            if proc.poll() is not None:
+                break
+            _time.sleep(0.05)
+        if proc.poll() is None:
+            assert ck.exists(), "no checkpoint appeared within the window"
+            proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()  # never orphan the solver on an assert/timeout path
+            proc.wait()
+    if proc.returncode == 0:
+        # Completed before the kill landed (fast machine): the checkpoint is
+        # already cleared, so there is nothing to resume — verdict parity is
+        # all this run can assert.
         assert proc.stdout.read().strip() == "true"
         return
-    assert ck.exists(), "no checkpoint appeared within the window"
-    proc.send_signal(signal.SIGKILL)
-    proc.wait()
 
     resumed = subprocess.run(
         cmd, input=data, capture_output=True, text=True, env=env, timeout=600,
