@@ -62,14 +62,14 @@ def minimal_blocking_set(graph: TrustGraph, scc: Sequence[int]) -> List[int]:
     # minimality of the RESULT does not depend on the order).
     indeg = graph.in_degrees()
     blocked.sort(key=lambda v: indeg[v])
-    changed = True
-    while changed:
-        changed = False
-        for v in list(blocked):
-            trial = [w for w in blocked if w != v]
-            if is_blocking(graph, scc, trial):
-                blocked = trial
-                changed = True
+    # One pass suffices: blocking is upward-monotone, so once dropping v
+    # fails (a quorum survives in scc ∖ (blocked ∖ {v})), it fails against
+    # every later, smaller blocked set too — a second pass can never drop
+    # anything more.
+    for v in list(blocked):
+        trial = [w for w in blocked if w != v]
+        if is_blocking(graph, scc, trial):
+            blocked = trial
     return sorted(blocked)
 
 

@@ -100,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="liveness-resilience mode: print a minimal blocking set of "
                         "the quorum-bearing SCC (node failures that halt consensus) "
                         "instead of the intersection verdict")
+    p.add_argument("--splitting-set", action="store_true",
+                   help="safety-margin mode: print a minimum splitting set (node "
+                        "deletions that leave two disjoint quorums) up to "
+                        "--splitting-max-k members, instead of the verdict")
+    p.add_argument("--splitting-max-k", type=int, default=2, metavar="K",
+                   help="splitting-set search depth (subsets up to size K; each "
+                        "candidate is a full NP-hard solve — default 2)")
     return p
 
 
@@ -120,7 +127,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from quorum_intersection_tpu.fbas.graph import build_graph
 
     try:
-        fbas = parse_fbas(sys.stdin)
+        # Buffered (not streamed): the splitting-set mode re-reads the raw
+        # node list, and dumps are at most a few MB.
+        stdin_text = sys.stdin.read()
+        fbas = parse_fbas(stdin_text)
     except ValueError as exc:
         # FbasSchemaError and json.JSONDecodeError both derive from ValueError.
         # (The reference crashes with an uncaught ptree exception here; a clean
@@ -144,6 +154,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write(f"[stats] pagerank_engine: {engine}\n")
         sys.stdout.write(format_pagerank(graph, ranks))
         return 0  # PageRank mode always exits 0 (cpp:787)
+
+    if args.splitting_set:
+        from quorum_intersection_tpu.analytics.splitting import (
+            POOL_LIMIT,
+            minimum_splitting_set,
+        )
+        from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
+        from quorum_intersection_tpu.pipeline import scan_scc_quorums
+
+        import json
+
+        raw = json.loads(stdin_text)
+        # Candidate pool from the graph already built under the user's
+        # dangling policy — no second front-end pass.
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        sccs = group_sccs(graph.n, comp, count)
+        pool: list = []
+        for sid, quorum in enumerate(scan_scc_quorums(graph, sccs)):
+            if quorum:
+                pool.extend(graph.node_ids[v] for v in sccs[sid])
+        if len(pool) > POOL_LIMIT:
+            sys.stdout.write(
+                f"splitting set: not computed (candidate pool {len(pool)} > {POOL_LIMIT})\n"
+            )
+            return 0
+        split = minimum_splitting_set(
+            raw, max_k=args.splitting_max_k, dangling=dangling, pool=pool
+        )
+        if split is None:
+            sys.stdout.write(
+                f"no splitting set with <= {args.splitting_max_k} nodes "
+                "(network stays intersecting under any such deletion)\n"
+            )
+        elif not split:
+            sys.stdout.write("minimum splitting set (0 nodes): already split\n")
+        else:
+            labels = " ".join(split)
+            sys.stdout.write(
+                f"minimum splitting set ({len(split)} nodes): {labels}\n"
+            )
+        return 0
 
     if args.blocking_set:
         from quorum_intersection_tpu.analytics.resilience import (

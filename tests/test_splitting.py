@@ -1,0 +1,124 @@
+"""Splitting-set (safety margin, byzantine-deletion semantics) tests."""
+
+import json
+import subprocess
+import sys
+
+from quorum_intersection_tpu.analytics.splitting import (
+    delete_nodes,
+    is_splitting,
+    minimum_splitting_set,
+)
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+
+
+def test_majority_splitting_number():
+    # Classic k-of-n result under byzantine deletion: a splitting set needs
+    # 2k - n members (the survivors' reduced thresholds then admit two
+    # disjoint quorums).  n=4, k=3 → 2;  n=3, k=2 → 1;  n=7, k=4 → 1.
+    for n, expect in ((4, 2), (3, 1), (7, 1)):
+        data = majority_fbas(n)
+        split = minimum_splitting_set(data, max_k=2)
+        assert split is not None and len(split) == expect, (n, split)
+
+
+def test_supermajority_resists_small_splits():
+    # 6-of-7: 2k - n = 5 > 2 → nothing within max_k=2 splits.
+    data = [
+        {"publicKey": f"K{i}", "name": f"k{i}",
+         "quorumSet": {"threshold": 6, "validators": [f"K{j}" for j in range(7)],
+                       "innerQuorumSets": []}}
+        for i in range(7)
+    ]
+    assert minimum_splitting_set(data, max_k=2) is None
+
+
+def test_broken_network_splits_with_empty_set():
+    data = majority_fbas(4, broken=True)
+    assert minimum_splitting_set(data) == []
+
+
+def test_halting_deletion_is_not_a_split():
+    # Deleting the whole validator list of everyone leaves trivial slices —
+    # but deleting nodes that merely REMOVE all quorums (halt) must not
+    # count as splitting.  A 2-node network 2-of-2: deleting one node makes
+    # the survivor's slice 1-of-1 over itself → single quorum, intersecting.
+    data = [
+        {"publicKey": "A", "name": "a",
+         "quorumSet": {"threshold": 2, "validators": ["A", "B"], "innerQuorumSets": []}},
+        {"publicKey": "B", "name": "b",
+         "quorumSet": {"threshold": 2, "validators": ["A", "B"], "innerQuorumSets": []}},
+    ]
+    assert not is_splitting(data, ["A"])
+
+
+def test_delete_reduces_thresholds_and_propagates_trivial_inner():
+    # 2-of-3 inner set fully deleted (2 of its members) → trivially
+    # satisfied → parent threshold drops by one.
+    data = [{"publicKey": "P", "name": "p", "quorumSet": {
+        "threshold": 2,
+        "validators": ["X"],
+        "innerQuorumSets": [
+            {"threshold": 2, "validators": ["A", "B", "C"], "innerQuorumSets": []}
+        ],
+    }}]
+    out = delete_nodes(data, ["A", "B"])
+    q = out[0]["quorumSet"]
+    assert q["threshold"] == 1  # inner became trivial: 2 - 1
+    assert q["validators"] == ["X"]
+    assert q["innerQuorumSets"] == []
+
+
+def test_hierarchical_splitting():
+    # 5 orgs × 3 validators (3-of-5 orgs, 2-of-3 inner): ONE byzantine
+    # validator suffices — its org's inner set drops to 1-of-2, so the org
+    # satisfies BOTH sides via different surviving members, and each side
+    # completes its 3-of-5 with two further disjoint org-majorities.
+    data = hierarchical_fbas(5, 3)
+    split = minimum_splitting_set(data, max_k=2)
+    assert split is not None and len(split) == 1
+
+
+def test_cli_splitting_set_mode():
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--splitting-set"],
+        input=json.dumps(majority_fbas(4)),
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0
+    assert "minimum splitting set (2 nodes):" in proc.stdout
+
+
+def test_cli_splitting_set_none_within_k():
+    data = [
+        {"publicKey": f"K{i}", "name": f"k{i}",
+         "quorumSet": {"threshold": 6, "validators": [f"K{j}" for j in range(7)],
+                       "innerQuorumSets": []}}
+        for i in range(7)
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--splitting-set"],
+        input=json.dumps(data), capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0
+    assert "no splitting set" in proc.stdout
+
+
+def test_string_thresholds_scrub_like_ints():
+    # ptree compat: the schema accepts numeric-string thresholds; deletion
+    # must too, or byzantine analysis silently degrades to crash semantics.
+    data = majority_fbas(3)
+    for node in data:
+        node["quorumSet"]["threshold"] = str(node["quorumSet"]["threshold"])
+    split = minimum_splitting_set(data, max_k=2)
+    assert split is not None and len(split) == 1
+
+
+def test_preexisting_zero_threshold_keeps_q3_semantics():
+    # A threshold<=0 qset is never satisfiable (Q3) — deletion of ZERO
+    # nodes must not flip it to trivially-true and fabricate a split.
+    data = majority_fbas(3) + [
+        {"publicKey": "ZZ", "name": "zz",
+         "quorumSet": {"threshold": 0, "validators": [], "innerQuorumSets": []}}
+    ]
+    assert not is_splitting(data, [])
