@@ -244,8 +244,15 @@ struct Search {
     return false;
   }
 
+  // `dont_known_no_quorum`: the exclude-branch child shares its parent's
+  // dontRemove set, whose fixpoint the parent just computed to be empty —
+  // recomputing it is a guaranteed repeat (the host-side analog of the
+  // hybrid's mask→result memo), so the parent passes the knowledge down
+  // and the child skips that fixpoint.  Exact: the same set against the
+  // same graph has the same greatest fixpoint.
   bool iterate(const std::vector<int32_t>& to_remove,
-               std::vector<int32_t>& dont_remove) {
+               std::vector<int32_t>& dont_remove,
+               bool dont_known_no_quorum = false) {
     ++bnb_calls;
     if (budget_calls > 0 && bnb_calls > budget_calls) {
       // Abort the whole recursion (true unwinds like a hit); the caller
@@ -273,10 +280,14 @@ struct Search {
     uint8_t* local = s_local.data();
     for (const int32_t v : dont_remove) local[v] = 1;
 
-    ++fixpoint_calls;
-    s_nodes.assign(dont_remove.begin(), dont_remove.end());
-    max_quorum_inplace(g, s_nodes, local, s_removed);
-    if (!s_nodes.empty()) {
+    bool dont_has_quorum = false;
+    if (!dont_known_no_quorum) {
+      ++fixpoint_calls;
+      s_nodes.assign(dont_remove.begin(), dont_remove.end());
+      max_quorum_inplace(g, s_nodes, local, s_removed);
+      dont_has_quorum = !s_nodes.empty();
+    }
+    if (dont_has_quorum) {
       // dontRemove already contains a quorum: report iff it IS a minimal
       // quorum; either way stop descending (cpp:281-291).
       if (minimal_on_scratch(dont_remove)) {
@@ -331,7 +342,11 @@ struct Search {
     std::sort(new_to_remove.begin(), new_to_remove.end());
 
     // Branch: exclude best first (cpp:336), then include it (cpp:343-345).
-    if (iterate(new_to_remove, dont_remove)) return true;
+    // Exclude child inherits this frame's dontRemove unchanged — its dont
+    // fixpoint is a guaranteed repeat of the empty one computed above.
+    if (iterate(new_to_remove, dont_remove, /*dont_known_no_quorum=*/true)) {
+      return true;
+    }
     dont_remove.push_back(best);
     const bool hit = iterate(new_to_remove, dont_remove);
     dont_remove.pop_back();

@@ -113,6 +113,7 @@ def iterate_minimal_quorums(
     current_visitor: Callable[[List[int]], bool],
     state: _SearchState,
     rng: Optional[random.Random],
+    dont_known_no_quorum: bool = False,
 ) -> bool:
     """Branch-and-bound enumeration of minimal quorums (cpp:252-346).
 
@@ -154,8 +155,14 @@ def iterate_minimal_quorums(
     for v in dont_remove:
         avail[v] = True
 
-    state.fixpoint_calls += 1
-    if max_quorum(graph, dont_remove, avail):
+    # The exclude-branch child shares its parent's dontRemove set, whose
+    # fixpoint the parent just computed to be empty — skip the guaranteed
+    # repeat (mirrors the native oracle exactly for stats lockstep).
+    dont_has_quorum = False
+    if not dont_known_no_quorum:
+        state.fixpoint_calls += 1
+        dont_has_quorum = bool(max_quorum(graph, dont_remove, avail))
+    if dont_has_quorum:
         if is_minimal_quorum(dont_remove, graph):
             state.minimal_quorums += 1
             if state.trace:
@@ -188,7 +195,8 @@ def iterate_minimal_quorums(
 
     new_to_remove = sorted(v for v in remaining if v != best)
     if iterate_minimal_quorums(
-        new_to_remove, dont_remove, graph, visitor, current_visitor, state, rng
+        new_to_remove, dont_remove, graph, visitor, current_visitor, state, rng,
+        dont_known_no_quorum=True,  # same dontRemove: fixpoint is a repeat
     ):
         return True
     return iterate_minimal_quorums(
