@@ -1,0 +1,94 @@
+"""Top-tier analytics: the union of all minimal quorums' members.
+
+The **top tier** of an FBAS is the set of validators that appear in at
+least one minimal quorum — the nodes whose configuration actually shapes
+consensus (everyone else piggybacks on them).  Third member of the
+analysis suite around the verdict, with
+:mod:`~quorum_intersection_tpu.analytics.resilience` (liveness) and
+:mod:`~quorum_intersection_tpu.analytics.splitting` (safety margin).
+
+Computed by the same branch-and-bound the verdict engines use, with two
+deliberate differences (see ``qi_top_tier`` in
+``backends/cpp/qi_oracle.cpp``): the half-size prune is DISABLED (it is
+sound only for the disjointness search — minimal quorums larger than
+⌊|scc|/2⌋ exist and belong in the union), and the visitor collects
+members instead of probing for a disjoint partner.  Enumeration is
+exponential in the worst case, so a B&B call budget bounds the work;
+exceeding it reports "not computed" rather than a partial answer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("analytics.top_tier")
+
+# ~2 s of native enumeration at the measured ~1 µs/call; the CLI surfaces
+# a "not computed" line beyond it rather than running unbounded.
+DEFAULT_BUDGET_CALLS = 2_000_000
+
+
+def top_tier(
+    graph: TrustGraph,
+    scc: Sequence[int],
+    budget_calls: int = DEFAULT_BUDGET_CALLS,
+) -> Tuple[Optional[List[int]], int]:
+    """``(members, minimal_quorum_count)`` for the SCC; members is None
+    when the enumeration exceeded ``budget_calls`` (count is then the
+    partial tally).  Native enumeration with a pure-Python fallback."""
+    try:
+        from quorum_intersection_tpu.backends.cpp import native_top_tier
+
+        return native_top_tier(graph, list(scc), budget_calls)
+    except Exception as exc:  # noqa: BLE001 — no g++ etc.
+        log.info("native top-tier unavailable (%s); using Python enumeration", exc)
+    # The budget is calibrated for native speed (~1 µs/call); the
+    # interpreted recursion is ~40× slower per call (the auto router's
+    # measured ORACLE_SECONDS_PER_CALL ratio), so scale it down to keep
+    # the same wall-clock bound.
+    from quorum_intersection_tpu.backends.auto import ORACLE_SECONDS_PER_CALL
+
+    ratio = ORACLE_SECONDS_PER_CALL["python"] / ORACLE_SECONDS_PER_CALL["cpp"]
+    return _python_top_tier(graph, scc, max(int(budget_calls / ratio), 1))
+
+
+def _python_top_tier(
+    graph: TrustGraph, scc: Sequence[int], budget_calls: int
+) -> Tuple[Optional[List[int]], int]:
+    from quorum_intersection_tpu.backends.python_oracle import (
+        _SearchState,
+        iterate_minimal_quorums,
+    )
+
+    union: set = set()
+    count = [0]
+
+    def visitor(quorum: List[int]) -> bool:
+        union.update(quorum)
+        count[0] += 1
+        return False  # keep enumerating
+
+    state = _SearchState(budget_calls=budget_calls)
+    import sys
+
+    needed = 4 * len(scc) + 1000
+    old_limit = sys.getrecursionlimit()
+    if needed > old_limit:
+        sys.setrecursionlimit(needed)
+    try:
+        iterate_minimal_quorums(
+            list(scc), [], graph, visitor,
+            lambda _candidate: False,  # half-size prune disabled
+            state, None,
+        )
+    finally:
+        if needed > old_limit:
+            sys.setrecursionlimit(old_limit)
+    # The python oracle counts minimal quorums in state; the visitor tally
+    # must agree — trust the visitor (it owns the union).
+    if state.budget_exceeded:
+        return None, count[0]
+    return sorted(union), count[0]

@@ -115,6 +115,16 @@ def _load() -> ctypes.CDLL:
         _i32p, _i32p, _i32p, _i32p,  # q1_out, q1_len, q2_out, q2_len
         _i64p,  # stats_out[3]
     ]
+    lib.qi_top_tier.restype = ctypes.c_int64
+    lib.qi_top_tier.argtypes = [
+        ctypes.c_int32,  # n
+        _i32p, _i32p,  # succ_off, succ_tgt
+        _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
+        _i32p, ctypes.c_int32,  # scc, scc_len
+        ctypes.c_int64,  # budget_calls
+        _u8p,  # union_out (n bytes)
+        _i64p,  # stats_out[3]
+    ]
     lib.qi_max_quorum.restype = ctypes.c_int32
     lib.qi_max_quorum.argtypes = [
         ctypes.c_int32,  # n
@@ -315,6 +325,36 @@ def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]
         avail[arr] = 0
         quorums.append(out[:qlen].tolist())
     return quorums
+
+
+def native_top_tier(
+    graph: TrustGraph, scc: List[int], budget_calls: int = 0
+) -> Tuple[Optional[List[int]], int]:
+    """Union of all minimal quorums' members in the SCC via the native
+    enumeration.  Returns ``(members, minimal_quorum_count)``; members is
+    None when the call budget was exceeded (partial enumeration)."""
+    lib = _load()
+    flat = FlatGraph(graph)
+    scc_arr = np.asarray(scc, dtype=np.int32)
+    union = np.zeros(graph.n, dtype=np.uint8)
+    stats = np.zeros(3, dtype=np.int64)
+    count = lib.qi_top_tier(
+        flat.n,
+        flat._ptr(flat.succ_off),
+        flat._ptr(flat.succ_tgt),
+        flat._ptr(flat.roots),
+        flat._ptr(flat.units),
+        flat._ptr(flat.mem),
+        flat._ptr(flat.inner),
+        scc_arr.ctypes.data_as(_i32p),
+        len(scc),
+        int(budget_calls),
+        union.ctypes.data_as(_u8p),
+        stats.ctypes.data_as(_i64p),
+    )
+    if count == -2:
+        return None, int(stats[1])
+    return np.nonzero(union)[0].tolist(), int(count)
 
 
 def native_candidate_check(graph: TrustGraph, masks: np.ndarray) -> Tuple[int, float]:

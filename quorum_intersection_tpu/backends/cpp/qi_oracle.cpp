@@ -138,6 +138,13 @@ struct Search {
   bool budget_exceeded = false;
   bool found = false;
   std::vector<int32_t> q1, q2;
+  // Collect mode (top-tier analytics): instead of probing each minimal
+  // quorum for a disjoint partner, accumulate the UNION of their members
+  // and keep enumerating.  The caller must disable the half-size prune —
+  // it is sound for the disjointness search only (two disjoint quorums
+  // cannot both exceed |scc|/2), not for full enumeration.
+  bool collect = false;
+  std::vector<uint8_t> union_mark;
 
   // Reusable per-frame scratch (hot-path allocation elimination, r3): every
   // buffer is fully consumed BEFORE the recursive calls in iterate(), so
@@ -297,6 +304,10 @@ struct Search {
                        static_cast<long long>(minimal_quorums),
                        dont_remove.size());
         }
+        if (collect) {
+          for (const int32_t v : dont_remove) union_mark[v] = 1;
+          return false;  // keep enumerating
+        }
         return visit(dont_remove);
       }
       if (trace) {
@@ -417,6 +428,43 @@ int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
   *q1_len = 0;
   *q2_len = 0;
   return 1;
+}
+
+// Top-tier enumeration: the union of ALL minimal quorums' members inside
+// the SCC (SCC-scoped availability), via the same branch-and-bound with
+// the half-size prune disabled (that prune is sound only for the
+// disjointness search) and a collecting visitor.  Writes the union as a
+// 0/1 bitmap into `union_out` (caller buffer of n bytes).  Returns the
+// minimal-quorum count, or -2 if `budget_calls` > 0 was exceeded (the
+// bitmap then holds a partial union; stats_out is still filled).
+int64_t qi_top_tier(int32_t n, const int32_t* succ_off,
+                    const int32_t* succ_tgt, const int32_t* roots,
+                    const int32_t* units, const int32_t* mem,
+                    const int32_t* inner, const int32_t* scc,
+                    int32_t scc_len, int64_t budget_calls,
+                    uint8_t* union_out, int64_t* stats_out) {
+  Graph g{n, succ_off, succ_tgt, roots, units, mem, inner};
+  std::vector<uint8_t> avail(n, 0);
+  std::vector<int32_t> scc_vec(scc, scc + scc_len);
+  for (const int32_t v : scc_vec) avail[v] = 1;  // scoped availability
+
+  // half = scc_len disables the size prune (dont_remove can never exceed
+  // the whole SCC); deterministic tie-break — the enumerated SET is
+  // order-independent anyway.
+  Search search{g, avail.data(), scc_vec, scc_len, nullptr, false};
+  search.collect = true;
+  search.union_mark.assign(n, 0);
+  search.budget_calls = budget_calls;
+  search.init_scratch();
+  std::vector<int32_t> dont;
+  search.iterate(scc_vec, dont);
+
+  std::copy(search.union_mark.begin(), search.union_mark.end(), union_out);
+  stats_out[0] = search.bnb_calls;
+  stats_out[1] = search.minimal_quorums;
+  stats_out[2] = search.fixpoint_calls;
+  if (search.budget_exceeded) return -2;
+  return search.minimal_quorums;
 }
 
 // Unbudgeted entry point (original ABI): kept for the native CLI and any

@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--splitting-max-k", type=int, default=2, metavar="K",
                    help="splitting-set search depth (subsets up to size K; each "
                         "candidate is a full NP-hard solve — default 2)")
+    p.add_argument("--top-tier", action="store_true",
+                   help="analysis mode: print the top tier (union of all minimal "
+                        "quorums' members — the validators that shape consensus) "
+                        "instead of the verdict")
     return p
 
 
@@ -155,25 +159,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         sys.stdout.write(format_pagerank(graph, ranks))
         return 0  # PageRank mode always exits 0 (cpp:787)
 
+    if args.top_tier:
+        from quorum_intersection_tpu.analytics.top_tier import top_tier
+        from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
+
+        members: list = []
+        quorum_count = 0
+        exceeded = False
+        bearing = quorum_bearing_sccs(graph)
+        for _sid, scc in bearing:
+            part, n_min = top_tier(graph, scc)
+            if part is None:
+                exceeded = True
+                break
+            members.extend(part)
+            quorum_count += n_min
+        if not bearing:
+            sys.stdout.write("top tier: empty (no quorum exists)\n")
+        elif exceeded:
+            sys.stdout.write(
+                "top tier: not computed (minimal-quorum enumeration exceeded "
+                "its call budget)\n"
+            )
+        else:
+            labels = " ".join(graph.label(v) for v in sorted(members))
+            sys.stdout.write(
+                f"top tier ({len(members)} nodes, {quorum_count} minimal "
+                f"quorums): {labels}\n"
+            )
+        return 0
+
     if args.splitting_set:
         from quorum_intersection_tpu.analytics.splitting import (
             POOL_LIMIT,
             minimum_splitting_set,
         )
-        from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
-        from quorum_intersection_tpu.pipeline import scan_scc_quorums
+        from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
 
         import json
 
         raw = json.loads(stdin_text)
         # Candidate pool from the graph already built under the user's
         # dangling policy — no second front-end pass.
-        count, comp = tarjan_scc(graph.n, graph.succ)
-        sccs = group_sccs(graph.n, comp, count)
         pool: list = []
-        for sid, quorum in enumerate(scan_scc_quorums(graph, sccs)):
-            if quorum:
-                pool.extend(graph.node_ids[v] for v in sccs[sid])
+        for _sid, scc in quorum_bearing_sccs(graph):
+            pool.extend(graph.node_ids[v] for v in scc)
         if len(pool) > POOL_LIMIT:
             sys.stdout.write(
                 f"splitting set: not computed (candidate pool {len(pool)} > {POOL_LIMIT})\n"
@@ -202,15 +232,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             minimal_blocking_set,
             minimum_blocking_size,
         )
-        from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
-        from quorum_intersection_tpu.pipeline import scan_scc_quorums
+        from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
 
-        count, comp = tarjan_scc(graph.n, graph.succ)
-        sccs = group_sccs(graph.n, comp, count)
-        quorum_sccs = [
-            sid for sid, q in enumerate(scan_scc_quorums(graph, sccs)) if q
-        ]
-        if not quorum_sccs:
+        bearing = quorum_bearing_sccs(graph)
+        if not bearing:
             sys.stdout.write("blocking set: none needed (no quorum exists)\n")
             return 0
         # Quorums in different SCCs are independent: halting the WHOLE
@@ -219,8 +244,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the sum of per-SCC minimums.
         blocking: list = []
         minimum_total: Optional[int] = 0
-        for sid in quorum_sccs:
-            scc = sccs[sid]
+        for _sid, scc in bearing:
             part = minimal_blocking_set(graph, scc)
             blocking.extend(part)
             minimum = minimum_blocking_size(graph, scc, upper=len(part))

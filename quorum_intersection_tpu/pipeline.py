@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import sys
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, TextIO, Union
+from typing import Dict, List, Optional, TextIO, Tuple, Union
 
 from quorum_intersection_tpu.backends.base import SearchBackend, get_backend
 from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit
@@ -61,6 +61,23 @@ def scan_scc_quorums(
             avail[v] = True
         quorums.append(max_quorum(graph, members, avail))
     return quorums
+
+
+def quorum_bearing_sccs(
+    graph: TrustGraph, *, allow_native: bool = True
+) -> List[Tuple[int, List[int]]]:
+    """``[(scc_id, members), ...]`` for every SCC that contains a quorum
+    when restricted to itself — the shared scaffolding of the CLI analysis
+    modes (top tier, blocking/splitting sets)."""
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    sccs = group_sccs(graph.n, comp, count)
+    return [
+        (sid, sccs[sid])
+        for sid, quorum in enumerate(
+            scan_scc_quorums(graph, sccs, allow_native=allow_native)
+        )
+        if quorum
+    ]
 
 
 @dataclass

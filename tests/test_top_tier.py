@@ -1,0 +1,83 @@
+"""Top-tier (union of minimal quorums) analytics tests."""
+
+import json
+import math
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.analytics.top_tier import _python_top_tier, top_tier
+from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+
+
+def _quorum_scc(data):
+    graph = build_graph(parse_fbas(data))
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    for members in group_sccs(graph.n, comp, count):
+        avail = [v in set(members) for v in range(graph.n)]
+        if max_quorum(graph, members, avail):
+            return graph, members
+    raise AssertionError("no quorum-bearing SCC")
+
+
+def test_majority_top_tier_is_everyone():
+    # k-of-n symmetric majority: every node is in some minimal quorum
+    # (any k-subset is one), and there are C(n, k) of them.
+    for n in (3, 5, 7):
+        graph, scc = _quorum_scc(majority_fbas(n))
+        members, n_min = top_tier(graph, scc)
+        assert members == sorted(scc)
+        assert n_min == math.comb(n, n // 2 + 1)
+
+
+def test_hierarchical_top_tier():
+    # 5 orgs x 3: minimal quorums are 3-org coalitions x 2-of-3 picks:
+    # C(5,3) * 3^3 = 270; union = all 15 validators.
+    graph, scc = _quorum_scc(hierarchical_fbas(5, 3))
+    members, n_min = top_tier(graph, scc)
+    assert members == sorted(scc)
+    assert n_min == math.comb(5, 3) * 27
+
+
+def test_python_and_native_agree():
+    graph, scc = _quorum_scc(hierarchical_fbas(4, 3))
+    native = top_tier(graph, scc)
+    python = _python_top_tier(graph, scc, budget_calls=0)
+    assert native == python
+
+
+def test_budget_exceeded_reports_none():
+    graph, scc = _quorum_scc(majority_fbas(9))
+    members, _ = top_tier(graph, scc, budget_calls=5)
+    assert members is None
+    members, _ = _python_top_tier(graph, scc, budget_calls=5)
+    assert members is None
+
+
+def test_cli_top_tier_piggybackers_excluded(ref_fixture):
+    # correct.json: the sink SCC is {SDF1, SDF2, SDF3, Eno} but Eno sits in
+    # no minimal quorum — the top tier is exactly the three SDF validators.
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--top-tier"],
+        input=ref_fixture("correct.json").read_text(),
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("top tier (3 nodes, 3 minimal quorums):")
+    assert "Eno" not in proc.stdout
+
+
+def test_cli_top_tier_no_quorum():
+    data = json.dumps(
+        [{"publicKey": f"N{i}", "name": "", "quorumSet": None} for i in range(3)]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "--top-tier"],
+        input=data, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "empty (no quorum exists)" in proc.stdout
