@@ -50,7 +50,7 @@ _AMBIENT_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
 # module docs); all `steps` programs dispatch asynchronously so the tunnel
 # RTT overlaps with device compute (sweep.py MAX_INFLIGHT rationale).
 FULL = dict(n_orgs=16, per_org=16, batch=32768, steps=24, chunks=128,
-            samples=40, sweep_nodes=31)
+            samples=40, sweep_nodes=31, wide_sweep_nodes=34)
 QUICK = dict(n_orgs=4, per_org=4, batch=256, steps=2, chunks=2,
              samples=10, sweep_nodes=13)
 # CPU-fallback shapes: the emulated CPU backend sustains ~0.5M cand/s, so a
@@ -65,6 +65,7 @@ TIMEOUTS = {
     "probe": (240, 120),
     "throughput": (600, 240),
     "sweep": (420, 240),
+    "sweep_wide": (420, 0),
     "snapshot": (360, 240),
     "pagerank": (240, 120),
     "hybrid": (420, 180),
@@ -540,6 +541,23 @@ def orchestrate(args) -> int:
         phases["sweep"] = "ok"
         headline.update(sweep)
     emit(headline)
+
+    # 5b. Wide sweep (2^(wide_sweep_nodes-1) candidates): large enough that
+    # the fixed session costs (tunnel handshake + program-load, see the
+    # sweep breakdown keys) amortize — the end-to-end rate here is the one
+    # comparable to the steady-state device rate.  Device mode only: the
+    # CPU emulation would need hours for 2^33.
+    if (not fallback and not args.quick and "wide_sweep_nodes" in shapes
+            and phases.get("sweep") == "ok"):
+        wide = run_child("sweep", deadline, tmo["sweep_wide"],
+                         ["--sweep-nodes", str(shapes["wide_sweep_nodes"])],
+                         platform)
+        if "error" in wide:
+            phases["sweep_wide"] = wide["error"]
+        else:
+            phases["sweep_wide"] = "ok"
+            headline.update({f"wide_{k}": v for k, v in wide.items()})
+        emit(headline)
 
     # 6. Snapshot time-to-verdict (auto backend).
     quick_flag = ["--quick"] if (args.quick or fallback) else []

@@ -81,10 +81,48 @@ STEPS_RAMP = (1, 8, 64, 256, 1024)
 # granularity from the second program onward (bounded by one max-size
 # program of device work).
 RAMP_DISPATCHES = 1
+# Pipeline depth while a ramp-jump compile is pending.  Small (ramp-level)
+# programs are pure dispatch-RTT: each drain costs one tunnel round-trip
+# (~65 ms) regardless of depth, so a full 32-deep queue of them adds ~2 s of
+# mandatory drains after the jump lands (measured on the r3 chip: the entire
+# level-1 bucket of a warm 2^30 sweep).  Capping the queue during the
+# compile window bounds that backlog to a few programs without idling the
+# device — the cap lifts the moment the jump happens.
+RAMP_INFLIGHT = 4
 
 
 class SccTooLargeError(ValueError):
     """Raised when the SCC exceeds the sweep's enumeration width."""
+
+
+# A jump level only reaches full throughput when enough programs of it fit
+# in the remaining work to keep the dispatch pipeline loaded — 2^30 at the
+# top level is exactly 2 programs, whose per-program result-fetch RTT cannot
+# overlap anything (measured r3: 1.50 G cand/s vs 2.05 G at the level below
+# with 8 programs).  Prefer the largest level with PIPE-many programs of
+# work; fall back to the sparser 2× rule when nothing satisfies it.
+JUMP_PIPE_FILL = 8
+
+
+def _jump_target_ix(ramp, ix: int, base_block: int, remaining: int) -> int:
+    """Largest ramp index above ``ix`` the remaining work can fill.
+
+    The 2× fallback applies only off the FIRST level: level-1 programs are
+    pure dispatch latency, so any growth beats staying put even with a
+    sparse pipeline — but once at a pipe-filling level, climbing to a level
+    the remainder can NOT fill would re-create the under-filled regime the
+    pipe rule exists to avoid (remaining work only shrinks, so such a climb
+    could never satisfy the pipe rule that the first jump already
+    maximized)."""
+    best = ix
+    for j in range(ix + 1, len(ramp)):
+        if remaining >= ramp[j] * base_block * JUMP_PIPE_FILL:
+            best = j
+    if best == ix and ix == 0:
+        for j in range(ix + 1, len(ramp)):
+            if remaining >= ramp[j] * base_block * 2:
+                best = j
+    return best
 
 
 def _pallas_ok(circuit: Circuit) -> bool:
@@ -199,9 +237,6 @@ class TpuSweepBackend:
     ) -> SccCheckResult:
         if circuit is None:
             raise ValueError("sweep backend requires the encoded circuit")
-        from quorum_intersection_tpu.utils.compile_cache import enable_compilation_cache
-
-        enable_compilation_cache()
         s = len(scc)
         bits = s - 1
         if bits > self.max_bits:
@@ -210,6 +245,13 @@ class TpuSweepBackend:
             )
         t0 = time.perf_counter()
         t0_monotonic = time.monotonic()
+        # After t0: enabling the cache touches jax.default_backend(), whose
+        # first call pays the tunnel handshake (seconds, high variance) —
+        # before t0 it leaks out of the setup bucket and the end-to-end vs
+        # sum-of-buckets ledger stops balancing.
+        from quorum_intersection_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
 
         n = circuit.n
         scc_mask = np.zeros(n, dtype=np.float32)
@@ -407,6 +449,26 @@ class TpuSweepBackend:
         since_ramp = 0  # dispatches since the last ramp change: the first
         # (small) program must run before the jump, so an early hit or crash
         # right at the start never has to sync/lose a maximum-size program.
+
+        def jump_worthwhile() -> bool:
+            """Can the remaining work still fill the next ramp level?  The
+            single source of truth for jump eligibility — the pre-loop
+            compile start, the loop's jump branch, and the stale-marker
+            clear must all agree or the depth cap / compiled big shape
+            desynchronize from the actual jump decision."""
+            return (
+                ramp_ix + 1 < len(STEPS_RAMP)
+                and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
+            )
+
+        if jump_worthwhile():
+            # The jump target is already known before the first dispatch, so
+            # its compile overlaps the level-1 compile instead of starting
+            # only after it (the first dispatch blocks on level-1's compile;
+            # serializing the two wastes the bigger compile's full latency).
+            start_async_compile(STEPS_RAMP[
+                _jump_target_ix(STEPS_RAMP, ramp_ix, base_block, total - start)
+            ])
         while start < total:
             # Grow the program only once the remaining work would fill at
             # least a couple of programs at the next size (never compile
@@ -415,11 +477,7 @@ class TpuSweepBackend:
             # The jump-target shape compiles in a background thread while
             # the current level keeps sweeping; the switch happens only when
             # the compiled program is ready (or inline if the thread died).
-            if (
-                ramp_ix + 1 < len(STEPS_RAMP)
-                and since_ramp >= RAMP_DISPATCHES
-                and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
-            ):
+            if since_ramp >= RAMP_DISPATCHES and jump_worthwhile():
                 ct = async_compile["target"]
                 thread = async_compile["thread"]
                 if (
@@ -431,13 +489,16 @@ class TpuSweepBackend:
                     ramp_ix, since_ramp = STEPS_RAMP.index(ct), 0
                     async_compile["target"] = None
                 elif thread is None or not thread.is_alive():
-                    target_ix = ramp_ix
-                    while (
-                        target_ix + 1 < len(STEPS_RAMP)
-                        and total - start >= STEPS_RAMP[target_ix + 1] * base_block * 2
-                    ):
-                        target_ix += 1
-                    if ct == STEPS_RAMP[target_ix] and ct not in dispatchers:
+                    target_ix = _jump_target_ix(
+                        STEPS_RAMP, ramp_ix, base_block, total - start
+                    )
+                    if target_ix == ramp_ix:
+                        # No level above is worth compiling for the work
+                        # that remains; drop any stale marker so the ramp
+                        # depth cap lifts (and never "compile" the current
+                        # level in a loop).
+                        async_compile["target"] = None
+                    elif ct == STEPS_RAMP[target_ix] and ct not in dispatchers:
                         # Thread finished without registering: compile
                         # failed; jump anyway, dispatch() compiles inline.
                         ramp_ix, since_ramp = target_ix, 0
@@ -447,6 +508,11 @@ class TpuSweepBackend:
                 # else: a compile is still in flight — keep sweeping at the
                 # current level; the target is re-validated against the
                 # remaining work at jump time, never re-chosen mid-compile.
+            elif async_compile["target"] is not None and not jump_worthwhile():
+                # The remaining work shrank below the jump guard while the
+                # compile was in flight: it will never be jumped to.  Clear
+                # the marker so the ramp depth cap lifts for the tail.
+                async_compile["target"] = None
             hi, lo = start >> lo_bits, start & (lo_total - 1)
             coverage = STEPS_RAMP[ramp_ix] * base_block
             spc = STEPS_RAMP[ramp_ix]
@@ -477,7 +543,18 @@ class TpuSweepBackend:
             inflight.append((start, coverage, hi, spc, dispatch(lo, hi, spc)))
             since_ramp += 1
             start += coverage
-            if len(inflight) >= self.max_inflight and drain_one():
+            # While a jump compile is pending AND the current level is the
+            # first one, the queue holds only small RTT-bound programs; keep
+            # it shallow (RAMP_INFLIGHT) so the post-jump drain backlog
+            # stays bounded.  Above level 1 the queued programs are real
+            # device work — capping them would idle the chip, and a pending
+            # target that can no longer be jumped to is cleared above.
+            depth = (
+                min(self.max_inflight, RAMP_INFLIGHT)
+                if async_compile["target"] is not None and ramp_ix == 0
+                else self.max_inflight
+            )
+            if len(inflight) >= max(depth, 1) and drain_one():
                 break
         while not found and inflight:
             if drain_one():
