@@ -12,11 +12,16 @@ metric), not TPU-nativeness for its own sake:
   (``OracleBudgetExceeded``), fall back to the sweep — exact and bounded at
   2^(|scc|-1)/rate.  Worst case ≈ 2× the sweep cost; typical case ≈ free.
 - **large SCC** (> ``sweep_limit``): the pruned search is the only tractable
-  option — native C++ oracle, falling back to pure Python; the TPU hybrid
-  (host frontier + batched device fixpoints) is selected with
-  ``prefer_tpu=True`` **and only on accelerator platforms** — the measured
-  crossover (benchmarks/hybrid_crossover.py, README table) shows the native
-  oracle winning at every tractable size on the CPU emulation.
+  option — native C++ oracle, falling back to pure Python — on EVERY
+  platform.  The r2 assumption that the TPU hybrid would win on a real chip
+  was measured false in r3 (benchmarks/results/crossover_tpu_r3.txt): the
+  hybrid's frontier is host-sequential and each batch pays a device
+  round-trip, sustaining ~9k fixpoints/s through the tunneled chip against
+  the native oracle's ~1.4M B&B calls/s — a 100-1000× loss at every
+  tractable size, mirroring the CPU-emulation crossover.  The hybrid stays
+  reachable only as an explicit opt-in (``--backend tpu-hybrid``) where its
+  orthogonal capabilities (frontier checkpointing, mesh-sharded fixpoints)
+  are wanted.
 
 Every selection is logged; failures to import/compile an accelerator backend
 degrade gracefully to the next option so the CLI always yields a verdict.
@@ -79,32 +84,19 @@ class AutoBackend:
         checkpoint=None,
         mesh=None,
     ) -> None:
+        # prefer_tpu (`--backend tpu`) is routing-neutral since the r3
+        # on-chip crossover: large SCCs go to the host oracle everywhere
+        # (it only changes a log line); kept for CLI compatibility.
         self.prefer_tpu = prefer_tpu
         self.sweep_limit = sweep_limit
-        self.checkpoint = checkpoint  # forwarded to the sweep/hybrid backends
-        self.mesh = mesh  # forwarded to the device backends (sweep/hybrid)
+        self.checkpoint = checkpoint  # forwarded to the sweep backend
+        self.mesh = mesh  # forwarded to the sweep backend
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
     def _sweep(self):
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
         return TpuSweepBackend(checkpoint=self.checkpoint, mesh=self.mesh)
-
-    def _hybrid(self):
-        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
-
-        # Same seeded/randomized tie-break contract as the host oracles.
-        options = dict(self._oracle_options)
-        if self.mesh is not None:
-            options["mesh"] = self.mesh
-        if self.checkpoint is not None:
-            # The user handed a sweep-format checkpoint (path-per-problem);
-            # the hybrid stores its frontier at the same path in its own
-            # format — the fingerprints keep the two from cross-resuming.
-            from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
-
-            options["checkpoint"] = HybridCheckpoint(self.checkpoint.path)
-        return TpuHybridBackend(**options)
 
     def _cpu_oracle(self, budget_s: Optional[float] = None):
         """Native oracle, degrading to pure Python; with ``budget_s``, the
@@ -220,24 +212,17 @@ class AutoBackend:
                 except Exception as exc:  # noqa: BLE001
                     log.info("sweep backend unavailable (%s); falling back", exc)
         if self.prefer_tpu:
-            # Measured (benchmarks/hybrid_crossover.py): on the CPU
-            # emulation the hybrid's per-row cost is ~100× the native
-            # oracle's per-fixpoint cost, so it loses at every tractable
-            # size — only route to it when a real accelerator is attached.
-            from quorum_intersection_tpu.utils.platform import is_cpu_platform
-
-            if is_cpu_platform():
-                log.info(
-                    "hybrid skipped on CPU platform (native oracle measured "
-                    "faster at every tractable size); using host oracle"
-                )
-            else:
-                try:
-                    backend = self._hybrid()
-                    log.debug("auto: hybrid backend for |scc|=%d", len(scc))
-                    return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
-                except Exception as exc:  # noqa: BLE001
-                    log.info("hybrid backend unavailable (%s); falling back", exc)
+            # Measured on BOTH platforms (benchmarks/results/
+            # crossover_cpu_r3.txt, crossover_tpu_r3.txt): the hybrid loses
+            # to the native oracle at every tractable size — see the module
+            # docstring.  Honest routing sends large SCCs to the host
+            # oracle everywhere; `--backend tpu-hybrid` remains the
+            # explicit opt-in for checkpointed or mesh-sharded searches.
+            log.info(
+                "hybrid skipped (measured slower than the native oracle at "
+                "every tractable size, on the real chip as on CPU); "
+                "using host oracle"
+            )
         if self.checkpoint is not None:
             # Host oracles are all-or-nothing; honor the user's expectation
             # loudly instead of silently dropping progress recording.

@@ -216,5 +216,8 @@ def test_auto_backend_forwards_mesh():
     mesh = candidate_mesh(4)
     auto = AutoBackend(mesh=mesh)
     assert auto._sweep().mesh is mesh
-    auto2 = AutoBackend(prefer_tpu=True, mesh=mesh)
-    assert auto2._hybrid().mesh is mesh
+    # Mesh plumbing into the hybrid is the CLI's job now (auto no longer
+    # routes to it, r3 on-chip crossover); direct construction covers it.
+    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+
+    assert TpuHybridBackend(mesh=mesh).mesh is mesh

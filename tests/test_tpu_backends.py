@@ -286,13 +286,6 @@ class TestHybridOptions:
             assert res.intersects is False
             assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
 
-    def test_auto_plumbs_seed_into_hybrid(self):
-        from quorum_intersection_tpu.backends.auto import AutoBackend
-
-        auto = AutoBackend(prefer_tpu=True, seed=3)
-        hybrid = auto._hybrid()
-        assert hybrid._rng is not None
-
     def test_cli_routes_seed_to_hybrid(self, ref_fixture):
         import subprocess
         import sys
@@ -337,25 +330,37 @@ class TestHybridOptions:
         assert res.intersects is True
         assert called  # host oracle used, not the hybrid
 
-    def test_auto_on_accelerator_prefers_hybrid(self, monkeypatch):
-        # Pretend an accelerator is attached: prefer_tpu must route large
-        # SCCs to the hybrid (the complement of the CPU-platform gate).
+    def test_auto_never_picks_hybrid_even_on_accelerator(self, monkeypatch):
+        # r3 on-chip crossover (benchmarks/results/crossover_tpu_r3.txt):
+        # the hybrid loses 100-1000x to the native oracle at every
+        # tractable size on the REAL chip too, so prefer_tpu must route
+        # large SCCs to the host oracle on every platform.  Pretend an
+        # accelerator is attached to pin the non-CPU path.
         from quorum_intersection_tpu.backends.auto import AutoBackend
 
         monkeypatch.setattr(
             "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: False
         )
         auto = AutoBackend(prefer_tpu=True, sweep_limit=4)
-        called = []
-        real_hybrid = auto._hybrid
+        oracle_calls, hybrid_attempts = [], []
 
-        def spy():
-            called.append(True)
-            return real_hybrid()
+        # Record (never raise): a raising sentinel would be swallowed by a
+        # reintroduced try/except-degrade route and the test would pass
+        # while auto actually picked the hybrid.
+        monkeypatch.setattr(
+            auto, "_hybrid", lambda: hybrid_attempts.append(True),
+            raising=False,
+        )
+        orig = auto._cpu_oracle
 
-        monkeypatch.setattr(auto, "_hybrid", spy)
+        def spy(budget_s=None):
+            oracle_calls.append(True)
+            return orig(budget_s=budget_s)
+
+        monkeypatch.setattr(auto, "_cpu_oracle", spy)
         res = solve(majority_fbas(9), backend=auto)
-        assert called and res.intersects is True
+        assert not hybrid_attempts
+        assert oracle_calls and res.intersects is True
 
 
 class TestHybridCheckpoint:
@@ -426,14 +431,23 @@ class TestHybridCheckpoint:
         assert other.intersects is True
         assert "resumed_states" not in other.stats
 
-    def test_auto_routes_checkpoint_to_hybrid(self):
-        from quorum_intersection_tpu.backends.auto import AutoBackend
-        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+    def test_cli_builds_hybrid_checkpoint_for_hybrid_backend(self, tmp_path):
+        # `--backend tpu-hybrid --checkpoint PATH` must hand the hybrid a
+        # HybridCheckpoint (frontier format): a sweep-format object would
+        # crash the hybrid's resume_states call.  The CLI owns this mapping
+        # since auto no longer routes to the hybrid (r3 on-chip crossover).
+        import json
+        import subprocess
+        import sys
 
-        auto = AutoBackend(prefer_tpu=True, checkpoint=SweepCheckpoint("/tmp/x.ckpt"))
-        hybrid = auto._hybrid()
-        assert hybrid.checkpoint is not None
-        assert str(hybrid.checkpoint.path) == "/tmp/x.ckpt"
+        proc = subprocess.run(
+            [sys.executable, "-m", "quorum_intersection_tpu",
+             "--backend", "tpu-hybrid", "--checkpoint", str(tmp_path / "x.ckpt")],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=180,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout == "true\n"
 
 
 class TestLatencyAwareRouting:
