@@ -73,28 +73,31 @@ def main() -> int:
     import jax
 
     from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
     from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
     device = jax.devices()[0].device_kind
     print(f"device: {device}\n")
-    print("| workload | native C++ (s) | hybrid (s) | speedup | hybrid fixpoints | cache hits | wasted rows |")
+    print("| workload | native C++ (s) | hybrid (s) | frontier (s) | frontier speedup | frontier states | flagged |")
     print("|---|---|---|---|---|---|---|")
     for name, data, scc in workloads(args.quick):
         cpp_s, cpp_res = time_solve(data, CppOracleBackend())
         hy_s, hy_res = time_solve(data, TpuHybridBackend(batch=args.batch))
-        ok = cpp_res.intersects == hy_res.intersects
-        speed = cpp_s / hy_s if hy_s > 0 else float("inf")
+        fr_s, fr_res = time_solve(data, TpuFrontierBackend())
+        ok = (cpp_res.intersects == hy_res.intersects == fr_res.intersects)
+        speed = cpp_s / fr_s if fr_s > 0 else float("inf")
         flag = "" if ok else " **INVALID: verdict mismatch**"
         print(
-            f"| {name} | {cpp_s:.3f} | {hy_s:.3f} | {speed:.2f}x{flag} | "
-            f"{hy_res.stats.get('fixpoints')} | {hy_res.stats.get('cache_hits')} | "
-            f"{hy_res.stats.get('wasted_rows')} |"
+            f"| {name} | {cpp_s:.3f} | {hy_s:.3f} | {fr_s:.3f} | {speed:.2f}x{flag} | "
+            f"{fr_res.stats.get('states_popped')} | {fr_res.stats.get('flagged')} |"
         )
         print(json.dumps({
             "workload": name, "scc": scc, "device": device,
             "cpp_seconds": round(cpp_s, 4), "hybrid_seconds": round(hy_s, 4),
-            "speedup": round(speed, 3), "verdict_ok": ok,
+            "frontier_seconds": round(fr_s, 4),
+            "frontier_speedup_vs_cpp": round(speed, 3), "verdict_ok": ok,
             "hybrid_stats": {k: v for k, v in hy_res.stats.items() if k != "backend"},
+            "frontier_stats": {k: v for k, v in fr_res.stats.items() if k != "backend"},
             "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),
         }))
     return 0

@@ -15,7 +15,11 @@ Backends:
 - ``tpu-sweep``  — JAX exhaustive batched subset sweep (small SCCs; verdict-
                    equivalent by the half-size argument, exact by construction)
 - ``tpu-hybrid`` — host frontier + batched device fixpoint evaluation
-- ``auto``       — picks per-SCC-size: sweep for tiny, hybrid/cpp beyond
+- ``tpu-frontier`` — device-resident B&B: the worklist lives in HBM and
+                   expands inside one lax.while_loop (zero round-trips in
+                   the tree interior; rare leaves host-checked exactly)
+- ``auto``       — latency-aware: budgeted oracle first, sweep fallback for
+                   small SCCs; host oracle beyond (measured crossover)
 """
 
 from quorum_intersection_tpu.backends.base import SccCheckResult, SearchBackend, get_backend

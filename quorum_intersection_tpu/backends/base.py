@@ -80,6 +80,10 @@ def get_backend(name: str, **options) -> SearchBackend:
         from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
         return TpuHybridBackend(**options)
+    if name == "tpu-frontier":
+        from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+
+        return TpuFrontierBackend(**options)
     if name in ("tpu", "auto"):
         from quorum_intersection_tpu.backends.auto import AutoBackend
 

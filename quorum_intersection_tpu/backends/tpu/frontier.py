@@ -1,0 +1,471 @@
+"""Device-resident branch-and-bound — the frontier lives in HBM.
+
+The r3 on-chip crossover (benchmarks/results/crossover_tpu_r3.txt) showed
+WHY the round-trip hybrid loses to the native oracle everywhere: the B&B
+frontier is host-sequential, so every batch of fixpoints pays a host↔device
+round-trip (~65-100 ms through the tunneled chip) for a frontier that rarely
+fills it.  This backend removes the round-trips entirely: the **worklist
+itself is a device array** — a LIFO stack of (toRemove, dontRemove) bitmask
+pairs in SCC-index space — and one jitted ``lax.while_loop`` pops a block of
+states, evaluates their fixpoints as batched matmuls, applies the
+reference's prunes (cpp:252-346; pinned spec `backends/python_oracle.py`),
+and pushes children, thousands of states per device iteration with zero
+host involvement.
+
+Division of labor (verdict-equivalent to the serial oracle):
+
+- **Device** handles the tree interior: the size prune (cpp:386-391 via the
+  caller's half bound), the empty prune (cpp:266-268), the
+  ``fixpoint(dontRemove)`` test (cpp:281), the full-candidate fixpoint +
+  containment prunes (cpp:301-314), the branch-variable choice
+  (max in-degree within the quorum, cpp:203-250) and the two-child
+  expansion (cpp:336, :343-345).
+- **Host** handles the rare leaves: states whose ``dontRemove`` already
+  contains a quorum are *flagged* into a side buffer and never expanded
+  (sound: the oracle prunes descent there either way, cpp:281-291).  The
+  host re-checks each flagged set with the exact reference semantics —
+  minimality (cpp:179-201) and the disjointness probe (cpp:357-384, Q6
+  availability) — so every witness that leaves this backend went through
+  `fbas/semantics.py`, the same code path the oracles trust.  Flagged
+  states are rare by construction: on symmetric-majority networks the
+  half-size prune fires first and ZERO states flag; on hierarchical
+  networks ~0.5 % of states flag (measured, crossover_tpu_r3.txt stats).
+
+Deliberate deviation from cpp:221: when no quorum member has an edge into
+``quorum ∖ dontRemove``, the reference falls back to ``quorum.front()`` —
+which may lie in ``dontRemove``, making both children identical to their
+parent (a latent non-termination in the reference).  This backend always
+branches on a member of ``quorum ∖ dontRemove`` (lowest index when
+in-degrees tie), which is the standard inclusion/exclusion branch variable
+and keeps the enumeration complete AND strictly shrinking.
+
+Scale-out of the worklist: the device arena is fixed-capacity; when it
+nears overflow the chunk returns to the host, which spills the oldest half
+of the stack to host memory and re-feeds it when the device runs dry —
+LIFO across spills is not preserved, which affects only traversal order,
+never the enumerated set.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.tpu.frontier")
+
+# Arena capacity (states).  A state is 2×s int8 (s = |scc| ≤ 64 for the
+# sizes this backend targets): 2^18 states ≈ 32 MB of HBM at s=64.  DFS-ish
+# LIFO keeps the live frontier far below this for every measured workload.
+ARENA = 1 << 18
+# States popped per device iteration.  Big enough that the two batched
+# fixpoints fill the MXU, small enough that a shallow tree still saturates
+# quickly (the frontier roughly doubles per iteration until it exceeds POP).
+POP = 2048
+# Exit the device loop once this many dontRemove-quorum states are flagged
+# (the host then runs the exact minimality/witness checks).  Small enough
+# to surface a broken network's witness fast, big enough to amortize the
+# chunk round-trip on safe hierarchical networks that flag thousands.
+FLAG_EXIT = 512
+# Device iterations per chunk: bounds time-to-host-visibility (stats,
+# checkpoints, KeyboardInterrupt) without materially costing throughput.
+CHUNK_ITERS = 512
+
+
+class FrontierSearchInterrupted(RuntimeError):
+    """Raised by the preemption-simulation hook after writing a checkpoint
+    (``interrupt_after_chunks``); production runs never see it."""
+
+
+class TpuFrontierBackend:
+    """Device-resident B&B over the quorum-bearing SCC."""
+
+    name = "tpu-frontier"
+    needs_circuit = True
+
+    def __init__(
+        self,
+        arena: int = ARENA,
+        pop: int = POP,
+        flag_exit: int = FLAG_EXIT,
+        chunk_iters: int = CHUNK_ITERS,
+        checkpoint=None,
+        checkpoint_interval_s: float = 5.0,
+        interrupt_after_chunks: Optional[int] = None,
+    ) -> None:
+        self.arena = arena
+        self.pop = min(pop, arena // 4)
+        self.flag_exit = flag_exit
+        # The loop exits once flag_exit states are flagged, and one more
+        # iteration can flag at most `pop` more — this capacity makes a
+        # dropped (lost) flag impossible, which matters for completeness.
+        self.flag_cap = self.flag_exit + self.pop
+        self.chunk_iters = chunk_iters
+        self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
+        self.checkpoint_interval_s = checkpoint_interval_s
+        # Preemption simulation for kill/resume tests (same contract as the
+        # hybrid's interrupt_after_batches): after this many chunks, force a
+        # checkpoint write and raise.
+        self.interrupt_after_chunks = interrupt_after_chunks
+
+    # ---- host-side exact checks (reference semantics) -------------------
+
+    @staticmethod
+    def _host_witness_check(
+        graph: TrustGraph,
+        scc: List[int],
+        members: List[int],
+        scope_to_scc: bool,
+    ) -> Tuple[bool, Optional[Tuple[List[int], List[int]]]]:
+        """Exact minimality + disjointness probe for one flagged set.
+
+        Mirrors the oracle's visitor (python_oracle.py): returns
+        ``(is_minimal, witness)`` where witness is ``(disjoint, members)``
+        or None.  Runs `fbas/semantics.py` end-to-end, so device results
+        never reach the verdict unchecked."""
+        from quorum_intersection_tpu.backends.python_oracle import is_minimal_quorum
+
+        if not is_minimal_quorum(members, graph):
+            return False, None
+        if scope_to_scc:
+            avail = [False] * graph.n
+            for v in scc:
+                avail[v] = True
+        else:
+            avail = [True] * graph.n  # Q6 whole-graph availability (cpp:354)
+        for v in members:
+            avail[v] = False
+        disjoint = max_quorum(graph, scc, avail)
+        if disjoint:
+            return True, (disjoint, list(members))
+        return True, None
+
+    # ---- device chunk builder -------------------------------------------
+
+    def _build_chunk(self, circuit: Circuit, scc: List[int], a_scc: np.ndarray,
+                     half: int):
+        """Compile ``run_chunk(T, D, top) -> (T, D, top, flags, fcount,
+        iters, popped)`` — the device-resident expansion loop."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays, fixpoint
+
+        arrays = CircuitArrays(circuit)
+        s = len(scc)
+        n = circuit.n
+        K = self.pop
+        C = self.arena
+        flag_cap = self.flag_cap
+        scc_idx = jnp.asarray(np.asarray(scc, dtype=np.int32))
+        # In-degree counts within the SCC, with multiplicity (Q7): a_scc[u, w]
+        # = #edges u→w.  int32 matmul keeps counts exact.
+        a_mat = jnp.asarray(a_scc.astype(np.int32))
+
+        def expand(T, D, top, flags, fcount, iters, popped):
+            k = jnp.minimum(top, K)
+            base = top - k
+            blk_T = lax.dynamic_slice(T, (base, 0), (K, s))
+            blk_D = lax.dynamic_slice(D, (base, 0), (K, s))
+            valid = (jnp.arange(K, dtype=jnp.int32) < k)
+
+            dsize = blk_D.sum(-1, dtype=jnp.int32)
+            union = jnp.maximum(blk_T, blk_D)
+            live = valid & (dsize <= half) & (union.sum(-1, dtype=jnp.int32) > 0)
+
+            # Batched fixpoints in full-graph index space (the circuit is
+            # n-wide); T, D ⊆ scc so survivors ⊆ scc and the gather back to
+            # SCC space below is lossless.
+            def to_full(rows):
+                full = jnp.zeros((K, n), dtype=arrays.dtype)
+                return full.at[:, scc_idx].set(rows.astype(arrays.dtype))
+
+            f1 = fixpoint(arrays, to_full(blk_D))[:, scc_idx]
+            f2 = fixpoint(arrays, to_full(union))[:, scc_idx]
+
+            d_has_q = live & (f1.sum(-1, dtype=jnp.int32) > 0)
+            interior = live & ~d_has_q
+
+            f2i = f2.astype(jnp.int8)
+            contained = (blk_D.astype(jnp.int32) * (1 - f2i.astype(jnp.int32))).sum(-1) == 0
+            nonempty = f2.sum(-1, dtype=jnp.int32) > 0
+            eligible = f2i * (1 - blk_D)
+            has_eligible = eligible.sum(-1, dtype=jnp.int32) > 0
+            branchable = interior & nonempty & contained & has_eligible
+
+            # Branch variable: max in-degree (from quorum members, with
+            # multiplicity) within quorum ∖ dontRemove; argmax breaks ties
+            # on the lowest index.  All-zero in-degrees fall through to the
+            # lowest-index eligible node (deliberate cpp:221 deviation, see
+            # module docstring).
+            indeg = lax.dot(
+                f2i.astype(jnp.int32), a_mat, preferred_element_type=jnp.int32
+            )
+            masked = jnp.where(eligible > 0, indeg, jnp.int32(-1))
+            best = jnp.argmax(masked, axis=-1)
+            best_oh = jax.nn.one_hot(best, s, dtype=jnp.int8)
+
+            child_T = eligible * (1 - best_oh)
+            incl_D = jnp.minimum(blk_D + best_oh, 1)
+            # Pre-push prunes (identical to the entry prunes the children
+            # would fail anyway — saves arena slots): the include child dies
+            # on the size bound, either child dies when both sets are empty.
+            excl_ok = branchable & (
+                (child_T.sum(-1, dtype=jnp.int32) + dsize) > 0
+            )
+            incl_ok = branchable & (dsize + 1 <= half)
+
+            # Compact writes: exclude children above include children so the
+            # LIFO pops the exclude branch first (serial order, cpp:336).
+            n_child = excl_ok.astype(jnp.int32) + incl_ok.astype(jnp.int32)
+            off = jnp.cumsum(n_child) - n_child
+            incl_pos = jnp.where(incl_ok, base + off, C)
+            excl_pos = jnp.where(
+                excl_ok, base + off + incl_ok.astype(jnp.int32), C
+            )
+            T = T.at[incl_pos].set(child_T, mode="drop")
+            D = D.at[incl_pos].set(incl_D, mode="drop")
+            T = T.at[excl_pos].set(child_T, mode="drop")
+            D = D.at[excl_pos].set(blk_D, mode="drop")
+            new_top = base + n_child.sum(dtype=jnp.int32)
+
+            # Flag dontRemove-quorum states for the host's exact check.
+            nf = d_has_q.astype(jnp.int32)
+            fpos = jnp.where(d_has_q, fcount + jnp.cumsum(nf) - nf, flag_cap)
+            flags = flags.at[fpos].set(blk_D, mode="drop")
+            fcount = jnp.minimum(fcount + nf.sum(dtype=jnp.int32), flag_cap)
+
+            return T, D, new_top, flags, fcount, iters + 1, popped + k
+
+        def cond(carry):
+            T, D, top, flags, fcount, iters, popped = carry
+            return (
+                (top > 0)
+                & (iters < self.chunk_iters)
+                & (fcount < self.flag_exit)
+                & (top <= C - 2 * K)  # overflow guard: host spills
+            )
+
+        @jax.jit
+        def run_chunk(T, D, top):
+            flags = jnp.zeros((flag_cap, s), dtype=jnp.int8)
+            carry = (T, D, top, flags, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            return lax.while_loop(cond, lambda c: expand(*c), carry)
+
+        return run_chunk
+
+    # ---- main entry ------------------------------------------------------
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        if circuit is None:
+            raise ValueError("frontier backend requires the encoded circuit")
+        from quorum_intersection_tpu.utils.compile_cache import enable_compilation_cache
+
+        t0 = time.perf_counter()
+        enable_compilation_cache()
+        import jax.numpy as jnp
+
+        s = len(scc)
+        half = s // 2
+        scc_pos = {v: i for i, v in enumerate(scc)}
+        a_scc = np.zeros((s, s), dtype=np.int32)
+        for u in scc:
+            for w in graph.succ[u]:
+                j = scc_pos.get(w)
+                if j is not None:
+                    a_scc[scc_pos[u], j] += 1
+
+        run_chunk = self._build_chunk(circuit, scc, a_scc, half)
+
+        stats = {
+            "backend": self.name,
+            "device_iters": 0,
+            "device_chunks": 0,
+            "states_popped": 0,
+            "flagged": 0,
+            "host_checks": 0,
+            "minimal_quorums": 0,
+            "spills": 0,
+        }
+
+        C, K = self.arena, self.pop
+        T = np.zeros((C, s), dtype=np.int8)
+        D = np.zeros((C, s), dtype=np.int8)
+
+        fingerprint = None
+        resumed = None
+        if self.checkpoint is not None:
+            from quorum_intersection_tpu.utils.checkpoint import sweep_fingerprint
+
+            scc_mask = np.zeros(circuit.n, dtype=np.float32)
+            scc_mask[scc] = 1.0
+            frozen = (
+                np.zeros(circuit.n, dtype=np.float32) if scope_to_scc
+                else 1.0 - scc_mask
+            )
+            fingerprint = sweep_fingerprint(
+                circuit.members, circuit.child, circuit.thresholds,
+                np.asarray(scc, dtype=np.int32), scc_mask, frozen,
+            )
+            resumed = self.checkpoint.resume_states(fingerprint)
+
+        spill: List[Tuple[np.ndarray, np.ndarray]] = []  # host stack of blocks
+
+        def seed_states(pairs) -> int:
+            rows = 0
+            for to_remove, dont_remove in pairs:
+                for v in to_remove:
+                    T[rows, scc_pos[v]] = 1
+                for v in dont_remove:
+                    D[rows, scc_pos[v]] = 1
+                rows += 1
+            return rows
+
+        if resumed:
+            stats["resumed_states"] = len(resumed)
+            top = seed_states(resumed[: C // 2])
+            # Excess resumed states go to the host spill in C//2-row blocks
+            # (same granularity as overflow spills), so draining them later
+            # is one chunk per block, not one per state.
+            for i in range(C // 2, len(resumed), C // 2):
+                block = resumed[i: i + C // 2]
+                t_blk = np.zeros((len(block), s), dtype=np.int8)
+                d_blk = np.zeros((len(block), s), dtype=np.int8)
+                for r, (to_remove, dont_remove) in enumerate(block):
+                    for v in to_remove:
+                        t_blk[r, scc_pos[v]] = 1
+                    for v in dont_remove:
+                        d_blk[r, scc_pos[v]] = 1
+                spill.append((t_blk, d_blk))
+        else:
+            top = seed_states([(list(scc), [])])
+
+        T_dev = jnp.asarray(T)
+        D_dev = jnp.asarray(D)
+        top_dev = jnp.int32(top)
+        witness: Optional[Tuple[List[int], List[int]]] = None
+        last_ckpt = time.monotonic()
+
+        while witness is None:
+            T_dev, D_dev, top_dev, flags, fcount, iters, popped = run_chunk(
+                T_dev, D_dev, top_dev
+            )
+            fcount_h = int(fcount)
+            top_h = int(top_dev)
+            stats["device_chunks"] += 1
+            stats["device_iters"] += int(iters)
+            stats["states_popped"] += int(popped)
+            stats["flagged"] += fcount_h
+            log.debug(
+                "frontier chunk %d: %d iters, %d popped, top=%d, %d flagged, "
+                "%d spilled blocks",
+                stats["device_chunks"], int(iters), int(popped), top_h,
+                fcount_h, len(spill),
+            )
+
+            if fcount_h:
+                flags_h = np.asarray(flags[:fcount_h])
+                for row in flags_h:
+                    members = [scc[i] for i in np.nonzero(row)[0]]
+                    stats["host_checks"] += 1
+                    minimal, hit = self._host_witness_check(
+                        graph, scc, members, scope_to_scc
+                    )
+                    if minimal:
+                        stats["minimal_quorums"] += 1
+                    if hit is not None:
+                        witness = hit
+                        break
+                if witness is not None:
+                    break
+
+            if top_h > C - 2 * K:
+                # Overflow: spill the OLDEST half of the stack (indices
+                # [0, C//2)) to the host and compact the rest down.
+                # np.array (not asarray): device buffers view as read-only.
+                T_h = np.array(T_dev)
+                D_h = np.array(D_dev)
+                spill.append((T_h[: C // 2].copy(), D_h[: C // 2].copy()))
+                keep = top_h - C // 2
+                T_h[:keep] = T_h[C // 2: top_h]
+                D_h[:keep] = D_h[C // 2: top_h]
+                T_dev, D_dev, top_dev = (
+                    jnp.asarray(T_h), jnp.asarray(D_h), jnp.int32(keep)
+                )
+                top_h = keep
+                stats["spills"] += 1
+            elif top_h == 0:
+                if not spill:
+                    break  # worklist exhausted: all quorums intersect
+                T_blk, D_blk = spill.pop()
+                # Re-feed a spilled block (valid rows are the nonempty ones —
+                # spilled blocks are dense prefixes by construction).
+                live = np.nonzero((T_blk | D_blk).any(axis=1))[0]
+                T_h = np.zeros((C, s), dtype=np.int8)
+                D_h = np.zeros((C, s), dtype=np.int8)
+                T_h[: len(live)] = T_blk[live]
+                D_h[: len(live)] = D_blk[live]
+                T_dev, D_dev, top_dev = (
+                    jnp.asarray(T_h), jnp.asarray(D_h), jnp.int32(len(live))
+                )
+                top_h = len(live)
+
+            if self.checkpoint is not None and witness is None:
+                # Same post-witness write suppression as the hybrid: the
+                # witness-bearing state is resolved and absent from the
+                # frontier, so a write+kill after the witness could resume
+                # into a witness-free remainder and flip the verdict.
+                if (
+                    self.interrupt_after_chunks is not None
+                    and stats["device_chunks"] >= self.interrupt_after_chunks
+                    and (top_h > 0 or spill)
+                ):
+                    self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)
+                    raise FrontierSearchInterrupted(
+                        f"simulated preemption after {stats['device_chunks']} chunks"
+                    )
+                if time.monotonic() - last_ckpt >= self.checkpoint_interval_s:
+                    self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)
+                    last_ckpt = time.monotonic()
+
+        stats["seconds"] = time.perf_counter() - t0
+        if self.checkpoint is not None:
+            self.checkpoint.clear()
+        if witness is not None:
+            q1, q2 = witness
+            return SccCheckResult(intersects=False, q1=q1, q2=q2, stats=stats)
+        return SccCheckResult(intersects=True, stats=stats)
+
+    def _write_checkpoint(self, T_dev, D_dev, top, spill, scc, fingerprint) -> None:
+        """Persist the full frontier (device stack + host spill) in the
+        HybridCheckpoint (toRemove, dontRemove) node-list format."""
+        states = []
+
+        def add_block(T_blk, D_blk):
+            for t_row, d_row in zip(T_blk, D_blk):
+                if not (t_row.any() or d_row.any()):
+                    continue
+                states.append([
+                    [scc[i] for i in np.nonzero(t_row)[0]],
+                    [scc[i] for i in np.nonzero(d_row)[0]],
+                ])
+
+        add_block(np.asarray(T_dev)[:top], np.asarray(D_dev)[:top])
+        for T_blk, D_blk in spill:
+            add_block(T_blk, D_blk)
+        self.checkpoint.record(states, fingerprint)

@@ -67,7 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PageRank convergence threshold (default 0.0001)")
     # --- superset flags ---
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep", "tpu-hybrid"],
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep", "tpu-hybrid",
+                            "tpu-frontier"],
                    help="disjoint-quorum search backend (default auto)")
     p.add_argument("--dangling-policy", default=None, choices=["strict", "alias0"],
                    help="unknown validator refs: strict=never available (default), "
@@ -271,10 +272,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ):
         backend_options = {"seed": args.seed, "randomized": True}
     if args.checkpoint is not None:
-        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid"):
+        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid",
+                                "tpu-frontier"):
             sys.stderr.write(
                 "--checkpoint requires a checkpoint-capable backend "
-                "(auto/tpu/tpu-sweep/tpu-hybrid)\n"
+                "(auto/tpu/tpu-sweep/tpu-hybrid/tpu-frontier)\n"
             )
             return 1
         from quorum_intersection_tpu.utils.checkpoint import (
@@ -283,8 +285,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
         backend_options["checkpoint"] = (
+            # Frontier snapshots reuse the hybrid's (toRemove, dontRemove)
+            # frontier format; the sweep records a scan position instead.
             HybridCheckpoint(args.checkpoint)
-            if args.backend == "tpu-hybrid"
+            if args.backend in ("tpu-hybrid", "tpu-frontier")
             else SweepCheckpoint(args.checkpoint)
         )
     if args.mesh is not None:

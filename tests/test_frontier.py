@@ -1,0 +1,158 @@
+"""Device-resident frontier B&B (`backends/tpu/frontier.py`).
+
+Differential tests against the Python oracle pin both the verdict AND the
+confirmed-minimal-quorum count: equality of the counts on safe networks is
+an enumeration-completeness check, not just a verdict check (a frontier
+that silently dropped states could still luck into the right verdict)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+from quorum_intersection_tpu.backends.tpu.frontier import (
+    FrontierSearchInterrupted,
+    TpuFrontierBackend,
+)
+from quorum_intersection_tpu.fbas.synth import (
+    hierarchical_fbas,
+    majority_fbas,
+    random_fbas,
+)
+from quorum_intersection_tpu.pipeline import solve
+
+
+def _pair(data, **frontier_kw):
+    po = solve(data, backend=PythonOracleBackend())
+    fr = solve(data, backend=TpuFrontierBackend(**frontier_kw))
+    return po, fr
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("n", [7, 9, 11])
+    def test_majority_safe(self, n):
+        po, fr = _pair(majority_fbas(n), arena=8192, pop=256)
+        assert fr.intersects is True and po.intersects is True
+        assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+
+    @pytest.mark.parametrize("n", [8, 10, 12])
+    def test_majority_broken(self, n):
+        po, fr = _pair(majority_fbas(n, broken=True), arena=8192, pop=256)
+        assert fr.intersects is False and po.intersects is False
+        assert fr.q1 and fr.q2 and not set(fr.q1) & set(fr.q2)
+
+    def test_hierarchical_flag_path(self):
+        # Hierarchical networks flag dontRemove-quorum states (the host
+        # minimality path); count parity proves none were lost or invented.
+        po, fr = _pair(hierarchical_fbas(4, 3), arena=8192, pop=256)
+        assert fr.intersects is True
+        assert fr.stats["flagged"] > 0
+        assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"] > 0
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_differential(self, seed):
+        po, fr = _pair(random_fbas(13, seed=seed), arena=8192, pop=256)
+        assert po.intersects == fr.intersects
+        if po.stats.get("reason") != "scc_guard" and po.intersects:
+            assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+
+    def test_scope_to_scc(self):
+        from quorum_intersection_tpu.encode.circuit import encode_circuit
+        from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+
+        graph = build_graph(parse_fbas(majority_fbas(10, broken=True)))
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        scc = max(group_sccs(graph.n, comp, count), key=len)
+        circuit = encode_circuit(graph)
+        po = PythonOracleBackend().check_scc(graph, None, scc, scope_to_scc=True)
+        fr = TpuFrontierBackend(arena=4096, pop=128).check_scc(
+            graph, circuit, scc, scope_to_scc=True
+        )
+        assert po.intersects == fr.intersects is False
+
+
+class TestArenaSpill:
+    def test_tiny_arena_forces_spill(self):
+        # A 64-slot arena with 16-state pops overflows on hier-4x3's tree
+        # (measured: ~22 spills) and must still enumerate everything
+        # (count parity).
+        po, fr = _pair(hierarchical_fbas(4, 3), arena=64, pop=16)
+        assert fr.intersects is True
+        assert fr.stats["spills"] > 0
+        assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+
+    def test_tiny_arena_broken_verdict(self):
+        _, fr = _pair(majority_fbas(12, broken=True), arena=128, pop=16)
+        assert fr.intersects is False
+        assert fr.q1 and fr.q2 and not set(fr.q1) & set(fr.q2)
+
+
+class TestCheckpoint:
+    def _ck(self, tmp_path):
+        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+        return HybridCheckpoint(tmp_path / "frontier.ckpt")
+
+    def test_kill_resume_same_verdict(self, tmp_path):
+        ck = self._ck(tmp_path)
+        with pytest.raises(FrontierSearchInterrupted):
+            solve(
+                hierarchical_fbas(4, 3),
+                backend=TpuFrontierBackend(
+                    arena=2048, pop=64, chunk_iters=2, checkpoint=ck,
+                    interrupt_after_chunks=2,
+                ),
+            )
+        assert ck.path.exists()
+        resumed = solve(
+            hierarchical_fbas(4, 3),
+            backend=TpuFrontierBackend(arena=2048, pop=64, checkpoint=ck),
+        )
+        assert resumed.intersects is True
+        assert resumed.stats.get("resumed_states", 0) > 0
+        assert not ck.path.exists()  # cleared on completion
+
+    def test_stale_checkpoint_rejected(self, tmp_path):
+        ck = self._ck(tmp_path)
+        with pytest.raises(FrontierSearchInterrupted):
+            solve(
+                hierarchical_fbas(4, 3),
+                backend=TpuFrontierBackend(
+                    arena=2048, pop=64, chunk_iters=2, checkpoint=ck,
+                    interrupt_after_chunks=2,
+                ),
+            )
+        # Different problem, same file: the fingerprint must reject it.
+        other = solve(
+            majority_fbas(9),
+            backend=TpuFrontierBackend(arena=2048, pop=64, checkpoint=ck),
+        )
+        assert other.intersects is True
+        assert "resumed_states" not in other.stats
+
+
+class TestCli:
+    def test_cli_frontier_backend(self, ref_fixture):
+        proc = subprocess.run(
+            [sys.executable, "-m", "quorum_intersection_tpu",
+             "--backend", "tpu-frontier"],
+            input=ref_fixture("broken.json").read_text(),
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 1, proc.stderr
+        assert proc.stdout == "false\n"
+
+    def test_cli_frontier_checkpoint_flag(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "quorum_intersection_tpu",
+             "--backend", "tpu-frontier", "--checkpoint", str(tmp_path / "f.ckpt")],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout == "true\n"
