@@ -167,8 +167,16 @@ class TpuFrontierBackend:
         flag_cap = self.flag_cap
         scc_idx = jnp.asarray(np.asarray(scc, dtype=np.int32))
         # In-degree counts within the SCC, with multiplicity (Q7): a_scc[u, w]
-        # = #edges u→w.  int32 matmul keeps counts exact.
-        a_mat = jnp.asarray(a_scc.astype(np.int32))
+        # = #edges u→w.  Operand dtype follows the centralized CircuitArrays
+        # policy: int8 only where that backend supports 8-bit dots (it
+        # already encodes the CPU-backend mis-lowering exception,
+        # kernels.py:77-80); accumulation stays int32 either way.
+        a_dtype = (
+            jnp.int8
+            if arrays.dtype == jnp.int8 and int(a_scc.max(initial=0)) <= 127
+            else jnp.int32
+        )
+        a_mat = jnp.asarray(a_scc).astype(a_dtype)
 
         def expand(T, D, top, flags, fcount, iters, popped):
             k = jnp.minimum(top, K)
@@ -183,13 +191,14 @@ class TpuFrontierBackend:
 
             # Batched fixpoints in full-graph index space (the circuit is
             # n-wide); T, D ⊆ scc so survivors ⊆ scc and the gather back to
-            # SCC space below is lossless.
-            def to_full(rows):
-                full = jnp.zeros((K, n), dtype=arrays.dtype)
-                return full.at[:, scc_idx].set(rows.astype(arrays.dtype))
-
-            f1 = fixpoint(arrays, to_full(blk_D))[:, scc_idx]
-            f2 = fixpoint(arrays, to_full(union))[:, scc_idx]
+            # SCC space below is lossless.  The D-rows and union-rows run as
+            # ONE double-height batch: one while_loop convergence instead of
+            # two, and a taller matmul for the MXU.
+            stacked = jnp.zeros((2 * K, n), dtype=arrays.dtype).at[:, scc_idx].set(
+                jnp.concatenate([blk_D, union], axis=0).astype(arrays.dtype)
+            )
+            out = fixpoint(arrays, stacked)[:, scc_idx]
+            f1, f2 = out[:K], out[K:]
 
             d_has_q = live & (f1.sum(-1, dtype=jnp.int32) > 0)
             interior = live & ~d_has_q
@@ -207,7 +216,7 @@ class TpuFrontierBackend:
             # lowest-index eligible node (deliberate cpp:221 deviation, see
             # module docstring).
             indeg = lax.dot(
-                f2i.astype(jnp.int32), a_mat, preferred_element_type=jnp.int32
+                f2i.astype(a_mat.dtype), a_mat, preferred_element_type=jnp.int32
             )
             masked = jnp.where(eligible > 0, indeg, jnp.int32(-1))
             best = jnp.argmax(masked, axis=-1)
@@ -231,10 +240,15 @@ class TpuFrontierBackend:
             excl_pos = jnp.where(
                 excl_ok, base + off + incl_ok.astype(jnp.int32), C
             )
-            T = T.at[incl_pos].set(child_T, mode="drop")
-            D = D.at[incl_pos].set(incl_D, mode="drop")
-            T = T.at[excl_pos].set(child_T, mode="drop")
-            D = D.at[excl_pos].set(blk_D, mode="drop")
+            # One scatter per arena array (not one per child kind): both
+            # children share T'; D differs (include adds best).
+            pos = jnp.concatenate([incl_pos, excl_pos], axis=0)
+            T = T.at[pos].set(
+                jnp.concatenate([child_T, child_T], axis=0), mode="drop"
+            )
+            D = D.at[pos].set(
+                jnp.concatenate([incl_D, blk_D], axis=0), mode="drop"
+            )
             new_top = base + n_child.sum(dtype=jnp.int32)
 
             # Flag dontRemove-quorum states for the host's exact check.
