@@ -99,15 +99,16 @@ class TpuFrontierBackend:
         checkpoint=None,
         checkpoint_interval_s: float = 5.0,
         interrupt_after_chunks: Optional[int] = None,
+        mesh=None,
     ) -> None:
         self.arena = arena
         self.pop = min(pop, arena // 4)
         self.flag_exit = flag_exit
-        # The loop exits once flag_exit states are flagged, and one more
-        # iteration can flag at most `pop` more — this capacity makes a
-        # dropped (lost) flag impossible, which matters for completeness.
-        self.flag_cap = self.flag_exit + self.pop
         self.chunk_iters = chunk_iters
+        # Optional jax.sharding.Mesh: the popped block's fixpoint rows shard
+        # across devices (all_gather reassembles); the arena and all control
+        # flow replicate, so every device runs the identical expansion.
+        self.mesh = mesh
         self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
         self.checkpoint_interval_s = checkpoint_interval_s
         # Preemption simulation for kill/resume tests (same contract as the
@@ -150,7 +151,7 @@ class TpuFrontierBackend:
     # ---- device chunk builder -------------------------------------------
 
     def _build_chunk(self, circuit: Circuit, scc: List[int], a_scc: np.ndarray,
-                     half: int):
+                     half: int, K: int):
         """Compile ``run_chunk(T, D, top) -> (T, D, top, flags, fcount,
         iters, popped)`` — the device-resident expansion loop."""
         import jax
@@ -162,9 +163,29 @@ class TpuFrontierBackend:
         arrays = CircuitArrays(circuit)
         s = len(scc)
         n = circuit.n
-        K = self.pop
         C = self.arena
-        flag_cap = self.flag_cap
+        # The loop exits once flag_exit states are flagged, and one more
+        # iteration can flag at most K more — this capacity makes a dropped
+        # (lost) flag impossible, which matters for completeness.  Derived
+        # from the EFFECTIVE (mesh-rounded) K, not self.pop.
+        flag_cap = self.flag_exit + K
+
+        if self.mesh is not None:
+            axis = self.mesh.axis_names[0]
+            n_dev = int(self.mesh.devices.size)
+            rows = (2 * K) // n_dev  # K is pre-rounded so this is exact
+
+            def batch_fixpoint(stacked):
+                # Row-shard the double-height batch: each device evaluates
+                # its contiguous block, one tiled all_gather reassembles.
+                rank = lax.axis_index(axis)
+                mine = lax.dynamic_slice(stacked, (rank * rows, 0), (rows, n))
+                return lax.all_gather(
+                    fixpoint(arrays, mine), axis, axis=0, tiled=True
+                )
+        else:
+            def batch_fixpoint(stacked):
+                return fixpoint(arrays, stacked)
         scc_idx = jnp.asarray(np.asarray(scc, dtype=np.int32))
         # In-degree counts within the SCC, with multiplicity (Q7): a_scc[u, w]
         # = #edges u→w.  Operand dtype follows the centralized CircuitArrays
@@ -197,7 +218,7 @@ class TpuFrontierBackend:
             stacked = jnp.zeros((2 * K, n), dtype=arrays.dtype).at[:, scc_idx].set(
                 jnp.concatenate([blk_D, union], axis=0).astype(arrays.dtype)
             )
-            out = fixpoint(arrays, stacked)[:, scc_idx]
+            out = batch_fixpoint(stacked)[:, scc_idx]
             f1, f2 = out[:K], out[K:]
 
             d_has_q = live & (f1.sum(-1, dtype=jnp.int32) > 0)
@@ -268,13 +289,37 @@ class TpuFrontierBackend:
                 & (top <= C - 2 * K)  # overflow guard: host spills
             )
 
-        @jax.jit
-        def run_chunk(T, D, top):
+        def chunk_fn(T, D, top):
             flags = jnp.zeros((flag_cap, s), dtype=jnp.int8)
             carry = (T, D, top, flags, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            if self.mesh is not None:
+                # Seed every carry leaf's manual-axis varyingness from the
+                # device rank (numerically a no-op): the loop body produces
+                # varying values (all_gather output feeds the scatters), and
+                # a replicated init would trip the while_loop carry-type
+                # check under shard_map (cf. kernels.fixpoint, sweep.py).
+                rank = lax.axis_index(self.mesh.axis_names[0])
+                carry = tuple(
+                    leaf + rank.astype(leaf.dtype) * 0 for leaf in carry
+                )
             return lax.while_loop(cond, lambda c: expand(*c), carry)
 
-        return run_chunk
+        if self.mesh is not None:
+            from quorum_intersection_tpu.parallel.mesh import P, shard_map
+
+            # Everything replicates in and out; the sharding happens inside
+            # batch_fixpoint.  Control flow is identical on every device, so
+            # the collective inside the loop always aligns.  check_vma=False:
+            # the rank-seeded carries are varying-marked but numerically
+            # replicated (deterministic identical computation per device), a
+            # fact the static checker cannot infer through the while_loop.
+            return jax.jit(shard_map(
+                chunk_fn, mesh=self.mesh,
+                in_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P(), P(), P()),
+                check_vma=False,
+            ))
+        return jax.jit(chunk_fn)
 
     # ---- main entry ------------------------------------------------------
 
@@ -304,7 +349,24 @@ class TpuFrontierBackend:
                 if j is not None:
                     a_scc[scc_pos[u], j] += 1
 
-        run_chunk = self._build_chunk(circuit, scc, a_scc, half)
+        K = self.pop
+        if self.mesh is not None:
+            # The double-height fixpoint batch must split evenly across the
+            # mesh: round the pop block up to a device-count multiple —
+            # but never above arena//4, or the overflow-spill compaction's
+            # `keep = top - C//2` could go negative (the device loop exits
+            # at top > C - 2K, which must stay >= C//2).
+            n_dev = int(self.mesh.devices.size)
+            if self.arena < 4 * n_dev:
+                raise ValueError(
+                    f"arena={self.arena} too small for a {n_dev}-device mesh "
+                    f"(needs >= {4 * n_dev})"
+                )
+            K = min(
+                ((K + n_dev - 1) // n_dev) * n_dev,
+                (self.arena // 4 // n_dev) * n_dev,
+            )
+        run_chunk = self._build_chunk(circuit, scc, a_scc, half, K)
 
         stats = {
             "backend": self.name,
@@ -317,7 +379,9 @@ class TpuFrontierBackend:
             "spills": 0,
         }
 
-        C, K = self.arena, self.pop
+        C = self.arena  # K fixed above (mesh-rounded) — the host overflow
+        # guard and the device loop's exit must use the same value or the
+        # two can disagree and livelock.
         T = np.zeros((C, s), dtype=np.int8)
         D = np.zeros((C, s), dtype=np.int8)
 

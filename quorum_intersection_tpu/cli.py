@@ -96,7 +96,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(open with TensorBoard/XProf)")
     p.add_argument("--mesh", metavar="N", default=None,
                    help="shard the device search across N devices ('all' = every "
-                        "visible device); applies to auto/tpu/tpu-sweep/tpu-hybrid")
+                        "visible device); applies to auto/tpu/tpu-sweep/tpu-hybrid/"
+                        "tpu-frontier")
     p.add_argument("--blocking-set", action="store_true",
                    help="liveness-resilience mode: print a minimal blocking set of "
                         "the quorum-bearing SCC (node failures that halt consensus) "
@@ -292,8 +293,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             else SweepCheckpoint(args.checkpoint)
         )
     if args.mesh is not None:
-        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid"):
-            sys.stderr.write("--mesh requires a device backend (auto/tpu/tpu-sweep/tpu-hybrid)\n")
+        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid",
+                                "tpu-frontier"):
+            sys.stderr.write(
+                "--mesh requires a device backend "
+                "(auto/tpu/tpu-sweep/tpu-hybrid/tpu-frontier)\n")
             return 1
         try:
             n_dev = None if args.mesh == "all" else int(args.mesh)

@@ -9,7 +9,11 @@ from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 from quorum_intersection_tpu.fbas.graph import build_graph
 from quorum_intersection_tpu.fbas.schema import parse_fbas
 from quorum_intersection_tpu.fbas.semantics import is_quorum
-from quorum_intersection_tpu.fbas.synth import majority_fbas, random_fbas
+from quorum_intersection_tpu.fbas.synth import (
+    hierarchical_fbas,
+    majority_fbas,
+    random_fbas,
+)
 from quorum_intersection_tpu.parallel.mesh import candidate_mesh
 from quorum_intersection_tpu.pipeline import solve
 
@@ -207,6 +211,56 @@ def test_mesh_sweep_ramp_jump(monkeypatch):
     assert res.intersects is True
     assert res.stats["steady_level"] > 1
     assert res.stats["candidates_checked"] >= res.stats["enumeration_total"]
+
+
+@needs_8_devices
+def test_frontier_mesh_count_parity():
+    # The mesh-sharded frontier must enumerate EXACTLY the oracle's set of
+    # minimal quorums (count parity = completeness through the sharded
+    # fixpoint + all_gather path), and find witnesses on broken networks.
+    from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+
+    mesh = candidate_mesh(8)
+    po = solve(hierarchical_fbas(4, 3), backend=PythonOracleBackend())
+    fr = solve(
+        hierarchical_fbas(4, 3),
+        backend=TpuFrontierBackend(arena=4096, pop=250, mesh=mesh),
+    )
+    assert fr.intersects is True
+    assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"] > 0
+
+    br = solve(
+        majority_fbas(12, broken=True),
+        backend=TpuFrontierBackend(arena=4096, pop=256, mesh=mesh),
+    )
+    assert br.intersects is False
+    assert br.q1 and br.q2 and not set(br.q1) & set(br.q2)
+
+
+@needs_8_devices
+def test_frontier_mesh_nondividing_device_count():
+    # A device count that does not divide arena//4 must clamp the rounded
+    # pop block so the overflow-spill compaction can never go negative
+    # (regression: 3-device mesh, pop=512, arena=2048 crashed mid-spill),
+    # and the flag capacity must follow the EFFECTIVE block size.
+    from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+
+    mesh = candidate_mesh(3)
+    po = solve(hierarchical_fbas(4, 3), backend=PythonOracleBackend())
+    fr = solve(
+        hierarchical_fbas(4, 3),
+        backend=TpuFrontierBackend(arena=2048, pop=512, mesh=mesh),
+    )
+    assert fr.intersects is True
+    assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+
+    with pytest.raises(ValueError, match="too small"):
+        solve(
+            majority_fbas(9),
+            backend=TpuFrontierBackend(arena=8, pop=4, mesh=mesh),
+        )
 
 
 @needs_8_devices
