@@ -305,19 +305,19 @@ class TpuFrontierBackend:
             return lax.while_loop(cond, lambda c: expand(*c), carry)
 
         if self.mesh is not None:
-            from quorum_intersection_tpu.parallel.mesh import P, shard_map
+            from quorum_intersection_tpu.parallel.mesh import P, shard_map_unchecked
 
             # Everything replicates in and out; the sharding happens inside
             # batch_fixpoint.  Control flow is identical on every device, so
-            # the collective inside the loop always aligns.  check_vma=False:
-            # the rank-seeded carries are varying-marked but numerically
-            # replicated (deterministic identical computation per device), a
-            # fact the static checker cannot infer through the while_loop.
-            return jax.jit(shard_map(
-                chunk_fn, mesh=self.mesh,
+            # the collective inside the loop always aligns.  The replication
+            # check is disabled: the rank-seeded carries are varying-marked
+            # but numerically replicated (deterministic identical
+            # computation per device), a fact the static checker cannot
+            # infer through the while_loop.
+            return jax.jit(shard_map_unchecked(
+                chunk_fn, self.mesh,
                 in_specs=(P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P(), P(), P()),
-                check_vma=False,
             ))
         return jax.jit(chunk_fn)
 
@@ -433,9 +433,28 @@ class TpuFrontierBackend:
         else:
             top = seed_states([(list(scc), [])])
 
-        T_dev = jnp.asarray(T)
-        D_dev = jnp.asarray(D)
-        top_dev = jnp.int32(top)
+        if self.mesh is not None:
+            # Replicated GLOBAL arrays: on a multi-host mesh, plain
+            # jnp.asarray builds host-local arrays that a shard_map over the
+            # global mesh rejects; an explicit replicated device_put is
+            # correct on both single- and multi-host meshes (every process
+            # computes identical values, so replication is consistent).
+            import jax
+            from jax.sharding import NamedSharding
+
+            from quorum_intersection_tpu.parallel.mesh import P
+
+            _sharding = NamedSharding(self.mesh, P())
+
+            def to_dev(x):
+                return jax.device_put(jnp.asarray(x), _sharding)
+        else:
+            def to_dev(x):
+                return jnp.asarray(x)
+
+        T_dev = to_dev(T)
+        D_dev = to_dev(D)
+        top_dev = to_dev(jnp.int32(top))
         witness: Optional[Tuple[List[int], List[int]]] = None
         last_ckpt = time.monotonic()
 
@@ -483,7 +502,7 @@ class TpuFrontierBackend:
                 T_h[:keep] = T_h[C // 2: top_h]
                 D_h[:keep] = D_h[C // 2: top_h]
                 T_dev, D_dev, top_dev = (
-                    jnp.asarray(T_h), jnp.asarray(D_h), jnp.int32(keep)
+                    to_dev(T_h), to_dev(D_h), to_dev(jnp.int32(keep))
                 )
                 top_h = keep
                 stats["spills"] += 1
@@ -499,7 +518,7 @@ class TpuFrontierBackend:
                 T_h[: len(live)] = T_blk[live]
                 D_h[: len(live)] = D_blk[live]
                 T_dev, D_dev, top_dev = (
-                    jnp.asarray(T_h), jnp.asarray(D_h), jnp.int32(len(live))
+                    to_dev(T_h), to_dev(D_h), to_dev(jnp.int32(len(live)))
                 )
                 top_h = len(live)
 

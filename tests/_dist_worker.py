@@ -52,6 +52,22 @@ def main() -> int:
             "q2": res.q2,
             "candidates_checked": res.stats.get("candidates_checked"),
         }
+
+    # Device-resident frontier across the SAME two-process mesh: its
+    # all_gather runs INSIDE the device while_loop, so iteration counts
+    # must align across processes (they do: identical replicated inputs).
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+
+    fr = solve(
+        hierarchical_fbas(4, 3),
+        backend=TpuFrontierBackend(arena=1024, pop=8 * mesh.devices.size, mesh=mesh),
+    )
+    out["frontier"] = {
+        "intersects": fr.intersects,
+        "minimal_quorums": fr.stats.get("minimal_quorums"),
+        "states_popped": fr.stats.get("states_popped"),
+    }
     print(json.dumps(out), flush=True)
     return 0
 

@@ -83,3 +83,10 @@ def test_verdict_parity_with_single_process(two_process_results):
     assert not set(r0["broken"]["q1"]) & set(r0["broken"]["q2"])
     # The sharded run must have counted the full enumeration on the safe net.
     assert r0["safe"]["candidates_checked"] >= 1 << 10
+
+    # Frontier across the two-process mesh: identical on both processes,
+    # correct verdict, and the exact oracle minimal-quorum count (108 for
+    # hier-4x3) — completeness through the cross-process all_gather path.
+    assert r0["frontier"] == two_process_results[1]["frontier"]
+    assert r0["frontier"]["intersects"] is True
+    assert r0["frontier"]["minimal_quorums"] == 108

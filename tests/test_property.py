@@ -105,3 +105,25 @@ def test_raising_a_threshold_never_breaks_a_safe_majority(n, bump, victim):
     q["threshold"] = min(q["threshold"] + bump, n)
     res = solve(data, backend="python")
     assert res.intersects is True
+
+
+@settings(max_examples=15, **COMMON)
+@given(params=fbas_params)
+def test_oracle_and_frontier_agree_with_count_parity(params):
+    # The device-resident frontier must match the oracle's verdict on
+    # hypothesis-searched instances AND, on safe single-SCC verdicts, its
+    # confirmed-minimal-quorum count (enumeration completeness — a frontier
+    # that drops states could still luck into the right verdict).
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+
+    data = random_fbas(**params)
+    oracle = solve(data, backend="python")
+    frontier = solve(data, backend=TpuFrontierBackend(arena=2048, pop=128))
+    assert oracle.intersects is frontier.intersects
+    if oracle.intersects and oracle.stats.get("reason") != "scc_guard":
+        assert (
+            frontier.stats["minimal_quorums"] == oracle.stats["minimal_quorums"]
+        )
+    if not frontier.intersects and frontier.q1 is not None:
+        assert frontier.q2 is not None
+        assert not set(frontier.q1) & set(frontier.q2)
