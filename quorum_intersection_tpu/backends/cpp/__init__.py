@@ -343,27 +343,14 @@ def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]
     snapshots where N interpreted-Python fixpoints dominate the solve
     (VERDICT r1 §weak-7).  Returns one (possibly empty) quorum per SCC, in
     the same member order as the Python scan."""
-    lib = _load()
-    flat = FlatGraph(graph)
+    nmq = NativeMaxQuorum(graph)
     avail = np.zeros(graph.n, dtype=np.uint8)
-    out = np.zeros(graph.n, dtype=np.int32)
     quorums: List[List[int]] = []
     for members in sccs:
         arr = np.asarray(members, dtype=np.int32)
         avail[arr] = 1
-        qlen = lib.qi_max_quorum(
-            flat.n,
-            flat._ptr(flat.roots),
-            flat._ptr(flat.units),
-            flat._ptr(flat.mem),
-            flat._ptr(flat.inner),
-            arr.ctypes.data_as(_i32p),
-            len(members),
-            avail.ctypes.data_as(_u8p),
-            out.ctypes.data_as(_i32p),
-        )
+        quorums.append(nmq(arr, avail))
         avail[arr] = 0
-        quorums.append(out[:qlen].tolist())
     return quorums
 
 

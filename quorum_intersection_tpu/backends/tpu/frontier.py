@@ -25,8 +25,11 @@ Division of labor (verdict-equivalent to the serial oracle):
   (sound: the oracle prunes descent there either way, cpp:281-291).  The
   host re-checks each flagged set with the exact reference semantics —
   minimality (cpp:179-201) and the disjointness probe (cpp:357-384, Q6
-  availability) — so every witness that leaves this backend went through
-  `fbas/semantics.py`, the same code path the oracles trust.  Flagged
+  availability) — through a pinned host engine: the native
+  ``qi_max_quorum`` (the C++ oracle's own fixpoint, parity-tested against
+  the Python spec) when the library builds, else `fbas/semantics.py`
+  directly.  Either way no witness leaves this backend on device results
+  alone.  Flagged
   states are rare by construction: on symmetric-majority networks the
   half-size prune fires first and ZERO states flag; on hierarchical
   networks ~0.5 % of states flag (measured, crossover_tpu_r3.txt stats).
@@ -414,7 +417,10 @@ class TpuFrontierBackend:
                 (self.arena // 4 // n_dev) * n_dev,
             )
         run_chunk = self._build_chunk(circuit, scc, a_scc, half, K)
-        host_check = self._make_host_checker(graph, scc, scope_to_scc)
+        # Built lazily on the first flagged batch: majority-style searches
+        # flag nothing, and the native engine behind the checker may pay a
+        # one-off g++ compile that a pure device run should never wait on.
+        host_check = None
 
         stats = {
             "backend": self.name,
@@ -505,12 +511,22 @@ class TpuFrontierBackend:
         top_dev = to_dev(jnp.int32(top))
         witness: Optional[Tuple[List[int], List[int]]] = None
         last_ckpt = time.monotonic()
+        first_chunk_s = 0.0
+        chunk_s = 0.0  # steady-state chunks, unrounded until loop exit
 
         while witness is None:
+            t_chunk = time.perf_counter()
             T_dev, D_dev, top_dev, flags, fcount, iters, popped = run_chunk(
                 T_dev, D_dev, top_dev
             )
-            fcount_h = int(fcount)
+            fcount_h = int(fcount)  # sync point: chunk fully drained here
+            if stats["device_chunks"] == 0:
+                # First call traces + compiles; keeping it separate makes
+                # the on-chip ledger interpretable (compile through the
+                # tunnel is seconds and high-variance).
+                first_chunk_s = time.perf_counter() - t_chunk
+            else:
+                chunk_s += time.perf_counter() - t_chunk
             top_h = int(top_dev)
             stats["device_chunks"] += 1
             stats["device_iters"] += int(iters)
@@ -524,6 +540,8 @@ class TpuFrontierBackend:
             )
 
             if fcount_h:
+                if host_check is None:
+                    host_check = self._make_host_checker(graph, scc, scope_to_scc)
                 flags_h = np.asarray(flags[:fcount_h])
                 for row in flags_h:
                     members = [scc[i] for i in np.nonzero(row)[0]]
@@ -587,6 +605,8 @@ class TpuFrontierBackend:
                     last_ckpt = time.monotonic()
 
         stats["seconds"] = time.perf_counter() - t0
+        stats["first_chunk_seconds"] = round(first_chunk_s, 3)
+        stats["chunk_seconds"] = round(chunk_s, 3)
         if self.checkpoint is not None:
             self.checkpoint.clear()
         if witness is not None:

@@ -159,7 +159,8 @@ class TestCli:
 
 
 class TestHostChecker:
-    def test_native_and_python_checkers_agree(self):
+    @pytest.mark.parametrize("scope_to_scc", [False, True])
+    def test_native_and_python_checkers_agree(self, scope_to_scc):
         # The flagged-set host check has two engines (native qi_max_quorum /
         # Python semantics); they must return identical (minimal, witness)
         # on realistic flagged sets: every subset the hier search flags plus
@@ -175,17 +176,19 @@ class TestHostChecker:
         scc = max(group_sccs(graph.n, comp, count), key=len)
         backend = TpuFrontierBackend()
         try:
-            native = backend._make_host_checker(graph, scc, False)
             from quorum_intersection_tpu.backends.cpp import NativeMaxQuorum
 
             NativeMaxQuorum(graph)  # skip cleanly when g++ unavailable
         except Exception:
             pytest.skip("native library unavailable")
+        native = backend._make_host_checker(graph, scc, scope_to_scc)
         import itertools
 
         for r in (2, 3, 4, 5):
             for members in itertools.islice(itertools.combinations(scc, r), 40):
                 got = native(list(members))
-                want = backend._host_witness_check(graph, scc, list(members), False)
+                want = backend._host_witness_check(
+                    graph, scc, list(members), scope_to_scc
+                )
                 assert got[0] == want[0], members
                 assert (got[1] is None) == (want[1] is None), members
