@@ -192,3 +192,43 @@ class TestHostChecker:
                 )
                 assert got[0] == want[0], members
                 assert (got[1] is None) == (want[1] is None), members
+
+
+class TestDeterminism:
+    def test_repeated_runs_identical_witness(self):
+        # Deterministic branch choice (lowest-index argmax) + FIFO-ordered
+        # flag processing ⇒ byte-identical witnesses run to run.
+        a = solve(majority_fbas(12, broken=True),
+                  backend=TpuFrontierBackend(arena=2048, pop=128))
+        b = solve(majority_fbas(12, broken=True),
+                  backend=TpuFrontierBackend(arena=2048, pop=128))
+        assert a.intersects is b.intersects is False
+        assert a.q1 == b.q1 and a.q2 == b.q2
+
+
+class TestResumeSpill:
+    def test_resume_frontier_larger_than_arena(self, tmp_path):
+        # A checkpoint written under a BIG arena can hold more states than
+        # the resuming backend's arena//2; the excess must re-feed through
+        # the host spill in blocks, with count parity intact.
+        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+        ck = HybridCheckpoint(tmp_path / "f.ckpt")
+        with pytest.raises(FrontierSearchInterrupted):
+            solve(
+                hierarchical_fbas(4, 3),
+                backend=TpuFrontierBackend(
+                    arena=4096, pop=128, chunk_iters=4, checkpoint=ck,
+                    interrupt_after_chunks=2,
+                ),
+            )
+        resumed = solve(
+            hierarchical_fbas(4, 3),
+            backend=TpuFrontierBackend(arena=64, pop=16, checkpoint=ck),
+        )
+        assert resumed.intersects is True
+        assert resumed.stats.get("resumed_states", 0) > 0
+        # Full-search count parity would need the pre-interrupt quorums too;
+        # the strong invariant here is completion + no crash through the
+        # block-spill resume path and a clean final checkpoint.
+        assert not ck.path.exists()
