@@ -40,13 +40,17 @@ log = get_logger("backends.auto")
 
 # Exhaustive-sweep cutoffs by platform: the sweep is exact and fastest while
 # 2^(|scc|-1) stays cheap.  Measured:
-# - v5e chip: ~0.5-1G cand/s → 2^32 ≈ a few seconds ⇒ limit 33;
+# - v5e chip (r3, benchmarks/results/bench_full_r3_onchip.json): 626M
+#   cand/s END-TO-END on the 2^33 wide sweep (steady 1.2-2.1G on device) —
+#   2^34 ≈ 27 s at that measured rate, ~60 s under the variance-halved
+#   SWEEP_RATE below: either way an acceptable exact fallback when the
+#   oracle has already burned a comparably-sized budget ⇒ limit 35;
 # - CPU emulation: ~0.45M cand/s (bench.py throughput phase) while the
 #   native oracle runs ~0.7 µs/B&B-call (benchmarks/hybrid_crossover.py:
 #   majority-18 = 185k calls = 0.13 s) — the oracle beats an exhaustive
 #   2^(n-1) sweep at every measured size, so on CPU the sweep is only kept
 #   where its worst case is sub-second: 2^17/0.45M ≈ 0.3 s ⇒ limit 18.
-SWEEP_LIMIT_TPU = 33
+SWEEP_LIMIT_TPU = 35
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 
@@ -57,11 +61,12 @@ DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 #   0.13 s); pure Python ≈ 30 µs/call (BASELINE.md: n=16 → 48.6k calls,
 #   1.1 s);
 # - sweep ≈ fixed overhead (device probe + compile) + 2^(|scc|-1)/rate;
-#   rates from BENCH_r02.json (end-to-end 96.5M cand/s on the chip, ~0.5M/s
-#   CPU emulation) — deliberately conservative so the budget errs toward
-#   giving the oracle MORE room, never less than MIN_ORACLE_BUDGET.
+#   accel rate = half the measured r3 end-to-end 626M cand/s
+#   (bench_full_r3_onchip.json wide sweep; halved for tunnel variance),
+#   CPU ~0.5M/s emulated — deliberately conservative so the budget errs
+#   toward giving the oracle MORE room, never less than MIN_ORACLE_BUDGET.
 ORACLE_SECONDS_PER_CALL = {"cpp": 0.7e-6, "python": 3e-5}
-SWEEP_RATE = {"cpu": 5e5, "accel": 9e7}
+SWEEP_RATE = {"cpu": 5e5, "accel": 3e8}
 SWEEP_OVERHEAD_S = {"cpu": 1.0, "accel": 5.0}
 MIN_ORACLE_BUDGET = 50_000
 
