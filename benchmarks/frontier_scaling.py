@@ -1,0 +1,82 @@
+"""Frontier pop-block scaling study (device-resident B&B).
+
+Sweeps the ``pop`` block size on fixed workloads and reports states/s with
+the compile/steady time split (`first_chunk_seconds` vs `chunk_seconds`),
+plus the native-oracle reference time.  The interesting knob on a real
+chip: larger pops amortize per-iteration loop overhead but need a wide
+frontier to fill (the tree only doubles per iteration), so states/s rises
+then flattens.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/frontier_scaling.py --quick  # smoke
+    python benchmarks/frontier_scaling.py                            # chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+
+    from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    device = jax.devices()[0].device_kind
+    print(f"device: {device}\n")
+
+    workloads = (
+        [("majority-14", majority_fbas(14))] if args.quick
+        else [("majority-18", majority_fbas(18)), ("hier-6x4", hierarchical_fbas(6, 4))]
+    )
+    pops = [256, 1024] if args.quick else [512, 2048, 8192]
+
+    print("| workload | pop | native (s) | frontier (s) | states/s | states | iters | first-chunk (s) | steady (s) |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for name, data in workloads:
+        t0 = time.perf_counter()
+        cpp_res = solve(data, backend=CppOracleBackend())
+        cpp_s = time.perf_counter() - t0
+        for pop in pops:
+            t0 = time.perf_counter()
+            res = solve(data, backend=TpuFrontierBackend(pop=pop))
+            fr_s = time.perf_counter() - t0
+            ok = res.intersects == cpp_res.intersects
+            st = res.stats
+            rate = st["states_popped"] / fr_s if fr_s > 0 else 0
+            flag = "" if ok else " **INVALID**"
+            print(
+                f"| {name} | {pop} | {cpp_s:.3f} | {fr_s:.3f}{flag} | "
+                f"{rate:,.0f} | {st['states_popped']} | {st['device_iters']} | "
+                f"{st.get('first_chunk_seconds')} | {st.get('chunk_seconds')} |"
+            )
+            print(json.dumps({
+                "workload": name, "pop": pop, "device": device,
+                "cpp_seconds": round(cpp_s, 4),
+                "frontier_seconds": round(fr_s, 4),
+                "states_per_sec": round(rate, 1), "verdict_ok": ok,
+                "stats": {k: v for k, v in st.items() if k != "backend"},
+            }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
