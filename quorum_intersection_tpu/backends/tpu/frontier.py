@@ -330,12 +330,14 @@ class TpuFrontierBackend:
 
             return T, D, new_top, flags, fcount, iters + 1, popped + k
 
+        chunk_iters, flag_exit = self.chunk_iters, self.flag_exit
+
         def cond(carry):
             T, D, top, flags, fcount, iters, popped = carry
             return (
                 (top > 0)
-                & (iters < self.chunk_iters)
-                & (fcount < self.flag_exit)
+                & (iters < chunk_iters)
+                & (fcount < flag_exit)
                 & (top <= C - 2 * K)  # overflow guard: host spills
             )
 
@@ -458,34 +460,28 @@ class TpuFrontierBackend:
 
         spill: List[Tuple[np.ndarray, np.ndarray]] = []  # host stack of blocks
 
-        def seed_states(pairs) -> int:
-            rows = 0
-            for to_remove, dont_remove in pairs:
+        def encode_states(pairs) -> Tuple[np.ndarray, np.ndarray]:
+            """(toRemove, dontRemove) node-list pairs → int8 bitmask blocks."""
+            t_blk = np.zeros((len(pairs), s), dtype=np.int8)
+            d_blk = np.zeros((len(pairs), s), dtype=np.int8)
+            for r, (to_remove, dont_remove) in enumerate(pairs):
                 for v in to_remove:
-                    T[rows, scc_pos[v]] = 1
+                    t_blk[r, scc_pos[v]] = 1
                 for v in dont_remove:
-                    D[rows, scc_pos[v]] = 1
-                rows += 1
-            return rows
+                    d_blk[r, scc_pos[v]] = 1
+            return t_blk, d_blk
 
+        seed = resumed[: C // 2] if resumed else [(list(scc), [])]
+        t_blk, d_blk = encode_states(seed)
+        top = len(seed)
+        T[:top], D[:top] = t_blk, d_blk
         if resumed:
             stats["resumed_states"] = len(resumed)
-            top = seed_states(resumed[: C // 2])
             # Excess resumed states go to the host spill in C//2-row blocks
             # (same granularity as overflow spills), so draining them later
             # is one chunk per block, not one per state.
             for i in range(C // 2, len(resumed), C // 2):
-                block = resumed[i: i + C // 2]
-                t_blk = np.zeros((len(block), s), dtype=np.int8)
-                d_blk = np.zeros((len(block), s), dtype=np.int8)
-                for r, (to_remove, dont_remove) in enumerate(block):
-                    for v in to_remove:
-                        t_blk[r, scc_pos[v]] = 1
-                    for v in dont_remove:
-                        d_blk[r, scc_pos[v]] = 1
-                spill.append((t_blk, d_blk))
-        else:
-            top = seed_states([(list(scc), [])])
+                spill.append(encode_states(resumed[i: i + C // 2]))
 
         if self.mesh is not None:
             # Replicated GLOBAL arrays: on a multi-host mesh, plain
@@ -628,7 +624,9 @@ class TpuFrontierBackend:
                     [scc[i] for i in np.nonzero(d_row)[0]],
                 ])
 
-        add_block(np.asarray(T_dev)[:top], np.asarray(D_dev)[:top])
+        # Slice on device BEFORE the transfer: the arena is ~16 MB while the
+        # live stack is usually a few rows, and this runs every few seconds.
+        add_block(np.asarray(T_dev[:top]), np.asarray(D_dev[:top]))
         for T_blk, D_blk in spill:
             add_block(T_blk, D_blk)
         self.checkpoint.record(states, fingerprint)
