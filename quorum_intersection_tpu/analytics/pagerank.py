@@ -201,9 +201,17 @@ def pagerank(
 
 # Product-path engine selection: on the CPU platform the NumPy loop wins
 # below this vertex count (no compile latency, sub-ms iterations); above it
-# the compiled sparse matvec amortizes its ~1 s compile.  On an accelerator
-# platform the JAX path is always chosen — that is the point of it.
+# the compiled sparse matvec amortizes its ~1 s compile.  Accelerator
+# platforms route by the edge floor below instead.
 JAX_CPU_LIMIT = 1024
+# Accelerator crossover, measured on the r3 chip
+# (benchmarks/results/bench_full_r3_onchip.json): the fully-on-device power
+# loop still pays ~one dispatch round-trip (77 ms warm) while the NumPy
+# re-model finishes the 2,971-node / 14.4k-edge dump fixture in 3 ms — the
+# device wins only once the host iteration cost clears the dispatch floor.
+# Extrapolating the measured NumPy rate (~5 µs per k-edges per iteration
+# set), that is ~50k+ edges.
+ACCEL_MIN_EDGES = 50_000
 
 
 def pagerank_auto(
@@ -212,14 +220,22 @@ def pagerank_auto(
     convergence: float = 0.0001,
     max_iterations: int = 100000,
 ) -> Tuple[np.ndarray, str]:
-    """Platform/size-aware selection for the product path (CLI, bench):
-    the device power iteration (:func:`pagerank`) on accelerator platforms
-    or large graphs, the NumPy re-model otherwise; device failures degrade
-    to NumPy so ``--pagerank`` always yields output.  Returns
-    ``(ranks, engine)`` with engine in {"jax", "numpy"}."""
+    """Latency-aware engine selection for the product path (CLI, bench).
+
+    Routes by measured time-to-result, not platform pride: on accelerators
+    the device power iteration wins only above ``ACCEL_MIN_EDGES`` (below
+    it the dispatch round-trip alone exceeds the whole NumPy solve); on the
+    CPU platform the vectorized XLA loop wins above ``JAX_CPU_LIMIT``
+    nodes.  Device failures degrade to NumPy so ``--pagerank`` always
+    yields output.  Returns ``(ranks, engine)``, engine in {"jax", "numpy"}."""
     from quorum_intersection_tpu.utils.platform import is_cpu_platform
 
-    if not is_cpu_platform() or graph.n > JAX_CPU_LIMIT:
+    use_jax = (
+        graph.n > JAX_CPU_LIMIT
+        if is_cpu_platform()
+        else graph.n_edges >= ACCEL_MIN_EDGES
+    )
+    if use_jax:
         try:
             return pagerank(graph, m, convergence, max_iterations), "jax"
         except Exception as exc:  # noqa: BLE001 — no jax / device init failure
