@@ -67,7 +67,7 @@ from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.hybrid")
 
-DEFAULT_BATCH = None  # platform-adaptive: see _auto_batch
+DEFAULT_BATCH = None  # platform-adaptive: BATCH_TPU / BATCH_CPU at check time
 # A real chip amortizes its fixed per-program dispatch cost best with big
 # row blocks (the sweep's measured lesson, sweep.py module docs); the CPU
 # emulation's per-row cost dominates instead, so smaller blocks keep

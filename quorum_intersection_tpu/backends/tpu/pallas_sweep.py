@@ -8,7 +8,9 @@ per-block early exit (each block stops when *its* rows converge, instead of
 the XLA path's whole-batch convergence), and each block writes back exactly
 one int32 (its min hit index).
 
-Measured on v5e (2026-07, properly pipelined with ≥16 programs in flight):
+Measured on v5e (2026-07, properly pipelined with ≥16 programs in flight;
+r3 re-measured the XLA path's steady rate at 1.57-2.08G cand/s on the same
+31-node circuit — bench_full_r3_onchip.json — widening this gap further):
 the XLA path is **faster** — ~1.1G cand/s vs ~0.3G on a 31-node circuit
 (Mosaic's per-grid-step overhead dominates at small widths and it does not
 pipeline blocks across the grid the way XLA overlaps its fused loop), and
