@@ -38,6 +38,9 @@ import sys
 import time
 
 HEADLINE_METRIC = "candidate_quorums_checked_per_sec_per_chip"
+# Children shorter than this can't even finish jax import + handshake;
+# module-level so tests can shrink it to exercise timeout paths quickly.
+MIN_CHILD_TIMEOUT = 20.0
 
 # Captured before the parent pins itself to CPU: device children must see
 # the AMBIENT platform config (the image exports the axon TPU platform),
@@ -253,6 +256,10 @@ def phase_hybrid(quick: bool) -> dict:
             # crashing the phase — a perf number for a wrong answer is
             # worthless, but the evidence of the divergence is not.
             out["hybrid_verdicts_ok"] = False
+        # Incremental emit: if a later row hangs past the phase timeout
+        # (e.g. a pathological device compile), the parent salvages the
+        # rows already completed instead of losing the whole phase.
+        print(json.dumps(out), flush=True)
     return out
 
 
@@ -403,14 +410,17 @@ class Deadline:
 
 
 def run_child(phase: str, deadline: Deadline, timeout: float,
-              extra_args: list | None = None, platform: str | None = None) -> dict:
+              extra_args: list | None = None, platform: str | None = None,
+              salvage: bool = False) -> dict:
     """Run one device phase in a subprocess with a hard kill timeout.
 
     Returns the child's JSON result, or ``{"error": ...}`` on timeout /
     crash / unparseable output — the parent never blocks on a hung tunnel.
+    ``salvage=True`` (phases that emit incrementally): on timeout, the last
+    parseable stdout line is returned with a ``partial_error`` marker.
     """
     timeout = min(timeout, max(deadline.remaining() - 15.0, 0.0))
-    if timeout < 20.0:
+    if timeout < MIN_CHILD_TIMEOUT:
         return {"error": "skipped: budget exhausted"}
     env = dict(os.environ)
     if platform is not None:
@@ -424,16 +434,39 @@ def run_child(phase: str, deadline: Deadline, timeout: float,
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
     )
+    def last_parseable(text):
+        """Scan stdout BACKWARDS for the last complete JSON line (a stray
+        library print or a SIGKILL mid-write can corrupt the literal last
+        line without invalidating the rows before it)."""
+        for ln in reversed([x for x in (text or "").strip().splitlines() if x.strip()]):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    def degraded(reason):
+        """Salvage: phases that emit incrementally (hybrid) leave their last
+        completed state on stdout — partial evidence beats none.  The
+        `partial_error` key lets the caller mark the phase degraded while
+        still merging the data."""
+        if salvage:
+            salvaged = last_parseable(out)
+            if salvaged is not None:
+                salvaged["partial_error"] = reason
+                return salvaged
+        return {"error": reason}
+
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         proc.kill()  # SIGKILL: the hang is inside native tunnel code
-        proc.communicate()
-        return {"error": f"timeout after {timeout:.0f}s"}
+        out, _ = proc.communicate()
+        return degraded(f"timeout after {timeout:.0f}s")
     lines = [ln for ln in (out or "").strip().splitlines() if ln.strip()]
     if proc.returncode != 0 or not lines:
         tail = (err or "").strip().splitlines()[-3:]
-        return {"error": f"exit {proc.returncode}: {' | '.join(tail) or 'no output'}"}
+        return degraded(f"exit {proc.returncode}: {' | '.join(tail) or 'no output'}")
     try:
         return json.loads(lines[-1])
     except json.JSONDecodeError:
@@ -592,13 +625,17 @@ def orchestrate(args) -> int:
 
     # 8. Hybrid vs native oracle on pruned-search workloads (on-chip
     # crossover evidence; VERDICT r2 §next-1).
-    hy = run_child("hybrid", deadline, tmo["hybrid"], quick_flag, platform)
+    hy = run_child("hybrid", deadline, tmo["hybrid"], quick_flag, platform,
+                   salvage=True)
     if "error" in hy:
         phases["hybrid"] = hy["error"]
     else:
         # Per-row verdict agreement gates the phase status: a perf number
-        # for a wrong answer must not read as a healthy benchmark.
-        phases["hybrid"] = "ok" if hy.get("hybrid_verdicts_ok", True) else "verdict-mismatch"
+        # for a wrong answer must not read as a healthy benchmark.  A
+        # salvaged partial phase reports which timeout truncated it.
+        status = "ok" if hy.get("hybrid_verdicts_ok", True) else "verdict-mismatch"
+        partial = hy.pop("partial_error", None)
+        phases["hybrid"] = f"partial({status}): {partial}" if partial else status
         headline.update(hy)
     emit(headline)
     return 0

@@ -47,3 +47,56 @@ def test_final_line_has_real_number_and_parity(quick_run):
     assert d["parity"].endswith("6/6 vendored")
     assert d["baseline_value"] > 0
     assert d["phases"].get("throughput") == "ok"
+
+
+def test_timeout_salvage_keeps_partial_phase_output(monkeypatch):
+    # A phase child that emits incrementally (the hybrid/frontier rows) and
+    # then hangs past its timeout must leave its completed rows on the
+    # record with a partial_error marker; a crash after emitting rows is
+    # salvaged the same way (with a trailing corrupt line skipped); strict
+    # phases keep the plain error contract.
+    import subprocess
+    import sys
+    import textwrap
+
+    import bench
+
+    class FakeDeadline:
+        def remaining(self):
+            return 1e9
+
+    monkeypatch.setattr(bench, "MIN_CHILD_TIMEOUT", 0.5)
+    real_popen = subprocess.Popen
+
+    def fake_child(script):
+        def fake_popen(cmd, **kw):
+            return real_popen([sys.executable, "-c", script], **kw)
+        return fake_popen
+
+    hang = textwrap.dedent(
+        """
+        import json, time
+        print(json.dumps({"hybrid_row1": 1}), flush=True)
+        time.sleep(600)
+        """
+    )
+    monkeypatch.setattr(subprocess, "Popen", fake_child(hang))
+    res = bench.run_child("hybrid", FakeDeadline(), 3.0, salvage=True)
+    assert res.get("hybrid_row1") == 1
+    assert "partial_error" in res and "error" not in res
+    strict = bench.run_child("sweep", FakeDeadline(), 3.0)
+    assert strict == {"error": "timeout after 3s"}
+
+    crash = textwrap.dedent(
+        """
+        import json, sys
+        print(json.dumps({"hybrid_row1": 2}), flush=True)
+        sys.stdout.write("{corrupt trailing line")
+        sys.stdout.flush()
+        sys.exit(11)
+        """
+    )
+    monkeypatch.setattr(subprocess, "Popen", fake_child(crash))
+    res = bench.run_child("hybrid", FakeDeadline(), 3.0, salvage=True)
+    assert res.get("hybrid_row1") == 2  # reverse scan skipped the corrupt tail
+    assert res["partial_error"].startswith("exit 11")
