@@ -92,12 +92,16 @@ class _SearchState:
     """
 
     __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls", "trace",
-                 "budget_calls", "budget_exceeded")
+                 "budget_calls", "budget_exceeded", "best_node_fallback")
 
     def __init__(self, budget_calls: int = 0) -> None:
         self.bnb_calls = 0
         self.minimal_quorums = 0
         self.fixpoint_calls = 0
+        # Times the cpp:221 bestNode initialization fallback fired with a node
+        # already in dontRemove (PARITY.md D15) — the one branch where the
+        # frontier's enumeration legitimately diverges from this oracle's.
+        self.best_node_fallback = 0
         self.trace = log.isEnabledFor(logging.DEBUG)
         # 0 = unlimited; otherwise the search aborts (budget_exceeded) once
         # bnb_calls passes the budget — see base.OracleBudgetExceeded.
@@ -190,6 +194,11 @@ def iterate_minimal_quorums(
     best = find_best_node(quorum, dont_remove, graph, rng)
 
     remaining = quorum_set - set(dont_remove)
+    if best not in remaining:
+        # Only the cpp:221 fallback can pick a dontRemove member (the normal
+        # argmax is over quorum ∖ restriction) — record it so differential
+        # tests can tell D15 divergence apart from a frontier bug.
+        state.best_node_fallback += 1
     if not remaining:
         return False
 
@@ -307,6 +316,7 @@ class PythonOracleBackend:
                 "bnb_calls": state.bnb_calls,
                 "minimal_quorums": state.minimal_quorums,
                 "fixpoint_calls": state.fixpoint_calls,
+                "best_node_fallback": state.best_node_fallback,
                 "seconds": seconds,
             },
         )

@@ -104,6 +104,11 @@ class TpuFrontierBackend:
         interrupt_after_chunks: Optional[int] = None,
         mesh=None,
     ) -> None:
+        if arena < 4:
+            # Mirrors the mesh-path validation in check_scc: pop is clamped to
+            # arena//4, and a zero pop block makes the chunk loop spin forever
+            # (each chunk pops nothing) instead of failing.
+            raise ValueError(f"arena={arena} too small (needs >= 4)")
         self.arena = arena
         self.pop = min(pop, arena // 4)
         self.flag_exit = flag_exit

@@ -91,6 +91,14 @@ class TestArenaSpill:
         assert fr.intersects is False
         assert fr.q1 and fr.q2 and not set(fr.q1) & set(fr.q2)
 
+    def test_degenerate_arena_rejected(self):
+        # arena < 4 would clamp pop to 0 and spin the chunk loop forever;
+        # the constructor must reject it like the mesh path rejects
+        # arena < 4 * n_dev.
+        for arena in (-8, 0, 1, 3):
+            with pytest.raises(ValueError):
+                TpuFrontierBackend(arena=arena, pop=16)
+
 
 class TestCheckpoint:
     def _ck(self, tmp_path):

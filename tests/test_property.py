@@ -120,7 +120,15 @@ def test_oracle_and_frontier_agree_with_count_parity(params):
     oracle = solve(data, backend="python")
     frontier = solve(data, backend=TpuFrontierBackend(arena=2048, pop=128))
     assert oracle.intersects is frontier.intersects
-    if oracle.intersects and oracle.stats.get("reason") != "scc_guard":
+    if (
+        oracle.intersects
+        and oracle.stats.get("reason") != "scc_guard"
+        # PARITY.md D15: when the oracle's cpp:221 bestNode fallback fires it
+        # branches on a dontRemove member (duplicating it), while the frontier
+        # uses an always-eligible branch variable — counts may then differ
+        # legitimately, so only assert parity on fallback-free searches.
+        and oracle.stats.get("best_node_fallback", 0) == 0
+    ):
         assert (
             frontier.stats["minimal_quorums"] == oracle.stats["minimal_quorums"]
         )
