@@ -21,6 +21,15 @@ with a parseable result even if a later phase dies or the driver window
 closes early.  `--budget-seconds` bounds total wall-clock; phases that no
 longer fit are skipped and recorded in `phases`.
 
+Tunnel flakes are survivable in BOTH directions: the initial probe retries
+with backoff, a mid-run fallback re-probes before the remaining device
+phases (recovering onto the chip re-runs the headline throughput there),
+and every device phase stamps the hardware it actually ran on in
+`phase_devices` — "chip-unavailable" is distinguishable from "regressed"
+per phase, not per run.  Beyond throughput/sweep, the record carries the
+north-star `verdict_256`/`verdict_1024` time-to-verdict comparisons
+(BASELINE.json configs) and a `sweep_mfu_pct` roofline estimate.
+
 Usage::
 
     python bench.py                     # full run (driver mode, real chip)
@@ -65,14 +74,54 @@ CPU_FALLBACK = dict(n_orgs=4, per_org=4, batch=4096, steps=4, chunks=8,
 # Per-phase hard timeouts, seconds (full / quick).  First device contact
 # includes jax import (~15 s) + tunnel handshake + first compile (20-40 s).
 TIMEOUTS = {
-    "probe": (240, 120),
+    "probe": (90, 120),  # per ATTEMPT in full mode — see PROBE_RETRY_WAITS
     "throughput": (600, 240),
     "sweep": (420, 240),
     "sweep_wide": (420, 0),
+    "verdict": (700, 240),
     "snapshot": (360, 240),
     "pagerank": (240, 120),
     "hybrid": (420, 180),
 }
+
+# Tunnel-flake posture (VERDICT r3 §weak-1: one bad handshake at t=0 must not
+# downgrade the whole artifact).  The tunnel is known to flake AND recover
+# within a bench window, so: (a) the initial probe retries with backoff —
+# short attempts beat one long one because a down tunnel HANGS rather than
+# errors; (b) after a fallback, cheap re-probes before the remaining device
+# phases switch back to the chip the moment it returns, re-running the
+# headline throughput phase on it.
+PROBE_RETRY_WAITS = (40.0, 80.0)     # sleep before attempts 2, 3 (full mode)
+PROBE_RESERVE_S = 600.0              # keep this much budget for CPU fallback
+RECOVERY_PROBE_TIMEOUT = 60.0
+RECOVERY_MIN_REMAINING = 300.0
+
+# North-star verdict configs (BASELINE.json configs[3..4]): end-to-end
+# time-to-verdict through `auto` vs the single-core native oracle on the
+# same instance.  The k-of-n core is the quorum-bearing sink SCC; the
+# native baseline's full cost at these core sizes is hours, so it is
+# measured as (instance-measured call rate) × (call-count model) with the
+# measured floor alongside — see phase_verdict.
+VERDICT_CONFIGS = {
+    "256": dict(n_total=256, core=34, nested=False),
+    "1024": dict(n_total=1024, core=33, nested=True),
+}
+VERDICT_CONFIGS_QUICK = {
+    "256": dict(n_total=64, core=14, nested=False),
+    "1024": dict(n_total=96, core=16, nested=True),
+}
+NATIVE_CAP_S = {"full": 120.0, "quick": 20.0}
+# B&B call-count model for a symmetric k-of-n core: ≈ 3.8 × C(n, n//2)
+# (BASELINE.md measured table, n = 8..20: 251, 3 431, 48 619, 705 431 —
+# the 3.8 multiplier is stable across the fit range; beyond n=20 this is
+# an extrapolation of that verified trend and is labeled as such).
+NATIVE_CALLS_MODEL = "3.8*C(n,n//2) (BASELINE.md n=8..20)"
+
+# int8 MXU peak MACs/s by device kind substring — the sweep kernel's
+# operands are int8 on TPU (kernels.CircuitArrays), so the roofline basis
+# is the int8 TOPS figure (1 MAC = 2 ops): v5e/v5 lite ≈ 394 TOPS int8.
+# Kinds not listed (e.g. v5p) get no MFU line rather than a wrong one.
+INT8_PEAK_MACS = {"v5 lite": 1.97e14, "v5e": 1.97e14}
 
 
 # --------------------------------------------------------------------------
@@ -186,6 +235,178 @@ def phase_sweep(n_nodes: int) -> dict:
                 "ramp_profile"):
         if key in res.stats:
             out[f"sweep_{key}"] = res.stats[key]
+    import jax
+
+    out["sweep_device"] = jax.devices()[0].device_kind
+    try:
+        out.update(_sweep_roofline(n_nodes, out.get("sweep_steady_rate")))
+    except Exception as exc:  # noqa: BLE001 — roofline is diagnostics, never fatal
+        out["sweep_mfu_error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def _sweep_roofline(n_nodes: int, steady_rate) -> dict:
+    """Utilization calibration (VERDICT r3 §weak-5): relate the steady sweep
+    rate to the MXU's int8 peak.
+
+    MACs/candidate = (trips_Q + trips_D) × per-iteration matmul cost, where
+    the trip counts are MEASURED (kernels.fixpoint_iters) on random subsets
+    of the same circuit — representative of the enumeration, since the
+    fixpoint's convergence depends on the subset's density, not its index —
+    and the per-iteration cost is node_sat's n·U direct-vote matmul plus
+    depth·U² child propagation when inner sets exist.  `sweep_mfu_pct`
+    answers "is the kernel or the pipeline the next lever": single-digit %
+    ⇒ kernel headroom remains; tens of % ⇒ only pipeline work is left.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quorum_intersection_tpu.backends.tpu.kernels import (
+        CircuitArrays, fixpoint_iters,
+    )
+    from quorum_intersection_tpu.encode.circuit import encode_circuit
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+    circuit = encode_circuit(build_graph(parse_fbas(majority_fbas(n_nodes))))
+    arrays = CircuitArrays(circuit)
+    n, U = arrays.n, arrays.n_units
+
+    @jax.jit
+    def sample(key):
+        masks = jax.random.bernoulli(key, 0.5, (2048, n)).astype(arrays.dtype)
+        q, tq = fixpoint_iters(arrays, masks)
+        comp = jnp.clip(1 - q, 0, 1).astype(arrays.dtype)
+        _, td = fixpoint_iters(arrays, comp)
+        return tq, td
+
+    trips = [sample(jax.random.PRNGKey(i)) for i in range(4)]
+    tq = float(np.mean([int(t[0]) for t in trips]))
+    td = float(np.mean([int(t[1]) for t in trips]))
+    per_iter = n * U + (arrays.depth * U * U if arrays.has_inner else 0)
+    macs = (tq + td) * per_iter
+    out = {
+        "sweep_fixpoint_trips": [round(tq, 2), round(td, 2)],
+        "sweep_macs_per_candidate": round(macs, 1),
+    }
+    kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in INT8_PEAK_MACS.items() if k in kind.lower()), None)
+    if peak and steady_rate and arrays.dtype == jnp.int8:
+        out["sweep_mfu_pct"] = round(steady_rate * macs / peak * 100, 3)
+        out["sweep_mfu_peak"] = f"{kind} int8 {peak / 1e12:.0f}T MACs/s"
+    return out
+
+
+def phase_verdict(config: str, quick: bool) -> dict:
+    """North-star end-to-end time-to-verdict (VERDICT r3 §missing-3):
+    BASELINE.json configs[3..4] through whatever engine `auto` picks, vs the
+    single-core native oracle on the SAME instance.
+
+    The native baseline at full core sizes costs hours, so it is reported
+    three ways, each honestly labeled: `native_seconds` (measured, a FLOOR
+    when `native_completed` is false), `native_rate` (B&B calls/s measured
+    on this instance), and `native_est_seconds` (rate × the
+    NATIVE_CALLS_MODEL count — an extrapolation of the BASELINE.md-verified
+    trend).  `ratio_est` uses the estimate; `ratio_floor` uses only
+    measured time."""
+    from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    shape = (VERDICT_CONFIGS_QUICK if quick else VERDICT_CONFIGS)[config]
+    data = benchmark_fbas(
+        shape["n_total"], shape["core"], nested_watchers=shape["nested"]
+    )
+
+    import jax
+
+    out = {
+        "nodes": shape["n_total"],
+        "core": shape["core"],
+        "nested": shape["nested"],
+        "device": jax.devices()[0].device_kind,
+    }
+
+    t0 = time.perf_counter()
+    res = solve(data, backend="auto")
+    auto_s = time.perf_counter() - t0
+    out.update({
+        "auto_seconds": round(auto_s, 2),
+        "auto_backend": res.stats.get("backend", "scc-guard"),
+        "verdict_ok": res.intersects is True,
+    })
+    print(json.dumps(out), flush=True)  # salvage point: auto half done
+
+    out.update(_native_verdict_baseline(
+        data, shape["core"], NATIVE_CAP_S["quick" if quick else "full"]
+    ))
+    if out.get("native_seconds") is not None and auto_s > 0:
+        out["ratio_floor"] = round(out["native_seconds"] / auto_s, 2)
+        if out.get("native_completed"):
+            out["ratio"] = out["ratio_floor"]
+    if out.get("native_est_seconds") and auto_s > 0:
+        out["ratio_est"] = round(out["native_est_seconds"] / auto_s, 1)
+    return out
+
+
+def _native_verdict_baseline(data, core: int, cap_s: float) -> dict:
+    """Single-core native-oracle cost on the instance's quorum-bearing SCC:
+    measure the call rate with a budgeted probe run, finish the search if
+    the model says it fits in ``cap_s``, else report the measured floor plus
+    the model estimate."""
+    import math
+
+    from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+    from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.pipeline import scan_scc_quorums
+
+    graph = build_graph(parse_fbas(data))
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    sccs = group_sccs(graph.n, comp, count)
+    scc = next(
+        s for s, q in zip(sccs, scan_scc_quorums(graph, sccs)) if q
+    )
+    expected_calls = 3.8 * math.comb(core, core // 2)
+
+    try:  # native oracle, degrading to pure Python like every other consumer
+        from quorum_intersection_tpu.backends.cpp import CppOracleBackend as Oracle
+
+        Oracle(budget_calls=1).ensure_built()
+        engine = "cpp"
+    except Exception:  # noqa: BLE001 — no g++ etc.
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend as Oracle,
+        )
+
+        engine = "python"
+
+    def run(budget_calls: int):
+        backend = Oracle(budget_calls=budget_calls)
+        t0 = time.perf_counter()
+        try:
+            res = backend.check_scc(graph, None, scc)
+            return time.perf_counter() - t0, res.stats["bnb_calls"], True
+        except OracleBudgetExceeded:
+            return time.perf_counter() - t0, budget_calls, False
+
+    seconds, calls, completed = run(2_000_000)
+    rate = calls / seconds if seconds > 0 else 0.0
+    if not completed and rate > 0 and expected_calls / rate <= cap_s:
+        seconds, calls, completed = run(int(rate * cap_s * 2))
+        rate = calls / seconds if seconds > 0 else rate
+    out = {
+        "native_engine": engine,
+        "native_seconds": round(seconds, 4),
+        "native_calls": int(calls),
+        "native_rate": round(rate, 1),
+        "native_completed": completed,
+    }
+    if not completed and rate > 0:
+        out["native_est_calls"] = int(expected_calls)
+        out["native_est_seconds"] = round(expected_calls / rate, 1)
+        out["native_est_model"] = NATIVE_CALLS_MODEL
     return out
 
 
@@ -200,10 +421,14 @@ def phase_snapshot(quick: bool) -> dict:
     res = solve(data, backend="auto")
     seconds = time.perf_counter() - t0
     assert res.intersects is True
+
+    import jax
+
     return {
         "snapshot_nodes": len(data),
         "snapshot_verdict_seconds": round(seconds, 3),
         "snapshot_backend": res.stats.get("backend", "scc-guard"),
+        "snapshot_device": jax.devices()[0].device_kind,
     }
 
 
@@ -499,8 +724,20 @@ def orchestrate(args) -> int:
         "vs_baseline": 0,
         "device": "unknown",
         "phases": {},
+        # Per-phase device stamps (VERDICT r3 §next-2): which hardware each
+        # device phase ACTUALLY ran on, so a mid-run tunnel flake downgrades
+        # one phase's stamp, not the whole artifact's credibility.
+        "phase_devices": {},
     }
     phases = headline["phases"]
+    phase_devices = headline["phase_devices"]
+
+    def stamp(phase: str, result: dict, key: str) -> None:
+        if "error" in result:
+            kind = "chip-unavailable" if "timeout" in result["error"] else "failed"
+            phase_devices[phase] = f"{kind}: {result['error'][:80]}"
+        else:
+            phase_devices[phase] = result.get(key, "?")
 
     # 1. Verdict parity on the host oracle (fast, CPU-only, no tunnel risk).
     gate = parity_gate()
@@ -510,58 +747,96 @@ def orchestrate(args) -> int:
         return 0  # a parseable failure beats a silent one
     phases["parity"] = "ok"
 
-    # 2. Single-core baseline (host; needed for vs_baseline).
-    base = cpu_baseline(shapes["n_orgs"], shapes["per_org"], shapes["samples"])
-    headline.update({k: round(v, 1) if isinstance(v, float) else v
-                     for k, v in base.items()})
+    # 2. Single-core baseline (host; needed for vs_baseline).  Stashed so a
+    # later chip recovery can restore it without re-measuring.
+    full_baseline = {
+        k: round(v, 1) if isinstance(v, float) else v
+        for k, v in cpu_baseline(shapes["n_orgs"], shapes["per_org"],
+                                 shapes["samples"]).items()
+    }
+    headline.update(full_baseline)
     phases["baseline"] = "ok"
     emit(headline)  # first safety line: parity + baseline, value still 0
 
-    # 3. Device liveness probe under a hard timeout (the tunnel can hang).
-    probe = run_child("probe", deadline, tmo["probe"])
+    # 3. Device liveness probe — bounded retry with backoff (the tunnel
+    # hangs when down but is known to recover within a bench window; short
+    # attempts spread over time beat one long one).
+    attempts: list = []
+    probe = {"error": "not attempted"}
+    max_attempts = 1 if args.quick else 1 + len(PROBE_RETRY_WAITS)
+    for i in range(max_attempts):
+        if i > 0:
+            wait = PROBE_RETRY_WAITS[i - 1]
+            if deadline.remaining() < PROBE_RESERVE_S + wait:
+                attempts.append("retry-skipped: budget")
+                break
+            time.sleep(wait)
+        probe = run_child("probe", deadline, tmo["probe"])
+        if "error" not in probe:
+            break
+        attempts.append(probe["error"])
     fallback = "error" in probe
-    if fallback:
-        phases["probe"] = probe["error"]
+
+    def to_cpu_shapes() -> None:
         shapes.update({k: v for k, v in CPU_FALLBACK.items()
                        if k in ("n_orgs", "per_org", "batch", "steps",
                                 "chunks", "sweep_nodes")})
-        headline["device"] = "cpu-fallback"
-        # The baseline was measured on the FULL workload; per-candidate cost
-        # scales with graph size, so re-measure on the fallback shapes or
-        # vs_baseline would be inflated by orders of magnitude.
+        shapes.pop("wide_sweep_nodes", None)
+
+    def remeasure_baseline() -> None:
+        # The baseline must match the active workload shapes; per-candidate
+        # cost scales with graph size, so a stale baseline would inflate
+        # vs_baseline by orders of magnitude.
         base = cpu_baseline(shapes["n_orgs"], shapes["per_org"], shapes["samples"])
         headline.update({k: round(v, 1) if isinstance(v, float) else v
                          for k, v in base.items()})
+
+    if fallback:
+        tunnel_down = all("timeout" in a for a in attempts if not a.startswith("retry"))
+        phases["probe"] = (
+            f"chip-unavailable (tunnel): {'; '.join(attempts)}" if tunnel_down
+            else "; ".join(attempts)
+        )
+        to_cpu_shapes()
+        headline["device"] = "cpu-fallback"
+        remeasure_baseline()
     else:
-        phases["probe"] = "ok"
+        phases["probe"] = "ok" if not attempts else (
+            f"ok after {len(attempts) + 1} attempts ({'; '.join(attempts)})"
+        )
         headline["device"] = probe.get("device", "unknown")
+    stamp("probe", probe, "device")
     platform = "cpu" if fallback else None
 
+    def try_recover(stage: str) -> bool:
+        """After a fallback: cheap re-probe before a remaining device phase;
+        on success the rest of the run moves back to the chip (full device
+        shapes restored) and the recovery point is on the record."""
+        nonlocal fallback, platform
+        if not fallback or args.quick:
+            return False
+        if deadline.remaining() < RECOVERY_MIN_REMAINING:
+            return False
+        r = run_child("probe", deadline, RECOVERY_PROBE_TIMEOUT)
+        if "error" in r:
+            phases["probe"] += f"; re-probe at {stage}: down"
+            return False
+        fallback, platform = False, None
+        shapes.update({k: FULL[k] for k in ("n_orgs", "per_org", "batch",
+                                            "steps", "chunks", "sweep_nodes",
+                                            "wide_sweep_nodes")})
+        phases["probe"] += f"; recovered at {stage}"
+        phase_devices["probe"] = r.get("device", "?")
+        return True
+
     # 4. Throughput — the headline value.
-    tp_args = ["--n-orgs", str(shapes["n_orgs"]), "--per-org", str(shapes["per_org"]),
-               "--batch", str(shapes["batch"]), "--steps", str(shapes["steps"]),
-               "--chunks", str(shapes["chunks"])]
-    tp = run_child("throughput", deadline, tmo["throughput"], tp_args, platform)
-    if "error" in tp and not fallback:
-        # Tunnel died after a healthy probe: fall back to CPU for the rest.
-        phases["throughput"] = tp["error"]
-        fallback, platform = True, "cpu"
-        headline["device"] = "cpu-fallback"
-        shapes.update({k: v for k, v in CPU_FALLBACK.items()
-                       if k in ("n_orgs", "per_org", "batch", "steps",
-                                "chunks", "sweep_nodes")})
+    def run_throughput():
         tp_args = ["--n-orgs", str(shapes["n_orgs"]), "--per-org", str(shapes["per_org"]),
                    "--batch", str(shapes["batch"]), "--steps", str(shapes["steps"]),
                    "--chunks", str(shapes["chunks"])]
-        tp = run_child("throughput", deadline, tmo["throughput"], tp_args, platform)
-        # Baseline workload changed with the fallback shapes: re-measure.
-        base = cpu_baseline(shapes["n_orgs"], shapes["per_org"], shapes["samples"])
-        headline.update({k: round(v, 1) if isinstance(v, float) else v
-                         for k, v in base.items()})
-    if "error" in tp:
-        phases["throughput"] = tp["error"]
-        emit(headline)
-    else:
+        return run_child("throughput", deadline, tmo["throughput"], tp_args, platform)
+
+    def merge_throughput(tp: dict) -> None:
         phases["throughput"] = "ok"
         rate = tp["rate"]
         base_rate = headline.get("baseline_value") or 0
@@ -575,9 +850,41 @@ def orchestrate(args) -> int:
         })
         if fallback:
             headline["device"] = "cpu-fallback"
-        emit(headline)  # the headline number is now safe on the record
 
-    # 5. Exhaustive-sweep time-to-verdict.
+    tp = run_throughput()
+    if "error" in tp and not fallback:
+        # Tunnel died after a healthy probe: fall back to CPU for the rest
+        # (recovery re-probes below may switch back).
+        phases["throughput"] = tp["error"]
+        fallback, platform = True, "cpu"
+        headline["device"] = "cpu-fallback"
+        to_cpu_shapes()
+        tp = run_throughput()
+        remeasure_baseline()
+    if "error" in tp:
+        phases["throughput"] = tp["error"]
+    else:
+        merge_throughput(tp)
+    stamp("throughput", tp, "device")
+    emit(headline)  # the headline number is now safe on the record
+
+    # 5. Exhaustive-sweep time-to-verdict.  If the run fell back earlier,
+    # a cheap re-probe here moves it back on-chip the moment the tunnel
+    # returns — and re-runs the headline throughput phase there.  The
+    # baseline/value swap happens only AFTER the re-run succeeds: if the
+    # tunnel dies again mid-re-run, the CPU-fallback numbers (value,
+    # vs_baseline, baseline_value, shapes, platform) all stay consistent.
+    if try_recover("sweep"):
+        tp = run_throughput()
+        if "error" in tp:
+            fallback, platform = True, "cpu"
+            to_cpu_shapes()
+            phases["probe"] += "; recovery lost at throughput re-run"
+        else:
+            headline.update(full_baseline)  # stashed step-2 full-shape rates
+            merge_throughput(tp)
+            stamp("throughput", tp, "device")
+        emit(headline)
     sweep = run_child("sweep", deadline, tmo["sweep"],
                       ["--sweep-nodes", str(shapes["sweep_nodes"])], platform)
     if "error" in sweep:
@@ -585,6 +892,7 @@ def orchestrate(args) -> int:
     else:
         phases["sweep"] = "ok"
         headline.update(sweep)
+    stamp("sweep", sweep, "sweep_device")
     emit(headline)
 
     # 5b. Wide sweep (2^(wide_sweep_nodes-1) candidates): large enough that
@@ -602,16 +910,37 @@ def orchestrate(args) -> int:
         else:
             phases["sweep_wide"] = "ok"
             headline.update({f"wide_{k}": v for k, v in wide.items()})
+        stamp("sweep_wide", wide, "sweep_device")
+        emit(headline)
+
+    # 5c. North-star verdict benchmarks (BASELINE.json configs[3..4]):
+    # end-to-end time-to-verdict through `auto` vs the single-core native
+    # oracle, one child per config (incremental salvage: the auto half
+    # emits before the native baseline starts).
+    quick_flag = ["--quick"] if (args.quick or fallback) else []
+    for cfg in ("256", "1024"):
+        key = f"verdict_{cfg}"
+        vd = run_child("verdict", deadline, tmo["verdict"],
+                       ["--verdict-config", cfg] + quick_flag, platform,
+                       salvage=True)
+        if "error" in vd:
+            phases[key] = vd["error"]
+        else:
+            partial = vd.pop("partial_error", None)
+            status = "ok" if vd.get("verdict_ok") else "verdict-mismatch"
+            phases[key] = f"partial({status}): {partial}" if partial else status
+            headline[key] = vd
+        stamp(key, vd, "device")
         emit(headline)
 
     # 6. Snapshot time-to-verdict (auto backend).
-    quick_flag = ["--quick"] if (args.quick or fallback) else []
     snap = run_child("snapshot", deadline, tmo["snapshot"], quick_flag, platform)
     if "error" in snap:
         phases["snapshot"] = snap["error"]
     else:
         phases["snapshot"] = "ok"
         headline.update(snap)
+    stamp("snapshot", snap, "snapshot_device")
     emit(headline)
 
     # 7. Device PageRank on a dump-scale graph (differential vs NumPy).
@@ -621,10 +950,14 @@ def orchestrate(args) -> int:
     else:
         phases["pagerank"] = "ok"
         headline.update(pr)
+    stamp("pagerank", pr, "pagerank_device")
     emit(headline)
 
     # 8. Hybrid vs native oracle on pruned-search workloads (on-chip
     # crossover evidence; VERDICT r2 §next-1).
+    if try_recover("hybrid"):
+        quick_flag = ["--quick"] if (args.quick or fallback) else []
+        emit(headline)
     hy = run_child("hybrid", deadline, tmo["hybrid"], quick_flag, platform,
                    salvage=True)
     if "error" in hy:
@@ -637,6 +970,7 @@ def orchestrate(args) -> int:
         partial = hy.pop("partial_error", None)
         phases["hybrid"] = f"partial({status}): {partial}" if partial else status
         headline.update(hy)
+    stamp("hybrid", hy, "hybrid_device")
     emit(headline)
     return 0
 
@@ -653,6 +987,8 @@ def child_main(args) -> int:
                                args.steps, args.chunks)
     elif args.phase == "sweep":
         out = phase_sweep(args.sweep_nodes)
+    elif args.phase == "verdict":
+        out = phase_verdict(args.verdict_config, args.quick)
     elif args.phase == "snapshot":
         out = phase_snapshot(args.quick)
     elif args.phase == "pagerank":
@@ -678,9 +1014,11 @@ def main() -> int:
     )
     # Internal: child-phase dispatch (run_child invokes bench.py --phase …).
     parser.add_argument("--phase",
-                        choices=("probe", "throughput", "sweep", "snapshot",
-                                 "pagerank", "hybrid"),
+                        choices=("probe", "throughput", "sweep", "verdict",
+                                 "snapshot", "pagerank", "hybrid"),
                         default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--verdict-config", choices=tuple(VERDICT_CONFIGS),
+                        default="256", help=argparse.SUPPRESS)
     parser.add_argument("--n-orgs", type=int, default=FULL["n_orgs"], help=argparse.SUPPRESS)
     parser.add_argument("--per-org", type=int, default=FULL["per_org"], help=argparse.SUPPRESS)
     parser.add_argument("--sweep-nodes", type=int, default=FULL["sweep_nodes"],

@@ -155,6 +155,36 @@ def fixpoint(
     return out
 
 
+def fixpoint_iters(
+    arrays: CircuitArrays,
+    avail: jnp.ndarray,
+    frozen: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`fixpoint` plus the executed while_loop trip count.
+
+    The trip count IS the batch's compute cost (every iteration re-evaluates
+    the whole batch until the slowest row stabilizes), which is what the
+    bench's roofline estimate needs: MACs/candidate = trips × per-iteration
+    matmul cost (node_sat: n·U direct votes + depth·U² child propagation).
+    Kept out of the hot sweep program — the counter is an extra carry."""
+    if frozen is None:
+        frozen_row = jnp.zeros((arrays.n,), dtype=arrays.dtype)
+    else:
+        frozen_row = arrays.cast(frozen)
+
+    def body(carry):
+        a, _, k = carry
+        total = jnp.maximum(a, frozen_row)
+        nxt = node_sat(arrays, total) * a
+        return nxt, jnp.any(nxt != a), k + 1
+
+    a0 = arrays.cast(avail)
+    out, _, trips = lax.while_loop(
+        lambda c: c[1], body, (a0, jnp.any(a0 == a0), jnp.int32(0))
+    )
+    return out, trips
+
+
 def make_batch_fixpoint(
     circuit: Circuit,
 ) -> Callable[[np.ndarray, Optional[np.ndarray]], np.ndarray]:

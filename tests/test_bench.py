@@ -49,6 +49,23 @@ def test_final_line_has_real_number_and_parity(quick_run):
     assert d["phases"].get("throughput") == "ok"
 
 
+def test_final_line_r4_fields(quick_run):
+    # r4 additions: per-phase device stamps, the north-star verdict
+    # comparisons, and the sweep roofline diagnostics.
+    d = json.loads(quick_run.stdout.strip().splitlines()[-1])
+    assert d["phase_devices"].get("throughput") == "cpu"
+    for key in ("verdict_256", "verdict_1024"):
+        assert d["phases"].get(key) == "ok"
+        vd = d[key]
+        assert vd["verdict_ok"] is True
+        assert vd["auto_seconds"] >= 0
+        assert vd["native_rate"] > 0
+        # Quick cores complete natively, so the ratio must be measured.
+        assert vd["native_completed"] is True and "ratio" in vd
+    assert d.get("sweep_fixpoint_trips"), "roofline trips missing"
+    assert d.get("sweep_macs_per_candidate", 0) > 0
+
+
 def test_timeout_salvage_keeps_partial_phase_output(monkeypatch):
     # A phase child that emits incrementally (the hybrid/frontier rows) and
     # then hangs past its timeout must leave its completed rows on the

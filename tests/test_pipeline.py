@@ -282,3 +282,48 @@ def test_scc_guard_no_quorum_anywhere_has_no_witness():
     assert res.intersects is False
     assert res.quorum_scc_ids == []
     assert res.q1 is None and res.q2 is None
+
+
+class TestBenchmarkFbas:
+    """The north-star verdict-benchmark generator (synth.benchmark_fbas,
+    BASELINE.json configs[3..4]): the k-of-n core must be the unique
+    quorum-bearing sink SCC and the one-knob broken twin must flip the
+    verdict — on the oracle AND the device sweep."""
+
+    def test_safe_and_broken_twins_differential(self):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+        from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+
+        data = benchmark_fbas(28, 9, seed=3)
+        broken = benchmark_fbas(28, 9, broken=True, seed=3)
+        for backend in ("python", TpuSweepBackend()):
+            assert solve(data, backend=backend).intersects is True
+            assert solve(broken, backend=backend).intersects is False
+
+    def test_nested_watchers_core_is_unique_quorum_scc(self):
+        from quorum_intersection_tpu.fbas.graph import build_graph
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+        from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+
+        data = benchmark_fbas(40, 11, nested_watchers=True, seed=1)
+        assert len(data) == 40
+        # At least one watcher actually carries an inner set (depth 2).
+        assert any(
+            n["quorumSet"] and n["quorumSet"]["innerQuorumSets"]
+            and not n["publicKey"].startswith("CORE")
+            for n in data
+        )
+        res = solve(data, backend="python")
+        assert res.intersects is True
+        assert len(res.quorum_scc_ids) == 1
+        assert len(res.main_scc) == 11
+        g = build_graph(parse_fbas(data))
+        core = {i for i in range(g.n) if g.node_ids[i].startswith("CORE")}
+        assert set(res.main_scc) == core
+
+    def test_degenerate_args_rejected(self):
+        from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+
+        for n_total, core in ((10, 2), (10, 11)):
+            with pytest.raises(ValueError):
+                benchmark_fbas(n_total, core)

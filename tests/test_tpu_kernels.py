@@ -126,3 +126,33 @@ def test_subset_masks_offset():
     masks = np.asarray(subset_masks(jnp.int32(2), 2, bit_nodes, 4))
     assert np.nonzero(masks[0])[0].tolist() == [1]  # index 2 = 0b10
     assert np.nonzero(masks[1])[0].tolist() == [0, 1]  # index 3
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        majority_fbas(8),
+        hierarchical_fbas(3, 3),
+        random_fbas(20, seed=7, nested_prob=0.4, null_prob=0.1),
+    ],
+    ids=["majority", "hierarchical", "random-nested"],
+)
+def test_fixpoint_iters_matches_fixpoint(data):
+    # The instrumented variant (bench roofline) must return the SAME
+    # fixpoint as the production kernel, plus a positive trip count that
+    # can only grow with a batch that converges slower.
+    import jax.numpy as jnp
+
+    from quorum_intersection_tpu.backends.tpu.kernels import fixpoint, fixpoint_iters
+
+    g, circuit = _circuit(data)
+    arrays = CircuitArrays(circuit)
+    rng = np.random.default_rng(5)
+    avail = _random_avail(rng, 16, g.n)
+    want = np.asarray(fixpoint(arrays, jnp.asarray(avail)))
+    got, trips = fixpoint_iters(arrays, jnp.asarray(avail))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(trips) >= 1
+    # An all-empty row is already stable: exactly one (no-change) sweep.
+    _, trips_empty = fixpoint_iters(arrays, jnp.zeros((1, g.n), jnp.float32))
+    assert int(trips_empty) == 1

@@ -1,0 +1,254 @@
+"""Reproducible differential soak harness (VERDICT r3 §next-4).
+
+One command re-runs — and EXTENDS — the cross-engine soak behind the
+"zero mismatches" claims (docs/ROUND3_NOTES.md): seed-controlled synthetic
+instances from every generator family (random / hierarchical / majority /
+stellar-like / benchmark), each solved by every engine that applies —
+
+- host oracles: ``python`` (reference semantics re-model) and ``cpp``
+  (native CSR oracle) — always;
+- device engines: ``tpu-frontier`` and ``tpu-hybrid`` — always;
+- ``tpu-sweep`` — when the largest SCC fits an exhaustive 2^(|scc|-1)
+  enumeration cheaply (≤ SWEEP_SCC_LIMIT).
+
+and cross-checked on:
+
+- **verdicts** (all engines must agree);
+- **witnesses** (every ``false`` verdict's (q1, q2) must be two disjoint
+  REAL quorums under the host set semantics — engines may legitimately
+  return *different* valid pairs);
+- **minimal-quorum counts** (enumeration completeness): cpp vs python
+  always (stats lockstep); frontier vs python unless the oracle's cpp:221
+  bestNode fallback fired (``best_node_fallback`` stat — PARITY.md D15:
+  the one branch where the enumerations legitimately diverge).
+
+Results append to a persistent ledger
+(``benchmarks/results/soak_ledger.json``) so the instance total grows
+round over round instead of resetting; re-running an already-recorded
+``(seed, instances)`` window is detected and skipped unless ``--force``.
+
+Usage::
+
+    python tools/soak.py                      # 40 instances from seed 0
+    python tools/soak.py --instances 100 --seed 1000
+    python tools/soak.py --no-ledger          # dry run, don't record
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, str(_REPO))
+
+LEDGER = _REPO / "benchmarks" / "results" / "soak_ledger.json"
+SWEEP_SCC_LIMIT = 15
+
+
+def make_instance(seed: int):
+    """Seed → (kind, description, node list).  The mix mirrors the
+    generator families the differential suite covers, with ~40% broken
+    twins so the witness path is exercised as hard as the safe path."""
+    from quorum_intersection_tpu.fbas import synth
+
+    rng = random.Random(seed)
+    kind = rng.choice(["random", "hierarchical", "majority", "stellar", "benchmark"])
+    broken = rng.random() < 0.4
+    if kind == "random":
+        n = rng.randint(6, 16)
+        data = synth.random_fbas(
+            n, seed=seed, nested_prob=rng.random() * 0.5,
+            null_prob=rng.random() * 0.2, dangling_prob=rng.random() * 0.2,
+        )
+        desc = f"random(n={n})"
+    elif kind == "hierarchical":
+        orgs, per = rng.randint(3, 4), rng.randint(2, 3)
+        data = synth.hierarchical_fbas(orgs, per, broken=broken)
+        desc = f"hier({orgs}x{per},broken={broken})"
+    elif kind == "majority":
+        n = rng.randint(5, 13)
+        data = synth.majority_fbas(n, broken=broken)
+        desc = f"majority(n={n},broken={broken})"
+    elif kind == "stellar":
+        orgs = rng.randint(3, 4)
+        data = synth.stellar_like_fbas(
+            n_core_orgs=orgs, per_org=3, n_watchers=rng.randint(8, 25),
+            n_null=rng.randint(0, 6), n_dangling=rng.randint(0, 3),
+            broken=broken, seed=seed,
+        )
+        desc = f"stellar(orgs={orgs},broken={broken})"
+    else:
+        core = rng.randint(7, 10)
+        n_total = core + rng.randint(8, 20)
+        data = synth.benchmark_fbas(
+            n_total, core, nested_watchers=rng.random() < 0.5,
+            broken=broken, seed=seed,
+        )
+        desc = f"benchmark(n={n_total},core={core},broken={broken})"
+    return kind, desc, data
+
+
+def witness_valid(graph, res) -> bool:
+    """A false verdict must ship two disjoint real quorums (host set
+    semantics) — except the no-quorum-anywhere guard case, which has none."""
+    from quorum_intersection_tpu.fbas.semantics import is_quorum
+
+    if res.q1 is None and res.q2 is None:
+        return res.stats.get("reason") == "scc_guard" and not res.quorum_scc_ids
+    return (
+        res.q1 is not None and res.q2 is not None
+        and not set(res.q1) & set(res.q2)
+        and is_quorum(graph, res.q1) and is_quorum(graph, res.q2)
+    )
+
+
+def run_instance(seed: int) -> dict:
+    """Solve one instance on every applicable engine; return the record
+    with any mismatches listed (empty list = clean)."""
+    from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    kind, desc, data = make_instance(seed)
+    graph = build_graph(parse_fbas(data))
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    max_scc = max(len(s) for s in group_sccs(graph.n, comp, count))
+
+    engines = {
+        "python": "python",
+        "cpp": CppOracleBackend(),
+        "frontier": TpuFrontierBackend(arena=2048, pop=128),
+        "hybrid": TpuHybridBackend(),
+    }
+    if max_scc <= SWEEP_SCC_LIMIT:
+        engines["sweep"] = TpuSweepBackend()
+
+    results, mismatches = {}, []
+    for name, backend in engines.items():
+        try:
+            results[name] = solve(data, backend=backend)
+        except Exception as exc:  # noqa: BLE001 — an engine crash IS a finding
+            mismatches.append(f"{name} crashed: {type(exc).__name__}: {exc}")
+    if "python" not in results:
+        return {"seed": seed, "kind": kind, "desc": desc,
+                "engines": list(results), "mismatches": mismatches}
+
+    oracle = results["python"]
+    for name, res in results.items():
+        if res.intersects is not oracle.intersects:
+            mismatches.append(
+                f"{name} verdict {res.intersects} != python {oracle.intersects}"
+            )
+        if not res.intersects and not witness_valid(graph, res):
+            mismatches.append(f"{name} witness invalid: q1={res.q1} q2={res.q2}")
+
+    # Enumeration-completeness count parity on safe single-SCC searches.
+    if oracle.intersects and oracle.stats.get("reason") != "scc_guard":
+        want = oracle.stats.get("minimal_quorums")
+        if "cpp" in results:
+            got = results["cpp"].stats.get("minimal_quorums")
+            if got != want:
+                mismatches.append(f"cpp minimal_quorums {got} != python {want}")
+        if "frontier" in results and oracle.stats.get("best_node_fallback", 0) == 0:
+            got = results["frontier"].stats.get("minimal_quorums")
+            if got != want:
+                mismatches.append(f"frontier minimal_quorums {got} != python {want}")
+
+    return {"seed": seed, "kind": kind, "desc": desc,
+            "engines": sorted(results), "max_scc": max_scc,
+            "mismatches": mismatches}
+
+
+def load_ledger() -> dict:
+    if LEDGER.exists():
+        return json.loads(LEDGER.read_text())
+    return {"totals": {"instances": 0, "mismatches": 0, "by_generator": {}},
+            "runs": []}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--instances", type=int, default=40)
+    parser.add_argument("--seed", type=int, default=0, help="first seed of the window")
+    parser.add_argument("--no-ledger", action="store_true",
+                        help="run without recording to the ledger")
+    parser.add_argument("--force", action="store_true",
+                        help="re-run a window the ledger already records")
+    parser.add_argument("--platform", choices=("cpu", "ambient"), default="cpu",
+                        help="cpu (default): pin jax to the host CPU so a dead "
+                             "tunnel can never hang the soak; ambient: use "
+                             "whatever JAX_PLATFORMS/the image selects (chip)")
+    args = parser.parse_args(argv)
+
+    # The differential contract is platform-independent, so the harness
+    # defaults to the host CPU — an explicit pin, because this image's
+    # ambient env exports JAX_PLATFORMS=axon and a soft default would lose.
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+        honor_platform_env()
+
+    ledger = load_ledger()
+    window = [args.seed, args.seed + args.instances]
+    if not args.force and not args.no_ledger:
+        for run in ledger["runs"]:
+            if run["window"] == window:
+                print(f"window {window} already recorded ({run['instances']} "
+                      f"instances, {run['n_mismatches']} mismatches); use "
+                      f"--force to re-run or pick a fresh --seed", file=sys.stderr)
+                return 0
+
+    t0 = time.time()
+    by_gen: dict = {}
+    bad: list = []
+    for i, seed in enumerate(range(*window)):
+        rec = run_instance(seed)
+        by_gen[rec["kind"]] = by_gen.get(rec["kind"], 0) + 1
+        if rec["mismatches"]:
+            bad.append(rec)
+            print(f"MISMATCH seed={seed} {rec['desc']}: {rec['mismatches']}")
+        if (i + 1) % 10 == 0:
+            print(f"  ... {i + 1}/{args.instances} "
+                  f"({time.time() - t0:.0f}s, {len(bad)} mismatches)",
+                  file=sys.stderr)
+
+    elapsed = time.time() - t0
+    summary = {
+        "window": window,
+        "instances": args.instances,
+        "n_mismatches": len(bad),
+        "mismatches": bad,
+        "by_generator": by_gen,
+        "seconds": round(elapsed, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", "ambient"),
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "mismatches"}))
+
+    if not args.no_ledger:
+        ledger["runs"].append(summary)
+        totals = ledger["totals"]
+        totals["instances"] += args.instances
+        totals["mismatches"] += len(bad)
+        for k, v in by_gen.items():
+            totals["by_generator"][k] = totals["by_generator"].get(k, 0) + v
+        LEDGER.parent.mkdir(parents=True, exist_ok=True)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: {totals['instances']} cumulative instances, "
+              f"{totals['mismatches']} mismatches -> {LEDGER}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
