@@ -54,19 +54,19 @@ SWEEP_LIMIT_TPU = 35
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 
-# Cost model for the oracle-first budget (measured this repo, on the
-# record):
-# - native oracle ≈ 0.7 µs/B&B call single-core
-#   (benchmarks/results/crossover_cpu_r2.txt: majority-18 = 185k calls in
-#   0.13 s); pure Python ≈ 30 µs/call (BASELINE.md: n=16 → 48.6k calls,
-#   1.1 s);
-# - sweep ≈ fixed overhead (device probe + compile) + 2^(|scc|-1)/rate;
-#   accel rate = half the measured r3 end-to-end 626M cand/s
-#   (bench_full_r3_onchip.json wide sweep; halved for tunnel variance),
-#   CPU ~0.5M/s emulated — deliberately conservative so the budget errs
-#   toward giving the oracle MORE room, never less than MIN_ORACLE_BUDGET.
-ORACLE_SECONDS_PER_CALL = {"cpp": 0.7e-6, "python": 3e-5}
-SWEEP_RATE = {"cpu": 5e5, "accel": 3e8}
+# Cost model for the oracle-first budget: DERIVED at import from the bench
+# artifacts committed in this repo (backends/calibration.py — VERDICT r3
+# §weak-3/§next-8: constants must track the hardware the suite last
+# measured).  Each value's source file is in CALIBRATION.provenance; the
+# r3 hand-measured constants remain the fallback when no artifact applies.
+# The safety factors (accel halved for tunnel variance, CPU steady rate
+# quartered for compile cost) live in the calibration module so the budget
+# still errs toward giving the oracle MORE room, never less than
+# MIN_ORACLE_BUDGET.
+from quorum_intersection_tpu.backends.calibration import CALIBRATION
+
+ORACLE_SECONDS_PER_CALL = CALIBRATION.oracle_seconds_per_call
+SWEEP_RATE = CALIBRATION.sweep_rate
 SWEEP_OVERHEAD_S = {"cpu": 1.0, "accel": 5.0}
 MIN_ORACLE_BUDGET = 50_000
 

@@ -1,0 +1,169 @@
+"""Auto-routing cost-model calibration from recorded bench artifacts.
+
+The `auto` backend's oracle-first budget needs two numbers: how fast the
+exhaustive sweep runs (per platform) and how fast a host oracle burns B&B
+calls.  Through r3 these were hand-pinned constants with the measurement
+cited in a comment (VERDICT r3 §weak-3: "will silently skew as kernels
+improve").  This module re-derives them at import time from the bench
+records actually committed in the repo — the driver's ``BENCH_r*.json``
+at the root and anything under ``benchmarks/results/`` — so the cost
+model tracks the hardware the suite last measured, with the r3 constants
+as fallback and every derived value carrying its source file name in
+``CALIBRATION.provenance``.
+
+Safety posture (unchanged from the hand-tuned constants):
+
+- the accelerator sweep rate is the best recorded END-TO-END wide-sweep
+  rate **halved** for tunnel variance — a conservative budget errs toward
+  giving the pruned oracle MORE room, never less;
+- the CPU sweep rate is the best recorded steady CPU rate **quartered**
+  (steady excludes compile, which a real solve pays);
+- derived values are clamped to sanity windows so one corrupt artifact
+  cannot wreck routing.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# r3 fallbacks (benchmarks/results/bench_full_r3_onchip.json wide sweep;
+# crossover_cpu_r2.txt majority-18; BASELINE.md n=16) — used whenever no
+# artifact yields a usable number.
+DEFAULT_SWEEP_RATE = {"cpu": 5e5, "accel": 3e8}
+DEFAULT_ORACLE_SPC = {"cpp": 0.7e-6, "python": 3e-5}
+
+# Sanity windows: a derived value outside these is ignored (artifact rot,
+# truncated tails, unit bugs) rather than trusted.
+_ACCEL_RATE_WINDOW = (1e7, 1e11)
+_CPU_RATE_WINDOW = (1e4, 1e8)
+_ORACLE_RATE_WINDOW = (1e4, 1e8)  # B&B calls/s
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _artifact_paths() -> List[pathlib.Path]:
+    out = sorted(_REPO.glob("BENCH_r*.json"))
+    results = _REPO / "benchmarks" / "results"
+    if results.is_dir():
+        out += sorted(results.glob("*.json"))
+    return out
+
+
+def _round_rank(name: str) -> int:
+    """Recency key: the largest integer embedded in the file name (the
+    round number in ``BENCH_r04.json`` / ``bench_full_r3_onchip.json``);
+    -1 when the name carries none."""
+    digits = [int(m) for m in re.findall(r"\d+", name)]
+    return max(digits) if digits else -1
+
+
+def _iter_records(paths: Iterable[pathlib.Path]):
+    """Yield (name, headline-record) pairs, tolerating the two artifact
+    shapes on disk: a bare headline dict, or the driver's wrapper with a
+    ``parsed`` record / raw ``tail`` text ending in the headline line."""
+    for path in paths:
+        try:
+            doc = json.loads(path.read_text())
+        except Exception:  # noqa: BLE001 — unreadable artifact: skip
+            continue
+        if not isinstance(doc, dict):
+            continue
+        rec = None
+        if isinstance(doc.get("parsed"), dict):
+            rec = doc["parsed"]
+        elif "metric" in doc or "sweep_steady_rate" in doc or "device" in doc:
+            rec = doc
+        elif isinstance(doc.get("tail"), str):
+            for ln in reversed(doc["tail"].strip().splitlines()):
+                try:
+                    cand = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(cand, dict):
+                    rec = cand
+                    break
+        if rec is not None:
+            yield path.name, rec
+
+
+def _is_tpu(rec: dict) -> bool:
+    return "tpu" in str(rec.get("device", "")).lower()
+
+
+def _in(window: Tuple[float, float], value) -> Optional[float]:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return v if window[0] <= v <= window[1] else None
+
+
+@dataclass
+class Calibration:
+    sweep_rate: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_SWEEP_RATE)
+    )
+    oracle_seconds_per_call: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_ORACLE_SPC)
+    )
+    # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
+    provenance: Dict[str, str] = field(default_factory=dict)
+
+
+def calibrate(paths: Optional[Iterable[pathlib.Path]] = None) -> Calibration:
+    cal = Calibration()
+    cal.provenance = {k: "default" for k in ("accel", "cpu", "cpp")}
+    chosen: Dict[str, Tuple[float, str]] = {}
+
+    try:
+        records = list(_iter_records(_artifact_paths() if paths is None else paths))
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        return cal
+
+    # The NEWEST round's measurement wins, not the fastest ever recorded:
+    # the contract is to track the hardware the suite LAST measured — a
+    # genuinely slower current chip/tunnel must lower the estimate, or the
+    # budget skews exactly the way hand-pinned constants did (stale-fast).
+    # Iterating in ascending round order with last-wins overwrites does that.
+    for name, rec in sorted(records, key=lambda nr: (_round_rank(nr[0]), nr[0])):
+        if _is_tpu(rec):
+            # End-to-end wide-sweep rate preferred (session costs amortized);
+            # the small-sweep end-to-end rate as a weaker substitute.
+            for fld in ("wide_sweep_device_cand_per_sec", "sweep_device_cand_per_sec"):
+                v = _in(_ACCEL_RATE_WINDOW, rec.get(fld))
+                if v is not None:
+                    chosen["accel"] = (v, f"{name}: {fld}={v:.4g}")
+                    break
+        else:
+            v = _in(_CPU_RATE_WINDOW, rec.get("sweep_steady_rate"))
+            if v is not None:
+                chosen["cpu"] = (v, f"{name}: sweep_steady_rate={v:.4g}")
+        # Native oracle call rate: the r4+ verdict phases measure it on the
+        # benchmark instance itself (bench.py _native_verdict_baseline).
+        # The engine must be EXPLICITLY cpp — a python-measured (or
+        # unlabeled) rate would shrink the cpp budget ~50x, violating the
+        # "more room for the oracle" posture.
+        for key in ("verdict_256", "verdict_1024"):
+            vd = rec.get(key)
+            if isinstance(vd, dict) and vd.get("native_engine") == "cpp":
+                v = _in(_ORACLE_RATE_WINDOW, vd.get("native_rate"))
+                if v is not None:
+                    chosen["cpp"] = (v, f"{name}: {key}.native_rate={v:.4g}")
+
+    if "accel" in chosen:
+        cal.sweep_rate["accel"] = chosen["accel"][0] / 2  # tunnel variance
+        cal.provenance["accel"] = chosen["accel"][1] + " (halved)"
+    if "cpu" in chosen:
+        cal.sweep_rate["cpu"] = chosen["cpu"][0] / 4  # steady excludes compile
+        cal.provenance["cpu"] = chosen["cpu"][1] + " (quartered)"
+    if "cpp" in chosen:
+        cal.oracle_seconds_per_call["cpp"] = 1.0 / chosen["cpp"][0]
+        cal.provenance["cpp"] = chosen["cpp"][1] + " (inverted)"
+    return cal
+
+
+CALIBRATION = calibrate()
