@@ -54,11 +54,13 @@ def _artifact_paths() -> List[pathlib.Path]:
 
 
 def _round_rank(name: str) -> int:
-    """Recency key: the largest integer embedded in the file name (the
-    round number in ``BENCH_r04.json`` / ``bench_full_r3_onchip.json``);
-    -1 when the name carries none."""
-    digits = [int(m) for m in re.findall(r"\d+", name)]
-    return max(digits) if digits else -1
+    """Recency key: the round number in the ``r<N>`` convention both
+    artifact families use (``BENCH_r04.json``, ``bench_full_r3_onchip``);
+    -1 when the name carries none.  Deliberately NOT "any integer in the
+    name" — a results file like ``verdict_1024.json`` must never outrank
+    genuinely newer rounds."""
+    rounds = [int(m) for m in re.findall(r"(?:\b|_)[rR](\d+)", name)]
+    return max(rounds) if rounds else -1
 
 
 def _iter_records(paths: Iterable[pathlib.Path]):

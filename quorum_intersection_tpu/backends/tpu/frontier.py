@@ -76,7 +76,13 @@ POP = 2048
 # (the host then runs the exact minimality/witness checks).  Small enough
 # to surface a broken network's witness fast, big enough to amortize the
 # chunk round-trip on safe hierarchical networks that flag thousands.
+# The exit threshold is a TRACED scalar: when a chunk exits flag-bound
+# (safe-looking network, flags keep confirming non-witnesses) the host
+# doubles it up to FLAG_EXIT_GROWTH× the initial value — fewer chunk
+# round-trips on exactly the workloads that flag the most — without
+# recompiling (the flag buffer is sized for the cap once).
 FLAG_EXIT = 512
+FLAG_EXIT_GROWTH = 16
 # Device iterations per chunk: bounds time-to-host-visibility (stats,
 # checkpoints, KeyboardInterrupt) without materially costing throughput.
 CHUNK_ITERS = 512
@@ -219,11 +225,12 @@ class TpuFrontierBackend:
         s = len(scc)
         n = circuit.n
         C = self.arena
-        # The loop exits once flag_exit states are flagged, and one more
-        # iteration can flag at most K more — this capacity makes a dropped
-        # (lost) flag impossible, which matters for completeness.  Derived
-        # from the EFFECTIVE (mesh-rounded) K, not self.pop.
-        flag_cap = self.flag_exit + K
+        # The loop exits once the (dynamic, host-grown) flag_exit threshold
+        # is reached, and one more iteration can flag at most K more — this
+        # capacity makes a dropped (lost) flag impossible at the threshold's
+        # CAP, which matters for completeness.  Derived from the EFFECTIVE
+        # (mesh-rounded) K, not self.pop.
+        flag_cap = self.flag_exit * FLAG_EXIT_GROWTH + K
 
         if self.mesh is not None:
             axis = self.mesh.axis_names[0]
@@ -335,18 +342,18 @@ class TpuFrontierBackend:
 
             return T, D, new_top, flags, fcount, iters + 1, popped + k
 
-        chunk_iters, flag_exit = self.chunk_iters, self.flag_exit
+        chunk_iters = self.chunk_iters
 
-        def cond(carry):
-            T, D, top, flags, fcount, iters, popped = carry
-            return (
-                (top > 0)
-                & (iters < chunk_iters)
-                & (fcount < flag_exit)
-                & (top <= C - 2 * K)  # overflow guard: host spills
-            )
+        def chunk_fn(T, D, top, flag_exit):
+            def cond(carry):
+                T, D, top, flags, fcount, iters, popped = carry
+                return (
+                    (top > 0)
+                    & (iters < chunk_iters)
+                    & (fcount < flag_exit)
+                    & (top <= C - 2 * K)  # overflow guard: host spills
+                )
 
-        def chunk_fn(T, D, top):
             flags = jnp.zeros((flag_cap, s), dtype=jnp.int8)
             carry = (T, D, top, flags, jnp.int32(0), jnp.int32(0), jnp.int32(0))
             if self.mesh is not None:
@@ -373,7 +380,7 @@ class TpuFrontierBackend:
             # infer through the while_loop.
             return jax.jit(shard_map_unchecked(
                 chunk_fn, self.mesh,
-                in_specs=(P(), P(), P()),
+                in_specs=(P(), P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P(), P(), P()),
             ))
         return jax.jit(chunk_fn)
@@ -515,11 +522,74 @@ class TpuFrontierBackend:
         first_chunk_s = 0.0
         chunk_s = 0.0  # steady-state chunks, unrounded until loop exit
 
-        while witness is None:
-            t_chunk = time.perf_counter()
-            T_dev, D_dev, top_dev, flags, fcount, iters, popped = run_chunk(
-                T_dev, D_dev, top_dev
+        # Dynamic flag-exit threshold: starts at the configured value (fast
+        # first witness on broken networks), doubles every time a chunk
+        # exits flag-bound — safe networks that flag thousands converge to
+        # ~one chunk round-trip per flag_cap instead of one per flag_exit.
+        flag_exit_cur = self.flag_exit
+        flag_exit_cap = self.flag_exit * FLAG_EXIT_GROWTH
+
+        # Flagged sets awaiting the exact host check.  Processing them is
+        # deferred until AFTER the next chunk's dispatch, so the (serial,
+        # native) host checks overlap the device's async execution instead
+        # of idling it; every conclusion point (verdict, checkpoint write)
+        # drains this list first — a pending state is already off the
+        # frontier, so a checkpoint written before its check could lose the
+        # witness.
+        pending_members: List[List[int]] = []
+
+        def process_pending() -> None:
+            nonlocal witness, host_check
+            if not pending_members:
+                return
+            if host_check is None:
+                host_check = self._make_host_checker(graph, scc, scope_to_scc)
+            for members in pending_members:
+                stats["host_checks"] += 1
+                minimal, hit = host_check(members)
+                if minimal:
+                    stats["minimal_quorums"] += 1
+                if hit is not None:
+                    witness = hit
+                    break
+            pending_members.clear()
+
+        # The whole chunk pipeline is asynchronous: `inflight` holds the
+        # dispatched-but-unsynced current chunk (with the flag threshold it
+        # was dispatched under), and each loop turn chains a SPECULATIVE
+        # next chunk onto its device-resident outputs before the host reads
+        # anything.  Speculation is safe because the chunk's own entry
+        # guards make it a no-op exactly when the host must intervene:
+        # top == 0 (exhausted/refeed) and top > C - 2K (spill) both fail the
+        # while_loop cond immediately, returning the carry unchanged — the
+        # host then discards the no-op and dispatches a fresh chunk after
+        # intervening.  Net effect: in the common path the device never
+        # idles across the host's sync + flag handling (one tunnel RTT +
+        # the host checks, both now overlapped).
+        def dispatch(T_a, D_a, top_a):
+            # The threshold scalar goes through to_dev like every other
+            # shard_map input: on a multi-host mesh a host-local scalar
+            # would be rejected against the P() in_spec.
+            return (
+                run_chunk(T_a, D_a, top_a, to_dev(jnp.int32(flag_exit_cur))),
+                flag_exit_cur,
             )
+
+        t_chunk = time.perf_counter()  # first interval includes trace+compile
+        inflight, inflight_fe = dispatch(T_dev, D_dev, top_dev)
+        while witness is None:
+            spec, spec_fe = dispatch(inflight[0], inflight[1], inflight[2])
+            # Overlap: host-check the PREVIOUS chunk's flags while the
+            # device crunches the current + speculative ones.
+            process_pending()
+            if witness is not None:
+                # The completed-but-unread inflight chunk is abandoned: its
+                # iters/popped/flagged never reach stats (syncing it here
+                # would stall a broken network's verdict by a chunk).  The
+                # marker keeps flag-rate denominators honest.
+                stats["discarded_chunks"] = 1
+                break
+            T_dev, D_dev, top_dev, flags, fcount, iters, popped = inflight
             fcount_h = int(fcount)  # sync point: chunk fully drained here
             if stats["device_chunks"] == 0:
                 # First call traces + compiles; keeping it separate makes
@@ -534,28 +604,27 @@ class TpuFrontierBackend:
             stats["states_popped"] += int(popped)
             stats["flagged"] += fcount_h
             log.debug(
-                "frontier chunk %d: %d iters, %d popped, top=%d, %d flagged, "
-                "%d spilled blocks",
+                "frontier chunk %d: %d iters, %d popped, top=%d, %d flagged "
+                "(exit at %d), %d spilled blocks",
                 stats["device_chunks"], int(iters), int(popped), top_h,
-                fcount_h, len(spill),
+                fcount_h, flag_exit_cur, len(spill),
             )
 
             if fcount_h:
-                if host_check is None:
-                    host_check = self._make_host_checker(graph, scc, scope_to_scc)
                 flags_h = np.asarray(flags[:fcount_h])
-                for row in flags_h:
-                    members = [scc[i] for i in np.nonzero(row)[0]]
-                    stats["host_checks"] += 1
-                    minimal, hit = host_check(members)
-                    if minimal:
-                        stats["minimal_quorums"] += 1
-                    if hit is not None:
-                        witness = hit
-                        break
-                if witness is not None:
-                    break
+                pending_members = [
+                    [scc[i] for i in np.nonzero(row)[0]] for row in flags_h
+                ]
+                # Grow against the threshold THIS chunk was dispatched with:
+                # the speculative chunk always runs one threshold behind, so
+                # comparing against the already-doubled current value would
+                # stall growth to every other chunk.
+                if fcount_h >= inflight_fe and flag_exit_cur < flag_exit_cap:
+                    flag_exit_cur = min(
+                        max(flag_exit_cur, inflight_fe) * 2, flag_exit_cap
+                    )
 
+            intervened = False
             if top_h > C - 2 * K:
                 # Overflow: spill the OLDEST half of the stack (indices
                 # [0, C//2)) to the host and compact the rest down.
@@ -571,9 +640,14 @@ class TpuFrontierBackend:
                 )
                 top_h = keep
                 stats["spills"] += 1
+                intervened = True
             elif top_h == 0:
                 if not spill:
-                    break  # worklist exhausted: all quorums intersect
+                    # Worklist exhausted: drain any still-pending flags (the
+                    # overlap defers them one chunk) before concluding that
+                    # all quorums intersect.
+                    process_pending()
+                    break
                 T_blk, D_blk = spill.pop()
                 # Re-feed a spilled block (valid rows are the nonempty ones —
                 # spilled blocks are dense prefixes by construction).
@@ -586,24 +660,46 @@ class TpuFrontierBackend:
                     to_dev(T_h), to_dev(D_h), to_dev(jnp.int32(len(live)))
                 )
                 top_h = len(live)
+                intervened = True
 
             if self.checkpoint is not None and witness is None:
                 # Same post-witness write suppression as the hybrid: the
                 # witness-bearing state is resolved and absent from the
                 # frontier, so a write+kill after the witness could resume
-                # into a witness-free remainder and flip the verdict.
-                if (
+                # into a witness-free remainder and flip the verdict.  Any
+                # flags still pending from THIS chunk are part of "resolved"
+                # — drain them (losing the overlap for this one chunk)
+                # before writing, or a kill after the write could lose a
+                # pending witness.
+                due_interrupt = (
                     self.interrupt_after_chunks is not None
                     and stats["device_chunks"] >= self.interrupt_after_chunks
                     and (top_h > 0 or spill)
-                ):
+                )
+                due_interval = (
+                    time.monotonic() - last_ckpt >= self.checkpoint_interval_s
+                )
+                if due_interrupt or due_interval:
+                    process_pending()
+                    if witness is not None:
+                        break
+                if due_interrupt:
                     self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)
                     raise FrontierSearchInterrupted(
                         f"simulated preemption after {stats['device_chunks']} chunks"
                     )
-                if time.monotonic() - last_ckpt >= self.checkpoint_interval_s:
+                if due_interval:
                     self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)
                     last_ckpt = time.monotonic()
+
+            if intervened:
+                # The speculative chunk ran as a guarded no-op against the
+                # pre-intervention state; drop it and dispatch fresh on the
+                # spilled/re-fed arrays.
+                inflight, inflight_fe = dispatch(T_dev, D_dev, top_dev)
+            else:
+                inflight, inflight_fe = spec, spec_fe
+            t_chunk = time.perf_counter()
 
         stats["seconds"] = time.perf_counter() - t0
         stats["first_chunk_seconds"] = round(first_chunk_s, 3)

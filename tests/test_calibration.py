@@ -63,6 +63,14 @@ def test_cpu_record_and_newest_round_wins(tmp_path):
     assert cal.sweep_rate["accel"] == 0.6e8
     assert "BENCH_r07.json" in cal.provenance["accel"]
 
+    # A file whose name embeds a big number OUTSIDE the r<N> round
+    # convention must not outrank real rounds.
+    e = _write(tmp_path, "verdict_1024.json", {
+        "device": "TPU v5 lite", "wide_sweep_device_cand_per_sec": 9e8,
+    })
+    cal = calibrate(paths=[a, b, c, d, e])
+    assert "BENCH_r07.json" in cal.provenance["accel"]
+
 
 def test_out_of_window_and_corrupt_artifacts_ignored(tmp_path):
     bad_rate = _write(tmp_path, "BENCH_r07.json", {
