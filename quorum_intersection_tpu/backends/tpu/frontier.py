@@ -583,11 +583,12 @@ class TpuFrontierBackend:
             # device crunches the current + speculative ones.
             process_pending()
             if witness is not None:
-                # The completed-but-unread inflight chunk is abandoned: its
-                # iters/popped/flagged never reach stats (syncing it here
-                # would stall a broken network's verdict by a chunk).  The
-                # marker keeps flag-rate denominators honest.
-                stats["discarded_chunks"] = 1
+                # The completed-but-unread inflight chunk AND the
+                # speculative chunk just dispatched are both abandoned:
+                # their iters/popped/flagged never reach stats (syncing
+                # here would stall a broken network's verdict by a chunk).
+                # The marker keeps flag-rate denominators honest.
+                stats["discarded_chunks"] = 2
                 break
             T_dev, D_dev, top_dev, flags, fcount, iters, popped = inflight
             fcount_h = int(fcount)  # sync point: chunk fully drained here
@@ -682,6 +683,9 @@ class TpuFrontierBackend:
                 if due_interrupt or due_interval:
                     process_pending()
                     if witness is not None:
+                        # The speculative chunk dispatched this turn is
+                        # abandoned unread (cf. the loop-top break marker).
+                        stats["discarded_chunks"] = 1
                         break
                 if due_interrupt:
                     self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)

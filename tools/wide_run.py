@@ -1,0 +1,187 @@
+"""Wide-sweep ceiling exercise: checkpointed 2^N run with a REAL mid-run
+SIGKILL + resume (VERDICT r3 §next-6).
+
+Drives the production sweep backend on a safe majority FBAS wide enough
+that the two-level (hi|lo) decode runs with hi-bits > 4, in a CHILD
+process that is SIGKILLed partway through; the parent then resumes from
+the on-disk checkpoint — optionally under a different (batch, lo_bits)
+geometry — and records the whole ledger (positions, kill time, resume
+position, verdict, rates) to ``benchmarks/results/``.
+
+The CPU emulation sustains ~0.5M cand/s, so the default --bits here would
+take days off-chip: run small bits (<= 22) for CPU smoke, the real 36-38
+on the chip.
+
+Usage::
+
+    python tools/wide_run.py --bits 20 --kill-after 8 --platform cpu   # smoke
+    python tools/wide_run.py --bits 36 --kill-after 120                # chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+RESULTS = _REPO / "benchmarks" / "results"
+
+
+def child_main(args) -> int:
+    """Run the sweep to completion (or until the parent kills us),
+    checkpointing to --ckpt; prints one JSON line if it finishes."""
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+    ckpt = SweepCheckpoint(pathlib.Path(args.ckpt))
+    backend = TpuSweepBackend(
+        checkpoint=ckpt,
+        lo_bits=args.lo_bits,
+        **({"batch": args.batch} if args.batch else {}),
+    )
+    t0 = time.perf_counter()
+    res = solve(majority_fbas(args.bits + 1), backend=backend)
+    print(json.dumps({
+        "intersects": res.intersects,
+        "seconds": round(time.perf_counter() - t0, 2),
+        "candidates_checked": res.stats.get("candidates_checked"),
+        "candidates_per_sec": round(res.stats.get("candidates_per_sec", 0), 1),
+        "steady_rate": res.stats.get("steady_rate"),
+        "resumed": "resume" in json.dumps(res.stats),
+    }), flush=True)
+    return 0
+
+
+def read_ckpt(path: pathlib.Path):
+    try:
+        return json.loads(path.read_text())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def last_json(out: str) -> dict:
+    """Last parseable JSON line of a child's stdout, or an error marker —
+    a crashed child (OOM, tunnel drop) must degrade the record, never lose
+    the data already gathered before it."""
+    for ln in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"child produced no JSON (stdout tail: {(out or '')[-200:]!r})"}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--bits", type=int, default=36,
+                        help="enumeration width: sweeps 2^bits of a (bits+1)-node majority")
+    parser.add_argument("--kill-after", type=float, default=120.0,
+                        help="seconds before SIGKILLing the first attempt")
+    parser.add_argument("--lo-bits", type=int, default=30,
+                        help="first attempt's two-level split (resume uses --resume-lo-bits)")
+    parser.add_argument("--resume-lo-bits", type=int, default=None,
+                        help="geometry change on resume (default: lo_bits, i.e. unchanged)")
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--platform", choices=("cpu", "ambient"), default="ambient")
+    parser.add_argument("--tag", default="r4", help="results file suffix")
+    parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--ckpt", default=None, help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    if args.child:
+        return child_main(args)
+
+    if args.bits <= args.lo_bits:
+        print(f"--bits {args.bits} must exceed --lo-bits {args.lo_bits} "
+              f"(the point is hi-bits > 0)", file=sys.stderr)
+        return 2
+
+    RESULTS.mkdir(parents=True, exist_ok=True)
+    ckpt_path = RESULTS / f"wide_{args.tag}.ckpt.json"
+    ckpt_path.unlink(missing_ok=True)
+    record: dict = {
+        "bits": args.bits,
+        "total_candidates": 1 << args.bits,
+        "hi_bits": args.bits - min(args.bits, args.lo_bits),
+        "lo_bits": args.lo_bits,
+        "platform": args.platform,
+    }
+
+    def spawn(lo_bits: int) -> subprocess.Popen:
+        cmd = [sys.executable, os.path.abspath(__file__), "--child",
+               "--bits", str(args.bits), "--lo-bits", str(lo_bits),
+               "--ckpt", str(ckpt_path), "--platform", args.platform]
+        if args.batch:
+            cmd += ["--batch", str(args.batch)]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    # Attempt 1: run until the kill deadline, then SIGKILL (a real kill -9,
+    # not a simulated exception — the checkpoint on disk is all that
+    # survives, exactly the preemption story the ceiling claim needs).
+    t0 = time.time()
+    proc = spawn(args.lo_bits)
+    try:
+        out, _ = proc.communicate(timeout=args.kill_after)
+        # Finished before the kill: --bits too small for the platform rate.
+        record["first_attempt"] = last_json(out)
+        record["killed"] = False
+        print("first attempt FINISHED before the kill deadline; "
+              "no resume exercised — raise --bits or lower --kill-after",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGKILL)
+        proc.communicate()
+        record["killed"] = True
+        record["kill_after_seconds"] = args.kill_after
+        ck = read_ckpt(ckpt_path)
+        record["checkpoint_at_kill"] = ck
+        if not ck or not ck.get("position"):
+            print("KILLED but no checkpoint progress was recorded — "
+                  "kill window shorter than compile+first record?",
+                  file=sys.stderr)
+            record["resume"] = "no-checkpoint"
+            (RESULTS / f"wide_{args.tag}.json").write_text(json.dumps(record, indent=1))
+            return 1
+
+        # Attempt 2: resume (optionally under a different geometry).
+        resume_lo = args.resume_lo_bits or args.lo_bits
+        record["resume_lo_bits"] = resume_lo
+        t1 = time.time()
+        proc2 = spawn(resume_lo)
+        out, _ = proc2.communicate()
+        record["resume"] = last_json(out)
+        record["resume_wall_seconds"] = round(time.time() - t1, 1)
+        resumed_from = ck["position"]
+        done = record["resume"].get("candidates_checked")
+        if done is not None:
+            record["resume_covered_suffix_only"] = (
+                done <= (1 << args.bits) - resumed_from
+                + (1 << min(args.lo_bits, resume_lo))
+            )
+    record["wall_seconds"] = round(time.time() - t0, 1)
+    out_path = RESULTS / f"wide_{args.tag}.json"
+    out_path.write_text(json.dumps(record, indent=1))
+    print(json.dumps(record))
+    print(f"-> {out_path}", file=sys.stderr)
+    ckpt_path.unlink(missing_ok=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
