@@ -20,19 +20,29 @@ Division of labor (verdict-equivalent to the serial oracle):
   containment prunes (cpp:301-314), the branch-variable choice
   (max in-degree within the quorum, cpp:203-250) and the two-child
   expansion (cpp:336, :343-345).
-- **Host** handles the rare leaves: states whose ``dontRemove`` already
-  contains a quorum are *flagged* into a side buffer and never expanded
-  (sound: the oracle prunes descent there either way, cpp:281-291).  The
-  host re-checks each flagged set with the exact reference semantics —
-  minimality (cpp:179-201) and the disjointness probe (cpp:357-384, Q6
-  availability) — through a pinned host engine: the native
-  ``qi_max_quorum`` (the C++ oracle's own fixpoint, parity-tested against
-  the Python spec) when the library builds, else `fbas/semantics.py`
-  directly.  Either way no witness leaves this backend on device results
-  alone.  Flagged
-  states are rare by construction: on symmetric-majority networks the
-  half-size prune fires first and ZERO states flag; on hierarchical
-  networks ~0.5 % of states flag (measured, crossover_tpu_r3.txt stats).
+- **Leaves**: states whose ``dontRemove`` already contains a quorum are
+  *flagged* into a side buffer and never expanded (sound: the oracle
+  prunes descent there either way, cpp:281-291).  Each flagged set then
+  needs minimality (cpp:179-201) and the disjointness probe (cpp:357-384,
+  Q6 availability).  Two engines, chosen by ``flag_check``:
+
+  * ``"device"`` (default on accelerators): the leave-one-out minimality
+    rows and the availability probe run as batched device fixpoints
+    (:meth:`_build_flag_filter`) — necessary because flagged states are
+    NOT rare on hierarchical networks (hier-7x4: 2.5 % of popped = 583k
+    states; serial host checks would rival the native oracle's whole
+    search).  A **negative** verdict (all quorums intersect) then rests
+    on the device fixpoint — the same kernel the sweep backend's verdict
+    rests on, differentially pinned against the host semantics
+    (test_tpu_kernels.py, test_frontier.py count parity, tools/soak.py).
+  * ``"host"`` (default on the CPU backend): the serial exact check per
+    state through the native ``qi_max_quorum`` (parity-tested against
+    the Python spec) when the library builds, else `fbas/semantics.py`.
+
+  Either way a **positive witness** (verdict ``false``) never leaves this
+  backend on device results alone: the device filter only *nominates* the
+  first witness candidate, and the exact host semantics re-verify it
+  before any verdict.
 
 Deliberate deviation from cpp:221: when no quorum member has an edge into
 ``quorum ∖ dontRemove``, the reference falls back to ``quorum.front()`` —
@@ -109,16 +119,28 @@ class TpuFrontierBackend:
         checkpoint_interval_s: float = 5.0,
         interrupt_after_chunks: Optional[int] = None,
         mesh=None,
+        flag_check: str = "auto",
     ) -> None:
         if arena < 4:
             # Mirrors the mesh-path validation in check_scc: pop is clamped to
             # arena//4, and a zero pop block makes the chunk loop spin forever
             # (each chunk pops nothing) instead of failing.
             raise ValueError(f"arena={arena} too small (needs >= 4)")
+        if flag_check not in ("auto", "device", "host"):
+            raise ValueError(f"flag_check={flag_check!r} not in auto/device/host")
         self.arena = arena
         self.pop = min(pop, arena // 4)
         self.flag_exit = flag_exit
         self.chunk_iters = chunk_iters
+        # Flagged-state checking strategy (measured at scc 28: 2.5% of
+        # popped states flag — 583k serial host checks would dominate an
+        # on-chip run).  "device": batched leave-one-out minimality +
+        # disjointness-probe fixpoints on the accelerator, host only
+        # re-verifies the rare witness candidate exactly.  "host": the
+        # serial native/Python exact check per state.  "auto": device on
+        # accelerators, host on the CPU backend (where the emulated batch
+        # fixpoints lose to the native serial checks).
+        self.flag_check = flag_check
         # Optional jax.sharding.Mesh: the popped block's fixpoint rows shard
         # across devices (all_gather reassembles); the arena and all control
         # flow replicate, so every device runs the identical expansion.
@@ -208,6 +230,75 @@ class TpuFrontierBackend:
             return True, None
 
         return check
+
+    # ---- device flag filter ---------------------------------------------
+
+    def _build_flag_filter(self, circuit: Circuit, scc: List[int],
+                           scope_to_scc: bool, block: int):
+        """Compile ``filter_block(flags, count) -> (minimal_count, widx)``:
+        the flagged-state pipeline as batched device fixpoints.
+
+        For each valid flagged set D (``dontRemove`` already contains a
+        quorum, established by the chunk): D is a **minimal** quorum iff no
+        single-member removal leaves a quorum inside it (cpp:179-201 — the
+        leave-one-out rows run as ONE batch), and a minimal D is a
+        **witness** iff the availability-probe fixpoint over ``scc ∖ D``
+        (Q6 frozen helpers outside the SCC, cpp:357-384) survives.  Only
+        ``widx`` — the first witness candidate, or ``block`` for none —
+        ever returns to the host, which re-verifies it with the exact host
+        semantics before any verdict: device results alone never decide.
+
+        Measured necessity (hier-7x4, scc 28): 2.5% of popped states flag
+        — 583k serial host checks at |D|+2 native fixpoints each rival the
+        native oracle's whole search; batched on the accelerator they are
+        a handful of matmul dispatches.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from quorum_intersection_tpu.backends.tpu.kernels import (
+            CircuitArrays, fixpoint,
+        )
+
+        arrays = CircuitArrays(circuit)
+        s = len(scc)
+        n = circuit.n
+        scc_idx = jnp.asarray(np.asarray(scc, dtype=np.int32))
+        scc_mask_n = jnp.zeros((n,), dtype=arrays.dtype).at[scc_idx].set(1)
+        frozen = (
+            jnp.zeros((n,), dtype=arrays.dtype) if scope_to_scc
+            else (1 - scc_mask_n).astype(arrays.dtype)
+        )
+        eye_inv = (1 - jnp.eye(s, dtype=jnp.int8))
+
+        @jax.jit
+        def filter_block(flags_blk, count):
+            valid = jnp.arange(block, dtype=jnp.int32) < count
+            member = flags_blk > 0
+            # Leave-one-out variants (B, s, s): row (i, j) = D_i ∖ {j}.
+            loo = flags_blk[:, None, :] * eye_inv[None, :, :]
+            loo_n = jnp.zeros((block * s, n), dtype=arrays.dtype).at[
+                :, scc_idx
+            ].set(loo.reshape(block * s, s).astype(arrays.dtype))
+            q = fixpoint(arrays, loo_n)
+            has_q = (q.sum(-1, dtype=jnp.int32) > 0).reshape(block, s)
+            minimal = valid & ~jnp.any(has_q & member, axis=1)
+
+            d_n = jnp.zeros((block, n), dtype=arrays.dtype).at[:, scc_idx].set(
+                flags_blk.astype(arrays.dtype)
+            )
+            probe_avail = jnp.clip(
+                scc_mask_n[None, :] - d_n, 0, 1
+            ).astype(arrays.dtype)
+            pq = fixpoint(arrays, probe_avail, frozen)
+            probe_hit = pq.sum(-1, dtype=jnp.int32) > 0
+            wit = minimal & probe_hit
+            widx = jnp.where(
+                wit, jnp.arange(block, dtype=jnp.int32), jnp.int32(block)
+            ).min()
+            return minimal.sum(dtype=jnp.int32), widx
+
+        return filter_block
 
     # ---- device chunk builder -------------------------------------------
 
@@ -443,6 +534,7 @@ class TpuFrontierBackend:
             "states_popped": 0,
             "flagged": 0,
             "host_checks": 0,
+            "device_flag_checks": 0,
             "minimal_quorums": 0,
             "spills": 0,
         }
@@ -529,30 +621,84 @@ class TpuFrontierBackend:
         flag_exit_cur = self.flag_exit
         flag_exit_cap = self.flag_exit * FLAG_EXIT_GROWTH
 
-        # Flagged sets awaiting the exact host check.  Processing them is
-        # deferred until AFTER the next chunk's dispatch, so the (serial,
-        # native) host checks overlap the device's async execution instead
-        # of idling it; every conclusion point (verdict, checkpoint write)
-        # drains this list first — a pending state is already off the
-        # frontier, so a checkpoint written before its check could lose the
-        # witness.
-        pending_members: List[List[int]] = []
+        # Flagged sets awaiting their minimality/witness checks.  Processing
+        # is deferred until AFTER the next chunk's dispatch, so the checks
+        # overlap the device's async execution instead of idling it; every
+        # conclusion point (verdict, checkpoint write) drains this first —
+        # a pending state is already off the frontier, so a checkpoint
+        # written before its check could lose the witness.
+        from quorum_intersection_tpu.utils.platform import is_cpu_platform
 
-        def process_pending() -> None:
+        use_device_filter = self.flag_check == "device" or (
+            self.flag_check == "auto" and not is_cpu_platform()
+        )
+        flag_block = self.flag_exit * FLAG_EXIT_GROWTH + K
+        flag_filter = None  # compiled on the first flagged batch
+        pending_flags: Optional[np.ndarray] = None
+
+        def serial_check(rows: np.ndarray) -> bool:
+            """Exact host check per row; True iff a witness was found."""
             nonlocal witness, host_check
-            if not pending_members:
-                return
             if host_check is None:
                 host_check = self._make_host_checker(graph, scc, scope_to_scc)
-            for members in pending_members:
+            for row in rows:
+                members = [scc[i] for i in np.nonzero(row)[0]]
                 stats["host_checks"] += 1
                 minimal, hit = host_check(members)
                 if minimal:
                     stats["minimal_quorums"] += 1
                 if hit is not None:
                     witness = hit
-                    break
-            pending_members.clear()
+                    return True
+            return False
+
+        def process_pending() -> None:
+            nonlocal witness, host_check, pending_flags, flag_filter
+            rows = pending_flags
+            pending_flags = None
+            if rows is None or not len(rows):
+                return
+            if not use_device_filter:
+                serial_check(rows)
+                return
+            if flag_filter is None:
+                flag_filter = self._build_flag_filter(
+                    circuit, scc, scope_to_scc, flag_block
+                )
+            for start in range(0, len(rows), flag_block):
+                blk = rows[start:start + flag_block]
+                cnt = len(blk)
+                if cnt < flag_block:
+                    padded = np.zeros((flag_block, s), dtype=np.int8)
+                    padded[:cnt] = blk
+                else:
+                    padded = blk
+                mins, widx = flag_filter(jnp.asarray(padded), jnp.int32(cnt))
+                stats["device_flag_checks"] += cnt
+                widx_h = int(widx)
+                if widx_h >= flag_block:
+                    stats["minimal_quorums"] += int(mins)
+                    continue
+                # Device claims a witness candidate: the exact host
+                # semantics re-verify it before any verdict.
+                if host_check is None:
+                    host_check = self._make_host_checker(graph, scc, scope_to_scc)
+                members = [scc[i] for i in np.nonzero(blk[widx_h])[0]]
+                stats["host_checks"] += 1
+                minimal, hit = host_check(members)
+                if hit is not None:
+                    stats["minimal_quorums"] += int(mins)
+                    witness = hit
+                    return
+                # Disagreement (fixpoint parity is differentially tested, so
+                # this should be unreachable): exactness wins — redo the
+                # whole block serially and keep going.
+                log.warning(
+                    "device flag filter disagreed with the exact host check; "
+                    "serial fallback for %d flagged states", cnt,
+                )
+                if serial_check(blk):
+                    return
 
         # The whole chunk pipeline is asynchronous: `inflight` holds the
         # dispatched-but-unsynced current chunk (with the flag threshold it
@@ -612,10 +758,7 @@ class TpuFrontierBackend:
             )
 
             if fcount_h:
-                flags_h = np.asarray(flags[:fcount_h])
-                pending_members = [
-                    [scc[i] for i in np.nonzero(row)[0]] for row in flags_h
-                ]
+                pending_flags = np.asarray(flags[:fcount_h], dtype=np.int8)
                 # Grow against the threshold THIS chunk was dispatched with:
                 # the speculative chunk always runs one threshold behind, so
                 # comparing against the already-doubled current value would

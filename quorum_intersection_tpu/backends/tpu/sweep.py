@@ -574,6 +574,10 @@ class TpuSweepBackend:
             "seconds": seconds,
             "candidates_per_sec": candidates / seconds if seconds > 0 else 0.0,
         }
+        if start0:
+            # Resume provenance: lets tooling prove a run actually skipped a
+            # checkpointed prefix (tools/wide_run.py kill/resume ledger).
+            stats["resumed_from"] = start0
         stats.update(self._time_breakdown(
             t0_monotonic, t_first_dispatch, compile_seconds, drain_log, compile_log
         ))

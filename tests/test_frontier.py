@@ -240,3 +240,49 @@ class TestResumeSpill:
         # the strong invariant here is completion + no crash through the
         # block-spill resume path and a clean final checkpoint.
         assert not ck.path.exists()
+
+
+class TestDeviceFlagFilter:
+    """The batched device flag pipeline (`flag_check="device"`): leave-one-out
+    minimality + disjointness probe as device fixpoints, host re-verifying
+    only witness candidates.  Forced on explicitly (tests run on the CPU
+    platform, where `auto` would pick the serial host path)."""
+
+    def test_count_parity_vs_oracle(self):
+        po, fr = _pair(
+            hierarchical_fbas(5, 3), arena=8192, pop=256, flag_check="device"
+        )
+        assert po.intersects is fr.intersects is True
+        assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+        assert fr.stats["device_flag_checks"] == fr.stats["flagged"]
+        assert fr.stats["host_checks"] == 0  # safe: nothing to re-verify
+
+    def test_broken_witness_single_host_reverify(self):
+        from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+        data = stellar_like_fbas(
+            n_core_orgs=4, per_org=3, n_watchers=10, broken=True
+        )
+        po, fr = _pair(data, arena=8192, pop=256, flag_check="device")
+        assert po.intersects is fr.intersects is False
+        assert fr.q1 and fr.q2 and not set(fr.q1) & set(fr.q2)
+        # The device filter hands the host exactly one witness candidate.
+        assert fr.stats["host_checks"] == 1
+
+    def test_spill_path(self):
+        po, fr = _pair(
+            hierarchical_fbas(4, 3), arena=64, pop=16, flag_check="device"
+        )
+        assert fr.intersects is True
+        assert fr.stats["spills"] > 0
+        assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_differential(self, seed):
+        data = random_fbas(14, seed=seed, nested_prob=0.3, null_prob=0.1)
+        po, fr = _pair(data, arena=4096, pop=128, flag_check="device")
+        assert po.intersects is fr.intersects
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            TpuFrontierBackend(flag_check="gpu")

@@ -63,7 +63,7 @@ def child_main(args) -> int:
         "candidates_checked": res.stats.get("candidates_checked"),
         "candidates_per_sec": round(res.stats.get("candidates_per_sec", 0), 1),
         "steady_rate": res.stats.get("steady_rate"),
-        "resumed": "resume" in json.dumps(res.stats),
+        "resumed_from": res.stats.get("resumed_from", 0),
     }), flush=True)
     return 0
 
@@ -99,6 +99,9 @@ def main(argv=None) -> int:
                         help="geometry change on resume (default: lo_bits, i.e. unchanged)")
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--platform", choices=("cpu", "ambient"), default="ambient")
+    parser.add_argument("--resume-timeout", type=float, default=3600.0,
+                        help="hard deadline for the resume attempt (a hung "
+                             "tunnel must degrade the record, not hang it)")
     parser.add_argument("--tag", default="r4", help="results file suffix")
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--ckpt", default=None, help=argparse.SUPPRESS)
@@ -159,12 +162,26 @@ def main(argv=None) -> int:
             (RESULTS / f"wide_{args.tag}.json").write_text(json.dumps(record, indent=1))
             return 1
 
-        # Attempt 2: resume (optionally under a different geometry).
-        resume_lo = args.resume_lo_bits or args.lo_bits
+        # Persist what the kill gathered BEFORE risking attempt 2 — a hung
+        # resume (tunnel drop mid-collective) must not lose it.
+        record["resume"] = "in-progress"
+        (RESULTS / f"wide_{args.tag}.json").write_text(json.dumps(record, indent=1))
+
+        # Attempt 2: resume (optionally under a different geometry;
+        # lo_bits 0 is a valid all-hi decode, so no falsy-or).
+        resume_lo = (
+            args.resume_lo_bits if args.resume_lo_bits is not None
+            else args.lo_bits
+        )
         record["resume_lo_bits"] = resume_lo
         t1 = time.time()
         proc2 = spawn(resume_lo)
-        out, _ = proc2.communicate()
+        try:
+            out, _ = proc2.communicate(timeout=args.resume_timeout)
+        except subprocess.TimeoutExpired:
+            proc2.send_signal(signal.SIGKILL)
+            out, _ = proc2.communicate()
+            out = (out or "") + '\n{"error": "resume timed out"}'
         record["resume"] = last_json(out)
         record["resume_wall_seconds"] = round(time.time() - t1, 1)
         resumed_from = ck["position"]
