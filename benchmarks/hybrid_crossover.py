@@ -52,6 +52,20 @@ def workloads(quick: bool):
     return rows
 
 
+def large_workloads():
+    """The frontier win-region sizes (VERDICT r4 §next-1): native cost
+    grows ~9× per org (hier-7x4 ≈ 30 s, hier-8x4 ≈ 4.5 min single-core),
+    so these rows are opt-in (--large) and skip the round-trip hybrid,
+    whose loss at these sizes is already established
+    (crossover_tpu_r3.txt) and whose runtime would be tens of minutes."""
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+
+    return [
+        ("hier-7x4 (scc 28)", hierarchical_fbas(7, 4), 28),
+        ("hier-8x4 (scc 32)", hierarchical_fbas(8, 4), 32),
+    ]
+
+
 def time_solve(data, backend) -> tuple:
     from quorum_intersection_tpu.pipeline import solve
 
@@ -64,6 +78,16 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--batch", type=int, default=1024)
+    parser.add_argument("--large", action="store_true",
+                        help="add hier-7x4/8x4 frontier-vs-native rows "
+                             "(no hybrid; native alone is 30 s + ~4.5 min)")
+    parser.add_argument("--pop", type=int, default=None,
+                        help="frontier pop-block override for the large rows")
+    parser.add_argument("--flag-check", choices=("auto", "device", "host"),
+                        default="auto",
+                        help="frontier flag pipeline for the large rows "
+                             "(device reproduces the CPU-emulation numbers "
+                             "in docs/ROUND4_NOTES.md on a cpu platform)")
     args = parser.parse_args()
 
     from quorum_intersection_tpu.utils.platform import honor_platform_env
@@ -100,6 +124,37 @@ def main() -> int:
             "frontier_stats": {k: v for k, v in fr_res.stats.items() if k != "backend"},
             "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),
         }))
+
+    if args.large:
+        frontier_kw = {"flag_check": args.flag_check}
+        if args.pop is not None:
+            frontier_kw["pop"] = args.pop
+        for name, data, scc in large_workloads():
+            cpp_s, cpp_res = time_solve(data, CppOracleBackend())
+            fr_s, fr_res = time_solve(data, TpuFrontierBackend(**frontier_kw))
+            ok = cpp_res.intersects == fr_res.intersects
+            # Enumeration completeness, not just the verdict: count parity
+            # is the evidence these rows exist for.
+            counts_ok = (
+                cpp_res.stats.get("minimal_quorums")
+                == fr_res.stats.get("minimal_quorums")
+            )
+            speed = cpp_s / fr_s if fr_s > 0 else float("inf")
+            flag = "" if (ok and counts_ok) else " **INVALID**"
+            print(
+                f"| {name} | {cpp_s:.3f} | — | {fr_s:.3f} | {speed:.2f}x{flag} | "
+                f"{fr_res.stats.get('states_popped')} | {fr_res.stats.get('flagged')} |"
+            )
+            print(json.dumps({
+                "workload": name, "scc": scc, "device": device,
+                "cpp_seconds": round(cpp_s, 4),
+                "frontier_seconds": round(fr_s, 4),
+                "frontier_speedup_vs_cpp": round(speed, 3),
+                "verdict_ok": ok, "counts_ok": counts_ok,
+                "frontier_stats": {k: v for k, v in fr_res.stats.items()
+                                   if k != "backend"},
+                "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),
+            }), flush=True)
     return 0
 
 

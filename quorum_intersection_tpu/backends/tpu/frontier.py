@@ -691,13 +691,17 @@ class TpuFrontierBackend:
                     witness = hit
                     return
                 # Disagreement (fixpoint parity is differentially tested, so
-                # this should be unreachable): exactness wins — redo the
-                # whole block serially and keep going.
+                # this should be unreachable): exactness wins — count the
+                # already-checked nominee, then redo the REST of the block
+                # serially (re-checking the nominee would double-count
+                # host_checks in the evidence ledger).
                 log.warning(
                     "device flag filter disagreed with the exact host check; "
                     "serial fallback for %d flagged states", cnt,
                 )
-                if serial_check(blk):
+                if minimal:
+                    stats["minimal_quorums"] += 1
+                if serial_check(np.delete(blk[:cnt], widx_h, axis=0)):
                     return
 
         # The whole chunk pipeline is asynchronous: `inflight` holds the

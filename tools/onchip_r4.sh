@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 on-chip measurement sequence — run when the axon tunnel is up.
+# Probe first (a down tunnel HANGS, timeout everything); each step records
+# to benchmarks/results/ so a mid-sequence tunnel drop keeps the prefix.
+set -x
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+
+# 0. liveness
+timeout 100 python -c "import jax; print(jax.devices())" || exit 1
+
+# 1. three-way crossover incl. the frontier win-region rows (scc 28/32)
+timeout 1800 python benchmarks/hybrid_crossover.py --large \
+    2>&1 | tee "$R/crossover_tpu_r4.txt"
+
+# 2. pop-block scaling on the chip (informs the frontier's default pop)
+timeout 1200 python benchmarks/frontier_scaling.py \
+    2>&1 | tee "$R/frontier_scaling_tpu_r4.txt"
+
+# 3. wide-sweep ceiling: checkpointed 2^36 with a real SIGKILL + resume
+#    (~2 min to the kill, resume runs to completion at ~600M cand/s ≈ 2 min)
+timeout 3600 python tools/wide_run.py --bits 36 --kill-after 120 \
+    --resume-lo-bits 28 --tag r4
+
+# 4. full bench (the driver also runs this; a builder-recorded copy pins
+#    the numbers even if the driver window hits a flake)
+timeout 1800 python bench.py 2>/dev/null | tail -1 \
+    > "$R/bench_full_r4_onchip.json"
+
+# 5. soak a window on the chip (device engines on real hardware)
+timeout 1800 python tools/soak.py --instances 40 --seed 1000 --platform ambient
