@@ -128,7 +128,13 @@ def run_instance(seed: int) -> dict:
     engines = {
         "python": "python",
         "cpp": CppOracleBackend(),
-        "frontier": TpuFrontierBackend(arena=2048, pop=128),
+        # Alternate the flagged-state pipeline so BOTH paths soak: "device"
+        # (batched leave-one-out + probe fixpoints) on even seeds, the
+        # serial exact host path on odd ones.
+        "frontier": TpuFrontierBackend(
+            arena=2048, pop=128,
+            flag_check="device" if seed % 2 == 0 else "host",
+        ),
         "hybrid": TpuHybridBackend(),
     }
     if max_scc <= SWEEP_SCC_LIMIT:
