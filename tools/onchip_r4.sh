@@ -36,6 +36,9 @@ probe bench
 timeout 1800 python -u bench.py 2>/dev/null | tail -1 \
     > "$R/bench_full_r4_onchip.json"
 
-# 5. soak a window on the chip (device engines on real hardware)
+# 5. soak a window on the chip (device engines on real hardware); tee'd so
+#    per-instance progress/MISMATCH lines survive a mid-window hang (the
+#    ledger itself only writes after the full window)
 probe soak
-timeout 1800 python -u tools/soak.py --instances 40 --seed 1000 --platform ambient
+timeout 1800 python -u tools/soak.py --instances 40 --seed 1000 --platform ambient \
+    2>&1 | tee "$R/soak_tpu_r4.txt"
