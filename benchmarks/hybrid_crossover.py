@@ -151,6 +151,10 @@ def main() -> int:
                 "frontier_seconds": round(fr_s, 4),
                 "frontier_speedup_vs_cpp": round(speed, 3),
                 "verdict_ok": ok, "counts_ok": counts_ok,
+                # Machine-readable config: the calibration module only
+                # routes wins together with the kwargs they were measured
+                # under (backends/calibration.py _frontier_win_min_scc).
+                "frontier_kw": frontier_kw,
                 "frontier_stats": {k: v for k, v in fr_res.stats.items()
                                    if k != "backend"},
                 "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),

@@ -216,6 +216,39 @@ class AutoBackend:
                     )
                 except Exception as exc:  # noqa: BLE001
                     log.info("sweep backend unavailable (%s); falling back", exc)
+        # Large SCC: the device-resident frontier takes it ONLY inside a
+        # MEASURED on-chip win region (CALIBRATION.frontier_win_min_scc,
+        # derived from the newest crossover_tpu_r*.txt artifact with
+        # verdict+count parity on every qualifying row) — routing claims
+        # about the chip stay tied to recorded measurements, exactly like
+        # the sweep-rate constants above.  No artifact, or a CPU platform
+        # (where the native oracle wins every measured size): host oracle.
+        from quorum_intersection_tpu.utils.platform import is_cpu_platform
+
+        win = CALIBRATION.frontier_win_min_scc
+        if win is not None and len(scc) >= win and not is_cpu_platform():
+            try:
+                from quorum_intersection_tpu.backends.tpu.frontier import (
+                    TpuFrontierBackend,
+                )
+
+                # The kwargs the win was MEASURED under ride along — a win
+                # recorded at pop=4096 must not route to a default-pop
+                # frontier (unknown keys raise and fall through to the
+                # host oracle, so a rotten artifact degrades, not crashes).
+                backend = TpuFrontierBackend(
+                    checkpoint=self.checkpoint, mesh=self.mesh,
+                    **CALIBRATION.frontier_config,
+                )
+                log.info(
+                    "auto: device frontier for |scc|=%d (measured win region: %s)",
+                    len(scc), CALIBRATION.provenance.get("frontier"),
+                )
+                return backend.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                )
+            except Exception as exc:  # noqa: BLE001 — degrade to the host oracle
+                log.info("frontier unavailable (%s); falling back", exc)
         if self.prefer_tpu:
             # Measured on BOTH platforms (benchmarks/results/
             # crossover_cpu_r3.txt, crossover_tpu_r3.txt): the hybrid loses

@@ -112,14 +112,120 @@ class Calibration:
     oracle_seconds_per_call: Dict[str, float] = field(
         default_factory=lambda: dict(DEFAULT_ORACLE_SPC)
     )
+    # Measured frontier win region: route |scc| >= this to the
+    # device-resident frontier on accelerators (None = no measured win on
+    # record; the host oracle keeps large SCCs).  Derived from the newest
+    # on-chip crossover artifact — see _frontier_win_min_scc.
+    frontier_win_min_scc: Optional[int] = None
+    # The frontier constructor kwargs the winning rows were measured UNDER
+    # (a win at pop=4096 must not route to a default-pop frontier).
+    frontier_config: Dict = field(default_factory=dict)
     # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
     provenance: Dict[str, str] = field(default_factory=dict)
 
 
-def calibrate(paths: Optional[Iterable[pathlib.Path]] = None) -> Calibration:
+def _frontier_win_min_scc(
+    paths: Iterable[pathlib.Path],
+) -> Optional[Tuple[int, Dict, str]]:
+    """Smallest |scc| from which the frontier consistently beats the native
+    oracle ON A TPU, per the newest crossover artifact's JSON rows, plus
+    the frontier constructor kwargs it was measured under.
+
+    Conservative, per measured configuration: rows group by their recorded
+    ``frontier_kw`` (a win at pop=4096 says nothing about the default
+    pop), within a group the per-scc speed is the MINIMUM across that
+    scc's rows, and the threshold is the smallest scc such that every
+    measured scc at or above it wins (>= 1x, verdict+count parity) — one
+    losing or unparitied row above kills that group's region.  The group
+    with the smallest threshold wins.  Rows measured on CPU emulation
+    never qualify (the decision this gates is accelerator routing)."""
+    newest: Optional[Tuple[int, str, List[Tuple[int, float, str, Dict]]]] = None
+    for path in paths:
+        rows: List[Tuple[int, float, str, Dict]] = []
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if not _is_tpu(rec):
+                continue
+            scc = rec.get("scc")
+            speed = rec.get("frontier_speedup_vs_cpp")
+            if not isinstance(scc, int) or not isinstance(speed, (int, float)):
+                continue
+            config = rec.get("frontier_kw")
+            if not isinstance(config, dict):
+                config = {}
+            ok = rec.get("verdict_ok", False) and rec.get("counts_ok", True)
+            rows.append((
+                scc, float(speed) if ok else 0.0,
+                json.dumps(config, sort_keys=True), config,
+            ))
+        if rows:
+            rank = _round_rank(path.name)
+            if newest is None or rank > newest[0]:
+                newest = (rank, path.name, rows)
+    if newest is None:
+        return None
+    _, name, rows = newest
+
+    groups: Dict[str, Dict] = {}
+    for scc, speed, key, config in rows:
+        g = groups.setdefault(key, {"config": config, "by_scc": {}})
+        prev = g["by_scc"].get(scc)
+        g["by_scc"][scc] = speed if prev is None else min(prev, speed)
+
+    best: Optional[Tuple[int, Dict]] = None
+    for g in groups.values():
+        win = None
+        for scc in sorted(g["by_scc"], reverse=True):
+            if g["by_scc"][scc] >= 1.0:
+                win = scc
+            else:
+                break
+        if win is not None and (best is None or win < best[0]):
+            best = (win, g["config"])
+    if best is None:
+        return None
+    win, config = best
+    cfg = f" under {config}" if config else ""
+    return win, config, f"{name}: frontier >= 1x native for scc >= {win}{cfg}"
+
+
+def _crossover_paths() -> List[pathlib.Path]:
+    results = _REPO / "benchmarks" / "results"
+    if results.is_dir():
+        return sorted(results.glob("crossover_tpu_r*.txt"))
+    return []
+
+
+def calibrate(
+    paths: Optional[Iterable[pathlib.Path]] = None,
+    crossover_paths: Optional[Iterable[pathlib.Path]] = None,
+) -> Calibration:
     cal = Calibration()
     cal.provenance = {k: "default" for k in ("accel", "cpu", "cpp")}
     chosen: Dict[str, Tuple[float, str]] = {}
+
+    if crossover_paths is None:
+        # Hermeticity mirrors `paths`: a caller pinning paths=[] gets a
+        # fully artifact-free calibration, not one that still absorbs the
+        # repo's crossover files.
+        crossover_paths = _crossover_paths() if paths is None else []
+    try:
+        win = _frontier_win_min_scc(crossover_paths)
+        if win is not None:
+            (cal.frontier_win_min_scc, cal.frontier_config,
+             cal.provenance["frontier"]) = win
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        pass
 
     try:
         records = list(_iter_records(_artifact_paths() if paths is None else paths))

@@ -116,3 +116,106 @@ def test_repo_artifacts_actually_calibrate():
     # auto.py consumes the calibrated dicts (identity, not a copy).
     assert auto.SWEEP_RATE is CALIBRATION.sweep_rate
     assert auto.ORACLE_SECONDS_PER_CALL is CALIBRATION.oracle_seconds_per_call
+
+
+class TestFrontierWinRegion:
+    """Measured-crossover routing: auto sends large SCCs to the frontier
+    ONLY inside a win region recorded by an on-chip crossover artifact."""
+
+    def _txt(self, tmp_path, name, rows):
+        lines = ["| header |"]
+        for row in rows:
+            scc, speed, dev, ok = row[:4]
+            rec = {
+                "workload": f"w{scc}", "scc": scc, "device": dev,
+                "frontier_speedup_vs_cpp": speed, "verdict_ok": ok,
+                "counts_ok": True,
+            }
+            if len(row) > 4:
+                rec["frontier_kw"] = row[4]
+            lines.append(json.dumps(rec))
+        p = tmp_path / name
+        p.write_text("\n".join(lines))
+        return p
+
+    def test_win_region_from_artifact(self, tmp_path):
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (24, 0.8, "TPU v5 lite", True),
+            (28, 1.3, "TPU v5 lite", True),
+            (32, 2.5, "TPU v5 lite", True),
+        ])
+        cal = calibrate(paths=[], crossover_paths=[p])
+        assert cal.frontier_win_min_scc == 28
+        assert "crossover_tpu_r9.txt" in cal.provenance["frontier"]
+
+    def test_losing_or_unparitied_row_kills_region_above(self, tmp_path):
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (24, 1.5, "TPU v5 lite", True),   # win below a later loss: ignored
+            (28, 0.9, "TPU v5 lite", True),
+            (32, 2.5, "TPU v5 lite", False),  # no verdict parity: counts as loss
+            (36, 2.5, "TPU v5 lite", True),
+        ])
+        cal = calibrate(paths=[], crossover_paths=[p])
+        assert cal.frontier_win_min_scc == 36
+
+    def test_same_scc_win_and_loss_kills_region_there(self, tmp_path):
+        # Two rows at the same scc IN THE SAME CONFIG: the minimum gates.
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (28, 0.9, "TPU v5 lite", True),
+            (28, 1.2, "TPU v5 lite", True),
+            (32, 1.5, "TPU v5 lite", True),
+        ])
+        cal = calibrate(paths=[], crossover_paths=[p])
+        assert cal.frontier_win_min_scc == 32
+
+    def test_win_config_carried_and_grouped(self, tmp_path):
+        # A loss under defaults must not kill a win region measured under a
+        # different config — and the winning config rides into routing.
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (28, 0.9, "TPU v5 lite", True, {}),
+            (28, 1.3, "TPU v5 lite", True, {"pop": 4096}),
+            (32, 2.0, "TPU v5 lite", True, {"pop": 4096}),
+        ])
+        cal = calibrate(paths=[], crossover_paths=[p])
+        assert cal.frontier_win_min_scc == 28
+        assert cal.frontier_config == {"pop": 4096}
+        assert "pop" in cal.provenance["frontier"]
+
+    def test_cpu_rows_and_missing_artifacts_yield_none(self, tmp_path):
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (28, 5.0, "cpu", True),  # emulation rows must not gate chip routing
+        ])
+        assert calibrate(paths=[], crossover_paths=[p]).frontier_win_min_scc is None
+        assert calibrate(paths=[], crossover_paths=[]).frontier_win_min_scc is None
+
+    def test_newest_round_artifact_wins(self, tmp_path):
+        old = self._txt(tmp_path, "crossover_tpu_r4.txt",
+                        [(24, 1.5, "TPU v5 lite", True)])
+        new = self._txt(tmp_path, "crossover_tpu_r5.txt",
+                        [(24, 0.5, "TPU v5 lite", True),
+                         (30, 1.5, "TPU v5 lite", True)])
+        cal = calibrate(paths=[], crossover_paths=[old, new])
+        assert cal.frontier_win_min_scc == 30
+
+    def test_auto_routes_into_measured_win_region(self, tmp_path, monkeypatch):
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", 8)
+        monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
+        res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
+        assert res.intersects is True
+        assert res.stats["backend"] == "tpu-frontier"
+
+    def test_auto_stays_on_host_without_artifact(self, monkeypatch):
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", None)
+        monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
+        res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
+        assert res.stats["backend"] in ("python", "cpp")
