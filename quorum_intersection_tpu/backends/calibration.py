@@ -160,10 +160,18 @@ def _frontier_win_min_scc(
             speed = rec.get("frontier_speedup_vs_cpp")
             if not isinstance(scc, int) or not isinstance(speed, (int, float)):
                 continue
+            # Only rows that RECORDED their config and actually measured
+            # count parity can gate routing: a verdict-only or config-less
+            # row (the bench's standard loop, hand-assembled artifacts)
+            # never qualifies — enumeration completeness and the measured
+            # kwargs are the whole point of the gate.
             config = rec.get("frontier_kw")
             if not isinstance(config, dict):
-                config = {}
-            ok = rec.get("verdict_ok", False) and rec.get("counts_ok", True)
+                continue
+            ok = (
+                rec.get("verdict_ok", False)
+                and rec.get("counts_ok") is True
+            )
             rows.append((
                 scc, float(speed) if ok else 0.0,
                 json.dumps(config, sort_keys=True), config,

@@ -130,13 +130,28 @@ class TestFrontierWinRegion:
                 "workload": f"w{scc}", "scc": scc, "device": dev,
                 "frontier_speedup_vs_cpp": speed, "verdict_ok": ok,
                 "counts_ok": True,
+                # Machine-readable config is an ELIGIBILITY requirement
+                # (config-less rows never gate routing).
+                "frontier_kw": row[4] if len(row) > 4 else {},
             }
-            if len(row) > 4:
-                rec["frontier_kw"] = row[4]
             lines.append(json.dumps(rec))
         p = tmp_path / name
         p.write_text("\n".join(lines))
         return p
+
+    def test_configless_or_countless_rows_never_qualify(self, tmp_path):
+        p = tmp_path / "crossover_tpu_r9.txt"
+        p.write_text("\n".join([
+            # no frontier_kw: the bench's standard loop / hand-made rows
+            json.dumps({"scc": 28, "device": "TPU v5 lite",
+                        "frontier_speedup_vs_cpp": 5.0, "verdict_ok": True,
+                        "counts_ok": True}),
+            # no counts_ok: enumeration completeness never measured
+            json.dumps({"scc": 32, "device": "TPU v5 lite",
+                        "frontier_speedup_vs_cpp": 5.0, "verdict_ok": True,
+                        "frontier_kw": {}}),
+        ]))
+        assert calibrate(paths=[], crossover_paths=[p]).frontier_win_min_scc is None
 
     def test_win_region_from_artifact(self, tmp_path):
         p = self._txt(tmp_path, "crossover_tpu_r9.txt", [

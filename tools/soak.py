@@ -52,32 +52,39 @@ LEDGER = _REPO / "benchmarks" / "results" / "soak_ledger.json"
 SWEEP_SCC_LIMIT = 15
 
 
-def make_instance(seed: int):
+def make_instance(seed: int, profile: str = "small"):
     """Seed → (kind, description, node list).  The mix mirrors the
     generator families the differential suite covers, with ~40% broken
-    twins so the witness path is exercised as hard as the safe path."""
+    twins so the witness path is exercised as hard as the safe path.
+    ``profile="large"`` scales every family up to routing-relevant SCC
+    sizes (14-20) — slower per instance, but it soaks the arena-spill and
+    batched-flag paths the small profile rarely reaches."""
     from quorum_intersection_tpu.fbas import synth
 
+    big = profile == "large"
     rng = random.Random(seed)
     kind = rng.choice(["random", "hierarchical", "majority", "stellar", "benchmark"])
     broken = rng.random() < 0.4
     if kind == "random":
-        n = rng.randint(6, 16)
+        n = rng.randint(14, 20) if big else rng.randint(6, 16)
         data = synth.random_fbas(
             n, seed=seed, nested_prob=rng.random() * 0.5,
             null_prob=rng.random() * 0.2, dangling_prob=rng.random() * 0.2,
         )
         desc = f"random(n={n})"
     elif kind == "hierarchical":
-        orgs, per = rng.randint(3, 4), rng.randint(2, 3)
+        # Large profile keeps the SCC in the claimed 14-20+ band: 5x3=15 up
+        # to 6x4=24 (orgs alone with per 2-3 could dip to 10 nodes).
+        orgs = rng.randint(5, 6) if big else rng.randint(3, 4)
+        per = rng.randint(3, 4) if big else rng.randint(2, 3)
         data = synth.hierarchical_fbas(orgs, per, broken=broken)
         desc = f"hier({orgs}x{per},broken={broken})"
     elif kind == "majority":
-        n = rng.randint(5, 13)
+        n = rng.randint(14, 18) if big else rng.randint(5, 13)
         data = synth.majority_fbas(n, broken=broken)
         desc = f"majority(n={n},broken={broken})"
     elif kind == "stellar":
-        orgs = rng.randint(3, 4)
+        orgs = rng.randint(5, 6) if big else rng.randint(3, 4)
         data = synth.stellar_like_fbas(
             n_core_orgs=orgs, per_org=3, n_watchers=rng.randint(8, 25),
             n_null=rng.randint(0, 6), n_dangling=rng.randint(0, 3),
@@ -85,7 +92,7 @@ def make_instance(seed: int):
         )
         desc = f"stellar(orgs={orgs},broken={broken})"
     else:
-        core = rng.randint(7, 10)
+        core = rng.randint(13, 16) if big else rng.randint(7, 10)
         n_total = core + rng.randint(8, 20)
         data = synth.benchmark_fbas(
             n_total, core, nested_watchers=rng.random() < 0.5,
@@ -109,7 +116,7 @@ def witness_valid(graph, res) -> bool:
     )
 
 
-def run_instance(seed: int) -> dict:
+def run_instance(seed: int, profile: str = "small") -> dict:
     """Solve one instance on every applicable engine; return the record
     with any mismatches listed (empty list = clean)."""
     from quorum_intersection_tpu.backends.cpp import CppOracleBackend
@@ -120,7 +127,7 @@ def run_instance(seed: int) -> dict:
     from quorum_intersection_tpu.fbas.schema import parse_fbas
     from quorum_intersection_tpu.pipeline import solve
 
-    kind, desc, data = make_instance(seed)
+    kind, desc, data = make_instance(seed, profile)
     graph = build_graph(parse_fbas(data))
     count, comp = tarjan_scc(graph.n, graph.succ)
     max_scc = max(len(s) for s in group_sccs(graph.n, comp, count))
@@ -191,6 +198,9 @@ def main(argv=None) -> int:
                         help="run without recording to the ledger")
     parser.add_argument("--force", action="store_true",
                         help="re-run a window the ledger already records")
+    parser.add_argument("--profile", choices=("small", "large"), default="small",
+                        help="large: routing-relevant SCC sizes (14-20); slower "
+                             "per instance, soaks spill + batched-flag paths")
     parser.add_argument("--platform", choices=("cpu", "ambient"), default="cpu",
                         help="cpu (default): pin jax to the host CPU so a dead "
                              "tunnel can never hang the soak; ambient: use "
@@ -210,7 +220,8 @@ def main(argv=None) -> int:
     window = [args.seed, args.seed + args.instances]
     if not args.force and not args.no_ledger:
         for run in ledger["runs"]:
-            if run["window"] == window:
+            if (run["window"] == window
+                    and run.get("profile", "small") == args.profile):
                 print(f"window {window} already recorded ({run['instances']} "
                       f"instances, {run['n_mismatches']} mismatches); use "
                       f"--force to re-run or pick a fresh --seed", file=sys.stderr)
@@ -220,7 +231,7 @@ def main(argv=None) -> int:
     by_gen: dict = {}
     bad: list = []
     for i, seed in enumerate(range(*window)):
-        rec = run_instance(seed)
+        rec = run_instance(seed, args.profile)
         by_gen[rec["kind"]] = by_gen.get(rec["kind"], 0) + 1
         if rec["mismatches"]:
             bad.append(rec)
@@ -233,6 +244,7 @@ def main(argv=None) -> int:
     elapsed = time.time() - t0
     summary = {
         "window": window,
+        "profile": args.profile,
         "instances": args.instances,
         "n_mismatches": len(bad),
         "mismatches": bad,
