@@ -11,17 +11,20 @@ metric), not TPU-nativeness for its own sake:
   overhead.  If the search proves pathological and burns the budget
   (``OracleBudgetExceeded``), fall back to the sweep — exact and bounded at
   2^(|scc|-1)/rate.  Worst case ≈ 2× the sweep cost; typical case ≈ free.
-- **large SCC** (> ``sweep_limit``): the pruned search is the only tractable
-  option — native C++ oracle, falling back to pure Python — on EVERY
-  platform.  The r2 assumption that the TPU hybrid would win on a real chip
-  was measured false in r3 (benchmarks/results/crossover_tpu_r3.txt): the
-  hybrid's frontier is host-sequential and each batch pays a device
-  round-trip, sustaining ~9k fixpoints/s through the tunneled chip against
-  the native oracle's ~1.4M B&B calls/s — a 100-1000× loss at every
-  tractable size, mirroring the CPU-emulation crossover.  The hybrid stays
-  reachable only as an explicit opt-in (``--backend tpu-hybrid``) where its
-  orthogonal capabilities (frontier checkpointing, mesh-sharded fixpoints)
-  are wanted.
+- **large SCC** (> ``sweep_limit``): the pruned search — native C++
+  oracle, falling back to pure Python — unless a MEASURED on-chip win
+  region says otherwise: when the newest ``crossover_tpu_r*.txt`` artifact
+  records the device-resident frontier beating the native oracle from
+  some |scc| upward (verdict + minimal-quorum-count parity on every
+  qualifying row, config recorded), accelerator platforms route those
+  SCCs to the frontier under the exact measured config
+  (``calibration.frontier_win_min_scc``).  No artifact ⇒ host oracle
+  everywhere — routing claims about the chip stay tied to recorded
+  measurements.  The round-trip HYBRID never routes: r3 measured it
+  losing 100-1000× at every tractable size on chip and CPU alike
+  (benchmarks/results/crossover_tpu_r3.txt — host-sequential frontier,
+  ~9k fixpoints/s through the tunnel vs ~1.4M native B&B calls/s); it
+  stays reachable only as an explicit opt-in (``--backend tpu-hybrid``).
 
 Every selection is logged; failures to import/compile an accelerator backend
 degrade gracefully to the next option so the CLI always yields a verdict.
