@@ -104,7 +104,7 @@ RECOVERY_MIN_REMAINING = 300.0
 # measured floor alongside — see phase_verdict.
 VERDICT_CONFIGS = {
     "256": dict(n_total=256, core=34, nested=False),
-    "1024": dict(n_total=1024, core=33, nested=True),
+    "1024": dict(n_total=1024, core=34, nested=True),
 }
 VERDICT_CONFIGS_QUICK = {
     "256": dict(n_total=64, core=14, nested=False),
