@@ -239,6 +239,36 @@ def test_frontier_mesh_count_parity():
 
 
 @needs_8_devices
+def test_frontier_mesh_with_device_flag_filter():
+    # Mesh sharding composes with the batched device flag pipeline (the
+    # filter runs replicated outside the shard_mapped chunk): count parity
+    # on a flag-heavy safe network, exact witness on a broken one, zero
+    # serial host checks on the safe path.
+    from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+    from quorum_intersection_tpu.fbas.synth import stellar_like_fbas
+
+    mesh = candidate_mesh(8)
+    po = solve(hierarchical_fbas(5, 3), backend=PythonOracleBackend())
+    fr = solve(
+        hierarchical_fbas(5, 3),
+        backend=TpuFrontierBackend(arena=8192, pop=256, mesh=mesh,
+                                   flag_check="device"),
+    )
+    assert fr.intersects is True
+    assert fr.stats["minimal_quorums"] == po.stats["minimal_quorums"] > 0
+    assert fr.stats["host_checks"] == 0
+
+    br = solve(
+        stellar_like_fbas(n_core_orgs=4, per_org=3, n_watchers=10, broken=True),
+        backend=TpuFrontierBackend(arena=8192, pop=256, mesh=mesh,
+                                   flag_check="device"),
+    )
+    assert br.intersects is False
+    assert br.q1 and br.q2 and not set(br.q1) & set(br.q2)
+
+
+@needs_8_devices
 def test_frontier_mesh_nondividing_device_count():
     # A device count that does not divide arena//4 must clamp the rounded
     # pop block so the overflow-spill compaction can never go negative
