@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -111,11 +112,17 @@ VERDICT_CONFIGS_QUICK = {
     "1024": dict(n_total=96, core=16, nested=True),
 }
 NATIVE_CAP_S = {"full": 120.0, "quick": 20.0}
-# B&B call-count model for a symmetric k-of-n core: ≈ 3.8 × C(n, n//2)
-# (BASELINE.md measured table, n = 8..20: 251, 3 431, 48 619, 705 431 —
-# the 3.8 multiplier is stable across the fit range; beyond n=20 this is
-# an extrapolation of that verified trend and is labeled as such).
-NATIVE_CALLS_MODEL = "3.8*C(n,n//2) (BASELINE.md n=8..20)"
+# B&B call-count model for a symmetric k-of-n core, measured n = 8..26
+# (benchmarks/results/native_calls_model_r4.txt): odd n lands on exactly
+# 4·C(n, n//2); even n on 4·C(n, n//2)·(1 − 1/(n+2)) (3-decimal match for
+# n >= 14; small even n a few thousandths lower).
+# Beyond n=26 this is an extrapolation of that law and labeled as such.
+NATIVE_CALLS_MODEL = "4*C(n,n//2)*(1-1/(n+2) if even) (native_calls_model_r4.txt n=8..26)"
+
+
+def native_calls_estimate(core: int) -> float:
+    mult = 4.0 - (4.0 / (core + 2) if core % 2 == 0 else 0.0)
+    return mult * math.comb(core, core // 2)
 
 # int8 MXU peak MACs/s by device kind substring — the sweep kernel's
 # operands are int8 on TPU (kernels.CircuitArrays), so the roofline basis
@@ -308,9 +315,10 @@ def phase_verdict(config: str, quick: bool) -> dict:
     three ways, each honestly labeled: `native_seconds` (measured, a FLOOR
     when `native_completed` is false), `native_rate` (B&B calls/s measured
     on this instance), and `native_est_seconds` (rate × the
-    NATIVE_CALLS_MODEL count — an extrapolation of the BASELINE.md-verified
-    trend).  `ratio_est` uses the estimate; `ratio_floor` uses only
-    measured time."""
+    NATIVE_CALLS_MODEL count — an extrapolation of the call-count law
+    measured to n=26 in benchmarks/results/native_calls_model_r4.txt).
+    `ratio_est` uses the estimate; `ratio_floor` uses only measured
+    time."""
     from quorum_intersection_tpu.fbas.synth import benchmark_fbas
     from quorum_intersection_tpu.pipeline import solve
 
@@ -355,8 +363,6 @@ def _native_verdict_baseline(data, core: int, cap_s: float) -> dict:
     measure the call rate with a budgeted probe run, finish the search if
     the model says it fits in ``cap_s``, else report the measured floor plus
     the model estimate."""
-    import math
-
     from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
     from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
     from quorum_intersection_tpu.fbas.schema import parse_fbas
@@ -368,7 +374,7 @@ def _native_verdict_baseline(data, core: int, cap_s: float) -> dict:
     scc = next(
         s for s, q in zip(sccs, scan_scc_quorums(graph, sccs)) if q
     )
-    expected_calls = 3.8 * math.comb(core, core // 2)
+    expected_calls = native_calls_estimate(core)
 
     try:  # native oracle, degrading to pure Python like every other consumer
         from quorum_intersection_tpu.backends.cpp import CppOracleBackend as Oracle
