@@ -234,7 +234,8 @@ class TpuFrontierBackend:
     # ---- device flag filter ---------------------------------------------
 
     def _build_flag_filter(self, circuit: Circuit, scc: List[int],
-                           scope_to_scc: bool, block: int):
+                           scope_to_scc: bool, block: int,
+                           probe_circuit: Optional[Circuit] = None):
         """Compile ``filter_block(flags, count) -> (minimal_count, widx)``:
         the flagged-state pipeline as batched device fixpoints.
 
@@ -261,13 +262,19 @@ class TpuFrontierBackend:
         )
 
         arrays = CircuitArrays(circuit)
+        # Probe availability: with an SCC-restricted circuit the Q6 outside
+        # contribution is FOLDED into ``probe_circuit``'s thresholds, so the
+        # frozen row is all-zero; unrestricted, the single circuit serves
+        # both sides and the frozen row carries the outside nodes.
+        probe_arrays = arrays if probe_circuit is None else CircuitArrays(probe_circuit)
         s = len(scc)
         n = circuit.n
         scc_idx = jnp.asarray(np.asarray(scc, dtype=np.int32))
         scc_mask_n = jnp.zeros((n,), dtype=arrays.dtype).at[scc_idx].set(1)
         frozen = (
-            jnp.zeros((n,), dtype=arrays.dtype) if scope_to_scc
-            else (1 - scc_mask_n).astype(arrays.dtype)
+            jnp.zeros((n,), dtype=probe_arrays.dtype)
+            if (scope_to_scc or probe_circuit is not None)
+            else (1 - scc_mask_n).astype(probe_arrays.dtype)
         )
         eye_inv = (1 - jnp.eye(s, dtype=jnp.int8))
 
@@ -284,13 +291,13 @@ class TpuFrontierBackend:
             has_q = (q.sum(-1, dtype=jnp.int32) > 0).reshape(block, s)
             minimal = valid & ~jnp.any(has_q & member, axis=1)
 
-            d_n = jnp.zeros((block, n), dtype=arrays.dtype).at[:, scc_idx].set(
-                flags_blk.astype(arrays.dtype)
+            d_n = jnp.zeros((block, n), dtype=probe_arrays.dtype).at[:, scc_idx].set(
+                flags_blk.astype(probe_arrays.dtype)
             )
             probe_avail = jnp.clip(
-                scc_mask_n[None, :] - d_n, 0, 1
-            ).astype(arrays.dtype)
-            pq = fixpoint(arrays, probe_avail, frozen)
+                scc_mask_n.astype(jnp.int32)[None, :] - d_n.astype(jnp.int32), 0, 1
+            ).astype(probe_arrays.dtype)
+            pq = fixpoint(probe_arrays, probe_avail, frozen)
             probe_hit = pq.sum(-1, dtype=jnp.int32) > 0
             wit = minimal & probe_hit
             widx = jnp.where(
@@ -504,6 +511,32 @@ class TpuFrontierBackend:
                 if j is not None:
                     a_scc[scc_pos[u], j] += 1
 
+        # SCC restriction (encode.restrict_circuit_pair): on graphs wider
+        # than the SCC, fold the constant outside-availability into the
+        # thresholds and run every device fixpoint s-wide instead of
+        # n-wide.  The scoped fold drives the interior (candidate-scoped
+        # semantics, matching the oracle's avail construction); the Q6 fold
+        # rides into the flag filter's disjointness probe.  Host-side
+        # witness checks keep the ORIGINAL graph/scc (exact semantics are
+        # never restricted).
+        probe_circuit = None
+        scc_local = scc
+        if circuit.n > s:
+            from quorum_intersection_tpu.encode.circuit import restrict_circuit_pair
+
+            scoped_c, q6_c = restrict_circuit_pair(circuit, scc)
+            log.debug(
+                "frontier restricted to |scc|=%d: n %d->%d, units %d->%d",
+                s, circuit.n, scoped_c.n, circuit.n_units, scoped_c.n_units,
+            )
+            circuit = scoped_c
+            # Scoped searches need no separate probe fold (the filter's
+            # all-zero frozen row over the scoped circuit IS the scoped
+            # probe) — mirroring the sweep's circuit_d=None, and avoiding a
+            # duplicate device upload of identical constants.
+            probe_circuit = None if scope_to_scc else q6_c
+            scc_local = list(range(s))
+
         K = self.pop
         if self.mesh is not None:
             # The double-height fixpoint batch must split evenly across the
@@ -521,7 +554,7 @@ class TpuFrontierBackend:
                 ((K + n_dev - 1) // n_dev) * n_dev,
                 (self.arena // 4 // n_dev) * n_dev,
             )
-        run_chunk = self._build_chunk(circuit, scc, a_scc, half, K)
+        run_chunk = self._build_chunk(circuit, scc_local, a_scc, half, K)
         # Built lazily on the first flagged batch: majority-style searches
         # flag nothing, and the native engine behind the checker may pay a
         # one-off g++ compile that a pure device run should never wait on.
@@ -550,15 +583,23 @@ class TpuFrontierBackend:
         if self.checkpoint is not None:
             from quorum_intersection_tpu.utils.checkpoint import sweep_fingerprint
 
+            # Masks live in the (possibly restricted) circuit's index space
+            # — scc_local, NOT graph ids.  When restricted, the Q6/scoped
+            # distinction moved into the probe thresholds, so the frozen
+            # row is all-zero and the probe thresholds join the hash to
+            # keep the two problems' fingerprints distinct (cf. the sweep's
+            # fingerprint block).
             scc_mask = np.zeros(circuit.n, dtype=np.float32)
-            scc_mask[scc] = 1.0
+            scc_mask[scc_local] = 1.0
             frozen = (
-                np.zeros(circuit.n, dtype=np.float32) if scope_to_scc
+                np.zeros(circuit.n, dtype=np.float32)
+                if (scope_to_scc or probe_circuit is not None)
                 else 1.0 - scc_mask
             )
             fingerprint = sweep_fingerprint(
                 circuit.members, circuit.child, circuit.thresholds,
                 np.asarray(scc, dtype=np.int32), scc_mask, frozen,
+                None if probe_circuit is None else probe_circuit.thresholds,
             )
             resumed = self.checkpoint.resume_states(fingerprint)
 
@@ -663,7 +704,8 @@ class TpuFrontierBackend:
                 return
             if flag_filter is None:
                 flag_filter = self._build_flag_filter(
-                    circuit, scc, scope_to_scc, flag_block
+                    circuit, scc_local, scope_to_scc, flag_block,
+                    probe_circuit=probe_circuit,
                 )
             for start in range(0, len(rows), flag_block):
                 blk = rows[start:start + flag_block]

@@ -247,6 +247,7 @@ def sweep_step(
     scc_mask: jnp.ndarray,
     frozen: jnp.ndarray,
     hi_mask: Optional[jnp.ndarray] = None,
+    arrays_d: Optional[CircuitArrays] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Evaluate one contiguous block of candidate subsets.
 
@@ -263,13 +264,18 @@ def sweep_step(
     sizes (diagnostics).  Witness reconstruction happens on the host from
     the first hit index.
     """
+    # The Q fixpoint is scoped to the candidates; the D probe runs under the
+    # availability the caller encodes in ``frozen`` — OR, when the circuit
+    # was SCC-restricted (encode.restrict_circuit_pair), in ``arrays_d``'s
+    # pre-folded thresholds with frozen all-zero.
+    ad = arrays if arrays_d is None else arrays_d
     avail = decode_masks(start, batch, pos, arrays.dtype)
     if hi_mask is not None:
         avail = jnp.maximum(avail, hi_mask)
     q = fixpoint(arrays, avail)
     q_size = q.sum(axis=-1, dtype=jnp.int32)
-    complement = jnp.clip(scc_mask - q, 0, 1).astype(arrays.dtype)
-    d = fixpoint(arrays, complement, frozen)
+    complement = jnp.clip(scc_mask - q, 0, 1).astype(ad.dtype)
+    d = fixpoint(ad, complement, frozen)
     hit = jnp.logical_and(q_size > 0, d.sum(axis=-1, dtype=jnp.int32) > 0)
     return hit, q_size
 
@@ -300,6 +306,7 @@ def sweep_program_factory(
     scc_mask: np.ndarray,
     frozen: Optional[np.ndarray],
     batch: int,
+    circuit_d: Optional[Circuit] = None,
 ) -> Callable[[int], Callable[[int], jnp.ndarray]]:
     """Build sweep programs sharing one set of device-resident constants.
 
@@ -318,11 +325,13 @@ def sweep_program_factory(
     arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
         circuit, bit_nodes, scc_mask, frozen
     )
+    arrays_d = None if circuit_d is None else CircuitArrays(circuit_d)
     zeros_hi = jnp.zeros((circuit.n,), dtype=arrays.dtype)
 
     def block_min_hit(start, hi_mask):
         hit, _ = sweep_step(
-            arrays, start, batch, pos_j, scc_mask_j, frozen_j, hi_mask
+            arrays, start, batch, pos_j, scc_mask_j, frozen_j, hi_mask,
+            arrays_d=arrays_d,
         )
         idx = start + jnp.arange(batch, dtype=jnp.int32)
         return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()

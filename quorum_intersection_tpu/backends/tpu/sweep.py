@@ -253,11 +253,40 @@ class TpuSweepBackend:
 
         enable_compilation_cache()
 
+        # SCC restriction (encode.restrict_circuit_pair): when the graph is
+        # wider than the SCC, project the circuit onto the SCC's columns and
+        # fold the constant outside-availability into thresholds — the
+        # fixpoint matmuls shrink from (B,n)x(n,U) to (B,s)x(s,U').  The
+        # scoped fold drives the Q-side; the Q6 fold rides in ``circuit_d``
+        # for the D-side probe (kernels.sweep_step).  ``nodes`` keeps the
+        # graph-space ids for witness reconstruction.
+        nodes = list(scc)
+        circuit_d = None
+        engine = self.engine
+        restricted = circuit.n > s
+        if restricted:
+            from quorum_intersection_tpu.encode.circuit import restrict_circuit_pair
+
+            scoped_c, q6_c = restrict_circuit_pair(circuit, scc)
+            log.debug(
+                "sweep restricted to |scc|=%d: n %d->%d, units %d->%d",
+                s, circuit.n, scoped_c.n, circuit.n_units, scoped_c.n_units,
+            )
+            circuit = scoped_c
+            if not scope_to_scc:
+                circuit_d = q6_c
+            scc = list(range(s))
+            if engine == "pallas":
+                log.warning(
+                    "pallas engine requested but SCC-restricted sweeps use the XLA path"
+                )
+                engine = "xla"
+
         n = circuit.n
         scc_mask = np.zeros(n, dtype=np.float32)
         scc_mask[scc] = 1.0
         frozen = None
-        if not scope_to_scc:
+        if not scope_to_scc and not restricted:
             frozen = np.ones(n, dtype=np.float32) - scc_mask
         bit_nodes = np.asarray(scc[1:], dtype=np.int32)
 
@@ -281,6 +310,9 @@ class TpuSweepBackend:
             fingerprint = sweep_fingerprint(
                 circuit.members, circuit.child, circuit.thresholds,
                 bit_nodes, scc_mask, frozen,
+                # The restricted scoped/Q6 variants share every array above;
+                # the D-side thresholds keep the two PROBLEMS distinct.
+                None if circuit_d is None else circuit_d.thresholds,
             )
             start0 = self.checkpoint.resume_position(total, fingerprint)
             if start0:
@@ -295,18 +327,18 @@ class TpuSweepBackend:
             # the drain masks aliased hit indices.
             batch = 1 << (min(batch, lo_total).bit_length() - 1)
         lo_nodes = np.asarray(scc[1 : 1 + lo_bits], dtype=np.int32)
-        if self.engine == "pallas" and self.mesh is not None:
+        if engine == "pallas" and self.mesh is not None:
             log.warning("pallas engine requested but mesh sharding uses the XLA path")
-        elif self.engine == "pallas" and hi_nodes:
+        elif engine == "pallas" and hi_nodes:
             log.warning(
                 "pallas engine requested but wide (>2^%d) sweeps use the XLA path",
                 lo_bits,
             )
         if self.mesh is not None:
             base_block, make_dispatch = self._build_sharded_step(
-                circuit, lo_nodes, scc_mask, frozen, batch
+                circuit, lo_nodes, scc_mask, frozen, batch, circuit_d=circuit_d
             )
-        elif self.engine == "pallas" and not hi_nodes and _pallas_ok(circuit):
+        elif engine == "pallas" and not hi_nodes and _pallas_ok(circuit):
             # (wide sweeps use the XLA path: the pallas kernel has no
             # hi-mask input and wide enumerations are its weak spot anyway)
             from quorum_intersection_tpu.backends.tpu import pallas_sweep
@@ -321,7 +353,8 @@ class TpuSweepBackend:
             base_block = min(batch, max(lo_total, 1))
             # Device constants upload once; each ramp level only compiles.
             make_dispatch = sweep_program_factory(
-                circuit, lo_nodes, scc_mask, frozen, base_block
+                circuit, lo_nodes, scc_mask, frozen, base_block,
+                circuit_d=circuit_d,
             )
 
         # Pipelined drive: keep up to MAX_INFLIGHT asynchronous device
@@ -591,8 +624,8 @@ class TpuSweepBackend:
             return SccCheckResult(intersects=True, stats=stats)
 
         # Decode the winning subset and rebuild the witness pair on the host.
-        subset = [int(bit_nodes[j]) for j in range(bits) if (first_hit >> j) & 1]
-        q, disjoint = self._witness(graph, scc, subset, scope_to_scc)
+        subset = [nodes[1 + j] for j in range(bits) if (first_hit >> j) & 1]
+        q, disjoint = self._witness(graph, nodes, subset, scope_to_scc)
         if not q or not disjoint:
             # Defense in depth: the host recheck uses the exact reference
             # semantics, so an empty member here means the device decode lied
@@ -648,7 +681,8 @@ class TpuSweepBackend:
 
     # ---- sharded step ----------------------------------------------------
 
-    def _build_sharded_step(self, circuit, bit_nodes, scc_mask, frozen, batch):
+    def _build_sharded_step(self, circuit, bit_nodes, scc_mask, frozen, batch,
+                            circuit_d=None):
         """Mesh-sharded sweep step: each device takes a contiguous sub-block
         (``steps_per_call`` of them per program), hit indices combine with one
         pmin collective.  Returns ``(base_block, make_dispatch)`` matching the
@@ -672,6 +706,9 @@ class TpuSweepBackend:
         arrays, pos_j, scc_mask_j, frozen_j = sweep_constants(
             circuit, bit_nodes, scc_mask, frozen
         )
+        from quorum_intersection_tpu.backends.tpu.kernels import CircuitArrays
+
+        arrays_d = None if circuit_d is None else CircuitArrays(circuit_d)
         zeros_hi = jnp.zeros((circuit.n,), dtype=arrays.dtype)
 
         def make_dispatch(steps_per_call: int):
@@ -684,7 +721,7 @@ class TpuSweepBackend:
                     my_start = block_start + rank.astype(jnp.int32) * per_dev
                     hit, _ = sweep_step(
                         arrays, my_start, per_dev, pos_j, scc_mask_j, frozen_j,
-                        hi_mask,
+                        hi_mask, arrays_d=arrays_d,
                     )
                     idx = my_start + jnp.arange(per_dev, dtype=jnp.int32)
                     return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()

@@ -198,6 +198,125 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
     )
 
 
+def restrict_circuit_pair(circuit: Circuit, scc: List[int]) -> tuple:
+    """Project the circuit onto the SCC's columns, folding the constant
+    contribution of non-SCC nodes into thresholds — both folds at once:
+    ``(scoped, q6)``, identical members/child/unit layout.
+
+    Device searches (sweep, frontier) only ever evaluate availability rows
+    whose support lies inside the SCC; every other node's availability is a
+    CONSTANT for the whole search — 0 for the candidate-scoped Q-side
+    fixpoints, 1 for the Q6 whole-graph-availability probes (cpp:354).
+    Constants fold: a unit's non-SCC member votes become a threshold
+    reduction, and a unit with no SCC node in its transitive support has a
+    statically known satisfaction that folds into its parents the same way.
+    What remains is an equivalent circuit over ``len(scc)`` nodes — for a
+    1024-node snapshot with a 34-node core, the fixpoint matmuls shrink
+    from (B,1024)x(1024,U) to (B,34)x(34,U'), a ~30x MXU-work reduction at
+    identical semantics.  The dynamic-unit classification is fold-
+    independent, so the two variants share every array except thresholds —
+    searches that scope their Q-side but probe under Q6 (sweep_step, the
+    frontier's flag filter) take one of each.
+
+    Equivalence (pinned by differential tests): for any availability row
+    ``a`` with support ⊆ scc,
+    ``fixpoint(full, a, frozen)[scc] == fixpoint(restricted, a[scc])``
+    where ``frozen`` is the constant outside-availability row of the
+    matching fold.  Thresholds may legitimately become <= 0 here
+    ("satisfied by constants alone") — the kernels' ``>=`` compare needs
+    no special casing.  New node *j* is ``scc[j]``; root-unit layout
+    (unit j = node j's qset) is preserved.
+    """
+    n, U = circuit.n, circuit.n_units
+    s = len(scc)
+    scc_arr = np.asarray(scc, dtype=np.int64)
+    in_s = np.zeros(n, dtype=bool)
+    in_s[scc_arr] = True
+
+    members = circuit.members.astype(np.int64)
+    child = circuit.child.astype(np.int64)
+    const_votes = members[:, ~in_s].sum(axis=1)  # Q6 fold; scoped fold is 0
+    has_s_member = members[:, scc_arr].sum(axis=1) > 0
+
+    # Bottom-up (children are always deeper-interned units, so ascending
+    # height order visits children first): classify units as dynamic (an
+    # SCC node somewhere in the transitive support) and evaluate static
+    # units' constant satisfaction under each fold.
+    order = np.argsort(circuit.unit_depth, kind="stable")
+    dynamic = has_s_member.copy()
+    static_sat = {True: np.zeros(U, dtype=bool), False: np.zeros(U, dtype=bool)}
+    for u in order:
+        kids = np.nonzero(child[u])[0]
+        if kids.size and dynamic[kids].any():
+            dynamic[u] = True
+        if not dynamic[u]:
+            for q6 in (False, True):
+                votes = const_votes[u] if q6 else 0
+                if kids.size:
+                    votes += int((child[u, kids] * static_sat[q6][kids]).sum())
+                static_sat[q6][u] = votes >= circuit.thresholds[u]
+
+    thr = {q6: circuit.thresholds.astype(np.int64).copy() for q6 in (False, True)}
+    for u in np.nonzero(dynamic)[0]:
+        kids = np.nonzero(child[u])[0]
+        sk = kids[~dynamic[kids]] if kids.size else kids
+        for q6 in (False, True):
+            if q6:
+                thr[q6][u] -= const_votes[u]
+            if sk.size:
+                thr[q6][u] -= int((child[u, sk] * static_sat[q6][sk]).sum())
+
+    # Keep every SCC root (in scc order — the new root layout) plus the
+    # dynamic units reachable from them.  Static children folded above;
+    # dynamic units unreachable from SCC roots are dead weight.
+    keep: List[int] = [int(v) for v in scc_arr]
+    keep_set = set(keep)
+    stack = list(keep)
+    while stack:
+        u = stack.pop()
+        for c in np.nonzero(child[u])[0]:
+            c = int(c)
+            if dynamic[c] and c not in keep_set:
+                keep_set.add(c)
+                keep.append(c)
+                stack.append(c)
+    remap = {u: i for i, u in enumerate(keep)}
+
+    U2 = len(keep)
+    i32 = np.iinfo(np.int32)
+    members2 = np.zeros((U2, s), dtype=np.uint8)
+    child2 = np.zeros((U2, U2), dtype=np.uint8)
+    thresholds2 = {q6: np.zeros(U2, dtype=np.int32) for q6 in (False, True)}
+    for u in keep:
+        i = remap[u]
+        for q6 in (False, True):
+            thresholds2[q6][i] = int(np.clip(thr[q6][u], i32.min + 1, i32.max))
+        members2[i] = circuit.members[u, scc_arr]
+        for c in np.nonzero(child[u])[0]:
+            c = int(c)
+            if dynamic[c]:
+                child2[i, remap[c]] = circuit.child[u, c]
+
+    depth2 = np.zeros(U2, dtype=np.int32)
+    for u in sorted(keep, key=lambda x: int(circuit.unit_depth[x])):
+        i = remap[u]
+        kids = np.nonzero(child2[i])[0]
+        depth2[i] = 0 if kids.size == 0 else int(depth2[kids].max()) + 1
+
+    def build(q6: bool) -> Circuit:
+        return Circuit(
+            n=s,
+            n_units=U2,
+            depth=int(depth2.max(initial=0)),
+            thresholds=thresholds2[q6],
+            members=members2,
+            child=child2,
+            unit_depth=depth2,
+        )
+
+    return build(False), build(True)
+
+
 def node_sat_np(circuit: Circuit, avail: np.ndarray) -> np.ndarray:
     """NumPy reference evaluator: which nodes have a satisfied slice?
 

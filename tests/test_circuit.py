@@ -94,3 +94,83 @@ def test_overflow_raises_not_wraps():
     g = build_graph(parse_fbas(dup_validators))
     with pytest.raises(ValueError, match="255"):
         encode_circuit(g)
+
+
+class TestRestrictCircuit:
+    """SCC restriction (encode.restrict_circuit_pair): folding constant
+    outside-availability into thresholds must be EXACTLY equivalent to the
+    full-width fixpoint with a frozen row, for rows supported inside the
+    SCC — both folds (scoped Q-side, Q6 D-side)."""
+
+    def _cases(self):
+        from quorum_intersection_tpu.fbas.synth import (
+            benchmark_fbas, random_fbas, stellar_like_fbas,
+        )
+
+        return [
+            benchmark_fbas(64, 12, nested_watchers=True, seed=3),
+            stellar_like_fbas(n_core_orgs=4, per_org=3, n_watchers=20,
+                              n_null=5, n_dangling=2),
+            random_fbas(24, seed=5, nested_prob=0.4, null_prob=0.15,
+                        dangling_prob=0.2),
+        ]
+
+    def test_fixpoint_equivalence_both_folds(self):
+        import jax.numpy as jnp
+
+        from quorum_intersection_tpu.backends.tpu.kernels import (
+            CircuitArrays, fixpoint,
+        )
+        from quorum_intersection_tpu.encode.circuit import restrict_circuit_pair
+        from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
+        from quorum_intersection_tpu.pipeline import scan_scc_quorums
+
+        rng = np.random.default_rng(0)
+        for data in self._cases():
+            g = build_graph(parse_fbas(data))
+            circuit = encode_circuit(g)
+            count, comp = tarjan_scc(g.n, g.succ)
+            sccs = group_sccs(g.n, comp, count)
+            scc = next(
+                (s for s, q in zip(sccs, scan_scc_quorums(g, sccs)) if q),
+                sccs[0],
+            )
+            s = len(scc)
+            scoped_c, q6_c = restrict_circuit_pair(circuit, scc)
+            assert scoped_c.n == q6_c.n == s
+            assert scoped_c.n_units < circuit.n_units or circuit.n == s
+            fa = CircuitArrays(circuit)
+            rows_s = (rng.random((48, s)) < 0.5).astype(np.float32)
+            rows_n = np.zeros((48, g.n), np.float32)
+            rows_n[:, scc] = rows_s
+            frozen = np.ones(g.n, np.float32)
+            frozen[scc] = 0.0
+            for rc, froz in ((scoped_c, None), (q6_c, frozen)):
+                full = np.asarray(fixpoint(
+                    fa, jnp.asarray(rows_n),
+                    None if froz is None else jnp.asarray(froz),
+                ))[:, scc]
+                rest = np.asarray(fixpoint(CircuitArrays(rc), jnp.asarray(rows_s)))
+                np.testing.assert_array_equal(full != 0, rest != 0)
+
+    def test_root_layout_and_frozen_helper_fold(self):
+        # The Q4/frozen-helper scenario (test_fixpoint_frozen_mask_q6): A's
+        # slice needs frozen T — the Q6 fold must satisfy it with A alone,
+        # while the scoped fold must not.
+        import jax.numpy as jnp
+
+        from quorum_intersection_tpu.backends.tpu.kernels import (
+            CircuitArrays, fixpoint,
+        )
+        from quorum_intersection_tpu.encode.circuit import restrict_circuit_pair
+
+        data = [
+            {"publicKey": "A", "quorumSet": {"threshold": 2, "validators": ["A", "T"]}},
+            {"publicKey": "T", "quorumSet": None},
+        ]
+        g = build_graph(parse_fbas(data))
+        circuit = encode_circuit(g)
+        scoped_c, q6_c = restrict_circuit_pair(circuit, [0])  # S = {A}
+        row = jnp.ones((1, 1), jnp.float32)
+        assert int(fixpoint(CircuitArrays(q6_c), row).sum()) == 1
+        assert int(fixpoint(CircuitArrays(scoped_c), row).sum()) == 0

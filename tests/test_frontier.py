@@ -286,3 +286,40 @@ class TestDeviceFlagFilter:
     def test_bad_mode_rejected(self):
         with pytest.raises(ValueError):
             TpuFrontierBackend(flag_check="gpu")
+
+
+class TestRestrictedCheckpoint:
+    def test_checkpoint_on_wide_graph(self, tmp_path):
+        # Regression: the checkpoint fingerprint must build its masks in
+        # the RESTRICTED circuit's index space — graph-space SCC ids
+        # crashed with IndexError when the graph is wider than the SCC.
+        from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+        data = benchmark_fbas(64, 14, seed=1)
+        ck = HybridCheckpoint(tmp_path / "wide_frontier.json")
+        res = solve(
+            data,
+            backend=TpuFrontierBackend(arena=4096, pop=128, checkpoint=ck),
+        )
+        assert res.intersects is True
+
+    def test_kill_resume_on_wide_graph(self, tmp_path):
+        # The full preemption round-trip on a restricted circuit: interrupt
+        # after one chunk, resume from the written frontier, same verdict
+        # and a completed enumeration.
+        from quorum_intersection_tpu.fbas.synth import benchmark_fbas
+        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+
+        data = benchmark_fbas(48, 13, seed=4)
+        po = solve(data, backend="python")
+        ck = HybridCheckpoint(tmp_path / "wide_resume.json")
+        with pytest.raises(FrontierSearchInterrupted):
+            solve(data, backend=TpuFrontierBackend(
+                arena=1024, pop=32, chunk_iters=2, checkpoint=ck,
+                interrupt_after_chunks=1,
+            ))
+        res = solve(data, backend=TpuFrontierBackend(
+            arena=1024, pop=32, checkpoint=ck,
+        ))
+        assert res.intersects is po.intersects
