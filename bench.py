@@ -738,9 +738,14 @@ def orchestrate(args) -> int:
     phases = headline["phases"]
     phase_devices = headline["phase_devices"]
 
-    def stamp(phase: str, result: dict, key: str) -> None:
+    def stamp(phase: str, result: dict, key: str, pinned: str | None = None) -> None:
         if "error" in result:
-            kind = "chip-unavailable" if "timeout" in result["error"] else "failed"
+            # "chip-unavailable" only for phases dispatched WITHOUT a cpu
+            # platform pin: under the cpu-fallback pin a timeout means
+            # genuine CPU slowness, not a tunnel flake (ADVICE r4) — the
+            # label exists to keep those two failure modes distinguishable.
+            chip = pinned != "cpu" and "timeout" in result["error"]
+            kind = "chip-unavailable" if chip else "failed"
             phase_devices[phase] = f"{kind}: {result['error'][:80]}"
         else:
             phase_devices[phase] = result.get(key, "?")
@@ -871,7 +876,7 @@ def orchestrate(args) -> int:
         phases["throughput"] = tp["error"]
     else:
         merge_throughput(tp)
-    stamp("throughput", tp, "device")
+    stamp("throughput", tp, "device", platform)
     emit(headline)  # the headline number is now safe on the record
 
     # 5. Exhaustive-sweep time-to-verdict.  If the run fell back earlier,
@@ -889,7 +894,7 @@ def orchestrate(args) -> int:
         else:
             headline.update(full_baseline)  # stashed step-2 full-shape rates
             merge_throughput(tp)
-            stamp("throughput", tp, "device")
+            stamp("throughput", tp, "device", platform)
         emit(headline)
     sweep = run_child("sweep", deadline, tmo["sweep"],
                       ["--sweep-nodes", str(shapes["sweep_nodes"])], platform)
@@ -898,7 +903,7 @@ def orchestrate(args) -> int:
     else:
         phases["sweep"] = "ok"
         headline.update(sweep)
-    stamp("sweep", sweep, "sweep_device")
+    stamp("sweep", sweep, "sweep_device", platform)
     emit(headline)
 
     # 5b. Wide sweep (2^(wide_sweep_nodes-1) candidates): large enough that
@@ -916,7 +921,7 @@ def orchestrate(args) -> int:
         else:
             phases["sweep_wide"] = "ok"
             headline.update({f"wide_{k}": v for k, v in wide.items()})
-        stamp("sweep_wide", wide, "sweep_device")
+        stamp("sweep_wide", wide, "sweep_device", platform)
         emit(headline)
 
     # 5c. North-star verdict benchmarks (BASELINE.json configs[3..4]):
@@ -936,7 +941,7 @@ def orchestrate(args) -> int:
             status = "ok" if vd.get("verdict_ok") else "verdict-mismatch"
             phases[key] = f"partial({status}): {partial}" if partial else status
             headline[key] = vd
-        stamp(key, vd, "device")
+        stamp(key, vd, "device", platform)
         emit(headline)
 
     # 6. Snapshot time-to-verdict (auto backend).
@@ -946,7 +951,7 @@ def orchestrate(args) -> int:
     else:
         phases["snapshot"] = "ok"
         headline.update(snap)
-    stamp("snapshot", snap, "snapshot_device")
+    stamp("snapshot", snap, "snapshot_device", platform)
     emit(headline)
 
     # 7. Device PageRank on a dump-scale graph (differential vs NumPy).
@@ -956,7 +961,7 @@ def orchestrate(args) -> int:
     else:
         phases["pagerank"] = "ok"
         headline.update(pr)
-    stamp("pagerank", pr, "pagerank_device")
+    stamp("pagerank", pr, "pagerank_device", platform)
     emit(headline)
 
     # 8. Hybrid vs native oracle on pruned-search workloads (on-chip
@@ -976,7 +981,7 @@ def orchestrate(args) -> int:
         partial = hy.pop("partial_error", None)
         phases["hybrid"] = f"partial({status}): {partial}" if partial else status
         headline.update(hy)
-    stamp("hybrid", hy, "hybrid_device")
+    stamp("hybrid", hy, "hybrid_device", platform)
     emit(headline)
     return 0
 

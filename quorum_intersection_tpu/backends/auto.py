@@ -73,6 +73,10 @@ SWEEP_RATE = CALIBRATION.sweep_rate
 SWEEP_OVERHEAD_S = {"cpu": 1.0, "accel": 5.0}
 MIN_ORACLE_BUDGET = 50_000
 
+# How far past the largest MEASURED winning |scc| the frontier win region
+# extends (see the routing comment in check_scc): one crossover-grid step.
+FRONTIER_WIN_SCC_HEADROOM = 4
+
 
 def _platform_sweep_limit() -> int:
     from quorum_intersection_tpu.utils.platform import is_cpu_platform
@@ -224,12 +228,26 @@ class AutoBackend:
         # derived from the newest crossover_tpu_r*.txt artifact with
         # verdict+count parity on every qualifying row) — routing claims
         # about the chip stay tied to recorded measurements, exactly like
-        # the sweep-rate constants above.  No artifact, or a CPU platform
-        # (where the native oracle wins every measured size): host oracle.
-        from quorum_intersection_tpu.utils.platform import is_cpu_platform
+        # the sweep-rate constants above.  Two bounds keep that honest
+        # (ADVICE r4 medium): the live device kind must MATCH the kind the
+        # win was measured on (a TPU win says nothing about a GPU), and
+        # |scc| may exceed the largest MEASURED winning size by at most
+        # FRONTIER_WIN_SCC_HEADROOM — one +4-org step, the granularity of
+        # the crossover grid, justified by the ratio improving
+        # monotonically with |scc| in every recorded artifact; beyond that
+        # the config is untested extrapolation and the host oracle keeps
+        # the SCC.  No artifact, or a CPU platform (where the native
+        # oracle wins every measured size): host oracle.
+        from quorum_intersection_tpu.utils.platform import backend_kind
 
         win = CALIBRATION.frontier_win_min_scc
-        if win is not None and len(scc) >= win and not is_cpu_platform():
+        hi = CALIBRATION.frontier_win_max_scc
+        in_region = (
+            win is not None
+            and win <= len(scc) <= (hi or win) + FRONTIER_WIN_SCC_HEADROOM
+            and backend_kind() == CALIBRATION.frontier_win_device
+        )
+        if in_region:
             try:
                 from quorum_intersection_tpu.backends.tpu.frontier import (
                     TpuFrontierBackend,

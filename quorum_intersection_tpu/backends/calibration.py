@@ -11,6 +11,14 @@ model tracks the hardware the suite last measured, with the r3 constants
 as fallback and every derived value carrying its source file name in
 ``CALIBRATION.provenance``.
 
+Artifacts are read from the WORKING TREE, untracked files included —
+deliberately (ADVICE r4): a freshly produced on-chip record (the driver
+drops ``BENCH_r*.json`` untracked; ``tools/onchip_r4.sh`` tees crossover
+rows) must steer routing immediately, without waiting for a commit.  The
+flip side is that two checkouts of identical committed code can route
+differently if their working trees differ; ``calibrate(paths=[...])``
+pins the inputs for tests and reproducibility.
+
 Safety posture (unchanged from the hand-tuned constants):
 
 - the accelerator sweep rate is the best recorded END-TO-END wide-sweep
@@ -117,6 +125,14 @@ class Calibration:
     # record; the host oracle keeps large SCCs).  Derived from the newest
     # on-chip crossover artifact — see _frontier_win_min_scc.
     frontier_win_min_scc: Optional[int] = None
+    # The LARGEST |scc| the winning group actually measured: routing above
+    # it is extrapolation, which auto caps at a small documented headroom
+    # (a win at scc 28-32 says little about scc 200 under a config tuned
+    # for 32) — ADVICE r4 medium.
+    frontier_win_max_scc: Optional[int] = None
+    # Device kind the win was measured on (jax backend name, e.g. "tpu"):
+    # a TPU-measured win must not route a GPU/other accelerator.
+    frontier_win_device: Optional[str] = None
     # The frontier constructor kwargs the winning rows were measured UNDER
     # (a win at pop=4096 must not route to a default-pop frontier).
     frontier_config: Dict = field(default_factory=dict)
@@ -126,7 +142,7 @@ class Calibration:
 
 def _frontier_win_min_scc(
     paths: Iterable[pathlib.Path],
-) -> Optional[Tuple[int, Dict, str]]:
+) -> Optional[Tuple[int, int, str, Dict, str]]:
     """Smallest |scc| from which the frontier consistently beats the native
     oracle ON A TPU, per the newest crossover artifact's JSON rows, plus
     the frontier constructor kwargs it was measured under.
@@ -138,10 +154,14 @@ def _frontier_win_min_scc(
     measured scc at or above it wins (>= 1x, verdict+count parity) — one
     losing or unparitied row above kills that group's region.  The group
     with the smallest threshold wins.  Rows measured on CPU emulation
-    never qualify (the decision this gates is accelerator routing)."""
-    newest: Optional[Tuple[int, str, List[Tuple[int, float, str, Dict]]]] = None
+    never qualify (the decision this gates is accelerator routing).
+
+    Returns ``(min_scc, max_measured_scc, device_kind, config, provenance)``
+    — the max and the device kind bound how far auto may extrapolate the
+    region (ADVICE r4 medium)."""
+    newest: Optional[Tuple[int, str, List[Tuple[int, float, str, Dict, str]]]] = None
     for path in paths:
-        rows: List[Tuple[int, float, str, Dict]] = []
+        rows: List[Tuple[int, float, str, Dict, str]] = []
         try:
             text = path.read_text()
         except OSError:
@@ -172,9 +192,14 @@ def _frontier_win_min_scc(
                 rec.get("verdict_ok", False)
                 and rec.get("counts_ok") is True
             )
+            # jax backend kind of the measured device ("TPU v5 lite" ->
+            # "tpu") — the routing gate compares it to the live backend.
+            # Qualifying rows are TPU-only today (the _is_tpu filter
+            # above); widen that filter before recording other kinds here.
+            kind = "tpu"
             rows.append((
                 scc, float(speed) if ok else 0.0,
-                json.dumps(config, sort_keys=True), config,
+                json.dumps(config, sort_keys=True), config, kind,
             ))
         if rows:
             rank = _round_rank(path.name)
@@ -185,12 +210,12 @@ def _frontier_win_min_scc(
     _, name, rows = newest
 
     groups: Dict[str, Dict] = {}
-    for scc, speed, key, config in rows:
-        g = groups.setdefault(key, {"config": config, "by_scc": {}})
+    for scc, speed, key, config, kind in rows:
+        g = groups.setdefault(key, {"config": config, "by_scc": {}, "device": kind})
         prev = g["by_scc"].get(scc)
         g["by_scc"][scc] = speed if prev is None else min(prev, speed)
 
-    best: Optional[Tuple[int, Dict]] = None
+    best: Optional[Tuple[int, int, str, Dict]] = None
     for g in groups.values():
         win = None
         for scc in sorted(g["by_scc"], reverse=True):
@@ -199,12 +224,14 @@ def _frontier_win_min_scc(
             else:
                 break
         if win is not None and (best is None or win < best[0]):
-            best = (win, g["config"])
+            best = (win, max(g["by_scc"]), g["device"], g["config"])
     if best is None:
         return None
-    win, config = best
+    win, hi, kind, config = best
     cfg = f" under {config}" if config else ""
-    return win, config, f"{name}: frontier >= 1x native for scc >= {win}{cfg}"
+    return win, hi, kind, config, (
+        f"{name}: frontier >= 1x native for scc {win}..{hi} on {kind}{cfg}"
+    )
 
 
 def _crossover_paths() -> List[pathlib.Path]:
@@ -230,7 +257,8 @@ def calibrate(
     try:
         win = _frontier_win_min_scc(crossover_paths)
         if win is not None:
-            (cal.frontier_win_min_scc, cal.frontier_config,
+            (cal.frontier_win_min_scc, cal.frontier_win_max_scc,
+             cal.frontier_win_device, cal.frontier_config,
              cal.provenance["frontier"]) = win
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass

@@ -570,6 +570,11 @@ class TpuFrontierBackend:
             "device_flag_checks": 0,
             "minimal_quorums": 0,
             "spills": 0,
+            # Dispatched-but-abandoned chunks (witness found / worklist
+            # exhausted before the sync): their iters/popped/flagged never
+            # reach the counters above, so flag-rate denominators derived
+            # from device_chunks alone would overcount coverage.
+            "discarded_chunks": 0,
         }
 
         C = self.arena  # K fixed above (mesh-rounded) — the host overflow
@@ -780,7 +785,7 @@ class TpuFrontierBackend:
                 # their iters/popped/flagged never reach stats (syncing
                 # here would stall a broken network's verdict by a chunk).
                 # The marker keeps flag-rate denominators honest.
-                stats["discarded_chunks"] = 2
+                stats["discarded_chunks"] += 2
                 break
             T_dev, D_dev, top_dev, flags, fcount, iters, popped = inflight
             fcount_h = int(fcount)  # sync point: chunk fully drained here
@@ -835,7 +840,10 @@ class TpuFrontierBackend:
                 if not spill:
                     # Worklist exhausted: drain any still-pending flags (the
                     # overlap defers them one chunk) before concluding that
-                    # all quorums intersect.
+                    # all quorums intersect.  The speculative chunk dispatched
+                    # at the loop top is abandoned unread (it ran as a
+                    # guarded no-op against the empty stack).
+                    stats["discarded_chunks"] += 1
                     process_pending()
                     break
                 T_blk, D_blk = spill.pop()
@@ -874,7 +882,7 @@ class TpuFrontierBackend:
                     if witness is not None:
                         # The speculative chunk dispatched this turn is
                         # abandoned unread (cf. the loop-top break marker).
-                        stats["discarded_chunks"] = 1
+                        stats["discarded_chunks"] += 1
                         break
                 if due_interrupt:
                     self._write_checkpoint(T_dev, D_dev, top_h, spill, scc, fingerprint)
@@ -889,6 +897,7 @@ class TpuFrontierBackend:
                 # The speculative chunk ran as a guarded no-op against the
                 # pre-intervention state; drop it and dispatch fresh on the
                 # spilled/re-fed arrays.
+                stats["discarded_chunks"] += 1
                 inflight, inflight_fe = dispatch(T_dev, D_dev, top_dev)
             else:
                 inflight, inflight_fe = spec, spec_fe

@@ -314,7 +314,19 @@ class TpuSweepBackend:
                 # the D-side thresholds keep the two PROBLEMS distinct.
                 None if circuit_d is None else circuit_d.thresholds,
             )
-            start0 = self.checkpoint.resume_position(total, fingerprint)
+            # Unrestricted problems hash to the same first six arrays as
+            # pre-r4 builds (which didn't append the D-thresholds field);
+            # accept that legacy hash so an old long-run checkpoint still
+            # resumes instead of restarting from zero (ADVICE r4).
+            alts = ()
+            if circuit_d is None:
+                alts = (sweep_fingerprint(
+                    circuit.members, circuit.child, circuit.thresholds,
+                    bit_nodes, scc_mask, frozen,
+                ),)
+            start0 = self.checkpoint.resume_position(
+                total, fingerprint, alt_fingerprints=alts
+            )
             if start0:
                 log.info("resuming sweep at candidate %d/%d", start0, total)
 

@@ -77,8 +77,20 @@ class SweepCheckpoint:
             return None
         return data if isinstance(data, dict) else None
 
-    def resume_position(self, total: int, fingerprint: Optional[str] = None) -> int:
-        """Last recorded block-aligned position, or 0 if absent/mismatched."""
+    def resume_position(
+        self,
+        total: int,
+        fingerprint: Optional[str] = None,
+        alt_fingerprints: tuple = (),
+    ) -> int:
+        """Last recorded block-aligned position, or 0 if absent/mismatched.
+
+        ``alt_fingerprints``: additional fingerprints accepted as THIS
+        problem — callers pass the hashes older builds would have computed
+        for an identical enumeration (e.g. the pre-r4 6-array sweep hash,
+        valid only for unrestricted problems) so a format-widening change
+        doesn't silently discard the long runs checkpoints exist for
+        (ADVICE r4).  The next record() rewrites the current format."""
         data = self._read()
         if data is None:
             return 0
@@ -86,8 +98,11 @@ class SweepCheckpoint:
             log.info("checkpoint total %s != current %d; ignoring", data.get("total"), total)
             return 0
         if fingerprint is not None and data.get("fingerprint") != fingerprint:
-            log.info("checkpoint belongs to a different problem; ignoring")
-            return 0
+            if data.get("fingerprint") in alt_fingerprints:
+                log.info("resuming from a legacy-format checkpoint fingerprint")
+            else:
+                log.info("checkpoint belongs to a different problem; ignoring")
+                return 0
         pos = int(data.get("position", 0))
         return pos if 0 <= pos <= total else 0
 

@@ -19,12 +19,20 @@ def is_cpu_platform() -> bool:
     hybrid batch sizes, hybrid routing) — callers must not re-implement it,
     or their exception policies drift apart.
     """
+    return backend_kind() == "cpu"
+
+
+def backend_kind() -> str:
+    """JAX's default backend name ("cpu", "tpu", "gpu", ...; "cpu" when JAX
+    is absent/broken).  The one place the jax probe lives —
+    :func:`is_cpu_platform` and the routing device-match gate both resolve
+    through it, so exception/platform policy can't drift between them."""
     try:
         import jax
 
-        return jax.default_backend() == "cpu"
+        return str(jax.default_backend())
     except Exception:  # noqa: BLE001 - no jax ⇒ no accelerator either
-        return True
+        return "cpu"
 
 
 def honor_platform_env() -> None:

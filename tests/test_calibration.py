@@ -161,6 +161,8 @@ class TestFrontierWinRegion:
         ])
         cal = calibrate(paths=[], crossover_paths=[p])
         assert cal.frontier_win_min_scc == 28
+        assert cal.frontier_win_max_scc == 32  # largest MEASURED winning size
+        assert cal.frontier_win_device == "tpu"
         assert "crossover_tpu_r9.txt" in cal.provenance["frontier"]
 
     def test_losing_or_unparitied_row_kills_region_above(self, tmp_path):
@@ -219,10 +221,41 @@ class TestFrontierWinRegion:
         from quorum_intersection_tpu.utils import platform as plat
 
         monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", 8)
-        monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_max_scc", 12)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_device", "tpu")
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
         res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
         assert res.intersects is True
         assert res.stats["backend"] == "tpu-frontier"
+
+    def test_auto_caps_extrapolation_above_measured_max(self, monkeypatch):
+        # |scc|=9 with a win measured only at scc 4: 9 > 4 + headroom(4),
+        # so routing must NOT extrapolate the region (ADVICE r4 medium).
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", 4)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_max_scc", 4)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_device", "tpu")
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
+        res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
+        assert res.stats["backend"] in ("python", "cpp")
+
+    def test_auto_requires_matching_device_kind(self, monkeypatch):
+        # A TPU-measured win must not route a different accelerator kind.
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", 8)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_max_scc", 12)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_device", "tpu")
+        monkeypatch.setattr(plat, "backend_kind", lambda: "gpu")
+        res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
+        assert res.stats["backend"] in ("python", "cpp")
 
     def test_auto_stays_on_host_without_artifact(self, monkeypatch):
         from quorum_intersection_tpu.backends import auto

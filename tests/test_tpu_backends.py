@@ -37,9 +37,9 @@ def make_recording_ckpt(path):
             self.history.append((position, total, fingerprint))
             super().record(position, total, fingerprint)
 
-        def resume_position(self, total, fingerprint=None):
+        def resume_position(self, total, fingerprint=None, **kw):
             self.fps.append(fingerprint)
-            return super().resume_position(total, fingerprint)
+            return super().resume_position(total, fingerprint, **kw)
 
     return RecordingCkpt(path)
 
@@ -166,6 +166,44 @@ class TestSweepSpecifics:
         assert ckpt.resume_position(256, "aaaa") == 100
         # legacy/fingerprint-free lookups still work
         assert ckpt.resume_position(256) == 100
+
+    def test_checkpoint_legacy_fingerprint_accepted(self, tmp_path):
+        # A file written under an older hash format resumes when the caller
+        # names that hash as an accepted alternate (ADVICE r4: format
+        # widening must not discard long-run progress).
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        ckpt = SweepCheckpoint(tmp_path / "sweep.json")
+        ckpt.record(100, 256, "old-format-hash")
+        assert ckpt.resume_position(256, "new", alt_fingerprints=("other",)) == 0
+        assert ckpt.resume_position(
+            256, "new", alt_fingerprints=("old-format-hash",)
+        ) == 100
+
+    def test_sweep_resumes_pre_r4_checkpoint(self, tmp_path, monkeypatch):
+        # End-to-end: forge the checkpoint a pre-r4 build would have left
+        # (6-array fingerprint, no D-thresholds field) and verify today's
+        # sweep resumes from it instead of restarting at zero.
+        import quorum_intersection_tpu.utils.checkpoint as ckpt_mod
+
+        ckpt = make_recording_ckpt(tmp_path / "sweep.json")
+        data = majority_fbas(9)
+        orig = ckpt_mod.sweep_fingerprint
+        seen = []
+        monkeypatch.setattr(
+            ckpt_mod, "sweep_fingerprint",
+            lambda *arrays: seen.append(arrays) or orig(*arrays),
+        )
+        res = solve(data, backend=TpuSweepBackend(batch=16, checkpoint=ckpt))
+        assert res.intersects
+        full = [a for a in seen if len(a) == 7]
+        assert full, "sweep no longer hashes the 7-field fingerprint"
+        legacy_fp = orig(*full[-1][:6])  # what a pre-r4 build wrote
+        total = 1 << 8
+        ckpt.record(128, total, legacy_fp)
+        res2 = solve(data, backend=TpuSweepBackend(batch=16, checkpoint=ckpt))
+        assert res2.intersects
+        assert res2.stats["candidates_checked"] <= total - 128 + 16
 
     def test_single_node_scc(self):
         data = [{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["A"]}}]

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-5 on-chip measurement sequence — run when the axon tunnel is up.
+# A down tunnel HANGS rather than errors, so: probe before EVERY step
+# (bounding the waste if it drops mid-sequence), run Python unbuffered
+# (-u: a SIGTERMed step keeps its completed rows in the tee'd artifact),
+# and timeout everything.  Each step records to benchmarks/results/ so a
+# drop keeps the prefix.
+set -x
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+
+probe() {
+    timeout 100 python -c "import jax; print(jax.devices())" || {
+        echo "tunnel down before: $1" >&2; exit 1; }
+}
+
+# 1. three-way crossover incl. the frontier win-region rows (scc 28/32)
+probe crossover
+timeout 1800 python -u benchmarks/hybrid_crossover.py --large \
+    2>&1 | tee "$R/crossover_tpu_r5.txt"
+
+# 2. pop-block scaling on the chip (informs the frontier's default pop)
+probe frontier_scaling
+timeout 1200 python -u benchmarks/frontier_scaling.py \
+    2>&1 | tee "$R/frontier_scaling_tpu_r5.txt"
+
+# 3. wide-sweep ceiling: checkpointed 2^36 with a real SIGKILL + resume
+#    (~2 min to the kill, resume runs to completion at ~600M cand/s ≈ 2 min)
+probe wide_run
+timeout 3600 python -u tools/wide_run.py --bits 36 --kill-after 120 \
+    --resume-lo-bits 28 --tag r5
+
+# 4. full bench (the driver also runs this; a builder-recorded copy pins
+#    the numbers even if the driver window hits a flake)
+probe bench
+timeout 1800 python -u bench.py 2>/dev/null | tail -1 \
+    > "$R/bench_full_r5_onchip.json"
+
+# 5. soak a window on the chip (device engines on real hardware); tee'd so
+#    per-instance progress/MISMATCH lines survive a mid-window hang (the
+#    ledger itself only writes after the full window)
+probe soak
+timeout 1800 python -u tools/soak.py --instances 40 --seed 1000 --platform ambient \
+    2>&1 | tee "$R/soak_tpu_r5.txt"
