@@ -81,6 +81,10 @@ def main() -> int:
     parser.add_argument("--large", action="store_true",
                         help="add hier-7x4/8x4 frontier-vs-native rows "
                              "(no hybrid; native alone is 30 s + ~4.5 min)")
+    parser.add_argument("--large-only", action="store_true",
+                        help="skip the standard (small) rows; implies --large "
+                             "— for re-measuring win-region rows under a "
+                             "different frontier config")
     parser.add_argument("--pop", type=int, default=None,
                         help="frontier pop-block override for the large rows")
     parser.add_argument("--flag-check", choices=("auto", "device", "host"),
@@ -104,7 +108,9 @@ def main() -> int:
     print(f"device: {device}\n")
     print("| workload | native C++ (s) | hybrid (s) | frontier (s) | frontier speedup | frontier states | flagged |")
     print("|---|---|---|---|---|---|---|")
-    for name, data, scc in workloads(args.quick):
+    if args.large_only:
+        args.large = True
+    for name, data, scc in ([] if args.large_only else workloads(args.quick)):
         cpp_s, cpp_res = time_solve(data, CppOracleBackend())
         hy_s, hy_res = time_solve(data, TpuHybridBackend(batch=args.batch))
         fr_s, fr_res = time_solve(data, TpuFrontierBackend())

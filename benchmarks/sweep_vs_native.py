@@ -1,0 +1,192 @@
+"""Time-to-verdict: exhaustive device sweep vs the native oracle at
+mid-range |scc| — the measurement behind auto's sweep routing window.
+
+Motivation (round 5).  The r5 on-chip crossover (crossover_tpu_r5.txt)
+measures the device-resident FRONTIER losing to the native oracle at
+every tractable size (0.03x at scc 24, ~0.19x at scc 28): per-chunk host
+seams and per-iteration latency through the tunnel dominate the tiny
+(≤32-wide) matmuls, exactly as the r3 hybrid measurement foreshadowed.
+The engine that DOES win this regime on the chip is the exhaustive
+SWEEP: 2^(|scc|-1) candidates at the measured enumeration rate (626M
+cand/s end-to-end on v5e, r3) beats the native B&B's ~1.4M calls/s
+whenever the B&B call count is within ~3 orders of the subset-space size
+— which holds for the symmetric k-of-n cores of the reference benchmarks
+(reference `quorum_intersection.cpp:252-346` enumerates ~4·C(n, n/2)
+calls ≈ 2^n·sqrt(2/(pi·n)) on them, see bench.py NATIVE_CALLS_MODEL).
+
+Rows: hierarchical k-of-n networks (`hierarchical_fbas(orgs, 4)`,
+|scc| = 4·orgs) at scc 28 / 32 / 36.
+
+- native: the C++ oracle run to completion when the call-count model says
+  it fits --native-cap (measured floor + model estimate otherwise, same
+  three-way honesty as bench.py phase_verdict).
+- sweep: TpuSweepBackend directly (the engine auto falls back to).
+- auto:  the full `auto` policy end-to-end — oracle-first with a
+  sweep-sized budget, then the sweep — i.e. what a user actually gets.
+  Skipped (with the reason recorded) when |scc| exceeds the platform
+  sweep limit and auto would run the UNBUDGETED native oracle: the row
+  would just re-measure `native`, at hours of wall clock.
+
+Usage:
+    JAX_PLATFORMS=cpu python benchmarks/sweep_vs_native.py --quick  # smoke
+    python benchmarks/sweep_vs_native.py                            # chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Measured B&B call counts for hierarchical_fbas(orgs, 4) — crossover
+# artifacts r3-r5 (exact, platform-independent: the search is
+# deterministic).  Beyond the table, extrapolated at the last measured
+# +4-org growth (139.9M/30.0M ≈ 4.66x), labeled as such.
+HIER_CALLS = {16: 184_755, 20: 1_307_504, 24: 1_009_587,
+              28: 30_029_267, 32: 139_942_245}
+HIER_CALLS_MODEL = (
+    "measured table (crossover_cpu/tpu_r3-r5) + 4.66x per +4 orgs beyond 32"
+)
+
+
+def hier_calls_estimate(scc: int) -> float:
+    if scc in HIER_CALLS:
+        return float(HIER_CALLS[scc])
+    return HIER_CALLS[32] * 4.66 ** ((scc - 32) / 4)
+
+
+def time_solve(data, backend):
+    from quorum_intersection_tpu.pipeline import solve
+
+    t0 = time.perf_counter()
+    res = solve(data, backend=backend)
+    return time.perf_counter() - t0, res
+
+
+def native_row(data, scc: int, cap_s: float) -> dict:
+    """A 2M-call probe measures this box's single-core call rate; the run
+    completes unbudgeted when (measured-or-extrapolated total)/rate fits
+    --native-cap, else the row reports the probe floor + the labeled
+    estimate (bench.py phase_verdict three-way honesty)."""
+    from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+    from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+
+    t0 = time.perf_counter()
+    try:
+        _, res = time_solve(data, CppOracleBackend(budget_calls=2_000_000))
+        return {
+            "native_seconds": round(time.perf_counter() - t0, 3),
+            "native_calls": res.stats.get("bnb_calls"),
+            "native_completed": True,
+            "_intersects": res.intersects,
+        }
+    except OracleBudgetExceeded:
+        probe_s = time.perf_counter() - t0
+    rate = 2_000_000 / probe_s if probe_s > 0 else 1.4e6
+    expected = hier_calls_estimate(scc)
+    if expected / rate <= cap_s:
+        sec, res = time_solve(data, CppOracleBackend())
+        return {
+            "native_seconds": round(sec, 3),
+            "native_calls": res.stats.get("bnb_calls"),
+            "native_completed": True,
+            "native_minimal_quorums": res.stats.get("minimal_quorums"),
+            "_intersects": res.intersects,
+        }
+    return {
+        "native_seconds": round(probe_s, 3),
+        "native_calls": 2_000_000,
+        "native_completed": False,
+        "native_rate": round(rate, 1),
+        "native_est_seconds": round(expected / rate, 1),
+        "native_est_calls": int(expected),
+        "native_est_model": HIER_CALLS_MODEL,
+        "_intersects": None,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="tiny sizes for a CPU smoke run")
+    parser.add_argument("--scc", type=int, nargs="*", default=None,
+                        help="|scc| sizes (multiples of 4)")
+    parser.add_argument("--native-cap", type=float, default=600.0,
+                        help="seconds the native oracle may run to completion")
+    args = parser.parse_args()
+
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+
+    from quorum_intersection_tpu.backends.auto import _platform_sweep_limit
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+
+    sizes = args.scc or ([16, 20] if args.quick else [28, 32, 36])
+    device = jax.devices()[0].device_kind
+    limit = _platform_sweep_limit()
+    print(f"device: {device}  (platform sweep limit: {limit})\n")
+    print("| scc | native (s) | sweep (s) | auto (s) | sweep speedup | auto speedup | cand/s |")
+    print("|---|---|---|---|---|---|---|")
+
+    for scc in sizes:
+        assert scc % 4 == 0, "hierarchical_fbas rows are 4 nodes/org"
+        data = hierarchical_fbas(scc // 4, 4)
+        nat = native_row(data, scc, args.native_cap)
+
+        sw_s, sw_res = time_solve(data, TpuSweepBackend())
+        row = {
+            "scc": scc, "device": device,
+            **{k: v for k, v in nat.items() if not k.startswith("_")},
+            "sweep_seconds": round(sw_s, 3),
+            "sweep_cand_per_sec": round(
+                sw_res.stats.get("candidates_per_sec", 0.0)
+            ),
+            "sweep_enumeration_total": sw_res.stats.get("enumeration_total"),
+        }
+        verdicts = {sw_res.intersects}
+        if nat["_intersects"] is not None:
+            verdicts.add(nat["_intersects"])
+
+        nat_s = nat.get("native_est_seconds") or nat["native_seconds"]
+        est = "" if nat["native_completed"] else " (est)"
+        row["sweep_speedup_vs_native"] = round(nat_s / sw_s, 2) if sw_s else None
+
+        if scc <= limit:
+            au_s, au_res = time_solve(data, "auto")
+            verdicts.add(au_res.intersects)
+            row.update({
+                "auto_seconds": round(au_s, 3),
+                "auto_backend": au_res.stats.get("backend"),
+                "auto_speedup_vs_native": round(nat_s / au_s, 2) if au_s else None,
+            })
+            auto_cell = f"{au_s:.2f}"
+            auto_speed = f"{row['auto_speedup_vs_native']}x"
+        else:
+            row["auto_skipped"] = (
+                f"|scc|={scc} > sweep limit {limit}: auto would run the "
+                "unbudgeted native oracle (the `native` column)"
+            )
+            auto_cell = "—"
+            auto_speed = "—"
+
+        row["verdict_ok"] = len(verdicts) == 1
+        flag = "" if row["verdict_ok"] else " **INVALID: verdict mismatch**"
+        print(
+            f"| {scc} | {nat_s:.2f}{est} | {sw_s:.2f} | {auto_cell} | "
+            f"{row['sweep_speedup_vs_native']}x{flag} | {auto_speed} | "
+            f"{row['sweep_cand_per_sec']:.3g} |"
+        )
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

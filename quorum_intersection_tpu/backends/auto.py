@@ -3,7 +3,13 @@
 Strategy, optimizing **time-to-verdict** (the BASELINE.json north-star
 metric), not TPU-nativeness for its own sake:
 
-- **small SCC** (≤ ``sweep_limit`` nodes): run the pruned host oracle FIRST
+- **small SCC** (≤ ``sweep_limit`` nodes — the static per-platform default,
+  raised on accelerators by a MEASURED sweep-vs-native win window when a
+  ``benchmarks/results/sweep_vs_native*_r*.txt`` artifact records the
+  exhaustive sweep beating COMPLETED native runs, same extrapolation
+  discipline as the frontier region: +4 headroom, device-kind match,
+  capped at any measured loss — ``calibration.sweep_win_max_scc``):
+  run the pruned host oracle FIRST
   with a B&B **call budget** equal to the estimated cost of the exhaustive
   sweep.  On real topologies the pruned search finishes in microseconds-to-
   milliseconds (the bundled snapshots need ~10 calls, SURVEY.md §6), so the
@@ -56,6 +62,14 @@ log = get_logger("backends.auto")
 SWEEP_LIMIT_TPU = 35
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
+# The two-level decode's hard width: bits = |scc|-1 <= DEFAULT_MAX_BITS(44)
+# (sweep.py) — no measured window may raise the routing limit past it.
+SWEEP_DECODE_CEILING = 45
+# How far past the largest MEASURED winning |scc| the sweep window
+# extends: one sweep_vs_native grid step, the same extrapolation
+# discipline as the frontier region below (and additionally capped at
+# any measured LOSS above the window, calibration.sweep_win_cap_scc).
+SWEEP_WIN_SCC_HEADROOM = 4
 
 # Cost model for the oracle-first budget: DERIVED at import from the bench
 # artifacts committed in this repo (backends/calibration.py — VERDICT r3
@@ -78,10 +92,34 @@ MIN_ORACLE_BUDGET = 50_000
 FRONTIER_WIN_SCC_HEADROOM = 4
 
 
-def _platform_sweep_limit() -> int:
-    from quorum_intersection_tpu.utils.platform import is_cpu_platform
+def _measured_sweep_raise() -> Optional[int]:
+    """The artifact-backed accelerator sweep limit, BEFORE the device-kind
+    gate: largest measured winning |scc| + headroom, capped at any
+    measured loss above the window and at the decode ceiling.  None when
+    no sweep_vs_native artifact recorded a win.  Deliberately touches no
+    device — callers that must stay probe-free (the optimistic bound in
+    check_scc) use it directly."""
+    win = CALIBRATION.sweep_win_max_scc
+    if win is None:
+        return None
+    raised = min(win + SWEEP_WIN_SCC_HEADROOM, SWEEP_DECODE_CEILING)
+    if CALIBRATION.sweep_win_cap_scc is not None:
+        raised = min(raised, CALIBRATION.sweep_win_cap_scc)
+    return raised
 
-    return SWEEP_LIMIT_CPU if is_cpu_platform() else SWEEP_LIMIT_TPU
+
+def _platform_sweep_limit() -> int:
+    from quorum_intersection_tpu.utils.platform import (
+        backend_kind, is_cpu_platform,
+    )
+
+    if is_cpu_platform():
+        return SWEEP_LIMIT_CPU
+    limit = SWEEP_LIMIT_TPU
+    raised = _measured_sweep_raise()
+    if raised is not None and backend_kind() == CALIBRATION.sweep_win_device:
+        limit = max(limit, raised)
+    return limit
 
 
 class AutoBackend:
@@ -201,7 +239,10 @@ class AutoBackend:
         # entirely: re-burning the budget on every resume of a preempted
         # sweep would tax exactly the long runs checkpoints exist for.
         resumable = self._has_recorded_progress(scc)
-        optimistic = self.sweep_limit if self.sweep_limit is not None else SWEEP_LIMIT_TPU
+        optimistic = (
+            self.sweep_limit if self.sweep_limit is not None
+            else max(SWEEP_LIMIT_TPU, _measured_sweep_raise() or 0)
+        )
         if len(scc) <= optimistic:
             if not resumable:
                 res = self._budgeted_oracle(

@@ -136,6 +136,18 @@ class Calibration:
     # The frontier constructor kwargs the winning rows were measured UNDER
     # (a win at pop=4096 must not route to a default-pop frontier).
     frontier_config: Dict = field(default_factory=dict)
+    # Measured sweep win window (benchmarks/sweep_vs_native.py artifacts):
+    # the largest |scc| at which the exhaustive sweep measured >= 1x a
+    # COMPLETED native-oracle run on an accelerator.  Raises auto's
+    # accelerator sweep limit above the static conservative default
+    # (auto._platform_sweep_limit), with the same headroom/device-kind
+    # bounds as the frontier region.  None = no measured window.
+    sweep_win_max_scc: Optional[int] = None
+    # Hard bound on extrapolating past the window top: set (to loss-1) when
+    # a LOSS was measured at some |scc| above the largest win — headroom
+    # must never route a measured-slower size to the sweep.
+    sweep_win_cap_scc: Optional[int] = None
+    sweep_win_device: Optional[str] = None
     # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
     provenance: Dict[str, str] = field(default_factory=dict)
 
@@ -234,6 +246,74 @@ def _frontier_win_min_scc(
     )
 
 
+def _sweep_win_max_scc(
+    paths: Iterable[pathlib.Path],
+) -> Optional[Tuple[int, Optional[int], str, str]]:
+    """Largest |scc| at which the exhaustive sweep beat the native oracle
+    on an accelerator, per the newest sweep_vs_native artifact's JSON rows.
+
+    Eligibility is strict: the native run must have COMPLETED (an
+    estimated-total row proves a floor, not a ratio), verdict parity must
+    hold, and emulation (CPU-platform) rows never qualify.
+
+    Returns ``(max_winning_scc, cap_scc, device_kind, provenance)`` where
+    ``cap_scc`` bounds extrapolation when a LOSS was measured above the
+    window top (auto's headroom must never route past a measured loss);
+    None when no loss was measured above."""
+    newest: Optional[Tuple[int, str, Dict[int, float]]] = None
+    for path in paths:
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        by_scc: Dict[int, float] = {}
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if not _is_tpu(rec):
+                continue
+            scc = rec.get("scc")
+            speed = rec.get("sweep_speedup_vs_native")
+            if not isinstance(scc, int) or not isinstance(speed, (int, float)):
+                continue
+            ok = (
+                rec.get("verdict_ok", False)
+                and rec.get("native_completed") is True
+            )
+            v = float(speed) if ok else 0.0
+            by_scc[scc] = min(by_scc.get(scc, v), v)
+        if by_scc:
+            rank = _round_rank(path.name)
+            if newest is None or rank > newest[0]:
+                newest = (rank, path.name, by_scc)
+    if newest is None:
+        return None
+    _, name, by_scc = newest
+    losses = [scc for scc, v in by_scc.items() if v < 1.0]
+    # A measured loss bounds the window from above AND disqualifies any
+    # "win" beyond it: the limit this feeds routes EVERY |scc| up to it to
+    # the sweep, so the window may contain no measured-slower size — a win
+    # above a loss (physically implausible; measurement noise) must not
+    # leapfrog the loss.
+    cap = min(losses) - 1 if losses else None
+    wins = [
+        scc for scc, v in by_scc.items()
+        if v >= 1.0 and (cap is None or scc <= cap)
+    ]
+    if not wins:
+        return None
+    win = max(wins)
+    capped = f", loss measured at scc {cap + 1}" if cap is not None else ""
+    return win, cap, "tpu", (
+        f"{name}: sweep >= 1x completed native up to scc {win} on tpu{capped}"
+    )
+
+
 def _crossover_paths() -> List[pathlib.Path]:
     results = _REPO / "benchmarks" / "results"
     if results.is_dir():
@@ -241,9 +321,17 @@ def _crossover_paths() -> List[pathlib.Path]:
     return []
 
 
+def _sweep_window_paths() -> List[pathlib.Path]:
+    results = _REPO / "benchmarks" / "results"
+    if results.is_dir():
+        return sorted(results.glob("sweep_vs_native*r*.txt"))
+    return []
+
+
 def calibrate(
     paths: Optional[Iterable[pathlib.Path]] = None,
     crossover_paths: Optional[Iterable[pathlib.Path]] = None,
+    sweep_window_paths: Optional[Iterable[pathlib.Path]] = None,
 ) -> Calibration:
     cal = Calibration()
     cal.provenance = {k: "default" for k in ("accel", "cpu", "cpp")}
@@ -254,12 +342,21 @@ def calibrate(
         # fully artifact-free calibration, not one that still absorbs the
         # repo's crossover files.
         crossover_paths = _crossover_paths() if paths is None else []
+    if sweep_window_paths is None:
+        sweep_window_paths = _sweep_window_paths() if paths is None else []
     try:
         win = _frontier_win_min_scc(crossover_paths)
         if win is not None:
             (cal.frontier_win_min_scc, cal.frontier_win_max_scc,
              cal.frontier_win_device, cal.frontier_config,
              cal.provenance["frontier"]) = win
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        pass
+    try:
+        sw = _sweep_win_max_scc(sweep_window_paths)
+        if sw is not None:
+            (cal.sweep_win_max_scc, cal.sweep_win_cap_scc,
+             cal.sweep_win_device, cal.provenance["sweep_window"]) = sw
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass
 

@@ -267,3 +267,102 @@ class TestFrontierWinRegion:
         monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
         res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
         assert res.stats["backend"] in ("python", "cpp")
+
+
+class TestSweepWindow:
+    """Measured sweep-vs-native routing window: auto's accelerator sweep
+    limit rises above the static default ONLY when an artifact records the
+    exhaustive sweep beating COMPLETED native-oracle runs on the chip."""
+
+    def _txt(self, tmp_path, name, rows):
+        lines = ["| header |"]
+        for scc, speed, dev, ok, completed in rows:
+            lines.append(json.dumps({
+                "scc": scc, "device": dev, "sweep_speedup_vs_native": speed,
+                "verdict_ok": ok, "native_completed": completed,
+            }))
+        p = tmp_path / name
+        p.write_text("\n".join(lines))
+        return p
+
+    def test_window_from_artifact(self, tmp_path):
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (28, 5.8, "TPU v5 lite", True, True),
+            (32, 9.1, "TPU v5 lite", True, True),
+            (36, 6.0, "TPU v5 lite", True, True),
+        ])
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 36
+        assert cal.sweep_win_cap_scc is None
+        assert cal.sweep_win_device == "tpu"
+        assert "sweep_vs_native_tpu_r5.txt" in cal.provenance["sweep_window"]
+
+    def test_incomplete_native_or_cpu_rows_never_qualify(self, tmp_path):
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (36, 9.0, "TPU v5 lite", True, False),  # estimated total: a floor
+            (32, 9.0, "cpu", True, True),           # emulation row
+            (28, 9.0, "TPU v5 lite", False, True),  # no verdict parity
+        ])
+        assert calibrate(
+            paths=[], sweep_window_paths=[p]
+        ).sweep_win_max_scc is None
+        assert calibrate(
+            paths=[], sweep_window_paths=[]
+        ).sweep_win_max_scc is None
+
+    def test_loss_above_window_caps_extrapolation(self, tmp_path):
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (32, 2.0, "TPU v5 lite", True, True),
+            (36, 0.8, "TPU v5 lite", True, True),
+        ])
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 32
+        assert cal.sweep_win_cap_scc == 35  # headroom may not reach the loss
+
+    def test_loss_disqualifies_wins_above_it(self, tmp_path):
+        # A "win" beyond a measured loss (noise; the trend is monotone) must
+        # not leapfrog the loss: the limit routes EVERY size up to it.
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (36, 0.8, "TPU v5 lite", True, True),
+            (40, 1.2, "TPU v5 lite", True, True),
+        ])
+        assert calibrate(
+            paths=[], sweep_window_paths=[p]
+        ).sweep_win_max_scc is None
+
+    def test_platform_limit_raised_only_with_matching_device(self, monkeypatch):
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_max_scc", 36)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_cap_scc", None)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_device", "tpu")
+        assert auto._platform_sweep_limit() == 40  # 36 + headroom 4
+
+        monkeypatch.setattr(plat, "backend_kind", lambda: "gpu")
+        assert auto._platform_sweep_limit() == auto.SWEEP_LIMIT_TPU
+
+        # The raise respects a measured-loss cap and the decode ceiling.
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_cap_scc", 37)
+        assert auto._platform_sweep_limit() == 37
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_cap_scc", None)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_max_scc", 44)
+        assert auto._platform_sweep_limit() == auto.SWEEP_DECODE_CEILING
+
+        # CPU platform: the window never applies.
+        monkeypatch.setattr(plat, "is_cpu_platform", lambda: True)
+        assert auto._platform_sweep_limit() == auto.SWEEP_LIMIT_CPU
+
+    def test_window_never_lowers_the_static_limit(self, monkeypatch):
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "is_cpu_platform", lambda: False)
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_max_scc", 20)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_cap_scc", None)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_win_device", "tpu")
+        assert auto._platform_sweep_limit() == auto.SWEEP_LIMIT_TPU

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 follow-up measurements (run after tools/onchip_r5.sh):
+#   1. sweep-vs-native rows — the artifact that raises auto's accelerator
+#      sweep limit (backends/calibration.py sweep window) and records the
+#      engine that actually wins the mid-range on this chip;
+#   2. a wide-sweep run with a kill EARLY enough to really fire (the r5
+#      2^36 run finished in 92 s, before the 120 s kill; VERDICT §next-6
+#      wants a real on-chip SIGKILL + resume);
+#   3. frontier win-region rows under pop=2048 — the frontier_scaling
+#      sweet spot (hier-6x4: 5.5 s vs 25.5 s at the default config) —
+#      to widen the measured win region if scc 28 flips too.
+# Same discipline as onchip_r5.sh: probe before every step, unbuffered,
+# tee'd, timeouts everywhere — plus pipefail so a step killed mid-pipe
+# fails the script instead of exiting 0 through tee (r5 review finding;
+# a caller like tunnel_watch.sh keys "sequence COMPLETE" off rc=0).
+set -x
+set -o pipefail
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+
+probe() {
+    timeout 100 python -c "import jax; print(jax.devices())" || {
+        echo "tunnel down before: $1" >&2; exit 1; }
+}
+
+rc=0
+
+probe sweep_vs_native
+timeout 3600 python -u benchmarks/sweep_vs_native.py --native-cap 900 \
+    2>&1 | tee "$R/sweep_vs_native_tpu_r5.txt" || rc=1
+
+probe wide_kill
+timeout 1800 python -u tools/wide_run.py --bits 36 --kill-after 45 \
+    --resume-lo-bits 28 --tag r5kill || rc=1
+
+probe crossover_pop2048
+timeout 1800 python -u benchmarks/hybrid_crossover.py --large-only --pop 2048 \
+    2>&1 | tee -a "$R/crossover_tpu_r5.txt" || rc=1
+
+exit $rc
