@@ -82,7 +82,7 @@ TIMEOUTS = {
     "verdict": (700, 240),
     "snapshot": (360, 240),
     "pagerank": (240, 120),
-    "hybrid": (420, 180),
+    "frontier": (420, 180),
 }
 
 # Tunnel-flake posture (VERDICT r3 §weak-1: one bad handshake at t=0 must not
@@ -438,47 +438,38 @@ def phase_snapshot(quick: bool) -> dict:
     }
 
 
-def phase_hybrid(quick: bool) -> dict:
-    """Device search engines (round-trip hybrid AND device-resident
-    frontier) vs the native C++ oracle on pruned-search workloads — the
-    per-round on-chip crossover evidence.  Verdicts must agree or the phase
-    reports invalid."""
+def phase_frontier(quick: bool) -> dict:
+    """Device-resident frontier vs the native C++ oracle on pruned-search
+    workloads — per-round freshness evidence for the crossover story (the
+    full decision artifact lives in benchmarks/results/crossover_tpu_r*.txt;
+    the round-trip hybrid engine it used to measure was retired in r5).
+    Verdicts must agree or the phase reports invalid."""
     import jax
 
     from quorum_intersection_tpu.backends.cpp import CppOracleBackend
     from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
-    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
     from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas
     from quorum_intersection_tpu.pipeline import solve
 
-    # Row sizes: the full crossover (incl. hier-6x4, ~91 s hybrid on-chip)
-    # lives in benchmarks/results/crossover_tpu_r3.txt; the bench keeps two
-    # fast rows as per-round freshness evidence of the same verdict-parity +
-    # ratio story (~22 s total on the r3 chip).
     rows = (
         [("hier-5x3", hierarchical_fbas(5, 3))] if quick
         else [("majority-18", majority_fbas(18)), ("hier-5x3", hierarchical_fbas(5, 3))]
     )
-    out = {"hybrid_device": jax.devices()[0].device_kind, "hybrid_verdicts_ok": True}
+    out = {"frontier_device": jax.devices()[0].device_kind,
+           "frontier_verdicts_ok": True}
     for name, data in rows:
         t0 = time.perf_counter()
         cpp_res = solve(data, backend=CppOracleBackend())
         cpp_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        hy_res = solve(data, backend=TpuHybridBackend())
-        hy_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
         fr_res = solve(data, backend=TpuFrontierBackend())
         fr_s = time.perf_counter() - t0
-        ok = cpp_res.intersects == hy_res.intersects == fr_res.intersects
-        out[f"hybrid_{name}"] = {
+        ok = cpp_res.intersects == fr_res.intersects
+        out[f"frontier_{name}"] = {
             "cpp_seconds": round(cpp_s, 3),
-            "hybrid_seconds": round(hy_s, 3),
             "frontier_seconds": round(fr_s, 3),
             "frontier_speedup_vs_cpp": round(cpp_s / fr_s, 3) if fr_s > 0 else None,
             "verdict_ok": ok,
-            "fixpoints": hy_res.stats.get("fixpoints"),
-            "device_batches": hy_res.stats.get("device_batches"),
             "frontier_states": fr_res.stats.get("states_popped"),
             "frontier_device_iters": fr_res.stats.get("device_iters"),
         }
@@ -486,7 +477,7 @@ def phase_hybrid(quick: bool) -> dict:
             # Emit the row (identifying WHICH workload diverged) instead of
             # crashing the phase — a perf number for a wrong answer is
             # worthless, but the evidence of the divergence is not.
-            out["hybrid_verdicts_ok"] = False
+            out["frontier_verdicts_ok"] = False
         # Incremental emit: if a later row hangs past the phase timeout
         # (e.g. a pathological device compile), the parent salvages the
         # rows already completed instead of losing the whole phase.
@@ -677,7 +668,7 @@ def run_child(phase: str, deadline: Deadline, timeout: float,
         return None
 
     def degraded(reason):
-        """Salvage: phases that emit incrementally (hybrid) leave their last
+        """Salvage: phases that emit incrementally (frontier) leave their last
         completed state on stdout — partial evidence beats none.  The
         `partial_error` key lets the caller mark the phase degraded while
         still merging the data."""
@@ -964,24 +955,24 @@ def orchestrate(args) -> int:
     stamp("pagerank", pr, "pagerank_device", platform)
     emit(headline)
 
-    # 8. Hybrid vs native oracle on pruned-search workloads (on-chip
-    # crossover evidence; VERDICT r2 §next-1).
-    if try_recover("hybrid"):
+    # 8. Frontier vs native oracle on pruned-search workloads (on-chip
+    # crossover freshness evidence; VERDICT r2 §next-1, hybrid retired r5).
+    if try_recover("frontier"):
         quick_flag = ["--quick"] if (args.quick or fallback) else []
         emit(headline)
-    hy = run_child("hybrid", deadline, tmo["hybrid"], quick_flag, platform,
+    fr = run_child("frontier", deadline, tmo["frontier"], quick_flag, platform,
                    salvage=True)
-    if "error" in hy:
-        phases["hybrid"] = hy["error"]
+    if "error" in fr:
+        phases["frontier"] = fr["error"]
     else:
         # Per-row verdict agreement gates the phase status: a perf number
         # for a wrong answer must not read as a healthy benchmark.  A
         # salvaged partial phase reports which timeout truncated it.
-        status = "ok" if hy.get("hybrid_verdicts_ok", True) else "verdict-mismatch"
-        partial = hy.pop("partial_error", None)
-        phases["hybrid"] = f"partial({status}): {partial}" if partial else status
-        headline.update(hy)
-    stamp("hybrid", hy, "hybrid_device", platform)
+        status = "ok" if fr.get("frontier_verdicts_ok", True) else "verdict-mismatch"
+        partial = fr.pop("partial_error", None)
+        phases["frontier"] = f"partial({status}): {partial}" if partial else status
+        headline.update(fr)
+    stamp("frontier", fr, "frontier_device", platform)
     emit(headline)
     return 0
 
@@ -1004,8 +995,8 @@ def child_main(args) -> int:
         out = phase_snapshot(args.quick)
     elif args.phase == "pagerank":
         out = phase_pagerank(args.quick)
-    elif args.phase == "hybrid":
-        out = phase_hybrid(args.quick)
+    elif args.phase == "frontier":
+        out = phase_frontier(args.quick)
     else:
         raise SystemExit(f"unknown phase {args.phase!r}")
     print(json.dumps(out), flush=True)
@@ -1026,7 +1017,7 @@ def main() -> int:
     # Internal: child-phase dispatch (run_child invokes bench.py --phase …).
     parser.add_argument("--phase",
                         choices=("probe", "throughput", "sweep", "verdict",
-                                 "snapshot", "pagerank", "hybrid"),
+                                 "snapshot", "pagerank", "frontier"),
                         default=None, help=argparse.SUPPRESS)
     parser.add_argument("--verdict-config", choices=tuple(VERDICT_CONFIGS),
                         default="256", help=argparse.SUPPRESS)

@@ -1,11 +1,13 @@
-"""Hybrid-vs-native-oracle crossover benchmark (VERDICT r1 §next-2).
+"""Device-search-vs-native-oracle crossover benchmark (VERDICT r1
+§next-2; the file name survives the r5 retirement of the hybrid engine it
+was born to measure, keeping artifact lineage crossover_*_r1-r5 intact).
 
-Measures end-to-end time-to-verdict of the batched-device hybrid search
-against the native C++ oracle on the pruned-search workloads where the
-exhaustive sweep no longer applies: safe hierarchical networks at
-|SCC| = 36/48/64 and safe majority networks (the B&B worst case) at
-16/20 nodes.  Emits a markdown table (for the README) and a JSON line per
-row.
+Measures end-to-end time-to-verdict of the device-resident frontier
+against the native C++ oracle on pruned-search workloads: safe
+hierarchical networks and safe majority networks (the B&B worst case).
+Emits a markdown table (for the README) and a JSON line per row; the
+win-region rows (--large) carry their frontier config + minimal-quorum
+count parity and gate auto's routing (backends/calibration.py).
 
 The verdicts must agree row-by-row or the row is marked INVALID — a perf
 number for a wrong answer is worthless.
@@ -55,9 +57,7 @@ def workloads(quick: bool):
 def large_workloads():
     """The frontier win-region sizes (VERDICT r4 §next-1): native cost
     grows ~9× per org (hier-7x4 ≈ 30 s, hier-8x4 ≈ 4.5 min single-core),
-    so these rows are opt-in (--large) and skip the round-trip hybrid,
-    whose loss at these sizes is already established
-    (crossover_tpu_r3.txt) and whose runtime would be tens of minutes."""
+    so these rows are opt-in (--large)."""
     from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
 
     return [
@@ -77,10 +77,9 @@ def time_solve(data, backend) -> tuple:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
-    parser.add_argument("--batch", type=int, default=1024)
     parser.add_argument("--large", action="store_true",
                         help="add hier-7x4/8x4 frontier-vs-native rows "
-                             "(no hybrid; native alone is 30 s + ~4.5 min)")
+                             "(native alone is 30 s + ~4.5 min single-core)")
     parser.add_argument("--large-only", action="store_true",
                         help="skip the standard (small) rows; implies --large "
                              "— for re-measuring win-region rows under a "
@@ -102,31 +101,28 @@ def main() -> int:
 
     from quorum_intersection_tpu.backends.cpp import CppOracleBackend
     from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
-    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
 
     device = jax.devices()[0].device_kind
     print(f"device: {device}\n")
-    print("| workload | native C++ (s) | hybrid (s) | frontier (s) | frontier speedup | frontier states | flagged |")
-    print("|---|---|---|---|---|---|---|")
+    print("| workload | native C++ (s) | frontier (s) | frontier speedup | frontier states | flagged |")
+    print("|---|---|---|---|---|---|")
     if args.large_only:
         args.large = True
     for name, data, scc in ([] if args.large_only else workloads(args.quick)):
         cpp_s, cpp_res = time_solve(data, CppOracleBackend())
-        hy_s, hy_res = time_solve(data, TpuHybridBackend(batch=args.batch))
         fr_s, fr_res = time_solve(data, TpuFrontierBackend())
-        ok = (cpp_res.intersects == hy_res.intersects == fr_res.intersects)
+        ok = (cpp_res.intersects == fr_res.intersects)
         speed = cpp_s / fr_s if fr_s > 0 else float("inf")
         flag = "" if ok else " **INVALID: verdict mismatch**"
         print(
-            f"| {name} | {cpp_s:.3f} | {hy_s:.3f} | {fr_s:.3f} | {speed:.2f}x{flag} | "
+            f"| {name} | {cpp_s:.3f} | {fr_s:.3f} | {speed:.2f}x{flag} | "
             f"{fr_res.stats.get('states_popped')} | {fr_res.stats.get('flagged')} |"
         )
         print(json.dumps({
             "workload": name, "scc": scc, "device": device,
-            "cpp_seconds": round(cpp_s, 4), "hybrid_seconds": round(hy_s, 4),
+            "cpp_seconds": round(cpp_s, 4),
             "frontier_seconds": round(fr_s, 4),
             "frontier_speedup_vs_cpp": round(speed, 3), "verdict_ok": ok,
-            "hybrid_stats": {k: v for k, v in hy_res.stats.items() if k != "backend"},
             "frontier_stats": {k: v for k, v in fr_res.stats.items() if k != "backend"},
             "cpp_bnb_calls": cpp_res.stats.get("bnb_calls"),
         }))
@@ -148,7 +144,7 @@ def main() -> int:
             speed = cpp_s / fr_s if fr_s > 0 else float("inf")
             flag = "" if (ok and counts_ok) else " **INVALID**"
             print(
-                f"| {name} | {cpp_s:.3f} | — | {fr_s:.3f} | {speed:.2f}x{flag} | "
+                f"| {name} | {cpp_s:.3f} | {fr_s:.3f} | {speed:.2f}x{flag} | "
                 f"{fr_res.stats.get('states_popped')} | {fr_res.stats.get('flagged')} |"
             )
             print(json.dumps({

@@ -14,12 +14,20 @@ Backends:
                    circuit (the fast CPU oracle)
 - ``tpu-sweep``  — JAX exhaustive batched subset sweep (small SCCs; verdict-
                    equivalent by the half-size argument, exact by construction)
-- ``tpu-hybrid`` — host frontier + batched device fixpoint evaluation
 - ``tpu-frontier`` — device-resident B&B: the worklist lives in HBM and
                    expands inside one lax.while_loop (zero round-trips in
-                   the tree interior; rare leaves host-checked exactly)
+                   the tree interior; rare leaves host-checked exactly).
+                   Beats the native oracle at scc 32 on chip
+                   (crossover_tpu_r5.txt, 1.16x with count parity)
 - ``auto``       — latency-aware: budgeted oracle first, sweep fallback for
-                   small SCCs; host oracle beyond (measured crossover)
+                   small SCCs; host oracle beyond, except inside measured
+                   frontier/sweep win regions (backends/calibration.py)
+
+The round-trip ``tpu-hybrid`` engine (host frontier + batched device
+fixpoint evaluation) was retired in r5: measured 100-1000x slower than
+the native oracle at every size on chip and CPU alike (crossover
+artifacts r3-r5), with both of its unique capabilities — checkpoint and
+mesh sharding — carried by the frontier.
 """
 
 from quorum_intersection_tpu.backends.base import SccCheckResult, SearchBackend, get_backend

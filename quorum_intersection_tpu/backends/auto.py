@@ -26,11 +26,10 @@ metric), not TPU-nativeness for its own sake:
   SCCs to the frontier under the exact measured config
   (``calibration.frontier_win_min_scc``).  No artifact ⇒ host oracle
   everywhere — routing claims about the chip stay tied to recorded
-  measurements.  The round-trip HYBRID never routes: r3 measured it
-  losing 100-1000× at every tractable size on chip and CPU alike
-  (benchmarks/results/crossover_tpu_r3.txt — host-sequential frontier,
-  ~9k fixpoints/s through the tunnel vs ~1.4M native B&B calls/s); it
-  stays reachable only as an explicit opt-in (``--backend tpu-hybrid``).
+  measurements.  (The round-trip hybrid engine was retired in r5 after
+  losing 100-1000× at every measured size on chip and CPU alike,
+  crossover artifacts r3-r5; the frontier carries its checkpoint and
+  mesh capabilities.)
 
 Every selection is logged; failures to import/compile an accelerator backend
 degrade gracefully to the next option so the CLI always yields a verdict.
@@ -59,7 +58,16 @@ log = get_logger("backends.auto")
 #   majority-18 = 185k calls = 0.13 s) — the oracle beats an exhaustive
 #   2^(n-1) sweep at every measured size, so on CPU the sweep is only kept
 #   where its worst case is sub-second: 2^17/0.45M ≈ 0.3 s ⇒ limit 18.
-SWEEP_LIMIT_TPU = 35
+# The TPU value is the calibration module's SWEEP_WINDOW_FLOOR (single
+# source: the measured sweep window exempts losses at or below the static
+# limit, so the two constants must not drift) — imported below with
+# CALIBRATION to keep the module's lazy-import discipline in one place.
+from quorum_intersection_tpu.backends.calibration import (  # noqa: E402
+    CALIBRATION,
+    SWEEP_WINDOW_FLOOR,
+)
+
+SWEEP_LIMIT_TPU = SWEEP_WINDOW_FLOOR
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 # The two-level decode's hard width: bits = |scc|-1 <= DEFAULT_MAX_BITS(44)
@@ -79,9 +87,8 @@ SWEEP_WIN_SCC_HEADROOM = 4
 # The safety factors (accel halved for tunnel variance, CPU steady rate
 # quartered for compile cost) live in the calibration module so the budget
 # still errs toward giving the oracle MORE room, never less than
-# MIN_ORACLE_BUDGET.
-from quorum_intersection_tpu.backends.calibration import CALIBRATION
-
+# MIN_ORACLE_BUDGET.  (CALIBRATION itself is imported above with the
+# sweep-window floor.)
 ORACLE_SECONDS_PER_CALL = CALIBRATION.oracle_seconds_per_call
 SWEEP_RATE = CALIBRATION.sweep_rate
 SWEEP_OVERHEAD_S = {"cpu": 1.0, "accel": 5.0}
@@ -294,12 +301,26 @@ class AutoBackend:
                     TpuFrontierBackend,
                 )
 
+                # The CLI hands auto a SweepCheckpoint (it cannot know the
+                # routing outcome); the frontier needs the (toRemove,
+                # dontRemove) state format — convert at the same path, the
+                # way the CLI does for an explicit --backend tpu-frontier.
+                # Without this the frontier's resume_states call raises and
+                # the degrade path silently drops BOTH the device engine
+                # and the user's checkpointing (r5 review finding).
+                ckpt = self.checkpoint
+                if ckpt is not None and not hasattr(ckpt, "resume_states"):
+                    from quorum_intersection_tpu.utils.checkpoint import (
+                        FrontierCheckpoint,
+                    )
+
+                    ckpt = FrontierCheckpoint(ckpt.path)
                 # The kwargs the win was MEASURED under ride along — a win
                 # recorded at pop=4096 must not route to a default-pop
                 # frontier (unknown keys raise and fall through to the
                 # host oracle, so a rotten artifact degrades, not crashes).
                 backend = TpuFrontierBackend(
-                    checkpoint=self.checkpoint, mesh=self.mesh,
+                    checkpoint=ckpt, mesh=self.mesh,
                     **CALIBRATION.frontier_config,
                 )
                 log.info(
@@ -312,16 +333,11 @@ class AutoBackend:
             except Exception as exc:  # noqa: BLE001 — degrade to the host oracle
                 log.info("frontier unavailable (%s); falling back", exc)
         if self.prefer_tpu:
-            # Measured on BOTH platforms (benchmarks/results/
-            # crossover_cpu_r3.txt, crossover_tpu_r3.txt): the hybrid loses
-            # to the native oracle at every tractable size — see the module
-            # docstring.  Honest routing sends large SCCs to the host
-            # oracle everywhere; `--backend tpu-hybrid` remains the
-            # explicit opt-in for checkpointed or mesh-sharded searches.
+            # `--backend tpu` is honest about where large SCCs outside the
+            # measured win regions actually go — see the module docstring.
             log.info(
-                "hybrid skipped (measured slower than the native oracle at "
-                "every tractable size, on the real chip as on CPU); "
-                "using host oracle"
+                "device engines skipped for |scc|=%d (outside every "
+                "measured win region); using host oracle", len(scc),
             )
         if self.checkpoint is not None:
             # Host oracles are all-or-nothing; honor the user's expectation

@@ -77,9 +77,17 @@ def get_backend(name: str, **options) -> SearchBackend:
 
         return TpuSweepBackend(**options)
     if name == "tpu-hybrid":
-        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
-
-        return TpuHybridBackend(**options)
+        # Retired in r5: the round-trip hybrid lost 100-1000x to the native
+        # oracle at every measured size on chip and CPU alike (crossover
+        # artifacts r3-r5) while the device-resident frontier carries its
+        # checkpoint + mesh capabilities AND beats the native oracle at
+        # scc 32 on chip (crossover_tpu_r5.txt).  Fail with the successor
+        # rather than silently re-routing.
+        raise ValueError(
+            "backend 'tpu-hybrid' was retired (measured 100-1000x slower "
+            "than the native oracle everywhere, crossover_tpu_r3-r5); use "
+            "'tpu-frontier' (same checkpoint format and mesh support)"
+        )
     if name == "tpu-frontier":
         from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
 

@@ -50,6 +50,13 @@ _ACCEL_RATE_WINDOW = (1e7, 1e11)
 _CPU_RATE_WINDOW = (1e4, 1e8)
 _ORACLE_RATE_WINDOW = (1e4, 1e8)  # B&B calls/s
 
+# The static accelerator sweep limit (auto.SWEEP_LIMIT_TPU imports THIS so
+# the two can't drift).  The sweep window only decides routing ABOVE it —
+# sizes at or below route to the sweep by the static limit regardless — so
+# measured losses down there (compile-overhead-bound small rows) must not
+# veto a window whose raise they cannot affect.
+SWEEP_WINDOW_FLOOR = 35
+
 _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 
 
@@ -227,7 +234,7 @@ def _frontier_win_min_scc(
         prev = g["by_scc"].get(scc)
         g["by_scc"][scc] = speed if prev is None else min(prev, speed)
 
-    best: Optional[Tuple[int, int, str, Dict]] = None
+    best: Optional[Tuple[int, int, str, Dict, float]] = None
     for g in groups.values():
         win = None
         for scc in sorted(g["by_scc"], reverse=True):
@@ -235,11 +242,22 @@ def _frontier_win_min_scc(
                 win = scc
             else:
                 break
-        if win is not None and (best is None or win < best[0]):
-            best = (win, max(g["by_scc"]), g["device"], g["config"])
+        if win is None:
+            continue
+        # Group quality on a threshold tie: the worst ratio inside the win
+        # region — r5 measured two configs both winning from scc 32, at
+        # 1.16x (defaults) and 1.31x (pop=2048); routing must carry the
+        # faster measured config, not the first one parsed.
+        region_speed = min(v for k, v in g["by_scc"].items() if k >= win)
+        if (
+            best is None
+            or win < best[0]
+            or (win == best[0] and region_speed > best[4])
+        ):
+            best = (win, max(g["by_scc"]), g["device"], g["config"], region_speed)
     if best is None:
         return None
-    win, hi, kind, config = best
+    win, hi, kind, config, _ = best
     cfg = f" under {config}" if config else ""
     return win, hi, kind, config, (
         f"{name}: frontier >= 1x native for scc {win}..{hi} on {kind}{cfg}"
@@ -281,11 +299,16 @@ def _sweep_win_max_scc(
             speed = rec.get("sweep_speedup_vs_native")
             if not isinstance(scc, int) or not isinstance(speed, (int, float)):
                 continue
-            ok = (
-                rec.get("verdict_ok", False)
-                and rec.get("native_completed") is True
-            )
-            v = float(speed) if ok else 0.0
+            if not rec.get("verdict_ok", False):
+                v = 0.0  # a verdict mismatch poisons the size: never route into it
+            elif rec.get("native_completed") is not True:
+                # An estimate-only row (native didn't finish under the cap)
+                # is ABSENCE of a measured ratio, not a loss: skipping it
+                # lets a later completed-native run of the same size —
+                # appended to the same round artifact — extend the window.
+                continue
+            else:
+                v = float(speed)
             by_scc[scc] = min(by_scc.get(scc, v), v)
         if by_scc:
             rank = _round_rank(path.name)
@@ -294,12 +317,17 @@ def _sweep_win_max_scc(
     if newest is None:
         return None
     _, name, by_scc = newest
-    losses = [scc for scc, v in by_scc.items() if v < 1.0]
     # A measured loss bounds the window from above AND disqualifies any
     # "win" beyond it: the limit this feeds routes EVERY |scc| up to it to
     # the sweep, so the window may contain no measured-slower size — a win
     # above a loss (physically implausible; measurement noise) must not
-    # leapfrog the loss.
+    # leapfrog the loss.  Losses at or below the static floor are exempt:
+    # those sizes route to the sweep by the static limit no matter what
+    # this window says, so they cannot veto the raise they don't affect.
+    losses = [
+        scc for scc, v in by_scc.items()
+        if v < 1.0 and scc > SWEEP_WINDOW_FLOOR
+    ]
     cap = min(losses) - 1 if losses else None
     wins = [
         scc for scc, v in by_scc.items()

@@ -1,2 +1,2 @@
 """JAX/TPU backends: batched threshold-circuit kernels, exhaustive candidate
-sweep, and the hybrid host-frontier search."""
+sweep, and the device-resident frontier search."""

@@ -116,7 +116,7 @@ class TpuFrontierBackend:
         flag_exit: int = FLAG_EXIT,
         chunk_iters: int = CHUNK_ITERS,
         checkpoint=None,
-        checkpoint_interval_s: float = 5.0,
+        checkpoint_interval_s: Optional[float] = None,
         interrupt_after_chunks: Optional[int] = None,
         mesh=None,
         flag_check: str = "auto",
@@ -145,10 +145,19 @@ class TpuFrontierBackend:
         # across devices (all_gather reassembles); the arena and all control
         # flow replicate, so every device runs the identical expansion.
         self.mesh = mesh
-        self.checkpoint = checkpoint  # utils.checkpoint.HybridCheckpoint or None
+        self.checkpoint = checkpoint  # utils.checkpoint.FrontierCheckpoint or None
+        if checkpoint_interval_s is None:
+            # Env override (QI_FRONTIER_CKPT_INTERVAL_S) exists for the real
+            # process-death tests, which must shrink the write cadence of a
+            # CLI child they cannot construct in-process.
+            import os
+
+            checkpoint_interval_s = float(
+                os.environ.get("QI_FRONTIER_CKPT_INTERVAL_S", 5.0)
+            )
         self.checkpoint_interval_s = checkpoint_interval_s
-        # Preemption simulation for kill/resume tests (same contract as the
-        # hybrid's interrupt_after_batches): after this many chunks, force a
+        # Preemption simulation for kill/resume tests (retired-hybrid
+        # interrupt_after_batches contract): after this many chunks, force a
         # checkpoint write and raise.
         self.interrupt_after_chunks = interrupt_after_chunks
 
@@ -861,7 +870,7 @@ class TpuFrontierBackend:
                 intervened = True
 
             if self.checkpoint is not None and witness is None:
-                # Same post-witness write suppression as the hybrid: the
+                # Same post-witness write suppression as the retired hybrid: the
                 # witness-bearing state is resolved and absent from the
                 # frontier, so a write+kill after the witness could resume
                 # into a witness-free remainder and flip the verdict.  Any
@@ -915,7 +924,7 @@ class TpuFrontierBackend:
 
     def _write_checkpoint(self, T_dev, D_dev, top, spill, scc, fingerprint) -> None:
         """Persist the full frontier (device stack + host spill) in the
-        HybridCheckpoint (toRemove, dontRemove) node-list format."""
+        FrontierCheckpoint (toRemove, dontRemove) node-list format."""
         states = []
 
         def add_block(T_blk, D_blk):

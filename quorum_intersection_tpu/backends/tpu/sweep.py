@@ -241,7 +241,7 @@ class TpuSweepBackend:
         bits = s - 1
         if bits > self.max_bits:
             raise SccTooLargeError(
-                f"|scc|={s} exceeds sweep width {self.max_bits}+1; use the hybrid backend"
+                f"|scc|={s} exceeds sweep width {self.max_bits}+1; use the frontier backend"
             )
         t0 = time.perf_counter()
         t0_monotonic = time.monotonic()

@@ -67,7 +67,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PageRank convergence threshold (default 0.0001)")
     # --- superset flags ---
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep", "tpu-hybrid",
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep",
                             "tpu-frontier"],
                    help="disjoint-quorum search backend (default auto)")
     p.add_argument("--dangling-policy", default=None, choices=["strict", "alias0"],
@@ -88,15 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
     p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
-                   help="checkpoint file for long searches (sweep position or hybrid "
-                        "frontier): progress is recorded there and an interrupted run "
-                        "resumes instead of restarting")
+                   help="checkpoint file for long searches (sweep position or "
+                        "frontier state): progress is recorded there and an "
+                        "interrupted run resumes instead of restarting")
     p.add_argument("--profile-dir", metavar="DIR", default=None,
                    help="record a jax profiler trace of the solve into DIR "
                         "(open with TensorBoard/XProf)")
     p.add_argument("--mesh", metavar="N", default=None,
                    help="shard the device search across N devices ('all' = every "
-                        "visible device); applies to auto/tpu/tpu-sweep/tpu-hybrid/"
+                        "visible device); applies to auto/tpu/tpu-sweep/"
                         "tpu-frontier")
     p.add_argument("--blocking-set", action="store_true",
                    help="liveness-resilience mode: print a minimal blocking set of "
@@ -268,36 +268,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     from quorum_intersection_tpu.pipeline import solve_graph
 
     backend_options = {}
-    if args.backend in ("python", "cpp", "auto", "tpu", "tpu-hybrid") and (
+    if args.backend in ("python", "cpp", "auto", "tpu") and (
         args.seed is not None or args.randomized
     ):
         backend_options = {"seed": args.seed, "randomized": True}
     if args.checkpoint is not None:
-        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid",
+        if args.backend not in ("auto", "tpu", "tpu-sweep",
                                 "tpu-frontier"):
             sys.stderr.write(
                 "--checkpoint requires a checkpoint-capable backend "
-                "(auto/tpu/tpu-sweep/tpu-hybrid/tpu-frontier)\n"
+                "(auto/tpu/tpu-sweep/tpu-frontier)\n"
             )
             return 1
         from quorum_intersection_tpu.utils.checkpoint import (
-            HybridCheckpoint,
+            FrontierCheckpoint,
             SweepCheckpoint,
         )
 
         backend_options["checkpoint"] = (
-            # Frontier snapshots reuse the hybrid's (toRemove, dontRemove)
-            # frontier format; the sweep records a scan position instead.
-            HybridCheckpoint(args.checkpoint)
-            if args.backend in ("tpu-hybrid", "tpu-frontier")
+            # Frontier snapshots record (toRemove, dontRemove) node lists;
+            # the sweep records a scan position instead.
+            FrontierCheckpoint(args.checkpoint)
+            if args.backend == "tpu-frontier"
             else SweepCheckpoint(args.checkpoint)
         )
     if args.mesh is not None:
-        if args.backend not in ("auto", "tpu", "tpu-sweep", "tpu-hybrid",
+        if args.backend not in ("auto", "tpu", "tpu-sweep",
                                 "tpu-frontier"):
             sys.stderr.write(
                 "--mesh requires a device backend "
-                "(auto/tpu/tpu-sweep/tpu-hybrid/tpu-frontier)\n")
+                "(auto/tpu/tpu-sweep/tpu-frontier)\n")
             return 1
         try:
             n_dev = None if args.mesh == "all" else int(args.mesh)

@@ -59,10 +59,10 @@ class SweepCheckpoint:
         still guards the actual resume; callers like the auto router only
         need 'plausibly this problem' to decide routing.)
 
-        Also recognizes a hybrid-format frontier at the same path: the auto
-        router converts this checkpoint to a :class:`HybridCheckpoint` when
-        it routes to the hybrid, so the on-disk file may legitimately hold
-        either format mid-run."""
+        Also recognizes a frontier-format file at the same path (the CLI
+        hands the same --checkpoint path to whichever backend routing
+        picks), so the on-disk file may legitimately hold either format
+        mid-run."""
         data = self._read()
         if data is None:
             return False
@@ -122,10 +122,14 @@ class SweepCheckpoint:
 
 
 @dataclass
-class HybridCheckpoint:
-    """Checkpoint/resume for the hybrid branch-and-bound search.
+class FrontierCheckpoint:
+    """Checkpoint/resume for the branch-and-bound frontier search.
 
-    Unlike the sweep, hybrid progress is not a scalar position: it is the
+    (Introduced with the retired round-trip hybrid engine; the
+    device-resident frontier shares the exact on-disk format, so files
+    written by pre-r5 builds resume unchanged.)
+
+    Unlike the sweep, B&B progress is not a scalar position: it is the
     explicit worklist of unresolved branch-and-bound states.  The invariant
     that makes this sound: every unresolved state always has at least one
     request in the pending/in-flight queues (phase transitions happen
@@ -162,7 +166,7 @@ class HybridCheckpoint:
         if data is None:
             return None
         if data.get("fingerprint") != fingerprint:
-            log.info("hybrid checkpoint belongs to a different problem; ignoring")
+            log.info("frontier checkpoint belongs to a different problem; ignoring")
             return None
         states = data.get("states") or None
         if states is not None and not (
@@ -177,10 +181,10 @@ class HybridCheckpoint:
             # Malformed/foreign schema: the contract is "ignored, never
             # crashed into" — a checkpoint must not break the run it was
             # meant to rescue.
-            log.info("hybrid checkpoint states malformed; ignoring")
+            log.info("frontier checkpoint states malformed; ignoring")
             return None
         if states:
-            log.info("resuming hybrid search from %d frontier states", len(states))
+            log.info("resuming search from %d frontier states", len(states))
         return states
 
     def record(self, states, fingerprint: str) -> None:

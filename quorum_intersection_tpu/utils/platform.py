@@ -16,7 +16,7 @@ def is_cpu_platform() -> bool:
     """True when JAX's default backend is the CPU (or JAX is absent/broken).
 
     The single shared probe for platform-dependent tuning (sweep limits,
-    hybrid batch sizes, hybrid routing) — callers must not re-implement it,
+    batch sizes, engine routing) — callers must not re-implement it,
     or their exception policies drift apart.
     """
     return backend_kind() == "cpu"

@@ -67,7 +67,7 @@ def test_final_line_r4_fields(quick_run):
 
 
 def test_timeout_salvage_keeps_partial_phase_output(monkeypatch):
-    # A phase child that emits incrementally (the hybrid/frontier rows) and
+    # A phase child that emits incrementally (the frontier rows) and
     # then hangs past its timeout must leave its completed rows on the
     # record with a partial_error marker; a crash after emitting rows is
     # salvaged the same way (with a trailing corrupt line skipped); strict
@@ -90,30 +90,41 @@ def test_timeout_salvage_keeps_partial_phase_output(monkeypatch):
             return real_popen([sys.executable, "-c", script], **kw)
         return fake_popen
 
+    # The child timeout must cover interpreter startup, which this image's
+    # sitecustomize makes expensive (it imports jax into EVERY python
+    # process — measured >3 s on a busy 1-core box).  Measure it once and
+    # give the crash child 3x that; the hang children then cost the same
+    # bounded wait instead of a hard-coded guess that flakes under load.
+    import time as _time
+
+    t0 = _time.monotonic()
+    subprocess.run([sys.executable, "-c", "pass"], check=True)
+    child_timeout = max(3.0, 3.0 * (_time.monotonic() - t0))
+
     hang = textwrap.dedent(
         """
         import json, time
-        print(json.dumps({"hybrid_row1": 1}), flush=True)
+        print(json.dumps({"frontier_row1": 1}), flush=True)
         time.sleep(600)
         """
     )
     monkeypatch.setattr(subprocess, "Popen", fake_child(hang))
-    res = bench.run_child("hybrid", FakeDeadline(), 3.0, salvage=True)
-    assert res.get("hybrid_row1") == 1
+    res = bench.run_child("frontier", FakeDeadline(), child_timeout, salvage=True)
+    assert res.get("frontier_row1") == 1
     assert "partial_error" in res and "error" not in res
-    strict = bench.run_child("sweep", FakeDeadline(), 3.0)
-    assert strict == {"error": "timeout after 3s"}
+    strict = bench.run_child("sweep", FakeDeadline(), child_timeout)
+    assert strict == {"error": f"timeout after {child_timeout:.0f}s"}
 
     crash = textwrap.dedent(
         """
         import json, sys
-        print(json.dumps({"hybrid_row1": 2}), flush=True)
+        print(json.dumps({"frontier_row1": 2}), flush=True)
         sys.stdout.write("{corrupt trailing line")
         sys.stdout.flush()
         sys.exit(11)
         """
     )
     monkeypatch.setattr(subprocess, "Popen", fake_child(crash))
-    res = bench.run_child("hybrid", FakeDeadline(), 3.0, salvage=True)
-    assert res.get("hybrid_row1") == 2  # reverse scan skipped the corrupt tail
+    res = bench.run_child("frontier", FakeDeadline(), child_timeout, salvage=True)
+    assert res.get("frontier_row1") == 2  # reverse scan skipped the corrupt tail
     assert res["partial_error"].startswith("exit 11")

@@ -198,6 +198,17 @@ class TestFrontierWinRegion:
         assert cal.frontier_config == {"pop": 4096}
         assert "pop" in cal.provenance["frontier"]
 
+    def test_threshold_tie_prefers_faster_config(self, tmp_path):
+        # r5 measured two configs winning from the same scc: defaults at
+        # 1.16x and pop=2048 at 1.31x — routing must carry the faster one.
+        p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
+            (32, 1.16, "TPU v5 lite", True, {"flag_check": "auto"}),
+            (32, 1.31, "TPU v5 lite", True, {"pop": 2048}),
+        ])
+        cal = calibrate(paths=[], crossover_paths=[p])
+        assert cal.frontier_win_min_scc == 32
+        assert cal.frontier_config == {"pop": 2048}
+
     def test_cpu_rows_and_missing_artifacts_yield_none(self, tmp_path):
         p = self._txt(tmp_path, "crossover_tpu_r9.txt", [
             (28, 5.0, "cpu", True),  # emulation rows must not gate chip routing
@@ -225,6 +236,29 @@ class TestFrontierWinRegion:
         monkeypatch.setattr(auto.CALIBRATION, "frontier_win_device", "tpu")
         monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
         res = solve(majority_fbas(9), backend=auto.AutoBackend(sweep_limit=4))
+        assert res.intersects is True
+        assert res.stats["backend"] == "tpu-frontier"
+
+    def test_frontier_route_converts_sweep_checkpoint(self, tmp_path, monkeypatch):
+        # The CLI hands auto a SweepCheckpoint; the frontier route must
+        # convert it (same path, frontier format) instead of letting
+        # resume_states AttributeError silently degrade to the host oracle
+        # with no checkpointing (r5 review finding).
+        from quorum_intersection_tpu.backends import auto
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+        from quorum_intersection_tpu.utils import platform as plat
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_min_scc", 8)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_max_scc", 12)
+        monkeypatch.setattr(auto.CALIBRATION, "frontier_win_device", "tpu")
+        monkeypatch.setattr(plat, "backend_kind", lambda: "tpu")
+        ck = SweepCheckpoint(tmp_path / "auto.ckpt")
+        res = solve(
+            majority_fbas(9),
+            backend=auto.AutoBackend(sweep_limit=4, checkpoint=ck),
+        )
         assert res.intersects is True
         assert res.stats["backend"] == "tpu-frontier"
 
@@ -366,3 +400,37 @@ class TestSweepWindow:
         monkeypatch.setattr(auto.CALIBRATION, "sweep_win_cap_scc", None)
         monkeypatch.setattr(auto.CALIBRATION, "sweep_win_device", "tpu")
         assert auto._platform_sweep_limit() == auto.SWEEP_LIMIT_TPU
+
+    def test_estimate_only_row_does_not_cap_a_completed_win(self, tmp_path):
+        # r5 shape: the first run's scc-36 row was estimate-only (native
+        # hit the cap); a later completed-native run APPENDED to the same
+        # round artifact must be able to extend the window — absence of a
+        # measured ratio is not a loss.
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (32, 24.7, "TPU v5 lite", True, True),
+            (36, 10.7, "TPU v5 lite", True, False),   # estimate-only: skip
+        ])
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 32
+        assert cal.sweep_win_cap_scc is None  # NOT capped at 35
+        with p.open("a") as f:
+            f.write("\n" + json.dumps({
+                "scc": 36, "device": "TPU v5 lite",
+                "sweep_speedup_vs_native": 9.3,
+                "verdict_ok": True, "native_completed": True,
+            }))
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 36
+
+    def test_loss_at_or_below_static_floor_is_exempt(self, tmp_path):
+        # Small-scc rows lose to compile overhead by construction; sizes at
+        # or below the static limit route to the sweep regardless of this
+        # window, so such losses must not veto the raise.
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r5.txt", [
+            (24, 0.1, "TPU v5 lite", True, True),   # compile-bound loss
+            (28, 4.8, "TPU v5 lite", True, True),
+            (32, 24.7, "TPU v5 lite", True, True),
+        ])
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 32
+        assert cal.sweep_win_cap_scc is None

@@ -102,9 +102,9 @@ class TestArenaSpill:
 
 class TestCheckpoint:
     def _ck(self, tmp_path):
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import FrontierCheckpoint
 
-        return HybridCheckpoint(tmp_path / "frontier.ckpt")
+        return FrontierCheckpoint(tmp_path / "frontier.ckpt")
 
     def test_kill_resume_same_verdict(self, tmp_path):
         ck = self._ck(tmp_path)
@@ -219,9 +219,9 @@ class TestResumeSpill:
         # A checkpoint written under a BIG arena can hold more states than
         # the resuming backend's arena//2; the excess must re-feed through
         # the host spill in blocks, with count parity intact.
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import FrontierCheckpoint
 
-        ck = HybridCheckpoint(tmp_path / "f.ckpt")
+        ck = FrontierCheckpoint(tmp_path / "f.ckpt")
         with pytest.raises(FrontierSearchInterrupted):
             solve(
                 hierarchical_fbas(4, 3),
@@ -294,10 +294,10 @@ class TestRestrictedCheckpoint:
         # the RESTRICTED circuit's index space — graph-space SCC ids
         # crashed with IndexError when the graph is wider than the SCC.
         from quorum_intersection_tpu.fbas.synth import benchmark_fbas
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import FrontierCheckpoint
 
         data = benchmark_fbas(64, 14, seed=1)
-        ck = HybridCheckpoint(tmp_path / "wide_frontier.json")
+        ck = FrontierCheckpoint(tmp_path / "wide_frontier.json")
         res = solve(
             data,
             backend=TpuFrontierBackend(arena=4096, pop=128, checkpoint=ck),
@@ -309,11 +309,11 @@ class TestRestrictedCheckpoint:
         # after one chunk, resume from the written frontier, same verdict
         # and a completed enumeration.
         from quorum_intersection_tpu.fbas.synth import benchmark_fbas
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import FrontierCheckpoint
 
         data = benchmark_fbas(48, 13, seed=4)
         po = solve(data, backend="python")
-        ck = HybridCheckpoint(tmp_path / "wide_resume.json")
+        ck = FrontierCheckpoint(tmp_path / "wide_resume.json")
         with pytest.raises(FrontierSearchInterrupted):
             solve(data, backend=TpuFrontierBackend(
                 arena=1024, pop=32, chunk_iters=2, checkpoint=ck,

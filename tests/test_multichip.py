@@ -107,7 +107,10 @@ class TestDistributed:
         assert mesh.axis_names == ("candidates",)
 
     @needs_8_devices
-    def test_hybrid_mesh_falls_back_cleanly(self):
+    def test_hybrid_topology_mesh_falls_back_cleanly(self):
+        # "hybrid" here is mesh TOPOLOGY (ICI within a slice, DCN across
+        # hosts — mesh_utils.create_hybrid_device_mesh), unrelated to the
+        # retired hybrid search engine.
         from quorum_intersection_tpu.parallel import distributed
 
         mesh = distributed.hybrid_candidate_mesh()
@@ -122,36 +125,6 @@ class TestDistributed:
         backend = TpuSweepBackend(batch=64, mesh=distributed.global_candidate_mesh())
         res = solve(majority_fbas(9, broken=True), backend=backend)
         assert res.intersects is False
-
-
-class TestMeshHybrid:
-    """Mesh-capable hybrid (VERDICT r2 §next-8): the batched fixpoints shard
-    rows across the candidate mesh; verdict parity with the unsharded
-    hybrid on safe and broken networks."""
-
-    @needs_8_devices
-    @pytest.mark.parametrize("n_dev", [2, 8])
-    def test_verdict_parity(self, n_dev):
-        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
-
-        mesh = candidate_mesh(n_dev)
-        for data, expected in (
-            (majority_fbas(10), True),
-            (majority_fbas(10, broken=True), False),
-        ):
-            res = solve(data, backend=TpuHybridBackend(batch=128, mesh=mesh))
-            assert res.intersects is expected
-
-    @needs_8_devices
-    def test_matches_unsharded_on_random_fbas(self):
-        from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
-
-        mesh = candidate_mesh(8)
-        for seed in (1, 5):
-            data = random_fbas(12, seed=seed, nested_prob=0.3)
-            single = solve(data, backend=TpuHybridBackend(batch=128))
-            sharded = solve(data, backend=TpuHybridBackend(batch=128, mesh=mesh))
-            assert single.intersects is sharded.intersects
 
 
 class TestShardedCoverage:
@@ -317,8 +290,8 @@ def test_auto_backend_forwards_mesh():
     mesh = candidate_mesh(4)
     auto = AutoBackend(mesh=mesh)
     assert auto._sweep().mesh is mesh
-    # Mesh plumbing into the hybrid is the CLI's job now (auto no longer
-    # routes to it, r3 on-chip crossover); direct construction covers it.
-    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+    # Frontier mesh plumbing rides auto's win-region route AND the CLI;
+    # direct construction covers the attribute contract.
+    from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
 
-    assert TpuHybridBackend(mesh=mesh).mesh is mesh
+    assert TpuFrontierBackend(mesh=mesh).mesh is mesh

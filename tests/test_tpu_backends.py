@@ -4,7 +4,7 @@ oracle on synthetic networks, witnesses, checkpointing, size limits."""
 import numpy as np
 import pytest
 
-from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
+from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
 from quorum_intersection_tpu.backends.tpu.sweep import SccTooLargeError, TpuSweepBackend
 from quorum_intersection_tpu.fbas.graph import build_graph
 from quorum_intersection_tpu.fbas.schema import parse_fbas
@@ -13,11 +13,11 @@ from quorum_intersection_tpu.fbas.synth import hierarchical_fbas, majority_fbas,
 from quorum_intersection_tpu.pipeline import solve
 
 
-@pytest.fixture(params=["tpu-sweep", "tpu-hybrid"])
+@pytest.fixture(params=["tpu-sweep", "tpu-frontier"])
 def tpu_backend(request):
     if request.param == "tpu-sweep":
         return TpuSweepBackend(batch=512)
-    return TpuHybridBackend(batch=128)
+    return TpuFrontierBackend(arena=4096, pop=128)
 
 
 def make_recording_ckpt(path):
@@ -216,20 +216,33 @@ class TestSweepSpecifics:
             assert key in res.stats
 
 
-class TestHybridSpecifics:
-    def test_stats_present(self):
-        res = solve(majority_fbas(8), backend=TpuHybridBackend(batch=32))
-        for key in ("device_batches", "fixpoints", "bnb_states", "seconds"):
-            assert key in res.stats
+class TestHybridRetirement:
+    """The round-trip hybrid engine was retired in r5 (lost 100-1000x at
+    every measured size, crossover artifacts r3-r5).  Its name must fail
+    LOUDLY with the successor spelled out — not silently re-route."""
 
-    def test_minimal_quorum_count_matches_oracle_on_safe_network(self):
-        # On safe networks both enumerate the complete set of minimal quorums
-        # of size ≤ half (no early exit), so counts must agree exactly.
-        data = majority_fbas(9)
-        want = solve(data, backend="python")
-        got = solve(data, backend=TpuHybridBackend(batch=64))
-        assert got.intersects and want.intersects
-        assert got.stats["minimal_quorums"] == want.stats["minimal_quorums"]
+    def test_get_backend_names_the_successor(self):
+        from quorum_intersection_tpu.backends.base import get_backend
+
+        with pytest.raises(ValueError, match="tpu-frontier"):
+            get_backend("tpu-hybrid")
+
+    def test_cli_rejects_retired_backend(self, ref_fixture):
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "quorum_intersection_tpu",
+             "--backend", "tpu-hybrid"],
+            input=ref_fixture("correct_trivial.json").read_text(),
+            capture_output=True, text=True, timeout=60,
+        )
+        # Reference error contract (cli.py): "Invalid option!" + usage on
+        # stdout, exit 1 — and the usage line shows the surviving choices.
+        assert proc.returncode == 1
+        assert "Invalid option!" in proc.stdout
+        assert "tpu-frontier" in proc.stdout
+        assert "tpu-hybrid" not in proc.stdout
 
 
 class TestWideSweep:
@@ -323,44 +336,11 @@ class TestIndexCeilingGuards:
         assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
 
 
-class TestHybridOptions:
-    """Seed/randomized plumbing (VERDICT r1 §weak-2) and speculative-dispatch
-    bookkeeping of the r2 hybrid."""
+class TestPreferTpuRouting:
+    """`--backend tpu` stays routing-honest: large SCCs outside every
+    measured win region go to the host oracle on all platforms."""
 
-    def test_randomized_seed_verdict_stable(self):
-        data = majority_fbas(9, broken=True)
-        for seed in (1, 7):
-            res = solve(data, backend=TpuHybridBackend(batch=128, seed=seed))
-            assert res.intersects is False
-            assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
-
-    def test_cli_routes_seed_to_hybrid(self, ref_fixture):
-        import subprocess
-        import sys
-
-        proc = subprocess.run(
-            [sys.executable, "-m", "quorum_intersection_tpu",
-             "--backend", "tpu-hybrid", "--seed", "5"],
-            input=ref_fixture("broken.json").read_text(),
-            capture_output=True, text=True, timeout=180,
-        )
-        assert proc.returncode == 1
-        assert proc.stdout == "false\n"
-
-    def test_speculation_stats_accounted(self):
-        res = solve(
-            hierarchical_fbas(5, 3), backend=TpuHybridBackend(batch=256)
-        )
-        assert res.intersects is True
-        s = res.stats
-        assert s["minimal_quorums"] > 0  # minimality path exercised
-        assert s["cache_hits"] > 0  # exclude-branch memoization fired
-        assert s["fixpoints"] > 0 and s["device_batches"] > 0
-
-    def test_auto_on_cpu_never_picks_hybrid(self, monkeypatch):
-        # Measured crossover: hybrid loses on the CPU platform — auto must
-        # route large SCCs to the host oracle even under prefer_tpu.  Pin
-        # the platform probe so the test is hardware-independent.
+    def test_prefer_tpu_on_cpu_routes_to_host_oracle(self, monkeypatch):
         import quorum_intersection_tpu.utils.platform as plat
         from quorum_intersection_tpu.backends.auto import AutoBackend
 
@@ -376,126 +356,7 @@ class TestHybridOptions:
         monkeypatch.setattr(auto, "_cpu_oracle", spy)
         res = solve(majority_fbas(9), backend=auto)
         assert res.intersects is True
-        assert called  # host oracle used, not the hybrid
-
-    def test_auto_never_picks_hybrid_even_on_accelerator(self, monkeypatch):
-        # r3 on-chip crossover (benchmarks/results/crossover_tpu_r3.txt):
-        # the hybrid loses 100-1000x to the native oracle at every
-        # tractable size on the REAL chip too, so prefer_tpu must route
-        # large SCCs to the host oracle on every platform.  Pretend an
-        # accelerator is attached to pin the non-CPU path.
-        from quorum_intersection_tpu.backends.auto import AutoBackend
-
-        monkeypatch.setattr(
-            "quorum_intersection_tpu.utils.platform.is_cpu_platform", lambda: False
-        )
-        auto = AutoBackend(prefer_tpu=True, sweep_limit=4)
-        oracle_calls, hybrid_attempts = [], []
-
-        # Record (never raise): a raising sentinel would be swallowed by a
-        # reintroduced try/except-degrade route and the test would pass
-        # while auto actually picked the hybrid.
-        monkeypatch.setattr(
-            auto, "_hybrid", lambda: hybrid_attempts.append(True),
-            raising=False,
-        )
-        orig = auto._cpu_oracle
-
-        def spy(budget_s=None):
-            oracle_calls.append(True)
-            return orig(budget_s=budget_s)
-
-        monkeypatch.setattr(auto, "_cpu_oracle", spy)
-        res = solve(majority_fbas(9), backend=auto)
-        assert not hybrid_attempts
-        assert oracle_calls and res.intersects is True
-
-
-class TestHybridCheckpoint:
-    """Kill/resume for the hybrid search (VERDICT r2 §next-6): the explicit
-    worklist persists with the sweep's fingerprint discipline; a preempted
-    run resumes from the saved frontier without re-expanding resolved
-    states."""
-
-    def _backend(self, **kw):
-        return TpuHybridBackend(batch=64, max_inflight=1, **kw)
-
-    def test_kill_resume_safe_network(self, tmp_path):
-        from quorum_intersection_tpu.backends.tpu.hybrid import HybridSearchInterrupted
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
-
-        data = majority_fbas(12)  # safe: the tree must be exhausted
-        full = solve(data, backend=self._backend())
-        assert full.intersects is True
-        total_states = full.stats["bnb_states"]
-
-        ck = HybridCheckpoint(tmp_path / "hybrid.ckpt")
-        with pytest.raises(HybridSearchInterrupted):
-            solve(data, backend=self._backend(
-                checkpoint=ck, interrupt_after_batches=4))
-        assert ck.path.exists()
-
-        resumed = solve(data, backend=self._backend(checkpoint=ck))
-        assert resumed.intersects is True
-        # Progress survived: the resumed run starts from the saved frontier
-        # and expands strictly fewer states than a from-scratch run (states
-        # resolved before the kill are never re-expanded).
-        assert resumed.stats["resumed_states"] >= 1
-        assert resumed.stats["bnb_states"] < total_states
-        assert not ck.path.exists()  # cleared on completion
-
-    def test_kill_resume_broken_network(self, tmp_path):
-        from quorum_intersection_tpu.backends.tpu.hybrid import HybridSearchInterrupted
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
-
-        data = majority_fbas(12, broken=True)
-        ck = HybridCheckpoint(tmp_path / "hybrid.ckpt")
-        try:
-            first = solve(data, backend=self._backend(
-                checkpoint=ck, interrupt_after_batches=1))
-            # The witness can land in the very first batch; then there is
-            # nothing to resume and the checkpoint is already cleared.
-            assert first.intersects is False
-            assert not ck.path.exists()
-            return
-        except HybridSearchInterrupted:
-            pass
-        resumed = solve(data, backend=self._backend(checkpoint=ck))
-        assert resumed.intersects is False
-        assert resumed.q1 and resumed.q2 and not set(resumed.q1) & set(resumed.q2)
-        assert not ck.path.exists()
-
-    def test_stale_checkpoint_from_other_problem_ignored(self, tmp_path):
-        from quorum_intersection_tpu.backends.tpu.hybrid import HybridSearchInterrupted
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
-
-        ck = HybridCheckpoint(tmp_path / "hybrid.ckpt")
-        with pytest.raises(HybridSearchInterrupted):
-            solve(majority_fbas(12), backend=self._backend(
-                checkpoint=ck, interrupt_after_batches=4))
-        # Same checkpoint file, DIFFERENT problem: the fingerprint must
-        # reject the stale frontier (resuming it would skip subtrees).
-        other = solve(majority_fbas(13), backend=self._backend(checkpoint=ck))
-        assert other.intersects is True
-        assert "resumed_states" not in other.stats
-
-    def test_cli_builds_hybrid_checkpoint_for_hybrid_backend(self, tmp_path):
-        # `--backend tpu-hybrid --checkpoint PATH` must hand the hybrid a
-        # HybridCheckpoint (frontier format): a sweep-format object would
-        # crash the hybrid's resume_states call.  The CLI owns this mapping
-        # since auto no longer routes to the hybrid (r3 on-chip crossover).
-        import json
-        import subprocess
-        import sys
-
-        proc = subprocess.run(
-            [sys.executable, "-m", "quorum_intersection_tpu",
-             "--backend", "tpu-hybrid", "--checkpoint", str(tmp_path / "x.ckpt")],
-            input=json.dumps(majority_fbas(9)),
-            capture_output=True, text=True, timeout=180,
-        )
-        assert proc.returncode == 0, proc.stderr
-        assert proc.stdout == "true\n"
+        assert called  # host oracle used, no device engine
 
 
 class TestLatencyAwareRouting:
@@ -584,27 +445,27 @@ class TestLatencyAwareRouting:
         assert res.intersects is True
         assert res.stats["backend"] == "tpu-sweep"  # not the oracle
 
-    def test_malformed_hybrid_checkpoint_ignored(self, tmp_path):
+    def test_malformed_frontier_checkpoint_ignored(self, tmp_path):
         import json as _json
 
-        from quorum_intersection_tpu.backends.tpu.hybrid import (
-            HybridSearchInterrupted,
-            TpuHybridBackend,
+        from quorum_intersection_tpu.backends.tpu.frontier import (
+            FrontierSearchInterrupted,
         )
-        from quorum_intersection_tpu.utils.checkpoint import HybridCheckpoint
+        from quorum_intersection_tpu.utils.checkpoint import FrontierCheckpoint
 
         data = majority_fbas(12)
-        ck = HybridCheckpoint(tmp_path / "hybrid.ckpt")
-        with pytest.raises(HybridSearchInterrupted):
-            solve(data, backend=TpuHybridBackend(
-                batch=64, max_inflight=1, checkpoint=ck,
-                interrupt_after_batches=4))
+        ck = FrontierCheckpoint(tmp_path / "frontier.ckpt")
+        with pytest.raises(FrontierSearchInterrupted):
+            solve(data, backend=TpuFrontierBackend(
+                arena=2048, pop=32, checkpoint=ck,
+                interrupt_after_chunks=1, chunk_iters=2))
         # Corrupt the states while keeping the fingerprint valid: the file
         # must be ignored (fresh search), never crash the run.
         payload = _json.loads(ck.path.read_text())
         payload["states"] = [["not-a-pair"]]
         ck.path.write_text(_json.dumps(payload))
-        res = solve(data, backend=TpuHybridBackend(batch=64, checkpoint=ck))
+        res = solve(data, backend=TpuFrontierBackend(
+            arena=2048, pop=32, checkpoint=ck))
         assert res.intersects is True
         assert "resumed_states" not in res.stats
 
@@ -687,7 +548,7 @@ class TestRampJump:
             assert a is b
 
 
-def test_hybrid_real_sigkill_resume(tmp_path):
+def test_frontier_real_sigkill_resume(tmp_path):
     """True process-death resume: SIGKILL the CLI mid-search once the
     checkpoint file appears on disk, then resume in a fresh process —
     verdict parity and recorded-progress reuse (stats: resumed_states)."""
@@ -698,11 +559,11 @@ def test_hybrid_real_sigkill_resume(tmp_path):
     import sys
     import time as _time
 
-    ck = tmp_path / "hybrid.ckpt"
-    env = dict(os.environ, QI_HYBRID_CKPT_INTERVAL_S="0.1")
+    ck = tmp_path / "frontier.ckpt"
+    env = dict(os.environ, QI_FRONTIER_CKPT_INTERVAL_S="0.05")
     data = _json.dumps(majority_fbas(16))
     cmd = [sys.executable, "-m", "quorum_intersection_tpu",
-           "--backend", "tpu-hybrid", "--checkpoint", str(ck), "--timing"]
+           "--backend", "tpu-frontier", "--checkpoint", str(ck), "--timing"]
     proc = subprocess.Popen(
         cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, env=env,

@@ -4,10 +4,15 @@
 # (bounding the waste if it drops mid-sequence), run Python unbuffered
 # (-u: a SIGTERMed step keeps its completed rows in the tee'd artifact),
 # and timeout everything.  Each step records to benchmarks/results/ so a
-# drop keeps the prefix.
+# drop keeps the prefix.  pipefail: every step ends in a tee/tail pipe,
+# so without it a step killed by timeout exits 0 through the pipe and
+# tunnel_watch.sh would log "sequence COMPLETE" over truncated artifacts
+# (r5 review finding).
 set -x
+set -o pipefail
 cd "$(dirname "$0")/.."
 R=benchmarks/results
+rc=0
 
 probe() {
     timeout 100 python -c "import jax; print(jax.devices())" || {
@@ -17,28 +22,30 @@ probe() {
 # 1. three-way crossover incl. the frontier win-region rows (scc 28/32)
 probe crossover
 timeout 1800 python -u benchmarks/hybrid_crossover.py --large \
-    2>&1 | tee "$R/crossover_tpu_r5.txt"
+    2>&1 | tee "$R/crossover_tpu_r5.txt" || rc=1
 
 # 2. pop-block scaling on the chip (informs the frontier's default pop)
 probe frontier_scaling
 timeout 1200 python -u benchmarks/frontier_scaling.py \
-    2>&1 | tee "$R/frontier_scaling_tpu_r5.txt"
+    2>&1 | tee "$R/frontier_scaling_tpu_r5.txt" || rc=1
 
 # 3. wide-sweep ceiling: checkpointed 2^36 with a real SIGKILL + resume
 #    (~2 min to the kill, resume runs to completion at ~600M cand/s ≈ 2 min)
 probe wide_run
 timeout 3600 python -u tools/wide_run.py --bits 36 --kill-after 120 \
-    --resume-lo-bits 28 --tag r5
+    --resume-lo-bits 28 --tag r5 || rc=1
 
 # 4. full bench (the driver also runs this; a builder-recorded copy pins
 #    the numbers even if the driver window hits a flake)
 probe bench
 timeout 1800 python -u bench.py 2>/dev/null | tail -1 \
-    > "$R/bench_full_r5_onchip.json"
+    > "$R/bench_full_r5_onchip.json" || rc=1
 
 # 5. soak a window on the chip (device engines on real hardware); tee'd so
 #    per-instance progress/MISMATCH lines survive a mid-window hang (the
 #    ledger itself only writes after the full window)
 probe soak
 timeout 1800 python -u tools/soak.py --instances 40 --seed 1000 --platform ambient \
-    2>&1 | tee "$R/soak_tpu_r5.txt"
+    2>&1 | tee "$R/soak_tpu_r5.txt" || rc=1
+
+exit $rc

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 third chip pass: complete the native oracle at scc 36 (~21 min
+# single-core) so the sweep window's largest win is MEASURED, not
+# estimated — appended to the SAME round artifact (calibration skips the
+# earlier estimate-only row and takes the completed ratio; r5c in a new
+# file name would tie on round rank and be ignored).
+set -x
+set -o pipefail
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+
+timeout 100 python -c "import jax; print(jax.devices())" || {
+    echo "tunnel down" >&2; exit 1; }
+timeout 2400 python -u benchmarks/sweep_vs_native.py --scc 36 --native-cap 1400 \
+    2>&1 | tee -a "$R/sweep_vs_native_tpu_r5.txt"

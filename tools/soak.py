@@ -7,7 +7,8 @@ stellar-like / benchmark), each solved by every engine that applies —
 
 - host oracles: ``python`` (reference semantics re-model) and ``cpp``
   (native CSR oracle) — always;
-- device engines: ``tpu-frontier`` and ``tpu-hybrid`` — always;
+- device engine: ``tpu-frontier`` — always (the round-trip hybrid was
+  retired in r5; ledger windows before that include it);
 - ``tpu-sweep`` — when the largest SCC fits an exhaustive 2^(|scc|-1)
   enumeration cheaply (≤ SWEEP_SCC_LIMIT).
 
@@ -121,7 +122,6 @@ def run_instance(seed: int, profile: str = "small") -> dict:
     with any mismatches listed (empty list = clean)."""
     from quorum_intersection_tpu.backends.cpp import CppOracleBackend
     from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
-    from quorum_intersection_tpu.backends.tpu.hybrid import TpuHybridBackend
     from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
     from quorum_intersection_tpu.fbas.graph import build_graph, group_sccs, tarjan_scc
     from quorum_intersection_tpu.fbas.schema import parse_fbas
@@ -142,7 +142,6 @@ def run_instance(seed: int, profile: str = "small") -> dict:
             arena=2048, pop=128,
             flag_check="device" if seed % 2 == 0 else "host",
         ),
-        "hybrid": TpuHybridBackend(),
     }
     if max_scc <= SWEEP_SCC_LIMIT:
         engines["sweep"] = TpuSweepBackend()
