@@ -496,7 +496,17 @@ int32_t flatten_qset(const QSet& q, FlatGraph& g,
     throw std::runtime_error("quorumSet nesting exceeds depth " +
                              std::to_string(kMaxQSetDepth));
   }
-  if (q.null) return -1;
+  // Root-level null/{} (Q2): the caller stores -1 and the solver skips
+  // the node's slice entirely.  An INNER null must NOT get the sentinel —
+  // it still occupies a voting slot that can never be satisfied
+  // (fbas/semantics.py counts it in the fail budget; the Python-side
+  // FlatGraph encodes threshold 0).  Returning -1 at inner depths leaked
+  // the root sentinel into the inner pool, where slice_unit dereferenced
+  // units[-1] — a heap-buffer-overflow found by tools/fuzz_native.py on
+  // `"innerQuorumSets": [{}]` inputs.  Falling through is sufficient: a
+  // null qset has threshold 0 and no members, so the general path's Q3
+  // normalization below emits the never-satisfiable unit {1,0,0,0,0}.
+  if (q.null && depth == 0) return -1;
   const int32_t unit = static_cast<int32_t>(g.units.size() / 5);
   g.units.insert(g.units.end(), {0, 0, 0, 0, 0});  // placeholder
   std::vector<int32_t> members;

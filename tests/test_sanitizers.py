@@ -73,6 +73,16 @@ def test_compat_and_randomized_paths_clean(asan_cli, ref_fixture):
         nested_qset_node(400),  # deep qsets (capped flattener)
         '[{"publicKey": "A", "quorumSet": {"threshold": "' + "9" * 30 + '", "validators": ["A"]}}]',
         '[{"publicKey": "A", "quorumSet": {"threshold": 1, "validators": ["\\u0000"]}}]',
+        # Null/{} INNER qsets (Q2 at depth > 0): the r5 fuzzer caught the
+        # native flattener leaking the root -1 sentinel into the inner
+        # pool — slice_unit then read units[-1] (heap-buffer-overflow).
+        '[{"publicKey": "A", "quorumSet": {"threshold": 1, '
+        '"innerQuorumSets": [{}]}}]',
+        '[{"publicKey": "A", "quorumSet": '
+        + '{"threshold": 1, "innerQuorumSets": [' * 5 + '{}' + ']}' * 5
+        + '}]',
+        '[{"publicKey": "A", "quorumSet": {"threshold": 2, "validators": '
+        '["A"], "innerQuorumSets": [null, {}]}}]',
     ],
 )
 def test_hostile_inputs_clean_under_sanitizers(asan_cli, payload):

@@ -1,0 +1,228 @@
+"""Seed-controlled randomized fuzz of the ASan/UBSan-instrumented native
+CLI — volume evidence beyond the suite's fixed hostile corpus.
+
+The suite (tests/test_sanitizers.py, tests/test_hostile_input.py) pins a
+curated corpus: golden fixtures, deep nesting, junk unicode, truncation.
+This runner generates THOUSANDS of fresh cases per window and drives every
+one through the sanitized binary (`build_native_cli(sanitize=True)`,
+ASan + UBSan with -fno-sanitize-recover):
+
+- **mutated**: a valid synthetic FBAS (every generator family), serialized
+  then damaged — truncated at a random byte, random byte flips, junk
+  splices, randomly injected tokens.  Contract: exit 0/1 with a verdict OR
+  a clean `invalid FBAS configuration:` rejection — never a crash, never a
+  sanitizer report.
+- **random-json**: structurally random JSON-ish blobs (arrays/objects/
+  numbers/strings with hostile shapes).  Same contract.
+- **valid**: the undamaged serialization.  Contract additionally includes
+  VERDICT PARITY with the Python pipeline (`pipeline.solve`, auto engine).
+
+Every window appends to ``benchmarks/results/fuzz_native_ledger.json`` so
+the cumulative case count grows round over round, soak-style.  Re-running
+a recorded (seed, cases) window is skipped unless --force.
+
+Usage::
+
+    python tools/fuzz_native.py                    # 1500 cases from seed 0
+    python tools/fuzz_native.py --cases 3000 --seed 7000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+LEDGER = pathlib.Path(__file__).resolve().parent.parent / (
+    "benchmarks/results/fuzz_native_ledger.json"
+)
+
+SANITIZER_MARKERS = (
+    "AddressSanitizer",
+    "UndefinedBehaviorSanitizer",
+    "runtime error:",
+    "LeakSanitizer",
+)
+
+
+def make_valid(rng: random.Random) -> str:
+    """One valid synthetic FBAS, any generator family, serialized."""
+    from quorum_intersection_tpu.fbas.synth import (
+        benchmark_fbas,
+        hierarchical_fbas,
+        majority_fbas,
+        random_fbas,
+        stellar_like_fbas,
+    )
+
+    kind = rng.randrange(5)
+    broken = rng.random() < 0.3
+    if kind == 0:
+        data = majority_fbas(rng.randrange(3, 14), broken=broken)
+    elif kind == 1:
+        data = hierarchical_fbas(rng.randrange(2, 5), rng.randrange(3, 5),
+                                 broken=broken)
+    elif kind == 2:
+        data = random_fbas(rng.randrange(4, 16), seed=rng.randrange(10**6),
+                           nested_prob=rng.random() * 0.5)
+    elif kind == 3:
+        data = stellar_like_fbas(n_core_orgs=rng.randrange(3, 6),
+                                 n_watchers=rng.randrange(0, 12))
+    else:
+        n_total = rng.randrange(8, 40)
+        data = benchmark_fbas(n_total, rng.randrange(4, min(12, n_total)),
+                              broken=broken, seed=rng.randrange(10**6))
+    return json.dumps(data)
+
+
+def mutate(rng: random.Random, text: str) -> str:
+    """Damage a serialized FBAS in one of several byte/token-level ways."""
+    mode = rng.randrange(5)
+    if mode == 0 and len(text) > 2:  # truncate
+        return text[: rng.randrange(1, len(text))]
+    if mode == 1:  # byte flips
+        b = bytearray(text.encode())
+        for _ in range(rng.randrange(1, 8)):
+            b[rng.randrange(len(b))] = rng.randrange(256)
+        return b.decode("utf-8", errors="replace")
+    if mode == 2:  # junk splice
+        pos = rng.randrange(len(text))
+        junk = rng.choice(['{{{{', '\\u0000', '"' * 50, '\xff\xfe',
+                           '9' * 400, '[[[[', 'null,' * 30])
+        return text[:pos] + junk + text[pos:]
+    if mode == 3:  # token injection: duplicate / rename a key
+        return text.replace('"threshold"', rng.choice(
+            ['"threshold": 1e308, "threshold"', '"THRESHOLD"',
+             '"threshold\\u0000"']), 1)
+    # mode 4: wrap in garbage
+    return rng.choice(['x', '[', '{"a":']) + text
+
+
+def make_random_json(rng: random.Random) -> str:
+    """Structurally random JSON-ish blob with hostile shapes."""
+    choice = rng.randrange(6)
+    if choice == 0:
+        return "[" * rng.randrange(1, 200)
+    if choice == 1:
+        return json.dumps([{"publicKey": "K" * rng.randrange(1, 300),
+                            "quorumSet": {"threshold": rng.randrange(-5, 5),
+                                          "validators": []}}] * rng.randrange(1, 5))
+    if choice == 2:
+        return json.dumps({"a": [rng.random() for _ in range(rng.randrange(50))]})
+    if choice == 3:
+        return '[{"publicKey": %s}]' % rng.choice(
+            ['123', 'null', 'true', '{"x": 1}', '[1,2]'])
+    if choice == 4:
+        n = rng.randrange(1, 60)
+        return ('[{"publicKey": "A", "quorumSet": ' +
+                '{"threshold": 1, "innerQuorumSets": [' * n +
+                '{}' + ']}' * n + '}]')
+    return ''.join(rng.choice('[]{}",:0123456789nulltrue \n') for _ in
+                   range(rng.randrange(1, 500)))
+
+
+def run_case(cli: str, payload: str) -> tuple:
+    proc = subprocess.run(
+        [cli], input=payload, capture_output=True, text=True, timeout=120,
+    )
+    sanitizer = any(m in proc.stderr for m in SANITIZER_MARKERS)
+    return proc, sanitizer
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cases", type=int, default=1500)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--force", action="store_true")
+    parser.add_argument("--no-ledger", action="store_true")
+    args = parser.parse_args()
+
+    from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+    cli = str(build_native_cli(sanitize=True))
+
+    ledger = {"windows": [], "cumulative_cases": 0, "failures": []}
+    if LEDGER.exists():
+        ledger = json.loads(LEDGER.read_text())
+    window_key = [args.seed, args.cases]
+    if not args.force and any(
+        w["window"] == window_key for w in ledger["windows"]
+    ):
+        print(f"window {window_key} already recorded; --force to redo")
+        return 0
+
+    rng = random.Random(args.seed)
+    t0 = time.time()
+    counts = {"valid": 0, "mutated": 0, "random-json": 0}
+    failures = []
+    parity_checked = 0
+    for i in range(args.cases):
+        roll = rng.random()
+        if roll < 0.2:
+            kind, payload = "valid", make_valid(rng)
+        elif roll < 0.7:
+            kind, payload = "mutated", mutate(rng, make_valid(rng))
+        else:
+            kind, payload = "random-json", make_random_json(rng)
+        counts[kind] += 1
+        try:
+            proc, sanitizer = run_case(cli, payload)
+        except subprocess.TimeoutExpired:
+            failures.append({"case": i, "kind": kind, "why": "timeout 120s",
+                             "payload_head": payload[:200]})
+            continue
+        ok_exit = proc.returncode in (0, 1)
+        clean_reject = proc.stdout.startswith("invalid FBAS configuration:") \
+            or proc.stderr.startswith("invalid FBAS configuration:")
+        verdict = proc.stdout.strip() in ("true", "false")
+        if sanitizer or not ok_exit or not (verdict or clean_reject):
+            failures.append({
+                "case": i, "kind": kind, "rc": proc.returncode,
+                "sanitizer": sanitizer, "stdout_head": proc.stdout[:200],
+                "stderr_head": proc.stderr[:300],
+                "payload_head": payload[:200],
+            })
+            continue
+        if kind == "valid" and verdict:
+            # Verdict parity with the Python pipeline on undamaged inputs.
+            from quorum_intersection_tpu.pipeline import solve
+
+            want = solve(payload, backend="cpp").intersects
+            got = proc.stdout.strip() == "true"
+            parity_checked += 1
+            if want is not got:
+                failures.append({
+                    "case": i, "kind": "valid-PARITY", "native": got,
+                    "python_pipeline": want, "payload_head": payload[:300],
+                })
+        if (i + 1) % 200 == 0:
+            print(f"  ... {i + 1}/{args.cases} "
+                  f"({time.time() - t0:.0f}s, {len(failures)} failures)",
+                  flush=True)
+
+    record = {
+        "window": window_key, "cases": args.cases, "by_kind": counts,
+        "parity_checked": parity_checked, "n_failures": len(failures),
+        "seconds": round(time.time() - t0, 1),
+    }
+    print(json.dumps(record), flush=True)
+    for f in failures[:20]:
+        print("FAILURE:", json.dumps(f), flush=True)
+    if not args.no_ledger:
+        ledger["windows"].append(record)
+        ledger["cumulative_cases"] += args.cases
+        ledger["failures"].extend(failures)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: {ledger['cumulative_cases']} cumulative cases, "
+              f"{len(ledger['failures'])} failures -> {LEDGER}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
