@@ -14,8 +14,19 @@ ASan + UBSan with -fno-sanitize-recover):
   sanitizer report.
 - **random-json**: structurally random JSON-ish blobs (arrays/objects/
   numbers/strings with hostile shapes).  Same contract.
-- **valid**: the undamaged serialization.  Contract additionally includes
-  VERDICT PARITY with the Python pipeline (`pipeline.solve`, auto engine).
+- **valid**: the undamaged serialization.  When the drawn flag set
+  preserves verdict semantics (none / -v / -t / --seed — compat,
+  alias0 and --scope-scc legitimately change it), the contract
+  additionally includes VERDICT PARITY with the Python pipeline
+  (`pipeline.solve`, cpp engine).
+
+Each case runs under a randomly drawn FLAG SET (none / -v / -t / -p /
+-g / --compat / --seed N / combinations): the PageRank, Graphviz,
+trace, and compat code paths see the same hostile inputs as the verdict
+path — the curated suite exercises them on fixtures only.  Output
+contracts per mode: a verdict, a clean rejection, or mode-specific
+output (PageRank listing / DOT graph) — never a crash, never a
+sanitizer report.
 
 Every window appends to ``benchmarks/results/fuzz_native_ledger.json`` so
 the cumulative case count grows round over round, soak-style.  Re-running
@@ -33,6 +44,7 @@ import argparse
 import json
 import pathlib
 import random
+import re
 import subprocess
 import sys
 import time
@@ -83,7 +95,7 @@ def make_valid(rng: random.Random) -> str:
 
 def mutate(rng: random.Random, text: str) -> str:
     """Damage a serialized FBAS in one of several byte/token-level ways."""
-    mode = rng.randrange(5)
+    mode = rng.randrange(8)
     if mode == 0 and len(text) > 2:  # truncate
         return text[: rng.randrange(1, len(text))]
     if mode == 1:  # byte flips
@@ -100,8 +112,29 @@ def mutate(rng: random.Random, text: str) -> str:
         return text.replace('"threshold"', rng.choice(
             ['"threshold": 1e308, "threshold"', '"THRESHOLD"',
              '"threshold\\u0000"']), 1)
-    # mode 4: wrap in garbage
-    return rng.choice(['x', '[', '{"a":']) + text
+    if mode == 4:  # wrap in garbage
+        return rng.choice(['x', '[', '{"a":']) + text
+    if mode == 5:  # duplicate a whole node object (duplicate publicKey)
+        try:
+            arr = json.loads(text)
+            arr.append(arr[rng.randrange(len(arr))])
+            return json.dumps(arr)
+        except Exception:
+            return text + text
+    if mode == 6:  # numeric extremes on thresholds
+        repl = rng.choice(['-2147483649', '2147483648', '9' * 25,
+                           '1e309', '-0', '0.5'])
+        if rng.random() < 0.5:
+            # First threshold only: the extreme lands as the value and the
+            # original number is demoted to an ignored "x" key.
+            return text.replace(
+                '"threshold": ', '"threshold": ' + repl + ' , "x": ', 1
+            )
+        # Every threshold in the document.
+        return re.sub(r'"threshold": \d+', '"threshold": ' + repl, text)
+    # mode 7: blow up a validators array
+    return text.replace('"validators": [', '"validators": [' +
+                        ('"V", ' * rng.randrange(1, 2000)), 1)
 
 
 def make_random_json(rng: random.Random) -> str:
@@ -127,9 +160,30 @@ def make_random_json(rng: random.Random) -> str:
                    range(rng.randrange(1, 500)))
 
 
-def run_case(cli: str, payload: str) -> tuple:
+FLAG_SETS = (
+    [], [], [],  # bare verdict path, weighted
+    ["-v"], ["-t"], ["-p"], ["-g"], ["--compat"], ["--compat", "-v"],
+    ["--seed", "7"], ["-v", "-t"], ["--scope-scc"],
+    ["--dangling-policy", "alias0"],
+)
+
+# Flag sets that must not change the verdict on a valid FBAS: verbosity /
+# tracing only affect diagnostics, and the randomized tie-break is
+# verdict-independent by design (SURVEY C7).  compat / alias0 /
+# --scope-scc deliberately change semantics (PARITY.md deviations) and
+# are excluded from the parity oracle.
+SEMANTICS_PRESERVING = ({"-v", "-t"}, {"--seed", "7"})
+
+
+def preserves_semantics(flags) -> bool:
+    f = set(flags)
+    return any(f <= allowed for allowed in SEMANTICS_PRESERVING)
+
+
+def run_case(cli: str, payload: str, flags) -> tuple:
     proc = subprocess.run(
-        [cli], input=payload, capture_output=True, text=True, timeout=120,
+        [cli, *flags], input=payload, capture_output=True, text=True,
+        timeout=120,
     )
     sanitizer = any(m in proc.stderr for m in SANITIZER_MARKERS)
     return proc, sanitizer
@@ -171,30 +225,46 @@ def main() -> int:
         else:
             kind, payload = "random-json", make_random_json(rng)
         counts[kind] += 1
+        flags = rng.choice(FLAG_SETS)
         try:
-            proc, sanitizer = run_case(cli, payload)
+            proc, sanitizer = run_case(cli, payload, flags)
         except subprocess.TimeoutExpired:
-            failures.append({"case": i, "kind": kind, "why": "timeout 120s",
+            failures.append({"case": i, "kind": kind, "flags": flags,
+                             "why": "timeout 120s",
                              "payload_head": payload[:200]})
             continue
         ok_exit = proc.returncode in (0, 1)
         clean_reject = proc.stdout.startswith("invalid FBAS configuration:") \
             or proc.stderr.startswith("invalid FBAS configuration:")
-        verdict = proc.stdout.strip() in ("true", "false")
-        if sanitizer or not ok_exit or not (verdict or clean_reject):
+        out_lines = proc.stdout.strip().splitlines()
+        if flags:
+            # Verbose/trace modes print diagnostics above the verdict line.
+            verdict = bool(out_lines) and out_lines[-1] in ("true", "false")
+        else:
+            # The bare verdict path must print EXACTLY the verdict: a
+            # corrupted default-path print (stray diagnostic, double
+            # print) must fail even when it happens to end in a verdict.
+            verdict = proc.stdout.strip() in ("true", "false")
+        mode_output = (
+            ("-p" in flags and "PageRank" in proc.stdout)
+            or ("-g" in flags and "digraph" in proc.stdout)
+        )
+        if sanitizer or not ok_exit or not (verdict or clean_reject
+                                            or mode_output):
             failures.append({
                 "case": i, "kind": kind, "rc": proc.returncode,
+                "flags": flags,
                 "sanitizer": sanitizer, "stdout_head": proc.stdout[:200],
                 "stderr_head": proc.stderr[:300],
                 "payload_head": payload[:200],
             })
             continue
-        if kind == "valid" and verdict:
+        if kind == "valid" and verdict and preserves_semantics(flags):
             # Verdict parity with the Python pipeline on undamaged inputs.
             from quorum_intersection_tpu.pipeline import solve
 
             want = solve(payload, backend="cpp").intersects
-            got = proc.stdout.strip() == "true"
+            got = out_lines[-1] == "true"
             parity_checked += 1
             if want is not got:
                 failures.append({
