@@ -147,10 +147,13 @@ class FlatGraph:
 
     Unit layout (5 ints per unit): ``threshold, member_begin, member_end,
     inner_begin, inner_end`` — spans into the ``mem`` (node-index) and
-    ``inner`` (unit-index) pools.  A null qset flattens to root ``-1``; a
-    ``None`` threshold on an *inner* set flattens to threshold 0, which the
-    solver treats as never satisfiable — both match
-    :func:`~quorum_intersection_tpu.fbas.semantics.slice_satisfied`.
+    ``inner`` (unit-index) pools.  A null qset flattens to root ``-1``;
+    every stored threshold is Q3-normalized (degenerate ``<= 0`` —
+    including a ``None``-threshold *inner* set — or unreachable
+    ``> member count`` becomes the never-satisfiable sentinel
+    ``m_count + 1``), matching
+    :func:`~quorum_intersection_tpu.fbas.semantics.slice_satisfied` and
+    keeping arbitrary-precision JSON thresholds exact in int32.
     """
 
     def __init__(self, graph: TrustGraph) -> None:
@@ -177,6 +180,17 @@ class FlatGraph:
             inner.extend(child_ids)
             ie = len(inner)
             t = 0 if q.threshold is None else q.threshold
+            # Q3 normalization, exactly as qi_native.cpp flatten_qset: a
+            # degenerate (<= 0) or unreachable (> member count) threshold
+            # becomes the never-satisfiable sentinel m_count + 1.  Beyond
+            # matching fbas/semantics.py, this keeps arbitrary-precision
+            # JSON thresholds EXACT in the int32 unit table — a raw store
+            # raised OverflowError on out-of-int32 values (caught by
+            # tools/fuzz_python.py; the schema deliberately accepts any
+            # integer, and the verdict must not depend on its magnitude).
+            m_count = (me - mb) + (ie - ib)
+            if t <= 0 or t > m_count:
+                t = m_count + 1
             units[uid] = (t, mb, me, ib, ie)
             return uid
 

@@ -142,3 +142,39 @@ class TestNativeCandidateCheck:
             if q and d:
                 expected += 1
         assert hits == expected
+
+
+class TestThresholdExtremes:
+    """Arbitrary-precision JSON thresholds must not crash or skew any
+    engine: the ctypes FlatGraph raised OverflowError on out-of-int32
+    values until the Q3 clamp matched qi_native.cpp's (found by
+    tools/fuzz_python.py; the schema deliberately accepts any integer)."""
+
+    @pytest.mark.parametrize("extreme", [
+        9999999999999999999999999,   # far beyond int64
+        2**31,                       # first value past int32
+        -(2**31) - 1,                # first value below int32
+        -1,
+    ])
+    def test_engines_agree_with_extreme_threshold_node(self, extreme):
+        import json
+
+        from quorum_intersection_tpu.pipeline import solve
+
+        payload = json.dumps([
+            {"publicKey": "A",
+             "quorumSet": {"threshold": 2, "validators": ["A", "B"]}},
+            {"publicKey": "B",
+             "quorumSet": {"threshold": 2, "validators": ["A", "B"]}},
+            # The extreme-threshold node is OUTSIDE the quorum-bearing SCC
+            # but inside the flattened graph — exactly the shape that
+            # reached FlatGraph's int32 table and crashed.
+            {"publicKey": "C",
+             "quorumSet": {"threshold": extreme,
+                           "validators": ["A", "B", "C"]}},
+        ])
+        verdicts = {
+            solve(payload, backend=b).intersects
+            for b in ("python", "cpp", "tpu-sweep")
+        }
+        assert verdicts == {True}

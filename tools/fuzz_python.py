@@ -1,0 +1,137 @@
+"""In-process randomized fuzz of the PYTHON parsing/solving surface.
+
+tools/fuzz_native.py proved the class is real (it caught a native
+heap-buffer-overflow in its first 30 cases); this is the same generator
+suite pointed at the Python side, in-process so the image's expensive
+interpreter startup (sitecustomize imports jax into every child) is paid
+once instead of per case.
+
+Contract per case:
+
+- ``parse_fbas(payload)`` either succeeds or raises ``ValueError``
+  (``FbasSchemaError`` / ``json.JSONDecodeError`` both derive from it —
+  exactly what cli.py maps to ``invalid FBAS configuration``).  Any other
+  exception type (KeyError, TypeError, RecursionError, ...) is a bug: the
+  CLI would print a traceback instead of the clean diagnostic.
+- on successful parse: ``build_graph`` + a full ``solve`` (native oracle)
+  must yield a boolean verdict without raising.
+- the sanitizer (``fbas.sanitize.sanitize``) must likewise either
+  produce output or raise ``ValueError`` — it fronts the same untrusted
+  stdin in production.
+
+Appends to ``benchmarks/results/fuzz_python_ledger.json`` (soak-style,
+windows keyed by (seed, cases), skipped when already recorded).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/fuzz_python.py --cases 5000 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tools.fuzz_native import make_random_json, make_valid, mutate  # noqa: E402
+
+LEDGER = pathlib.Path(__file__).resolve().parent.parent / (
+    "benchmarks/results/fuzz_python_ledger.json"
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cases", type=int, default=5000)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--force", action="store_true")
+    parser.add_argument("--no-ledger", action="store_true")
+    args = parser.parse_args()
+
+    from quorum_intersection_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.sanitize import sanitize
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    ledger = {"windows": [], "cumulative_cases": 0, "failures": []}
+    if LEDGER.exists():
+        ledger = json.loads(LEDGER.read_text())
+    window_key = [args.seed, args.cases]
+    if not args.force and any(
+        w["window"] == window_key for w in ledger["windows"]
+    ):
+        print(f"window {window_key} already recorded; --force to redo")
+        return 0
+
+    rng = random.Random(args.seed)
+    t0 = time.time()
+    counts = {"valid": 0, "mutated": 0, "random-json": 0}
+    outcomes = {"parsed+solved": 0, "clean-reject": 0}
+    failures = []
+    for i in range(args.cases):
+        roll = rng.random()
+        if roll < 0.2:
+            kind, payload = "valid", make_valid(rng)
+        elif roll < 0.7:
+            kind, payload = "mutated", mutate(rng, make_valid(rng))
+        else:
+            kind, payload = "random-json", make_random_json(rng)
+        counts[kind] += 1
+
+        stage = "parse"
+        try:
+            fbas = parse_fbas(payload)
+            stage = "sanitize"
+            sanitize(json.loads(payload))
+            stage = "graph"
+            graph = build_graph(fbas)
+            stage = "solve"
+            res = solve(payload, backend="cpp")
+            assert res.intersects in (True, False)
+            del graph
+            outcomes["parsed+solved"] += 1
+        except ValueError:
+            # Clean rejection — includes FbasSchemaError and JSON errors;
+            # any parse that got past json.loads may still cleanly reject
+            # at a later stage (e.g. depth caps at graph/solve time).
+            outcomes["clean-reject"] += 1
+        except Exception as exc:  # noqa: BLE001 — the finding this hunts
+            failures.append({
+                "case": i, "kind": kind, "stage": stage,
+                "exc": f"{type(exc).__name__}: {exc}"[:300],
+                "payload_head": payload[:200],
+            })
+        if (i + 1) % 1000 == 0:
+            print(f"  ... {i + 1}/{args.cases} "
+                  f"({time.time() - t0:.0f}s, {len(failures)} failures)",
+                  flush=True)
+
+    record = {
+        "window": window_key, "cases": args.cases, "by_kind": counts,
+        "outcomes": outcomes, "n_failures": len(failures),
+        "seconds": round(time.time() - t0, 1),
+    }
+    print(json.dumps(record), flush=True)
+    for f in failures[:20]:
+        print("FAILURE:", json.dumps(f), flush=True)
+    if not args.no_ledger:
+        ledger["windows"].append(record)
+        ledger["cumulative_cases"] += args.cases
+        ledger["failures"].extend(failures)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: {ledger['cumulative_cases']} cumulative cases, "
+              f"{len(ledger['failures'])} failures -> {LEDGER}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
