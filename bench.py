@@ -83,6 +83,7 @@ TIMEOUTS = {
     "snapshot": (360, 240),
     "pagerank": (240, 120),
     "frontier": (420, 180),
+    "auto_race": (120, 120),
 }
 
 # Tunnel-flake posture (VERDICT r3 §weak-1: one bad handshake at t=0 must not
@@ -448,6 +449,34 @@ def phase_snapshot(quick: bool) -> dict:
         "snapshot_backend": res.stats.get("backend", "scc-guard"),
         "snapshot_device": jax.devices()[0].device_kind,
     }
+
+
+def phase_auto_race(quick: bool) -> dict:
+    """Racing-router overhead rows (ISSUE 1 acceptance): on the
+    deterministic fake-latency harness (benchmarks/auto_race.py), `auto`
+    must land within 1.2x of the faster engine in BOTH race outcomes —
+    CPU-only and engine-noise-free, so the number measures the racing
+    machinery itself (thread spin-up, cancel propagation, join)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "benchmarks"))
+    from auto_race import fake_rows
+
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+
+    rows = fake_rows(majority_fbas(7 if quick else 9))
+    out = {"auto_race_ok": all(
+        r["verdict_ok"] and (r["ratio_vs_fast"] or 99) <= 1.2 for r in rows
+    )}
+    for r in rows:
+        key = f"auto_race_{r['outcome']}"
+        out[key] = {
+            "fast_engine_s": r["fast_engine_s"],
+            "auto_race_s": r["auto_race_s"],
+            "auto_sequential_s": r["auto_sequential_s"],
+            "ratio_vs_fast": r["ratio_vs_fast"],
+            "winner": r["winner"],
+        }
+    return out
 
 
 def phase_frontier(quick: bool) -> dict:
@@ -947,6 +976,19 @@ def orchestrate(args) -> int:
         stamp(key, vd, "device", platform)
         emit(headline)
 
+    # 5d. Racing-router overhead rows (ISSUE 1): deterministic fake-latency
+    # harness, always CPU-pinned — no tunnel risk, and the measured number
+    # is the racing machinery, not the engines.
+    ar = run_child("auto_race", deadline, tmo["auto_race"],
+                   ["--quick"] if args.quick else [], "cpu")
+    if "error" in ar:
+        phases["auto_race"] = ar["error"]
+    else:
+        phases["auto_race"] = "ok" if ar.get("auto_race_ok") else "over-budget"
+        headline.update(ar)
+    stamp("auto_race", ar, "device", "cpu")
+    emit(headline)
+
     # 6. Snapshot time-to-verdict (auto backend).
     snap = run_child("snapshot", deadline, tmo["snapshot"], quick_flag, platform)
     if "error" in snap:
@@ -1005,6 +1047,8 @@ def child_main(args) -> int:
         out = phase_verdict(args.verdict_config, args.quick)
     elif args.phase == "snapshot":
         out = phase_snapshot(args.quick)
+    elif args.phase == "auto_race":
+        out = phase_auto_race(args.quick)
     elif args.phase == "pagerank":
         out = phase_pagerank(args.quick)
     elif args.phase == "frontier":
@@ -1029,7 +1073,8 @@ def main() -> int:
     # Internal: child-phase dispatch (run_child invokes bench.py --phase …).
     parser.add_argument("--phase",
                         choices=("probe", "throughput", "sweep", "verdict",
-                                 "snapshot", "pagerank", "frontier"),
+                                 "snapshot", "pagerank", "frontier",
+                                 "auto_race"),
                         default=None, help=argparse.SUPPRESS)
     parser.add_argument("--verdict-config", choices=tuple(VERDICT_CONFIGS),
                         default="256", help=argparse.SUPPRESS)

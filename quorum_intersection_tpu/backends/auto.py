@@ -9,14 +9,23 @@ metric), not TPU-nativeness for its own sake:
   exhaustive sweep beating COMPLETED native runs, same extrapolation
   discipline as the frontier region: +4 headroom, device-kind match,
   capped at any measured loss — ``calibration.sweep_win_max_scc``):
-  run the pruned host oracle FIRST
-  with a B&B **call budget** equal to the estimated cost of the exhaustive
-  sweep.  On real topologies the pruned search finishes in microseconds-to-
-  milliseconds (the bundled snapshots need ~10 calls, SURVEY.md §6), so the
-  verdict lands ~1000× sooner than paying the sweep's compile+dispatch
-  overhead.  If the search proves pathological and burns the budget
-  (``OracleBudgetExceeded``), fall back to the sweep — exact and bounded at
-  2^(|scc|-1)/rate.  Worst case ≈ 2× the sweep cost; typical case ≈ free.
+  **RACE** the pruned host oracle against the sweep's spin-up.  The oracle
+  runs on this thread with a B&B **call budget** equal to the estimated
+  cost of the exhaustive sweep, while a background worker concurrently
+  resolves the platform limit, AOT-compiles the sweep program
+  (``kernels.make_aot_dispatch(...).precompile``) and starts dispatching
+  windows.  First engine to a verdict wins; the loser is cancelled through
+  a cooperative ``base.CancelToken`` threaded into the oracle's
+  call-budget check and the sweep driver's window loop.  On real
+  topologies the pruned search finishes in microseconds-to-milliseconds
+  (the bundled snapshots need ~10 calls, SURVEY.md §6) and the sweep
+  worker is cancelled before it dispatches anything; on pathological
+  searches the sweep verdict lands at ~the direct-sweep cost instead of
+  the sequential budget-burn-then-spin-up sum (measured 3.4× at scc 36,
+  ``sweep_vs_native_tpu_r5.txt`` — VERDICT r5 weak-1).  Worst case ≈
+  max(oracle budget, sweep) instead of their sum; typical case ≈ free.
+  ``race=False`` (CLI ``--no-race``) restores the sequential chain:
+  oracle first, sweep only after ``OracleBudgetExceeded``.
 - **large SCC** (> ``sweep_limit``): the pruned search — native C++
   oracle, falling back to pure Python — unless a MEASURED on-chip win
   region says otherwise: when the newest ``crossover_tpu_r*.txt`` artifact
@@ -70,9 +79,17 @@ from quorum_intersection_tpu.backends.calibration import (  # noqa: E402
 SWEEP_LIMIT_TPU = SWEEP_WINDOW_FLOOR
 SWEEP_LIMIT_CPU = 18
 DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
-# The two-level decode's hard width: bits = |scc|-1 <= DEFAULT_MAX_BITS(44)
-# (sweep.py) — no measured window may raise the routing limit past it.
-SWEEP_DECODE_CEILING = 45
+# The two-level decode's hard width: bits = |scc|-1 <= DEFAULT_MAX_BITS
+# (sweep.py), i.e. |scc| <= DEFAULT_MAX_BITS + 1 — no measured window may
+# raise the routing limit past it.  Derived from the sweep module itself
+# (ADVICE r5 #3: the hand-duplicated literal 45 would silently rot if the
+# decode ever widened); sweep.py is jax-free at import, so this stays
+# within the module's lazy-device-import discipline.
+from quorum_intersection_tpu.backends.tpu.sweep import (  # noqa: E402
+    DEFAULT_MAX_BITS as _SWEEP_MAX_BITS,
+)
+
+SWEEP_DECODE_CEILING = _SWEEP_MAX_BITS + 1
 # How far past the largest MEASURED winning |scc| the sweep window
 # extends: one sweep_vs_native grid step, the same extrapolation
 # discipline as the frontier region below (and additionally capped at
@@ -97,6 +114,21 @@ MIN_ORACLE_BUDGET = 50_000
 # How far past the largest MEASURED winning |scc| the frontier win region
 # extends (see the routing comment in check_scc): one crossover-grid step.
 FRONTIER_WIN_SCC_HEADROOM = 4
+
+# Ceiling on how long the race driver waits for a CANCELLED losing engine
+# to unwind before returning the winner's verdict.  Cancellation is
+# cooperative: the sweep polls its token once per program (bounded by ~1 s
+# of device work at full ramp) but cannot interrupt a jax import / platform
+# probe / XLA compile already in flight, so the join is ADAPTIVE — about
+# twice the winning oracle's runtime, capped here — keeping the cleanup
+# wait proportional to the verdict it follows (a 5 ms verdict must not
+# stall 5 s on a worker mid-import).  A still-unwinding loser finishes in
+# the background (reported as `loser_joined: false` in the race stats) and
+# interpreter exit waits for it — the thread is deliberately NON-daemon,
+# the same choice sweep.py made for its compile threads after a daemon
+# thread hard-killed inside native XLA compile aborted the process.
+RACE_LOSER_JOIN_S = 5.0
+RACE_LOSER_JOIN_MIN_S = 0.2
 
 
 def _measured_sweep_raise() -> Optional[int]:
@@ -140,6 +172,7 @@ class AutoBackend:
         randomized: bool = False,
         checkpoint=None,
         mesh=None,
+        race: bool = True,
     ) -> None:
         # prefer_tpu (`--backend tpu`) is routing-neutral since the r3
         # on-chip crossover: large SCCs go to the host oracle everywhere
@@ -148,16 +181,25 @@ class AutoBackend:
         self.sweep_limit = sweep_limit
         self.checkpoint = checkpoint  # forwarded to the sweep backend
         self.mesh = mesh  # forwarded to the sweep backend
+        # race=False (`--no-race`) restores the sequential oracle-then-sweep
+        # chain: the budgeted oracle runs alone and only a budget burn
+        # touches the device.  The escape hatch exists for single-core
+        # boxes (the racing sweep competes for the oracle's CPU) and for
+        # debugging — verdicts are identical either way.
+        self.race = race
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
-    def _sweep(self):
+    def _sweep(self, cancel=None):
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
-        return TpuSweepBackend(checkpoint=self.checkpoint, mesh=self.mesh)
+        return TpuSweepBackend(
+            checkpoint=self.checkpoint, mesh=self.mesh, cancel=cancel
+        )
 
-    def _cpu_oracle(self, budget_s: Optional[float] = None):
+    def _cpu_oracle(self, budget_s: Optional[float] = None, cancel=None):
         """Native oracle, degrading to pure Python; with ``budget_s``, the
-        instance carries a B&B call budget sized per engine speed."""
+        instance carries a B&B call budget sized per engine speed; with
+        ``cancel``, a base.CancelToken the search polls (racing mode)."""
         try:
             from quorum_intersection_tpu.backends.cpp import CppOracleBackend
 
@@ -166,6 +208,8 @@ class AutoBackend:
                 options["budget_calls"] = max(
                     int(budget_s / ORACLE_SECONDS_PER_CALL["cpp"]), MIN_ORACLE_BUDGET
                 )
+            if cancel is not None:
+                options["cancel"] = cancel
             backend = CppOracleBackend(**options)
             backend.ensure_built()
             return backend
@@ -178,6 +222,8 @@ class AutoBackend:
                 options["budget_calls"] = max(
                     int(budget_s / ORACLE_SECONDS_PER_CALL["python"]), MIN_ORACLE_BUDGET
                 )
+            if cancel is not None:
+                options["cancel"] = cancel
             return PythonOracleBackend(**options)
 
     def _estimated_sweep_seconds(self, s: int) -> float:
@@ -189,16 +235,36 @@ class AutoBackend:
         min() keeps the budget honest on both platforms: at small |scc| the
         CPU estimate dominates the bound; at large |scc| the accelerator
         estimate stops a pathological oracle within ~the on-chip sweep cost.
+
+        The accelerator overhead term shrinks when an auto_race artifact
+        measured a HOT persistent compile cache (calibration.
+        sweep_warm_ratio: warm XLA-compile seconds / cold): per-shape
+        compile — the dominant fixed cost at snapshot scale — is mostly
+        cache hits then, so the budget stops a pathological oracle sooner
+        and routing prefers the chip exactly when the chip is cheap.
+        Like the accel RATE term above it, the chip-measured ratio applies
+        without a device-kind match — this estimate must stay probe-free —
+        and the leak onto a CPU-only box is bounded: the overhead floor is
+        SWEEP_OVERHEAD_S['cpu'], so the budget under-shoots by at most
+        (accel - cpu) overhead seconds, and the sizes where that matters
+        (> SWEEP_LIMIT_CPU) fall back to the unbudgeted oracle, never to a
+        CPU-emulated sweep.
         """
         space = float(1 << max(s - 1, 0))
+        accel_overhead = SWEEP_OVERHEAD_S["accel"]
+        warm = CALIBRATION.sweep_warm_ratio
+        if warm is not None:
+            accel_overhead = max(
+                SWEEP_OVERHEAD_S["cpu"], accel_overhead * warm
+            )
         return min(
             SWEEP_OVERHEAD_S["cpu"] + space / SWEEP_RATE["cpu"],
-            SWEEP_OVERHEAD_S["accel"] + space / SWEEP_RATE["accel"],
+            accel_overhead + space / SWEEP_RATE["accel"],
         )
 
     def _budgeted_oracle(self, graph, circuit, scc, scope_to_scc, budget_s):
-        """Oracle-first attempt: returns a result, or None meaning 'fall
-        back to the sweep' (budget burned)."""
+        """Sequential oracle-first attempt (``--no-race``): returns a
+        result, or None meaning 'fall back to the sweep' (budget burned)."""
         from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
 
         backend = self._cpu_oracle(budget_s=budget_s)
@@ -211,6 +277,177 @@ class AutoBackend:
         except OracleBudgetExceeded as exc:
             log.info("oracle budget burned (%s); switching to the exhaustive sweep", exc)
             return None
+
+    def _race(self, graph, circuit, scc, scope_to_scc, budget_s):
+        """Racing orchestrator: budgeted host oracle vs concurrent sweep
+        spin-up; first verdict wins, the loser is cooperatively cancelled.
+
+        The sequential chain measured its worst case at scc 36 as 3.4x the
+        direct sweep (benchmarks/results/sweep_vs_native_tpu_r5.txt: 174 s
+        of serial budget burn BEFORE the sweep's compile+dispatch even
+        started).  Racing overlaps the two: a background worker resolves
+        the platform sweep limit (the device probe moves OFF the verdict
+        path — a hung tunnel strands only the worker), builds the sweep,
+        and starts dispatching windows, while this thread runs the budgeted
+        B&B exactly as before.  Whichever engine reaches a verdict first
+        cancels the other through a base.CancelToken threaded into the
+        oracle's call-budget check and the sweep driver's window loop.
+
+        Verdicts cannot change: both engines implement the same pinned
+        spec and a cancelled engine raises (SearchCancelled) instead of
+        answering — the race alters scheduling only.  When BOTH finish,
+        the oracle's result is preferred, so witness output is identical
+        to the sequential path whenever the oracle finishes under budget.
+
+        Returns the winning result, or None when neither engine produced a
+        verdict (budget burned AND sweep ineligible/unavailable) — the
+        caller then falls through to the same sequential fallbacks as a
+        ``--no-race`` budget burn.
+        """
+        import threading
+        import time
+
+        from quorum_intersection_tpu.backends.base import (
+            CancelToken,
+            OracleBudgetExceeded,
+            SearchCancelled,
+        )
+
+        oracle_cancel = CancelToken()
+        sweep_cancel = CancelToken()
+        outcome: dict = {}
+        t0 = time.monotonic()
+
+        def sweep_worker() -> None:
+            try:
+                if sweep_cancel.cancelled:
+                    return
+                # The race's ONE device contact, off the verdict path.
+                limit = (
+                    self.sweep_limit if self.sweep_limit is not None
+                    else _platform_sweep_limit()
+                )
+                if len(scc) > limit:
+                    outcome["sweep_ineligible"] = (
+                        f"|scc|={len(scc)} > platform sweep limit {limit}"
+                    )
+                    return
+                if sweep_cancel.cancelled:
+                    return
+                backend = self._sweep(cancel=sweep_cancel)
+                res = backend.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                )
+                outcome["sweep_result"] = res
+                outcome["sweep_seconds"] = time.monotonic() - t0
+                oracle_cancel.cancel()
+            except SearchCancelled:
+                outcome["sweep_cancelled"] = True
+                if self.checkpoint is not None:
+                    # Discard this losing sweep's recorded progress FROM THE
+                    # WORKER THREAD, after its engine has raised: the worker
+                    # is the checkpoint's only writer, so no record can land
+                    # after this clear (the driver-side clear below covers
+                    # non-cancel exits, but only once the worker is joined —
+                    # clearing while the worker might still write would
+                    # re-create the residue it removes).
+                    try:
+                        self.checkpoint.clear()
+                    except Exception:  # noqa: BLE001 — cleanup is best-effort
+                        pass
+            except Exception as exc:  # noqa: BLE001 — degrade like sequential
+                outcome["sweep_error"] = str(exc)
+                log.info("race: sweep engine unavailable (%s)", exc)
+
+        # Non-daemon (see RACE_LOSER_JOIN_S): the verdict itself never
+        # waits on this thread beyond the adaptive join, but interpreter
+        # EXIT does — a daemon thread hard-killed inside native XLA
+        # compile/init aborts the process (the failure sweep.py's compile
+        # threads hit), which is worse than a bounded exit wait.  On a
+        # HUNG tunnel the probe can strand the worker and exit blocks;
+        # that environment already hangs the sequential router's post-burn
+        # probe on the MAIN thread — `--no-race` (or JAX_PLATFORMS=cpu,
+        # utils/platform.py) is the documented way out either way.
+        worker = threading.Thread(target=sweep_worker, name="qi-race-sweep")
+        worker.start()
+
+        oracle_res = None
+        oracle_state = "verdict"
+        backend = self._cpu_oracle(budget_s=budget_s, cancel=oracle_cancel)
+        log.debug(
+            "auto: racing %s (budget ~%.1fs of calls) against sweep "
+            "spin-up for |scc|=%d", backend.name, budget_s, len(scc),
+        )
+        t_oracle = time.monotonic()
+        try:
+            oracle_res = backend.check_scc(
+                graph, circuit, scc, scope_to_scc=scope_to_scc
+            )
+        except OracleBudgetExceeded as exc:
+            oracle_state = "budget_exceeded"
+            log.info("race: oracle budget burned (%s); awaiting the sweep", exc)
+        except SearchCancelled:
+            oracle_state = "cancelled"
+        oracle_seconds = time.monotonic() - t_oracle
+
+        def race_stats(winner: str, joined: bool) -> dict:
+            rs = {
+                "winner": winner,
+                "budget_s": round(budget_s, 3),
+                "oracle_seconds": round(oracle_seconds, 4),
+                "oracle_outcome": oracle_state,
+                "loser_joined": joined,
+            }
+            if "sweep_seconds" in outcome:
+                rs["sweep_seconds"] = round(outcome["sweep_seconds"], 4)
+            for key in ("sweep_ineligible", "sweep_error"):
+                if key in outcome:
+                    rs[key] = outcome[key]
+            return rs
+
+        if oracle_res is not None:
+            # Host oracle reached the verdict (the overwhelmingly common
+            # path on real topologies): cancel the sweep and give it a
+            # bounded window to unwind its in-flight work.
+            sweep_cancel.cancel()
+            worker.join(timeout=min(
+                RACE_LOSER_JOIN_S,
+                max(RACE_LOSER_JOIN_MIN_S, 2.0 * oracle_seconds),
+            ))
+            joined = not worker.is_alive()
+            if not joined:
+                log.info(
+                    "race: cancelled sweep still unwinding (finishes in "
+                    "the background; verdict is already final)"
+                )
+            if self.checkpoint is not None and joined:
+                # Discard any progress the LOSING sweep recorded before the
+                # cancel landed: the race only runs when the checkpoint held
+                # no progress (the resumable gate), so everything in it now
+                # is this race's residue — left on disk it would flip that
+                # gate and skip the oracle on every later run of the same
+                # problem, turning a milliseconds verdict into a full sweep
+                # (r1 review finding).  Joined-only: a still-running worker
+                # could otherwise re-record after this clear (TOCTOU); the
+                # unjoined case is covered by the worker's OWN clear in its
+                # SearchCancelled handler, which runs strictly after its
+                # engine's last possible record.
+                try:
+                    self.checkpoint.clear()
+                except Exception:  # noqa: BLE001 — cleanup must not cost the verdict
+                    pass
+            oracle_res.stats["race"] = race_stats("oracle", joined)
+            return oracle_res
+
+        # Budget burned (or the sweep already won and cancelled us): the
+        # sweep IS the verdict path now — wait for it like the sequential
+        # fallback would, minus the spin-up time it already overlapped.
+        worker.join()
+        res = outcome.get("sweep_result")
+        if res is not None:
+            res.stats["race"] = race_stats("sweep", True)
+            return res
+        return None
 
     def _has_recorded_progress(self, scc: List[int]) -> bool:
         """Does the attached checkpoint hold progress plausibly belonging to
@@ -234,17 +471,22 @@ class AutoBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
-        # Optimistic limit first (no device probe): oracle-first applies to
-        # every SCC a sweep could possibly handle on any platform; whether
-        # the sweep fallback is actually viable is only decided — with a
-        # real platform probe — once the budget has burned.  If that probe
-        # then rules the sweep out (CPU platform mid-range SCC, or no jax),
-        # the burned budget is lost and the unbudgeted oracle restarts: the
-        # documented worst case is 'sweep estimate + unbounded search', paid
-        # only on pathological inputs — the trade for a device-free happy
-        # path.  A checkpoint file WITH recorded progress skips oracle-first
-        # entirely: re-burning the budget on every resume of a preempted
-        # sweep would tax exactly the long runs checkpoints exist for.
+        # Optimistic limit first (no device probe on THIS thread): the
+        # oracle-vs-sweep window applies to every SCC a sweep could
+        # possibly handle on any platform.  Racing mode (default) overlaps
+        # the two engines — the platform probe and sweep spin-up happen in
+        # a background worker while the budgeted oracle runs here, so the
+        # worst case is ~max(engines) instead of the sequential
+        # budget-burn-THEN-spin-up sum (measured 3.4x the direct sweep at
+        # scc 36, sweep_vs_native_tpu_r5.txt).  --no-race restores the
+        # sequential chain, whose happy path touches no device at all; if
+        # its post-burn probe rules the sweep out (CPU platform mid-range
+        # SCC, or no jax), the burned budget is lost and the unbudgeted
+        # oracle restarts — the documented worst case, paid only on
+        # pathological inputs.  A checkpoint file WITH recorded progress
+        # skips the oracle entirely: re-burning the budget on every resume
+        # of a preempted sweep would tax exactly the long runs checkpoints
+        # exist for.
         resumable = self._has_recorded_progress(scc)
         optimistic = (
             self.sweep_limit if self.sweep_limit is not None
@@ -252,10 +494,9 @@ class AutoBackend:
         )
         if len(scc) <= optimistic:
             if not resumable:
-                res = self._budgeted_oracle(
-                    graph, circuit, scc, scope_to_scc,
-                    self._estimated_sweep_seconds(len(scc)),
-                )
+                budget_s = self._estimated_sweep_seconds(len(scc))
+                attempt = self._race if self.race else self._budgeted_oracle
+                res = attempt(graph, circuit, scc, scope_to_scc, budget_s)
                 if res is not None:
                     return res
             limit = (

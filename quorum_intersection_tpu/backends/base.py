@@ -21,6 +21,52 @@ class OracleBudgetExceeded(RuntimeError):
     an exhaustive engine whose cost the budget was derived from."""
 
 
+class SearchCancelled(RuntimeError):
+    """A cooperatively-cancelled search stopped before reaching a verdict.
+
+    Raised (never returned as a verdict) by engines that accept a
+    :class:`CancelToken` — the racing auto router cancels the losing engine
+    the moment the other one produces a verdict.  Like
+    :class:`OracleBudgetExceeded`, cancellation is an abort signal about
+    *scheduling*, never information about the verdict."""
+
+
+class CancelToken:
+    """Cooperative cancellation flag shared between racing engines.
+
+    Two views of one bit, set exactly once and never cleared:
+
+    - :attr:`cancelled` / :meth:`cancel` — the Python side, checked by the
+      pure-Python oracle's B&B call-budget hook and the sweep driver's
+      window loop;
+    - :attr:`flag` — a one-element int32 numpy buffer whose POINTER is
+      handed to the native oracle (``qi_check_scc_cancel``), which polls it
+      alongside its call-budget check.  ctypes releases the GIL during the
+      native call, so a concurrent :meth:`cancel` from the race driver is
+      observed within one B&B call.
+
+    jax-free and allocation-trivial: safe to create per-race.
+    """
+
+    __slots__ = ("flag", "_event")
+
+    def __init__(self) -> None:
+        import threading
+
+        import numpy as np
+
+        self.flag = np.zeros(1, dtype=np.int32)
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self.flag[0] = 1
+        self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+
 @dataclass
 class SccCheckResult:
     """Outcome of the disjoint-quorum search inside one SCC.

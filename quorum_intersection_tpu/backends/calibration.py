@@ -38,6 +38,10 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("backends.calibration")
+
 # r3 fallbacks (benchmarks/results/bench_full_r3_onchip.json wide sweep;
 # crossover_cpu_r2.txt majority-18; BASELINE.md n=16) — used whenever no
 # artifact yields a usable number.
@@ -155,6 +159,18 @@ class Calibration:
     # must never route a measured-slower size to the sweep.
     sweep_win_cap_scc: Optional[int] = None
     sweep_win_device: Optional[str] = None
+    # Measured warm-start compile ratio (benchmarks/auto_race.py artifacts):
+    # warm-run XLA-compile seconds / cold-run, on an accelerator with the
+    # persistent compile cache hot.  None = never measured.  auto's budget
+    # estimate scales its accelerator overhead term by it, so routing
+    # prefers the chip once the cache is known-hot (ISSUE 1 warm-start).
+    # NB the budget estimate is deliberately platform-blind (probe-free
+    # happy path), so unlike the win-region gates this value is consumed
+    # without a device-kind match — see _estimated_sweep_seconds for why
+    # the cross-platform leak is bounded; sweep_warm_device is recorded
+    # for any future probe-ful consumer.
+    sweep_warm_ratio: Optional[float] = None
+    sweep_warm_device: Optional[str] = None
     # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
     provenance: Dict[str, str] = field(default_factory=dict)
 
@@ -274,17 +290,25 @@ def _sweep_win_max_scc(
     estimated-total row proves a floor, not a ratio), verdict parity must
     hold, and emulation (CPU-platform) rows never qualify.
 
+    A ``verdict_ok: false`` row anywhere in the chosen artifact — at ANY
+    |scc|, including at or below the static floor — disqualifies the whole
+    raise (ADVICE r5 #2): it is evidence of an engine CORRECTNESS bug on
+    this hardware, not a slow size, so it must not slip under the
+    floor-loss exemption below (which exists only for *performance* losses
+    at sizes the window cannot affect).  Logged as a correctness veto.
+
     Returns ``(max_winning_scc, cap_scc, device_kind, provenance)`` where
     ``cap_scc`` bounds extrapolation when a LOSS was measured above the
     window top (auto's headroom must never route past a measured loss);
     None when no loss was measured above."""
-    newest: Optional[Tuple[int, str, Dict[int, float]]] = None
+    newest: Optional[Tuple[int, str, Dict[int, float], List[int]]] = None
     for path in paths:
         try:
             text = path.read_text()
         except OSError:
             continue
         by_scc: Dict[int, float] = {}
+        vetoes: List[int] = []
         for ln in text.splitlines():
             ln = ln.strip()
             if not ln.startswith("{"):
@@ -300,7 +324,8 @@ def _sweep_win_max_scc(
             if not isinstance(scc, int) or not isinstance(speed, (int, float)):
                 continue
             if not rec.get("verdict_ok", False):
-                v = 0.0  # a verdict mismatch poisons the size: never route into it
+                vetoes.append(scc)
+                continue
             elif rec.get("native_completed") is not True:
                 # An estimate-only row (native didn't finish under the cap)
                 # is ABSENCE of a measured ratio, not a loss: skipping it
@@ -310,13 +335,23 @@ def _sweep_win_max_scc(
             else:
                 v = float(speed)
             by_scc[scc] = min(by_scc.get(scc, v), v)
-        if by_scc:
+        if by_scc or vetoes:
             rank = _round_rank(path.name)
             if newest is None or rank > newest[0]:
-                newest = (rank, path.name, by_scc)
+                newest = (rank, path.name, by_scc, vetoes)
     if newest is None:
         return None
-    _, name, by_scc = newest
+    _, name, by_scc, vetoes = newest
+    if vetoes:
+        log.warning(
+            "sweep-window raise vetoed: %s records verdict_ok=false at "
+            "scc %s — correctness evidence disqualifies the window at "
+            "every size until re-measured clean",
+            name, sorted(set(vetoes)),
+        )
+        return None
+    if not by_scc:
+        return None
     # A measured loss bounds the window from above AND disqualifies any
     # "win" beyond it: the limit this feeds routes EVERY |scc| up to it to
     # the sweep, so the window may contain no measured-slower size — a win
@@ -342,6 +377,54 @@ def _sweep_win_max_scc(
     )
 
 
+def _sweep_warm_ratio(
+    paths: Iterable[pathlib.Path],
+) -> Optional[Tuple[float, str]]:
+    """Warm/cold XLA-compile ratio from the newest auto_race artifact's
+    accelerator rows (benchmarks/auto_race.py ``--warm-start`` emits
+    ``sweep_cold_xla_compile_s`` / ``sweep_warm_xla_compile_s`` pairs).
+
+    Conservative by the same posture as the rate constants: the WORST
+    (largest) ratio across the artifact's rows gates, a cold time too small
+    to measure (< 0.1 s) never qualifies, and the ratio clamps to [0, 1] —
+    a "warm slower than cold" reading is artifact rot, not physics."""
+    newest: Optional[Tuple[int, str, float]] = None
+    for path in paths:
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        worst: Optional[float] = None
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if not _is_tpu(rec):
+                continue
+            cold = rec.get("sweep_cold_xla_compile_s")
+            warm = rec.get("sweep_warm_xla_compile_s")
+            if not isinstance(cold, (int, float)) or not isinstance(warm, (int, float)):
+                continue
+            if cold < 0.1:
+                continue
+            ratio = min(max(float(warm) / float(cold), 0.0), 1.0)
+            worst = ratio if worst is None else max(worst, ratio)
+        if worst is not None:
+            rank = _round_rank(path.name)
+            if newest is None or rank > newest[0]:
+                newest = (rank, path.name, worst)
+    if newest is None:
+        return None
+    _, name, ratio = newest
+    # Qualifying rows are TPU-only today (the _is_tpu filter above) —
+    # widen that filter before recording other kinds here.
+    return ratio, "tpu", f"{name}: warm/cold xla compile = {ratio:.3f} (worst row)"
+
+
 def _crossover_paths() -> List[pathlib.Path]:
     results = _REPO / "benchmarks" / "results"
     if results.is_dir():
@@ -356,10 +439,18 @@ def _sweep_window_paths() -> List[pathlib.Path]:
     return []
 
 
+def _auto_race_paths() -> List[pathlib.Path]:
+    results = _REPO / "benchmarks" / "results"
+    if results.is_dir():
+        return sorted(results.glob("auto_race*r*.txt"))
+    return []
+
+
 def calibrate(
     paths: Optional[Iterable[pathlib.Path]] = None,
     crossover_paths: Optional[Iterable[pathlib.Path]] = None,
     sweep_window_paths: Optional[Iterable[pathlib.Path]] = None,
+    auto_race_paths: Optional[Iterable[pathlib.Path]] = None,
 ) -> Calibration:
     cal = Calibration()
     cal.provenance = {k: "default" for k in ("accel", "cpu", "cpp")}
@@ -372,6 +463,15 @@ def calibrate(
         crossover_paths = _crossover_paths() if paths is None else []
     if sweep_window_paths is None:
         sweep_window_paths = _sweep_window_paths() if paths is None else []
+    if auto_race_paths is None:
+        auto_race_paths = _auto_race_paths() if paths is None else []
+    try:
+        warm = _sweep_warm_ratio(auto_race_paths)
+        if warm is not None:
+            (cal.sweep_warm_ratio, cal.sweep_warm_device,
+             cal.provenance["warm_start"]) = warm
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        pass
     try:
         win = _frontier_win_min_scc(crossover_paths)
         if win is not None:

@@ -103,8 +103,8 @@ def _load() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     lib = ctypes.CDLL(str(build_library()))
-    lib.qi_check_scc_budget.restype = ctypes.c_int32
-    lib.qi_check_scc_budget.argtypes = [
+    lib.qi_check_scc_cancel.restype = ctypes.c_int32
+    lib.qi_check_scc_cancel.argtypes = [
         ctypes.c_int32,  # n
         _i32p, _i32p,  # succ_off, succ_tgt
         _i32p, _i32p, _i32p, _i32p,  # roots, units, mem, inner
@@ -112,6 +112,7 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,  # scope, use_rng, seed
         ctypes.c_int32,  # trace (per-call stderr narration)
         ctypes.c_int64,  # budget_calls (0 = unlimited; -2 return on overrun)
+        _i32p,  # cancel_flag (NULL = uncancellable; -3 return on cancel)
         _i32p, _i32p, _i32p, _i32p,  # q1_out, q1_len, q2_out, q2_len
         _i64p,  # stats_out[3]
     ]
@@ -232,6 +233,7 @@ class CppOracleBackend:
         seed: Optional[int] = None,
         randomized: bool = False,
         budget_calls: Optional[int] = None,
+        cancel=None,
     ) -> None:
         self._use_rng = bool(randomized or seed is not None)
         # randomized without an explicit seed means *actual* nondeterminism
@@ -244,6 +246,11 @@ class CppOracleBackend:
         # instead of running an unbounded exponential search (the auto
         # router's latency-aware oracle-first strategy).
         self._budget_calls = 0 if budget_calls is None else int(budget_calls)
+        # Optional base.CancelToken: the native search polls its int32 flag
+        # alongside the budget check and check_scc raises SearchCancelled —
+        # the racing auto router stops this engine from another thread when
+        # a concurrent engine reaches the verdict first.
+        self._cancel = cancel
 
     def ensure_built(self) -> None:
         _load()
@@ -265,8 +272,12 @@ class CppOracleBackend:
         q2_len = ctypes.c_int32(0)
         stats = np.zeros(3, dtype=np.int64)
 
+        cancel_ptr = (
+            _i32p() if self._cancel is None
+            else self._cancel.flag.ctypes.data_as(_i32p)
+        )
         t0 = time.perf_counter()
-        intersects = lib.qi_check_scc_budget(
+        intersects = lib.qi_check_scc_cancel(
             flat.n,
             flat._ptr(flat.succ_off),
             flat._ptr(flat.succ_tgt),
@@ -281,6 +292,7 @@ class CppOracleBackend:
             self._seed,
             int(log.isEnabledFor(logging.DEBUG)),  # -t routes here via set_trace
             self._budget_calls,
+            cancel_ptr,
             q1.ctypes.data_as(_i32p),
             ctypes.byref(q1_len),
             q2.ctypes.data_as(_i32p),
@@ -295,6 +307,13 @@ class CppOracleBackend:
             raise OracleBudgetExceeded(
                 f"native oracle exceeded {self._budget_calls} B&B calls "
                 f"on |scc|={len(scc)} after {seconds:.2f}s"
+            )
+        if intersects == -3:
+            from quorum_intersection_tpu.backends.base import SearchCancelled
+
+            raise SearchCancelled(
+                f"native oracle cancelled on |scc|={len(scc)} after "
+                f"{seconds:.2f}s ({int(stats[0])} B&B calls)"
             )
 
         return SccCheckResult(

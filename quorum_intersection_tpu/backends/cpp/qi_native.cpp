@@ -500,7 +500,8 @@ int32_t flatten_qset(const QSet& q, FlatGraph& g,
   // the node's slice entirely.  An INNER null must NOT get the sentinel —
   // it still occupies a voting slot that can never be satisfied
   // (fbas/semantics.py counts it in the fail budget; the Python-side
-  // FlatGraph encodes threshold 0).  Returning -1 at inner depths leaked
+  // FlatGraph Q3-clamps it to the never-satisfiable sentinel m_count+1,
+  // exactly like the normalization below).  Returning -1 at inner depths leaked
   // the root sentinel into the inner pool, where slice_unit dereferenced
   // units[-1] — a heap-buffer-overflow found by tools/fuzz_native.py on
   // `"innerQuorumSets": [{}]` inputs.  Falling through is sufficient: a

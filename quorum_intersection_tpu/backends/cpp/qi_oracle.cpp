@@ -136,6 +136,15 @@ struct Search {
   // the alternative (backends/auto.py latency-aware routing).
   int64_t budget_calls = 0;
   bool budget_exceeded = false;
+  // Optional cooperative cancel flag (nullptr = never cancelled): polled
+  // alongside the budget check, so a racing caller (backends/auto.py) can
+  // stop this search from another thread the moment a concurrent engine
+  // reaches a verdict.  The pointer targets a caller-owned int32 written
+  // from Python while this call runs without the GIL; a plain volatile
+  // read is sufficient — the flag only ever transitions 0 -> 1 and a
+  // one-call-delayed observation is harmless.
+  const volatile int32_t* cancel_flag = nullptr;
+  bool cancelled = false;
   bool found = false;
   std::vector<int32_t> q1, q2;
   // Collect mode (top-tier analytics): instead of probing each minimal
@@ -267,6 +276,12 @@ struct Search {
       budget_exceeded = true;
       return true;
     }
+    if (cancel_flag != nullptr && *cancel_flag != 0) {
+      // Same unwind as the budget abort; the caller distinguishes via the
+      // -3 return, never via the verdict.
+      cancelled = true;
+      return true;
+    }
     if (trace) {
       std::fprintf(stderr, "trace: B&B call %lld: |toRemove|=%zu |dontRemove|=%zu\n",
                    static_cast<long long>(bnb_calls), to_remove.size(),
@@ -372,17 +387,20 @@ extern "C" {
 // Disjoint-quorum search within one SCC.  Returns 1 iff all quorums
 // intersect; on 0, q1/q2 (buffers of capacity n) receive the witness pair;
 // -2 iff `budget_calls` > 0 and the search exceeded it (verdict unknown —
-// the caller falls back to another engine; backends/auto.py).
+// the caller falls back to another engine; backends/auto.py); -3 iff
+// `cancel_flag` became nonzero (a racing caller's concurrent engine won).
 // stats_out[0..2] = {bnb_calls, minimal_quorums, fixpoint_calls}.
 // `trace` != 0 narrates every B&B call / prune / probe to stderr (the
 // reference's -t trace spew, cpp:258-259).
-int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
+int32_t qi_check_scc_cancel(int32_t n, const int32_t* succ_off,
                             const int32_t* succ_tgt, const int32_t* roots,
                             const int32_t* units, const int32_t* mem,
                             const int32_t* inner, const int32_t* scc,
                             int32_t scc_len, int32_t scope_to_scc,
                             int32_t use_rng, uint64_t seed, int32_t trace,
-                            int64_t budget_calls, int32_t* q1_out,
+                            int64_t budget_calls,
+                            const volatile int32_t* cancel_flag,
+                            int32_t* q1_out,
                             int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
                             int64_t* stats_out) {
   Graph g{n, succ_off, succ_tgt, roots, units, mem, inner};
@@ -398,6 +416,7 @@ int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
   Search search{g, avail.data(), scc_vec, scc_len / 2,
                 use_rng ? &rng_engine : nullptr, trace != 0};
   search.budget_calls = budget_calls;
+  search.cancel_flag = cancel_flag;
   search.init_scratch();
   std::vector<int32_t> dont;
   search.iterate(scc_vec, dont);
@@ -413,10 +432,10 @@ int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
   stats_out[0] = search.bnb_calls;
   stats_out[1] = search.minimal_quorums;
   stats_out[2] = search.fixpoint_calls;
-  if (search.budget_exceeded) {
+  if (search.budget_exceeded || search.cancelled) {
     *q1_len = 0;
     *q2_len = 0;
-    return -2;
+    return search.cancelled ? -3 : -2;
   }
   if (search.found) {
     *q1_len = static_cast<int32_t>(search.q1.size());
@@ -428,6 +447,23 @@ int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
   *q1_len = 0;
   *q2_len = 0;
   return 1;
+}
+
+// Budgeted-but-uncancellable entry point (pre-race ABI): kept for any
+// binding built against it; forwards with no cancel flag.
+int32_t qi_check_scc_budget(int32_t n, const int32_t* succ_off,
+                            const int32_t* succ_tgt, const int32_t* roots,
+                            const int32_t* units, const int32_t* mem,
+                            const int32_t* inner, const int32_t* scc,
+                            int32_t scc_len, int32_t scope_to_scc,
+                            int32_t use_rng, uint64_t seed, int32_t trace,
+                            int64_t budget_calls, int32_t* q1_out,
+                            int32_t* q1_len, int32_t* q2_out, int32_t* q2_len,
+                            int64_t* stats_out) {
+  return qi_check_scc_cancel(n, succ_off, succ_tgt, roots, units, mem, inner,
+                             scc, scc_len, scope_to_scc, use_rng, seed, trace,
+                             budget_calls, nullptr, q1_out, q1_len, q2_out,
+                             q2_len, stats_out);
 }
 
 // Top-tier enumeration: the union of ALL minimal quorums' members inside

@@ -92,9 +92,10 @@ class _SearchState:
     """
 
     __slots__ = ("bnb_calls", "minimal_quorums", "fixpoint_calls", "trace",
-                 "budget_calls", "budget_exceeded", "best_node_fallback")
+                 "budget_calls", "budget_exceeded", "best_node_fallback",
+                 "cancel", "cancelled")
 
-    def __init__(self, budget_calls: int = 0) -> None:
+    def __init__(self, budget_calls: int = 0, cancel=None) -> None:
         self.bnb_calls = 0
         self.minimal_quorums = 0
         self.fixpoint_calls = 0
@@ -107,6 +108,11 @@ class _SearchState:
         # bnb_calls passes the budget — see base.OracleBudgetExceeded.
         self.budget_calls = budget_calls
         self.budget_exceeded = False
+        # Optional base.CancelToken, polled alongside the budget check so a
+        # racing caller can stop this search from another thread — see
+        # base.SearchCancelled.
+        self.cancel = cancel
+        self.cancelled = False
 
 
 def iterate_minimal_quorums(
@@ -142,6 +148,11 @@ def iterate_minimal_quorums(
         # Abort the whole recursion (True unwinds like a hit); the caller
         # distinguishes via budget_exceeded, never via the verdict.
         state.budget_exceeded = True
+        return True
+    if state.cancel is not None and state.cancel.cancelled:
+        # Same unwind as the budget abort; distinguished via `cancelled`,
+        # never via the verdict.
+        state.cancelled = True
         return True
     if state.trace:
         log.debug(
@@ -224,9 +235,11 @@ class PythonOracleBackend:
         seed: Optional[int] = None,
         randomized: bool = False,
         budget_calls: Optional[int] = None,
+        cancel=None,
     ) -> None:
         self._rng = random.Random(seed) if (randomized or seed is not None) else None
         self._budget_calls = 0 if budget_calls is None else int(budget_calls)
+        self._cancel = cancel  # base.CancelToken or None (racing auto router)
 
     def check_scc(
         self,
@@ -237,7 +250,7 @@ class PythonOracleBackend:
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
         t0 = time.perf_counter()
-        state = _SearchState(budget_calls=self._budget_calls)
+        state = _SearchState(budget_calls=self._budget_calls, cancel=self._cancel)
 
         if scope_to_scc:
             avail = [False] * graph.n
@@ -301,6 +314,13 @@ class PythonOracleBackend:
             raise OracleBudgetExceeded(
                 f"python oracle exceeded {self._budget_calls} B&B calls "
                 f"on |scc|={len(scc)} after {seconds:.2f}s"
+            )
+        if state.cancelled:
+            from quorum_intersection_tpu.backends.base import SearchCancelled
+
+            raise SearchCancelled(
+                f"python oracle cancelled on |scc|={len(scc)} after "
+                f"{seconds:.2f}s ({state.bnb_calls} B&B calls)"
             )
         if state.trace:
             log.debug(

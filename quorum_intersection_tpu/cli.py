@@ -19,7 +19,8 @@ Contract parity (SURVEY.md §2.2):
 Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 ``--scc-select``, ``--scope-scc``, ``--seed``, ``--randomized``, ``--compat``
 (reference-bug-compatible shorthand: alias0 dangling + front SCC selection),
-``--timing``, ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
+``--timing``, ``--no-race`` (sequential auto routing instead of the racing
+orchestrator), ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
 profiler trace).
 """
 
@@ -87,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compat", action="store_true",
                    help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
     p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
+    p.add_argument("--no-race", action="store_true",
+                   help="disable the auto backend's racing orchestrator "
+                        "(budgeted oracle vs concurrent sweep spin-up, first "
+                        "verdict wins): run the sequential oracle-then-sweep "
+                        "chain instead — identical verdicts, no background "
+                        "device contact")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
                    help="checkpoint file for long searches (sweep position or "
                         "frontier state): progress is recorded there and an "
@@ -272,6 +279,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.seed is not None or args.randomized
     ):
         backend_options = {"seed": args.seed, "randomized": True}
+    if args.no_race:
+        if args.backend not in ("auto", "tpu"):
+            sys.stderr.write(
+                "--no-race only applies to the auto router "
+                "(--backend auto/tpu)\n"
+            )
+            return 1
+        backend_options["race"] = False
     if args.checkpoint is not None:
         if args.backend not in ("auto", "tpu", "tpu-sweep",
                                 "tpu-frontier"):

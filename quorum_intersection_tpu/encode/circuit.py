@@ -198,6 +198,83 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
     )
 
 
+# Canonical pad ladder for device sweeps (backends/tpu/sweep.py warm-start
+# compile path): node and unit counts round UP to the nearest rung so the
+# compiled program shapes — which key the persistent XLA compilation cache —
+# collapse from "one per exact (n, n_units)" to a handful of buckets.  Rungs
+# are sub-tile below 128 (XLA pads the lane axis to 128 anyway, so the extra
+# columns are free) and tile-multiples above; beyond the ladder the exact
+# size is kept (snapshot-scale circuits are already restricted to their SCC
+# before padding applies).
+PAD_LADDER = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768, 1024)
+
+
+def pad_targets(n: int, n_units: int) -> tuple:
+    """Canonical padded ``(n, n_units)`` for one circuit: each dimension
+    rounds up to the smallest :data:`PAD_LADDER` rung that holds it (identity
+    beyond the ladder).  Two structural invariants the kernels read off the
+    shapes are preserved: ``n_units >= n`` (they slice ``sat[..., :n]``, so
+    every padded node index needs a unit row) and the STRICT ``n_units > n``
+    of a circuit with inner units (``CircuitArrays.has_inner`` — collapsing
+    it to equality would silently skip the child-propagation matmuls)."""
+
+    def up(x: int) -> int:
+        for rung in PAD_LADDER:
+            if x <= rung:
+                return rung
+        return x
+
+    n_pad = up(n)
+    if n_units <= n:
+        return n_pad, n_pad
+    return n_pad, up(max(n_units, n_pad + 1))
+
+
+def pad_circuit(circuit: Circuit, n_to: int, units_to: int) -> Circuit:
+    """Grow a circuit to ``(n_to, units_to)`` with inert padding — equal
+    satisfaction semantics for every availability row supported on the
+    original ``n`` nodes (pinned by differential tests vs
+    :func:`node_sat_np` / :func:`max_quorum_np`).
+
+    Padding is doubly inert: padded node COLUMNS carry zero votes in every
+    unit (a padded node's availability influences nothing), and padded unit
+    ROWS get the Q2 never-satisfiable encoding (threshold 1 over zero
+    members), so ``sat[..., n:n_to]`` is identically 0 regardless of input.
+    Callers must keep padded nodes out of every availability input (the
+    sweep decode does so structurally: its ``pos`` table maps only real
+    nodes; masks are zero-extended).
+    """
+    if n_to == circuit.n and units_to == circuit.n_units:
+        return circuit
+    if n_to < circuit.n or units_to < max(circuit.n_units, n_to):
+        raise ValueError(
+            f"pad target ({n_to}, {units_to}) below circuit shape "
+            f"({circuit.n}, {circuit.n_units})"
+        )
+    if circuit.n_units > circuit.n and units_to <= n_to:
+        raise ValueError(
+            "padding would collapse n_units > n — the inner-unit marker "
+            "the device kernels key child propagation on"
+        )
+    thresholds = np.ones(units_to, dtype=np.int32)  # Q2: unsatisfiable filler
+    thresholds[: circuit.n_units] = circuit.thresholds
+    members = np.zeros((units_to, n_to), dtype=np.uint8)
+    members[: circuit.n_units, : circuit.n] = circuit.members
+    child = np.zeros((units_to, units_to), dtype=np.uint8)
+    child[: circuit.n_units, : circuit.n_units] = circuit.child
+    unit_depth = np.zeros(units_to, dtype=np.int32)
+    unit_depth[: circuit.n_units] = circuit.unit_depth
+    return Circuit(
+        n=n_to,
+        n_units=units_to,
+        depth=circuit.depth,
+        thresholds=thresholds,
+        members=members,
+        child=child,
+        unit_depth=unit_depth,
+    )
+
+
 def restrict_circuit_pair(circuit: Circuit, scc: List[int]) -> tuple:
     """Project the circuit onto the SCC's columns, folding the constant
     contribution of non-SCC nodes into thresholds — both folds at once:

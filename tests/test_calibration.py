@@ -434,3 +434,129 @@ class TestSweepWindow:
         cal = calibrate(paths=[], sweep_window_paths=[p])
         assert cal.sweep_win_max_scc == 32
         assert cal.sweep_win_cap_scc is None
+
+
+class TestVerdictVeto:
+    """ADVICE r5 #2 regression: a verdict_ok=false row is CORRECTNESS
+    evidence and must disqualify the sweep-window raise at EVERY |scc| —
+    before the fix it was coerced to v=0.0 and, at sizes at or below the
+    static floor, slipped under the floor-loss exemption."""
+
+    def _txt(self, tmp_path, name, rows):
+        lines = ["| header |"]
+        for scc, speed, dev, ok, completed in rows:
+            lines.append(json.dumps({
+                "scc": scc, "device": dev, "sweep_speedup_vs_native": speed,
+                "verdict_ok": ok, "native_completed": completed,
+            }))
+        p = tmp_path / name
+        p.write_text("\n".join(lines))
+        return p
+
+    def test_mismatch_below_floor_vetoes_whole_window(self, tmp_path):
+        # The exact hole: scc 24 <= SWEEP_WINDOW_FLOOR(35) used to be
+        # exempt as a "loss"; as a verdict mismatch it must veto the raise.
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r9.txt", [
+            (24, 9.0, "TPU v5 lite", False, True),
+            (28, 4.8, "TPU v5 lite", True, True),
+            (32, 24.7, "TPU v5 lite", True, True),
+        ])
+        assert calibrate(
+            paths=[], sweep_window_paths=[p]
+        ).sweep_win_max_scc is None
+
+    def test_mismatch_above_floor_still_vetoes(self, tmp_path):
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r9.txt", [
+            (36, 2.0, "TPU v5 lite", False, True),
+            (40, 9.0, "TPU v5 lite", True, True),
+        ])
+        assert calibrate(
+            paths=[], sweep_window_paths=[p]
+        ).sweep_win_max_scc is None
+
+    def test_veto_logged_as_correctness(self, tmp_path):
+        # The package logger sets propagate=False, so capture with a
+        # handler attached directly instead of caplog.
+        import logging
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r9.txt", [
+            (24, 9.0, "TPU v5 lite", False, True),
+            (32, 24.7, "TPU v5 lite", True, True),
+        ])
+        logger = logging.getLogger("quorum_intersection_tpu.backends.calibration")
+        handler = _Capture(level=logging.WARNING)
+        logger.addHandler(handler)
+        try:
+            calibrate(paths=[], sweep_window_paths=[p])
+        finally:
+            logger.removeHandler(handler)
+        assert any("vetoed" in m and "verdict_ok=false" in m for m in records)
+
+    def test_perf_loss_below_floor_is_still_exempt(self, tmp_path):
+        # The exemption the veto must NOT swallow: a genuine performance
+        # loss (verdict parity held) at or below the floor keeps the raise.
+        p = self._txt(tmp_path, "sweep_vs_native_tpu_r9.txt", [
+            (24, 0.1, "TPU v5 lite", True, True),
+            (32, 24.7, "TPU v5 lite", True, True),
+        ])
+        cal = calibrate(paths=[], sweep_window_paths=[p])
+        assert cal.sweep_win_max_scc == 32
+
+
+class TestWarmStartRatio:
+    """Warm/cold compile ratio (benchmarks/auto_race.py artifacts) feeding
+    auto's budget estimate once the persistent compile cache is known-hot."""
+
+    def _txt(self, tmp_path, name, rows):
+        lines = []
+        for dev, cold, warm in rows:
+            lines.append(json.dumps({
+                "mode": "real", "device": dev,
+                "sweep_cold_xla_compile_s": cold,
+                "sweep_warm_xla_compile_s": warm,
+            }))
+        p = tmp_path / name
+        p.write_text("\n".join(lines))
+        return p
+
+    def test_ratio_from_artifact_worst_row_gates(self, tmp_path):
+        p = self._txt(tmp_path, "auto_race_tpu_r9.txt", [
+            ("TPU v5 lite", 20.0, 0.5),   # 0.025
+            ("TPU v5 lite", 10.0, 1.0),   # 0.1 — worst row wins
+        ])
+        cal = calibrate(paths=[], auto_race_paths=[p])
+        assert cal.sweep_warm_ratio == 0.1
+        assert "auto_race_tpu_r9.txt" in cal.provenance["warm_start"]
+
+    def test_tiny_cold_cpu_and_rotten_rows_ignored(self, tmp_path):
+        p = self._txt(tmp_path, "auto_race_tpu_r9.txt", [
+            ("TPU v5 lite", 0.05, 0.0),   # cold too small to measure
+            ("cpu", 20.0, 0.1),           # emulation row
+        ])
+        cal = calibrate(paths=[], auto_race_paths=[p])
+        assert cal.sweep_warm_ratio is None
+        # warm > cold clamps to 1.0 (artifact rot, not physics)
+        p2 = self._txt(tmp_path, "auto_race_tpu_r10.txt", [
+            ("TPU v5 lite", 2.0, 5.0),
+        ])
+        cal = calibrate(paths=[], auto_race_paths=[p2])
+        assert cal.sweep_warm_ratio == 1.0
+
+    def test_hermetic_and_default(self, tmp_path):
+        assert calibrate(paths=[]).sweep_warm_ratio is None
+
+    def test_warm_ratio_shrinks_auto_budget(self, monkeypatch):
+        from quorum_intersection_tpu.backends import auto
+
+        backend = auto.AutoBackend()
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_warm_ratio", None)
+        cold_budget = backend._estimated_sweep_seconds(34)
+        monkeypatch.setattr(auto.CALIBRATION, "sweep_warm_ratio", 0.05)
+        warm_budget = backend._estimated_sweep_seconds(34)
+        assert warm_budget < cold_budget  # routing prefers the chip sooner

@@ -174,3 +174,95 @@ class TestRestrictCircuit:
         row = jnp.ones((1, 1), jnp.float32)
         assert int(fixpoint(CircuitArrays(q6_c), row).sum()) == 1
         assert int(fixpoint(CircuitArrays(scoped_c), row).sum()) == 0
+
+
+class TestCanonicalPadding:
+    """Warm-start pad ladder (encode.pad_circuit): padding is semantically
+    inert for any availability row supported on the original nodes, and it
+    preserves the structural invariants the device kernels read off the
+    array shapes."""
+
+    def _random_circuit(self, seed, n=11):
+        from quorum_intersection_tpu.fbas.synth import random_fbas
+
+        data = random_fbas(n, seed=seed, nested_prob=0.4, null_prob=0.1)
+        return encode_circuit(build_graph(parse_fbas(data)))
+
+    def test_pad_targets_ladder_and_invariants(self):
+        from quorum_intersection_tpu.encode.circuit import pad_targets
+
+        assert pad_targets(5, 5) == (8, 8)
+        assert pad_targets(9, 9) == (16, 16)
+        assert pad_targets(36, 36) == (48, 48)
+        assert pad_targets(2000, 2000) == (2000, 2000)  # beyond the ladder
+        # Inner-unit circuits keep the STRICT n_units > n marker even when
+        # both would round to the same rung.
+        n_pad, u_pad = pad_targets(30, 31)
+        assert n_pad == 32 and u_pad > n_pad
+        # No inner units: padded shape stays square.
+        assert pad_targets(16, 16) == (16, 16)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_node_sat_equivalence(self, seed):
+        from quorum_intersection_tpu.encode.circuit import (
+            max_quorum_np,
+            pad_circuit,
+            pad_targets,
+        )
+
+        circuit = self._random_circuit(seed)
+        n_to, u_to = pad_targets(circuit.n, circuit.n_units)
+        padded = pad_circuit(circuit, n_to, u_to)
+        rng = np.random.default_rng(seed)
+        avail = rng.integers(0, 2, size=(16, circuit.n)).astype(bool)
+        avail_pad = np.zeros((16, padded.n), dtype=bool)
+        avail_pad[:, : circuit.n] = avail
+
+        sat = node_sat_np(circuit, avail)
+        sat_pad = node_sat_np(padded, avail_pad)
+        np.testing.assert_array_equal(sat_pad[:, : circuit.n], sat)
+        assert not sat_pad[:, circuit.n :].any()  # padded nodes are inert
+
+        mq = max_quorum_np(circuit, avail)
+        mq_pad = max_quorum_np(padded, avail_pad)
+        np.testing.assert_array_equal(mq_pad[:, : circuit.n], mq)
+        assert not mq_pad[:, circuit.n :].any()
+
+    def test_pad_identity_and_guards(self):
+        from quorum_intersection_tpu.encode.circuit import (
+            pad_circuit,
+            pad_targets,
+        )
+
+        circuit = encode_circuit(
+            build_graph(parse_fbas(hierarchical_fbas(4, 2)))
+        )
+        n_to, u_to = pad_targets(circuit.n, circuit.n_units)
+        assert pad_circuit(circuit, circuit.n, circuit.n_units) is circuit
+        with pytest.raises(ValueError, match="below circuit shape"):
+            pad_circuit(circuit, circuit.n - 1, u_to)
+        if circuit.n_units > circuit.n:
+            # A square pad target large enough to hold the units would
+            # collapse the strict n_units > n inner-unit marker.
+            square = max(n_to, u_to)
+            with pytest.raises(ValueError, match="inner-unit marker"):
+                pad_circuit(circuit, square, square)
+
+    def test_sweep_uses_canonical_shape_with_verdict_parity(self):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+        from quorum_intersection_tpu.fbas.synth import majority_fbas
+        from quorum_intersection_tpu.pipeline import solve
+
+        for broken in (False, True):
+            data = majority_fbas(12, broken=broken)
+            padded = solve(data, backend=TpuSweepBackend(batch=64))
+            exact = solve(
+                data, backend=TpuSweepBackend(batch=64, pad_shapes=False)
+            )
+            assert padded.intersects is exact.intersects is (not broken)
+            assert padded.stats["padded_shape"] == [16, 16]
+            assert "padded_shape" not in exact.stats
+            if broken:
+                # Identical enumeration order => identical first hit.
+                assert padded.stats["hit_index"] == exact.stats["hit_index"]
+                assert padded.q1 == exact.q1 and padded.q2 == exact.q2

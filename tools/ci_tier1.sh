@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Tier-1 verification gate — the exact command ROADMAP.md pins, wrapped so
+# every PR runs the same gate locally and in CI (.github/workflows/tier1.yml).
+#
+# Contract (keep in sync with ROADMAP.md "Tier-1 verify"):
+#   - CPU platform only (JAX_PLATFORMS=cpu): no chip, no tunnel;
+#   - not-slow marker selection, collection errors tolerated per-file;
+#   - pipefail + a DOTS_PASSED count parsed from the progress dots, so the
+#     driver can compare pass totals across runs even when the exit code
+#     alone would hide a shrinking suite;
+#   - hard timeout (870 s) with SIGKILL escalation.
+#
+# Usage: tools/ci_tier1.sh [extra pytest args...]
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"
