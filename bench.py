@@ -681,7 +681,29 @@ def run_child(phase: str, deadline: Deadline, timeout: float,
     crash / unparseable output — the parent never blocks on a hung tunnel.
     ``salvage=True`` (phases that emit incrementally): on timeout, the last
     parseable stdout line is returned with a ``partial_error`` marker.
+
+    Each dispatch is a ``bench.<phase>`` telemetry span in the parent's run
+    record (one schema with the CLI — docs/OBSERVABILITY.md); the child
+    inherits ``QI_METRICS_JSON`` through the environment, so its own
+    pipeline/sweep spans land in the same JSONL stream, grouped by pid.
     """
+    from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+    with get_run_record().span(
+        f"bench.{phase}", platform=platform or "ambient"
+    ) as sp:
+        result = _run_child_raw(phase, deadline, timeout, extra_args,
+                                platform, salvage)
+        sp.set(ok="error" not in result)
+        if "error" in result:
+            sp.set(error=result["error"][:120])
+        return result
+
+
+def _run_child_raw(phase: str, deadline: Deadline, timeout: float,
+                   extra_args: list | None = None,
+                   platform: str | None = None,
+                   salvage: bool = False) -> dict:
     timeout = min(timeout, max(deadline.remaining() - 15.0, 0.0))
     if timeout < MIN_CHILD_TIMEOUT:
         return {"error": "skipped: budget exhausted"}
@@ -747,6 +769,11 @@ def orchestrate(args) -> int:
     from quorum_intersection_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    if args.metrics_json:
+        # One stream for the whole bench: the env var (not a flag) carries
+        # the sink so every phase CHILD appends its own spans/counters to
+        # the same JSONL file the parent's bench.<phase> spans land in.
+        os.environ["QI_METRICS_JSON"] = os.path.abspath(args.metrics_json)
 
     deadline = Deadline(args.budget_seconds)
     shapes = dict(QUICK if args.quick else FULL)
@@ -1028,14 +1055,28 @@ def orchestrate(args) -> int:
         headline.update(fr)
     stamp("frontier", fr, "frontier_device", platform)
     emit(headline)
+
+    from quorum_intersection_tpu.utils import telemetry
+
+    rec = telemetry.get_run_record()
+    rec.gauge("bench.headline_value", headline.get("value"))
+    rec.event("bench.done", device=headline.get("device"),
+              phases={k: str(v)[:80] for k, v in phases.items()})
+    telemetry.finish()
     return 0
 
 
 def child_main(args) -> int:
     """Dispatch one phase in this (child) process and print its JSON."""
     from quorum_intersection_tpu.utils.platform import honor_platform_env
+    from quorum_intersection_tpu.utils.telemetry import get_run_record
 
     honor_platform_env()  # honors JAX_PLATFORMS=cpu for fallback children
+    with get_run_record().span(f"bench.child.{args.phase}"):
+        return _child_dispatch(args)
+
+
+def _child_dispatch(args) -> int:
     if args.phase == "probe":
         out = phase_probe()
     elif args.phase == "throughput":
@@ -1064,6 +1105,10 @@ def main() -> int:
     parser.add_argument("--quick", action="store_true", help="small smoke-test shapes")
     parser.add_argument("--budget-seconds", type=float, default=1500.0,
                         help="total wall-clock bound; phases that no longer fit are skipped")
+    parser.add_argument("--metrics-json", default=None, metavar="PATH",
+                        help="append run-record telemetry (qi-telemetry/1 "
+                             "JSONL, parent AND phase children) to PATH; "
+                             "render with tools/metrics_report.py")
     parser.add_argument("--batch", type=int, default=None, help="candidates per block")
     parser.add_argument("--steps", type=int, default=None, help="device programs dispatched")
     parser.add_argument(

@@ -261,28 +261,42 @@ def main() -> int:
                         help="with --real: emit the cold/warm compile pair")
     parser.add_argument("--scc", type=int, nargs="*", default=None,
                         help="|scc| sizes for --real (multiples of 4)")
+    parser.add_argument("--metrics-json", default=None, metavar="PATH",
+                        help="append run-record telemetry (qi-telemetry/1 "
+                             "JSONL — the same schema the CLI and bench.py "
+                             "emit) to PATH; warm-start child processes "
+                             "inherit the sink via the environment")
     args = parser.parse_args()
 
     from quorum_intersection_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    if args.metrics_json:
+        os.environ["QI_METRICS_JSON"] = os.path.abspath(args.metrics_json)
 
+    from quorum_intersection_tpu.utils import telemetry
+
+    rec = telemetry.get_run_record()
     rows = []
     if args.real:
         sizes = args.scc or [28, 32, 36]
-        rows += real_rows(sizes, args.warm_start)
+        with rec.span("auto_race.real", sizes=sizes):
+            rows += real_rows(sizes, args.warm_start)
     if args.fake or not args.real:
         from quorum_intersection_tpu.fbas.synth import majority_fbas
 
-        rows += fake_rows(majority_fbas(9))
+        with rec.span("auto_race.fake"):
+            rows += fake_rows(majority_fbas(9))
 
     ok = True
     for row in rows:
         print(json.dumps(row), flush=True)
+        rec.event("auto_race.row", **row)
         if row.get("ratio_vs_fast") is not None:
             ok = ok and row["ratio_vs_fast"] <= 1.2
         ok = ok and row.get("verdict_ok", False)
     print(f"auto_race: {'OK' if ok else 'DEGRADED'} ({len(rows)} rows)")
+    telemetry.finish()
     return 0 if ok else 1
 
 

@@ -10,7 +10,9 @@ Capability-equivalent to the reference C++ tool ``fixxxedpoint/quorum_intersecti
                   oracle, and the JAX/TPU batched-bitmask engine
 - ``analytics`` — PageRank power iteration + Graphviz export with SCC coloring
 - ``parallel``  — device-mesh / sharding helpers for the candidate-sweep axis
-- ``utils``     — logging, phase timers, throughput counters, sweep checkpointing
+- ``utils``     — logging, run-record telemetry (spans/counters/events, one
+                  schema from parse to chip — docs/OBSERVABILITY.md), phase
+                  timers, throughput counters, sweep checkpointing
 """
 
 __version__ = "0.1.0"

@@ -52,6 +52,7 @@ from quorum_intersection_tpu.backends.base import SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("backends.auto")
 
@@ -147,17 +148,50 @@ def _measured_sweep_raise() -> Optional[int]:
     return raised
 
 
+# Resolved per-platform sweep limit, cached after the first device probe
+# (ADVICE r5 / ISSUE 2 satellite): the optimistic oracle-first bound in
+# check_scc is deliberately probe-free, so on its FIRST pass it must use the
+# ungated _measured_sweep_raise() — which on a foreign device (GPU box with a
+# TPU-measured artifact) over-shoots, burns the oracle budget, and restarts
+# the oracle unbudgeted.  Once any budget burn / race worker has paid the
+# probe, the true gated limit is cached here and every later solve in the
+# process uses it for the optimistic bound too — the pathological path is
+# paid at most once per process, not once per resume.
+_resolved_platform_limit: Optional[int] = None
+
+
 def _platform_sweep_limit() -> int:
+    global _resolved_platform_limit
     from quorum_intersection_tpu.utils.platform import (
         backend_kind, is_cpu_platform,
     )
 
     if is_cpu_platform():
-        return SWEEP_LIMIT_CPU
-    limit = SWEEP_LIMIT_TPU
-    raised = _measured_sweep_raise()
-    if raised is not None and backend_kind() == CALIBRATION.sweep_win_device:
-        limit = max(limit, raised)
+        limit = SWEEP_LIMIT_CPU
+    else:
+        limit = SWEEP_LIMIT_TPU
+        raised = _measured_sweep_raise()
+        if raised is not None:
+            kind = backend_kind()
+            if kind == CALIBRATION.sweep_win_device:
+                limit = max(limit, raised)
+            elif _resolved_platform_limit is None:
+                # The artifact was measured on different hardware: ignore it,
+                # loudly — routing claims stay tied to the device they were
+                # measured on, and the record says so.  First resolution
+                # only: this limit is re-resolved once per SCC, and the
+                # identical event per SCC would just spam the stream.
+                get_run_record().event(
+                    "calibration.foreign_artifact_ignored",
+                    artifact_device=CALIBRATION.sweep_win_device,
+                    live_device=kind,
+                    raised_limit=raised,
+                )
+                log.info(
+                    "sweep-window artifact measured on %r ignored on %r",
+                    CALIBRATION.sweep_win_device, kind,
+                )
+    _resolved_platform_limit = limit
     return limit
 
 
@@ -275,6 +309,7 @@ class AutoBackend:
             )
             return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)
         except OracleBudgetExceeded as exc:
+            get_run_record().add("oracle.budget_burns")
             log.info("oracle budget burned (%s); switching to the exhaustive sweep", exc)
             return None
 
@@ -304,6 +339,13 @@ class AutoBackend:
         caller then falls through to the same sequential fallbacks as a
         ``--no-race`` budget burn.
         """
+        with get_run_record().span("race", scc=len(scc)) as race_span:
+            return self._race_inner(
+                graph, circuit, scc, scope_to_scc, budget_s, race_span
+            )
+
+    def _race_inner(self, graph, circuit, scc, scope_to_scc, budget_s,
+                    race_span):
         import threading
         import time
 
@@ -313,6 +355,7 @@ class AutoBackend:
             SearchCancelled,
         )
 
+        rec = get_run_record()
         oracle_cancel = CancelToken()
         sweep_cancel = CancelToken()
         outcome: dict = {}
@@ -385,12 +428,15 @@ class AutoBackend:
             )
         except OracleBudgetExceeded as exc:
             oracle_state = "budget_exceeded"
+            rec.add("oracle.budget_burns")
             log.info("race: oracle budget burned (%s); awaiting the sweep", exc)
         except SearchCancelled:
             oracle_state = "cancelled"
         oracle_seconds = time.monotonic() - t_oracle
 
-        def race_stats(winner: str, joined: bool) -> dict:
+        def race_stats(winner: str, joined: bool,
+                       loser_join_s: Optional[float] = None,
+                       winner_wait_s: Optional[float] = None) -> dict:
             rs = {
                 "winner": winner,
                 "budget_s": round(budget_s, 3),
@@ -398,11 +444,27 @@ class AutoBackend:
                 "oracle_outcome": oracle_state,
                 "loser_joined": joined,
             }
+            if loser_join_s is not None:
+                rs["loser_join_seconds"] = round(loser_join_s, 4)
+            if winner_wait_s is not None:
+                # Sweep-wins path: the join waited for the WINNER's verdict,
+                # not a loser's unwind (the losing oracle already finished
+                # on this thread) — a separate key, so loser_join_seconds
+                # stays a pure unwind-latency metric.
+                rs["winner_wait_seconds"] = round(winner_wait_s, 4)
             if "sweep_seconds" in outcome:
                 rs["sweep_seconds"] = round(outcome["sweep_seconds"], 4)
             for key in ("sweep_ineligible", "sweep_error"):
                 if key in outcome:
                     rs[key] = outcome[key]
+            # One schema everywhere: the race verdict lands in the span's
+            # attributes AND as a standalone event, so both a JSONL stream
+            # and the in-memory record answer "who won, how long did the
+            # loser take to unwind" without digging into res.stats.
+            race_span.set(**rs)
+            rec.event("race", **rs)
+            if loser_join_s is not None:
+                rec.gauge("race.loser_join_seconds", round(loser_join_s, 4))
             return rs
 
         if oracle_res is not None:
@@ -410,10 +472,12 @@ class AutoBackend:
             # path on real topologies): cancel the sweep and give it a
             # bounded window to unwind its in-flight work.
             sweep_cancel.cancel()
+            t_join = time.monotonic()
             worker.join(timeout=min(
                 RACE_LOSER_JOIN_S,
                 max(RACE_LOSER_JOIN_MIN_S, 2.0 * oracle_seconds),
             ))
+            loser_join_s = time.monotonic() - t_join
             joined = not worker.is_alive()
             if not joined:
                 log.info(
@@ -436,17 +500,22 @@ class AutoBackend:
                     self.checkpoint.clear()
                 except Exception:  # noqa: BLE001 — cleanup must not cost the verdict
                     pass
-            oracle_res.stats["race"] = race_stats("oracle", joined)
+            oracle_res.stats["race"] = race_stats("oracle", joined, loser_join_s)
             return oracle_res
 
         # Budget burned (or the sweep already won and cancelled us): the
         # sweep IS the verdict path now — wait for it like the sequential
         # fallback would, minus the spin-up time it already overlapped.
+        t_join = time.monotonic()
         worker.join()
+        winner_wait_s = time.monotonic() - t_join
         res = outcome.get("sweep_result")
         if res is not None:
-            res.stats["race"] = race_stats("sweep", True)
+            res.stats["race"] = race_stats(
+                "sweep", True, winner_wait_s=winner_wait_s
+            )
             return res
+        race_stats("none", True, winner_wait_s=winner_wait_s)
         return None
 
     def _has_recorded_progress(self, scc: List[int]) -> bool:
@@ -471,6 +540,27 @@ class AutoBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
+        # The routing decision is a span of its own ("route"): nested under
+        # the pipeline's phase.search span, wrapping the race span when one
+        # runs, and stamped with the engine that actually answered — the
+        # record shows WHERE the verdict came from, not just how long.
+        with get_run_record().span(
+            "route", scc=len(scc), race_enabled=self.race
+        ) as route_span:
+            res = self._route(
+                graph, circuit, scc, scope_to_scc=scope_to_scc
+            )
+            route_span.set(backend=res.stats.get("backend", "?"))
+            return res
+
+    def _route(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
         # Optimistic limit first (no device probe on THIS thread): the
         # oracle-vs-sweep window applies to every SCC a sweep could
         # possibly handle on any platform.  Racing mode (default) overlaps
@@ -488,16 +578,34 @@ class AutoBackend:
         # of a preempted sweep would tax exactly the long runs checkpoints
         # exist for.
         resumable = self._has_recorded_progress(scc)
-        optimistic = (
-            self.sweep_limit if self.sweep_limit is not None
-            else max(SWEEP_LIMIT_TPU, _measured_sweep_raise() or 0)
-        )
+        if self.sweep_limit is not None:
+            optimistic = self.sweep_limit
+        elif _resolved_platform_limit is not None:
+            # A prior burn/race already paid the device probe: the true
+            # gated limit replaces the ungated optimistic guess, so a
+            # foreign-device artifact cannot re-burn the budget on resume
+            # (ADVICE r5 auto.py:251).
+            optimistic = _resolved_platform_limit
+        else:
+            optimistic = max(SWEEP_LIMIT_TPU, _measured_sweep_raise() or 0)
         if len(scc) <= optimistic:
             if not resumable:
                 budget_s = self._estimated_sweep_seconds(len(scc))
                 attempt = self._race if self.race else self._budgeted_oracle
                 res = attempt(graph, circuit, scc, scope_to_scc, budget_s)
                 if res is not None:
+                    # The common path (race winner / oracle under budget)
+                    # gets a routing record too, not just the fallbacks.
+                    get_run_record().event(
+                        "route.decision",
+                        engine=res.stats.get("backend", "?"), scc=len(scc),
+                        reason=(
+                            f"race winner "
+                            f"({res.stats.get('race', {}).get('winner', '?')})"
+                            if self.race else
+                            f"oracle finished under ~{budget_s:.1f}s budget"
+                        ),
+                    )
                     return res
             limit = (
                 self.sweep_limit if self.sweep_limit is not None
@@ -507,6 +615,13 @@ class AutoBackend:
                 try:
                     backend = self._sweep()
                     log.debug("auto: sweep backend for |scc|=%d", len(scc))
+                    get_run_record().event(
+                        "route.decision", engine="tpu-sweep", scc=len(scc),
+                        reason=(
+                            "checkpoint has recorded progress" if resumable
+                            else f"|scc| <= platform sweep limit {limit}"
+                        ),
+                    )
                     return backend.check_scc(
                         graph, circuit, scc, scope_to_scc=scope_to_scc
                     )
@@ -568,6 +683,15 @@ class AutoBackend:
                     "auto: device frontier for |scc|=%d (measured win region: %s)",
                     len(scc), CALIBRATION.provenance.get("frontier"),
                 )
+                get_run_record().event(
+                    "route.decision", engine="tpu-frontier", scc=len(scc),
+                    reason=(
+                        f"measured win region [{win}, "
+                        f"{(hi or win) + FRONTIER_WIN_SCC_HEADROOM}] on "
+                        f"{CALIBRATION.frontier_win_device}"
+                    ),
+                    provenance=CALIBRATION.provenance.get("frontier"),
+                )
                 return backend.check_scc(
                     graph, circuit, scc, scope_to_scc=scope_to_scc
                 )
@@ -589,4 +713,8 @@ class AutoBackend:
             )
         backend = self._cpu_oracle()
         log.debug("auto: %s backend for |scc|=%d", backend.name, len(scc))
+        get_run_record().event(
+            "route.decision", engine=backend.name, scc=len(scc),
+            reason="host oracle (outside every measured device win region)",
+        )
         return backend.check_scc(graph, circuit, scc, scope_to_scc=scope_to_scc)

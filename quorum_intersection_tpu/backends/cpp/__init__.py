@@ -35,6 +35,7 @@ from quorum_intersection_tpu.backends.base import SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("backends.cpp")
 
@@ -301,9 +302,19 @@ class CppOracleBackend:
         )
         seconds = time.perf_counter() - t0
 
+        # Native-call accounting (ISSUE 2): every entry into the C++ search
+        # core lands in the run record — call count, wall time, and the B&B
+        # calls actually executed (also counted on budget/cancel exits,
+        # where no SccCheckResult carries them).
+        rec = get_run_record()
+        rec.add("native.check_scc_calls")
+        rec.add("native.check_scc_seconds", round(seconds, 6))
+        rec.add("native.bnb_calls", int(stats[0]))
+
         if intersects == -2:
             from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
 
+            rec.add("oracle.budget_calls_consumed", self._budget_calls)
             raise OracleBudgetExceeded(
                 f"native oracle exceeded {self._budget_calls} B&B calls "
                 f"on |scc|={len(scc)} after {seconds:.2f}s"
@@ -376,6 +387,7 @@ def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]
     snapshots where N interpreted-Python fixpoints dominate the solve
     (VERDICT r1 §weak-7).  Returns one (possibly empty) quorum per SCC, in
     the same member order as the Python scan."""
+    t0 = time.perf_counter()
     nmq = NativeMaxQuorum(graph)
     avail = np.zeros(graph.n, dtype=np.uint8)
     quorums: List[List[int]] = []
@@ -384,6 +396,9 @@ def native_scc_scan(graph: TrustGraph, sccs: List[List[int]]) -> List[List[int]]
         avail[arr] = 1
         quorums.append(nmq(arr, avail))
         avail[arr] = 0
+    rec = get_run_record()
+    rec.add("native.scan_fixpoints", len(sccs))
+    rec.add("native.scan_seconds", round(time.perf_counter() - t0, 6))
     return quorums
 
 

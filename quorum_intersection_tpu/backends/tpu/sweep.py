@@ -42,6 +42,8 @@ from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.timers import Throughput
 
 log = get_logger("backends.tpu.sweep")
 
@@ -436,6 +438,12 @@ class TpuSweepBackend:
         first_hit = 0
         inflight: "deque" = deque()
         dispatchers = {}
+        # Telemetry: windows dispatched/cancelled counters, one progress
+        # event per drained window, and the (finally wired) Throughput
+        # counter — fed drain-interval candidates/sec, so its rate excludes
+        # setup and blocking compiles unlike the end-to-end stat.
+        rec = get_run_record()
+        throughput = Throughput()
         hi_cache = [-1, None]  # last built (hi value, mask row)
         # Instrumentation (VERDICT r2 §next-2): where does wall-clock go?
         # - compile_seconds: synchronous trace+compile of each program shape
@@ -485,8 +493,22 @@ class TpuSweepBackend:
             start, coverage, hi_base, spc, handle = inflight.popleft()
             hit = int(handle)
             steps += 1
-            candidates += min(coverage, total - start)
-            drain_log.append((time.monotonic(), min(coverage, total - start), spc))
+            checked = min(coverage, total - start)
+            candidates += checked
+            now = time.monotonic()
+            prev_t = drain_log[-1][0] if drain_log else (
+                t_first_dispatch if t_first_dispatch is not None else now
+            )
+            interval = max(now - prev_t, 0.0)
+            throughput.add(checked, interval)
+            rec.add("sweep.candidates_checked", checked)
+            rec.event(
+                "sweep.window",
+                start=start, candidates=checked, steps_per_call=spc,
+                done=candidates, total=total, seconds=round(interval, 6),
+                rate=round(checked / interval, 1) if interval > 0 else None,
+            )
+            drain_log.append((now, checked, spc))
             if trace:
                 log.debug(
                     "sweep program %d: start=%d coverage=%d checked=%d/%d hit=%s",
@@ -557,6 +579,11 @@ class TpuSweepBackend:
             when the oracle wins (it would mis-route later runs), while a
             caller cancelling a genuinely long sweep may keep it."""
             if self.cancel is not None and self.cancel.cancelled:
+                rec.add("sweep.windows_cancelled", len(inflight))
+                rec.event(
+                    "sweep.cancelled", start=start, total=total,
+                    windows_dropped=len(inflight), drained=steps,
+                )
                 raise SearchCancelled(
                     f"sweep cancelled at candidate {start}/{total} "
                     f"({steps} programs dispatched)"
@@ -660,6 +687,7 @@ class TpuSweepBackend:
                 )
                 coverage = rem
             inflight.append((start, coverage, hi, spc, dispatch(lo, hi, spc)))
+            rec.add("sweep.windows_dispatched")
             since_ramp += 1
             start += coverage
             # While a jump compile is pending AND the current level is the
@@ -693,7 +721,12 @@ class TpuSweepBackend:
             "enumeration_total": total,
             "seconds": seconds,
             "candidates_per_sec": candidates / seconds if seconds > 0 else 0.0,
+            # Drain-interval rate from the wired Throughput counter: what
+            # the device sustained between drains, setup/compile excluded
+            # (the end-to-end candidates_per_sec includes them).
+            "window_candidates_per_sec": round(throughput.per_second, 1),
         }
+        rec.gauge("sweep.candidates_per_sec", round(throughput.per_second, 1))
         if start0:
             # Resume provenance: lets tooling prove a run actually skipped a
             # checkpointed prefix (tools/wide_run.py kill/resume ledger).
@@ -714,6 +747,7 @@ class TpuSweepBackend:
             ),
             4,
         )
+        rec.gauge("sweep.xla_compile_seconds", stats["xla_compile_seconds"])
         stats.update(self._time_breakdown(
             t0_monotonic, t_first_dispatch, compile_seconds, drain_log, compile_log
         ))

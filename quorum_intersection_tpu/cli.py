@@ -21,7 +21,8 @@ Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 (reference-bug-compatible shorthand: alias0 dangling + front SCC selection),
 ``--timing``, ``--no-race`` (sequential auto routing instead of the racing
 orchestrator), ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
-profiler trace).
+profiler trace), ``--metrics-json``/``--metrics-prom`` (run-record telemetry
+sinks — docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -87,7 +88,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the deterministic lowest-index rule")
     p.add_argument("--compat", action="store_true",
                    help="reference-bug-compatible mode: --dangling-policy alias0 --scc-select front")
-    p.add_argument("--timing", action="store_true", help="print phase timers to stderr")
+    p.add_argument("--timing", action="store_true",
+                   help="print phase timers (and the telemetry summary) to stderr")
+    p.add_argument("--metrics-json", metavar="PATH", default=None,
+                   help="stream run-record telemetry (spans, counters, "
+                        "events — docs/OBSERVABILITY.md) to PATH as JSONL; "
+                        "render with tools/metrics_report.py")
+    p.add_argument("--metrics-prom", metavar="PATH", default=None,
+                   help="write final counters/gauges to PATH as a "
+                        "Prometheus-style textfile (node_exporter textfile "
+                        "collector format) for soak runs")
     p.add_argument("--no-race", action="store_true",
                    help="disable the auto backend's racing orchestrator "
                         "(budgeted oracle vs concurrent sweep spin-up, first "
@@ -133,6 +143,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.trace:
         set_trace(True)
 
+    from quorum_intersection_tpu.utils import telemetry
+
+    record = telemetry.get_run_record()
+    if args.metrics_json:
+        record.add_sink(telemetry.JsonlSink(args.metrics_json))
+    if args.metrics_prom:
+        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+    try:
+        return _main(args, record)
+    finally:
+        # One flush for every exit path (verdict, analysis modes, errors):
+        # final counter/gauge lines land in the JSONL stream and the
+        # Prometheus textfile is (re)written.
+        record.finish()
+
+
+def _main(args, record) -> int:
     dangling = args.dangling_policy or ("alias0" if args.compat else "strict")
     scc_select = args.scc_select or ("front" if args.compat else "quorum-bearing")
 
@@ -142,8 +169,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         # Buffered (not streamed): the splitting-set mode re-reads the raw
         # node list, and dumps are at most a few MB.
-        stdin_text = sys.stdin.read()
-        fbas = parse_fbas(stdin_text)
+        with record.span("phase.parse"):
+            stdin_text = sys.stdin.read()
+            fbas = parse_fbas(stdin_text)
     except ValueError as exc:
         # FbasSchemaError and json.JSONDecodeError both derive from ValueError.
         # (The reference crashes with an uncaught ptree exception here; a clean
@@ -151,7 +179,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         sys.stderr.write(f"invalid FBAS configuration: {exc}\n")
         return 1
 
-    graph = build_graph(fbas, dangling=dangling)
+    with record.span("phase.graph"):
+        graph = build_graph(fbas, dangling=dangling)
 
     if args.pagerank:
         from quorum_intersection_tpu.analytics.pagerank import format_pagerank, pagerank_auto
@@ -351,10 +380,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     if args.timing:
+        # Legacy lines first, byte-compatible with pre-telemetry builds
+        # (docs/OBSERVABILITY.md); the run-record summary sink appends the
+        # clearly-marked extra [telemetry] lines after them.
         for name, seconds in result.timers.items():
             sys.stderr.write(f"[timing] {name}: {seconds * 1000:.2f} ms\n")
         for key, value in result.stats.items():
             sys.stderr.write(f"[stats] {key}: {value}\n")
+        from quorum_intersection_tpu.utils.telemetry import StderrSummarySink
+
+        StderrSummarySink().finish(record)
 
     sys.stdout.write("true\n" if result.intersects else "false\n")
     return 0 if result.intersects else 1

@@ -1,6 +1,15 @@
-"""Cross-cutting utilities: trace logging, phase timers, throughput counters."""
+"""Cross-cutting utilities: trace logging, phase timers, throughput counters,
+and the unified run-record telemetry layer (spans/counters/events + sinks)."""
 
 from quorum_intersection_tpu.utils.logging import get_logger, set_trace
+from quorum_intersection_tpu.utils.telemetry import RunRecord, get_run_record
 from quorum_intersection_tpu.utils.timers import PhaseTimers, Throughput
 
-__all__ = ["get_logger", "set_trace", "PhaseTimers", "Throughput"]
+__all__ = [
+    "get_logger",
+    "set_trace",
+    "PhaseTimers",
+    "Throughput",
+    "RunRecord",
+    "get_run_record",
+]

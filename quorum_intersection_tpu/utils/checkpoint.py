@@ -27,6 +27,7 @@ from typing import Optional, Union
 import numpy as np
 
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("utils.checkpoint")
 
@@ -104,6 +105,12 @@ class SweepCheckpoint:
                 log.info("checkpoint belongs to a different problem; ignoring")
                 return 0
         pos = int(data.get("position", 0))
+        if 0 < pos <= total:
+            get_run_record().add("checkpoint.restores")
+            get_run_record().event(
+                "checkpoint.restore", position=pos, total=total,
+                path=str(self.path),
+            )
         return pos if 0 <= pos <= total else 0
 
     def record(self, position: int, total: int, fingerprint: Optional[str] = None) -> None:
@@ -113,6 +120,7 @@ class SweepCheckpoint:
             payload["fingerprint"] = fingerprint
         tmp.write_text(json.dumps(payload))
         os.replace(tmp, self.path)
+        get_run_record().add("checkpoint.saves")
 
     def clear(self) -> None:
         try:
@@ -185,6 +193,10 @@ class FrontierCheckpoint:
             return None
         if states:
             log.info("resuming search from %d frontier states", len(states))
+            get_run_record().add("checkpoint.restores")
+            get_run_record().event(
+                "checkpoint.restore", states=len(states), path=str(self.path)
+            )
         return states
 
     def record(self, states, fingerprint: str) -> None:
@@ -193,6 +205,7 @@ class FrontierCheckpoint:
         tmp = self.path.with_suffix(".tmp")
         tmp.write_text(json.dumps({"fingerprint": fingerprint, "states": states}))
         os.replace(tmp, self.path)
+        get_run_record().add("checkpoint.saves")
 
     def clear(self) -> None:
         try:

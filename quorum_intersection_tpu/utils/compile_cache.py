@@ -23,10 +23,38 @@ import os
 from pathlib import Path
 
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("utils.compile_cache")
 
 _installed = False
+_listener_installed = False
+
+
+def _install_cache_listener() -> None:
+    """Forward jax's own compilation-cache monitoring events into the run
+    record: jax emits ``/jax/compilation_cache/cache_hits`` /
+    ``cache_misses`` through ``jax.monitoring`` on every lookup, so the
+    telemetry counters are the real cache behavior, not a re-derivation.
+    Best-effort — the monitoring module is jax-internal surface."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    _listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            if "/compilation_cache/" not in event:
+                return
+            if "cache_hits" in event:
+                get_run_record().add("compile_cache.hits")
+            elif "cache_misses" in event:
+                get_run_record().add("compile_cache.misses")
+
+        monitoring.register_event_listener(_on_event)
+    except Exception as exc:  # noqa: BLE001 — counters are diagnostics only
+        log.debug("compile-cache event listener unavailable: %s", exc)
 
 
 def enable_compilation_cache() -> None:
@@ -35,6 +63,7 @@ def enable_compilation_cache() -> None:
     if _installed or os.environ.get("QI_NO_COMPILE_CACHE"):
         return
     _installed = True
+    _install_cache_listener()
     try:
         import jax
 
@@ -58,6 +87,7 @@ def enable_compilation_cache() -> None:
         ) / "quorum_intersection_tpu" / "jax_cache"
         cache_dir.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        get_run_record().event("compile_cache.enabled", dir=str(cache_dir))
         # JAX's default thresholds (min compile time ~1 s) are kept: every
         # ramp program on a real chip compiles for multiple seconds and is
         # cached, while the sub-second kernels test suites churn through are

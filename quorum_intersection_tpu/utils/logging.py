@@ -3,15 +3,55 @@ setup (`/root/reference/quorum_intersection.cpp:735-742`): default level INFO,
 ``-t/--trace`` drops the filter to TRACE-equivalent (DEBUG here).  Solver
 internals log at trace level just as the reference saturates its solver with
 ``BOOST_LOG_TRIVIAL(trace)`` messages.
+
+Environment knobs (ISSUE 2 satellite):
+
+- ``QI_LOG_LEVEL`` — initial level by name (``DEBUG``/``INFO``/``WARNING``/
+  ``ERROR``/``CRITICAL``) or numeric value; ``-t`` still overrides it at the
+  CLI.  Before this, only ``-t`` could move the filter at all — soak/CI runs
+  had no way to quiet INFO or get DEBUG without a flag.
+- ``QI_LOG_JSON=1`` — opt-in JSON formatter: each log line becomes one JSON
+  object (``{"kind": "log", "level": ..., "logger": ..., "msg": ...,
+  "t_wall": ...}``) so log lines and ``qi-telemetry/1`` events interleave
+  cleanly in one machine-readable stream (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 
 _ROOT_NAME = "quorum_intersection_tpu"
 _configured = False
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per log line — telemetry-stream compatible."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = {
+            "kind": "log",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "t_wall": round(record.created, 3),
+        }
+        if record.exc_info:
+            line["exc"] = self.formatException(record.exc_info)
+        return json.dumps(line, default=str)
+
+
+def _env_level() -> int:
+    """Level named by QI_LOG_LEVEL (default INFO; bad values ignored)."""
+    raw = os.environ.get("QI_LOG_LEVEL", "").strip()
+    if not raw:
+        return logging.INFO
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    return level if isinstance(level, int) else logging.INFO
 
 
 def _configure() -> None:
@@ -20,9 +60,12 @@ def _configure() -> None:
         return
     logger = logging.getLogger(_ROOT_NAME)
     handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter("[%(levelname)s] %(name)s: %(message)s"))
+    if os.environ.get("QI_LOG_JSON"):
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter("[%(levelname)s] %(name)s: %(message)s"))
     logger.addHandler(handler)
-    logger.setLevel(logging.INFO)
+    logger.setLevel(_env_level())
     logger.propagate = False
     _configured = True
 
@@ -33,6 +76,9 @@ def get_logger(name: str = "") -> logging.Logger:
 
 
 def set_trace(enabled: bool = True) -> None:
-    """Enable trace-level (DEBUG) logging, the analog of the reference's ``-t``."""
+    """Enable trace-level (DEBUG) logging, the analog of the reference's
+    ``-t`` (overrides ``QI_LOG_LEVEL``; disabling restores the env level)."""
     _configure()
-    logging.getLogger(_ROOT_NAME).setLevel(logging.DEBUG if enabled else logging.INFO)
+    logging.getLogger(_ROOT_NAME).setLevel(
+        logging.DEBUG if enabled else _env_level()
+    )

@@ -15,13 +15,18 @@ from contextlib import contextmanager
 from typing import Iterator, Optional
 
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("utils.profiling")
 
 
 @contextmanager
 def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
-    """Record a ``jax.profiler`` trace into ``trace_dir`` (no-op if falsy)."""
+    """Record a ``jax.profiler`` trace into ``trace_dir`` (no-op if falsy).
+
+    The trace dir and its wall-clock window are correlated into the run
+    record (``profile.trace`` span + ``profile.trace_dir`` gauge), so a
+    JSONL stream names the XProf artifact that covers the same solve."""
     if not trace_dir:
         yield
         return
@@ -33,5 +38,8 @@ def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
         return
 
     log.info("recording jax profiler trace to %s", trace_dir)
-    with jax.profiler.trace(str(trace_dir)):
-        yield
+    rec = get_run_record()
+    rec.gauge("profile.trace_dir", str(trace_dir))
+    with rec.span("profile.trace", dir=str(trace_dir)):
+        with jax.profiler.trace(str(trace_dir)):
+            yield

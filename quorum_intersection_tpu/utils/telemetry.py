@@ -1,0 +1,440 @@
+"""Unified run-record telemetry: spans + counters from parse to chip.
+
+One schema across the CLI, the racing auto router, the sweep, and both
+benchmark drivers (ISSUE 2 tentpole).  The observability story used to be
+fragments — ``PhaseTimers`` dicts, ad-hoc ``[stats]`` stderr lines, race
+stats buried in ``res.stats["race"]`` — none of them machine-readable in one
+stream.  This module is the single cross-cutting layer they all feed:
+
+- **Spans**: named, nested wall-clock intervals (monotonic start/end,
+  parent id, free-form attributes).  ``PhaseTimers.phase`` opens one per
+  pipeline phase, the auto router wraps its routing decision and the race
+  in them, benchmark drivers wrap their phases.
+- **Counters / gauges**: typed process-wide accumulators (candidates
+  checked, sweep windows dispatched/cancelled, compile-cache hits/misses,
+  oracle budget consumed, checkpoint saves/restores).  ``add`` is
+  lock-protected — the race's two threads increment concurrently.
+- **Events**: point-in-time records (race verdicts, routing decisions,
+  per-window sweep progress, checkpoint activity).
+
+Sinks are pluggable and attach to the process-wide :class:`RunRecord`:
+
+- :class:`JsonlSink` — streaming JSONL event file (CLI ``--metrics-json``,
+  env ``QI_METRICS_JSON``); every span end / event is written as it
+  happens, so a crashed run still leaves a parseable prefix.
+- :class:`PromFileSink` — Prometheus-style textfile exporter for soak
+  runs (CLI ``--metrics-prom``, env ``QI_METRICS_PROM``): counters and
+  gauges rewritten atomically at finish, ready for node_exporter's
+  textfile collector.
+- :class:`StderrSummarySink` — the human summary (``[telemetry]`` lines),
+  appended after the legacy ``[timing]``/``[stats]`` output which stays
+  byte-compatible (docs/OBSERVABILITY.md).
+
+Schema (``qi-telemetry/1``, one JSON object per line):
+
+    {"kind": "meta",    "schema": "qi-telemetry/1", "pid": ..., "argv0": ..., "t_wall": ...}
+    {"kind": "span",    "name": "phase.search", "span_id": 3, "parent_id": 1,
+     "start_s": 0.01, "seconds": 1.2, "attrs": {...}}
+    {"kind": "event",   "name": "sweep.window", "t_s": 0.5, "span_id": 3, "attrs": {...}}
+    {"kind": "counter", "name": "sweep.candidates_checked", "value": 1048576}
+    {"kind": "gauge",   "name": "sweep.candidates_per_sec", "value": 2.1e9}
+
+``t_s``/``start_s`` are seconds since the record's creation (monotonic);
+``t_wall`` in the meta line anchors them to wall-clock.  Multi-process runs
+(the bench driver's phase children, CLI subprocesses under the test suite)
+append to one file; consumers group by ``pid``.  ``tools/metrics_report.py``
+renders a stream into per-phase / per-window tables.
+"""
+
+from __future__ import annotations
+
+import atexit
+import io
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("utils.telemetry")
+
+SCHEMA = "qi-telemetry/1"
+
+# In-memory retention caps: a 2^44 sweep drains millions of windows; the
+# JSONL sink streams them all, but the in-process lists (used by tests and
+# the stderr summary) stay bounded.  Overflow is counted, never silent.
+MAX_SPANS = 100_000
+MAX_EVENTS = 100_000
+
+
+def _jsonable(value):
+    """Best-effort JSON coercion — telemetry must never crash a solve."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return str(value)
+
+
+@dataclass
+class Span:
+    """One finished-or-open span.  Mutate attributes via :meth:`set`."""
+
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    start_s: float
+    seconds: Optional[float] = None
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def to_line(self) -> dict:
+        return {
+            "kind": "span",
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_s": round(self.start_s, 6),
+            "seconds": None if self.seconds is None else round(self.seconds, 6),
+            "attrs": _jsonable(self.attrs),
+        }
+
+
+class JsonlSink:
+    """Streaming JSONL sink (append mode: multi-process runs share a file)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh: Optional[io.TextIOBase] = None
+
+    def _handle(self):
+        if self._fh is None:
+            self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        return self._fh
+
+    def emit(self, line: dict) -> None:
+        try:
+            with self._lock:
+                self._handle().write(json.dumps(line, default=str) + "\n")
+        except OSError as exc:  # telemetry must never cost the verdict
+            log.info("metrics JSONL write failed: %s", exc)
+
+    def finish(self, record: "RunRecord") -> None:
+        for line in record.final_lines():
+            self.emit(line)
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+class PromFileSink:
+    """Prometheus textfile exporter: counters/gauges rewritten atomically at
+    finish — point node_exporter's textfile collector at the file for soak
+    runs (tools/soak.py)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+
+    def emit(self, line: dict) -> None:  # streaming is a no-op for textfiles
+        pass
+
+    @staticmethod
+    def _metric(name: str) -> str:
+        clean = "".join(c if c.isalnum() else "_" for c in name)
+        return f"qi_{clean}"
+
+    def finish(self, record: "RunRecord") -> None:
+        lines: List[str] = []
+        with record._lock:
+            counters = dict(record.counters)
+            gauges = dict(record.gauges)
+        for name, value in sorted(counters.items()):
+            m = self._metric(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {value}")
+        for name, value in sorted(gauges.items()):
+            if not isinstance(value, (int, float)):
+                continue
+            m = self._metric(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {value}")
+        for name, total, count in record.span_rollup():
+            m = self._metric(f"span_{name}_seconds")
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {round(total, 6)}")
+            lines.append(f"# TYPE {m}_count counter")
+            lines.append(f"{m}_count {count}")
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write("\n".join(lines) + "\n")
+            os.replace(tmp, self.path)
+        except OSError as exc:
+            log.info("metrics textfile write failed: %s", exc)
+
+
+class StderrSummarySink:
+    """Human stderr summary at finish — the ``[telemetry]`` lines the CLI
+    appends after the (byte-compatible) legacy ``[timing]``/``[stats]``
+    output."""
+
+    def emit(self, line: dict) -> None:
+        pass
+
+    def finish(self, record: "RunRecord") -> None:
+        import sys
+
+        for line in record.summary_lines():
+            sys.stderr.write(line + "\n")
+
+
+class RunRecord:
+    """Process-wide telemetry record.  Thread-safe; sinks pluggable."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.t0 = time.monotonic()
+        self.t_wall = time.time()
+        self.spans: List[Span] = []
+        self.events: List[dict] = []
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, object] = {}
+        self.dropped = 0
+        self._next_id = 0
+        self._sinks: list = []
+        self._finished = False
+        # Always-present counters (acceptance: one solve's stream carries the
+        # compile-cache hit/miss pair even when the cache saw no traffic).
+        self.declare("compile_cache.hits")
+        self.declare("compile_cache.misses")
+
+    # ---- sinks -----------------------------------------------------------
+
+    def add_sink(self, sink) -> None:
+        import sys
+
+        with self._lock:
+            self._sinks.append(sink)
+        # Every sink gets its own meta/schema header on attach — a sink
+        # added after the env sink must still open with the schema line
+        # (metrics_report groups multi-process streams by the meta pids).
+        try:
+            sink.emit({
+                "kind": "meta",
+                "schema": SCHEMA,
+                "pid": os.getpid(),
+                "argv0": os.path.basename(sys.argv[0]) if sys.argv else "",
+                "t_wall": round(self.t_wall, 3),
+            })
+        except Exception as exc:  # noqa: BLE001 — never cost the verdict
+            log.info("telemetry sink failed: %s", exc)
+
+    def _emit(self, line: dict) -> None:
+        for sink in list(self._sinks):
+            try:
+                sink.emit(line)
+            except Exception as exc:  # noqa: BLE001 — never cost the verdict
+                log.info("telemetry sink failed: %s", exc)
+
+    # ---- spans -----------------------------------------------------------
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @property
+    def current_span_id(self) -> Optional[int]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def span(self, name: str, parent_id: Optional[int] = None,
+             **attrs) -> Iterator[Span]:
+        """Open a nested span.  Nesting is per-thread (a worker thread's
+        spans are roots unless ``parent_id`` carries one across)."""
+        stack = self._stack()
+        with self._lock:
+            self._next_id += 1
+            sid = self._next_id
+        sp = Span(
+            name=name,
+            span_id=sid,
+            parent_id=parent_id if parent_id is not None else (
+                stack[-1] if stack else None
+            ),
+            start_s=time.monotonic() - self.t0,
+            attrs=dict(attrs),
+        )
+        stack.append(sid)
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            sp.seconds = (time.monotonic() - self.t0) - sp.start_s
+            with self._lock:
+                if len(self.spans) < MAX_SPANS:
+                    self.spans.append(sp)
+                else:
+                    self.dropped += 1
+            self._emit(sp.to_line())
+
+    # ---- events / counters / gauges -------------------------------------
+
+    def event(self, name: str, **attrs) -> None:
+        ev = {
+            "kind": "event",
+            "name": name,
+            "t_s": round(time.monotonic() - self.t0, 6),
+            "span_id": self.current_span_id,
+            "attrs": _jsonable(attrs),
+        }
+        with self._lock:
+            if len(self.events) < MAX_EVENTS:
+                self.events.append(ev)
+            else:
+                self.dropped += 1
+        self._emit(ev)
+
+    def declare(self, name: str) -> None:
+        """Ensure a counter exists (zero) so it is emitted even untouched."""
+        with self._lock:
+            self.counters.setdefault(name, 0)
+
+    def add(self, name: str, n: float = 1) -> None:
+        """Atomic counter increment (the race's two threads both call in)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    # ---- rollups / finish -------------------------------------------------
+
+    def span_rollup(self) -> List[tuple]:
+        """``[(name, total_seconds, count), ...]`` sorted by total desc."""
+        with self._lock:
+            totals: Dict[str, List[float]] = {}
+            for sp in self.spans:
+                if sp.seconds is None:
+                    continue
+                cur = totals.setdefault(sp.name, [0.0, 0])
+                cur[0] += sp.seconds
+                cur[1] += 1
+        return sorted(
+            ((name, t, int(c)) for name, (t, c) in totals.items()),
+            key=lambda row: -row[1],
+        )
+
+    def final_lines(self) -> List[dict]:
+        """Counter/gauge lines emitted once at finish."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            dropped = self.dropped
+        lines = [
+            {"kind": "counter", "name": name, "value": value}
+            for name, value in sorted(counters.items())
+        ]
+        lines += [
+            {"kind": "gauge", "name": name, "value": _jsonable(value)}
+            for name, value in sorted(gauges.items())
+        ]
+        if dropped:
+            lines.append({"kind": "counter", "name": "telemetry.dropped",
+                          "value": dropped})
+        return lines
+
+    def summary_lines(self) -> List[str]:
+        """Human summary: span rollup + non-zero counters + gauges."""
+        out = []
+        for name, total, count in self.span_rollup():
+            suffix = f" (x{count})" if count > 1 else ""
+            out.append(f"[telemetry] span {name}: {total * 1000:.2f} ms{suffix}")
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+        for name, value in sorted(counters.items()):
+            out.append(f"[telemetry] counter {name}: {value}")
+        for name, value in sorted(gauges.items()):
+            out.append(f"[telemetry] gauge {name}: {value}")
+        return out
+
+    def finish(self) -> None:
+        """Flush counters/gauges and close sinks (idempotent)."""
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink.finish(self)
+            except Exception as exc:  # noqa: BLE001
+                log.info("telemetry sink finish failed: %s", exc)
+
+
+# ---- process-wide record --------------------------------------------------
+
+_global: Optional[RunRecord] = None
+_global_lock = threading.Lock()
+
+
+def _attach_env_sinks(record: RunRecord) -> None:
+    """Honor QI_METRICS_JSON / QI_METRICS_PROM: the env-var hook the test
+    suite and CI use (tools/ci_tier1.sh) — every process in a run appends to
+    one shared stream without any flag plumbing."""
+    jsonl = os.environ.get("QI_METRICS_JSON")
+    if jsonl:
+        record.add_sink(JsonlSink(jsonl))
+    prom = os.environ.get("QI_METRICS_PROM")
+    if prom:
+        record.add_sink(PromFileSink(prom))
+
+
+def get_run_record() -> RunRecord:
+    """The process-wide :class:`RunRecord` (created lazily; env sinks
+    attached on first use; flushed at interpreter exit)."""
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                record = RunRecord()
+                _attach_env_sinks(record)
+                atexit.register(record.finish)
+                _global = record
+    return _global
+
+
+def reset_run_record() -> RunRecord:
+    """Replace the process-wide record with a fresh one (tests; the old
+    record is finished first so its sinks flush)."""
+    global _global
+    with _global_lock:
+        old, _global = _global, None
+    if old is not None:
+        old.finish()
+    return get_run_record()
+
+
+def finish() -> None:
+    """Finish the process-wide record if one exists (idempotent)."""
+    if _global is not None:
+        _global.finish()

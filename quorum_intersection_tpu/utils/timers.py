@@ -5,6 +5,14 @@ call counter (`/root/reference/quorum_intersection.cpp:258`).  The TPU-native
 equivalent (SURVEY.md §5) is structured: named phase timers plus a throughput
 counter measuring candidate quorums checked per second (the BASELINE.json
 headline metric).
+
+Since ISSUE 2 the timers are a thin façade over the process-wide telemetry
+record (:mod:`quorum_intersection_tpu.utils.telemetry`): every
+:meth:`PhaseTimers.phase` opens a ``phase.<name>`` span in the run record —
+one instrumentation point feeds both the legacy ``SolveResult.timers`` dict
+(``--timing`` stays byte-compatible) and the machine-readable JSONL stream.
+:class:`Throughput` is fed by the sweep's window-drain loop
+(`backends/tpu/sweep.py`) and surfaces as ``window_candidates_per_sec``.
 """
 
 from __future__ import annotations
@@ -14,10 +22,13 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterator
 
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
 
 @dataclass
 class PhaseTimers:
-    """Accumulating named wall-clock timers."""
+    """Accumulating named wall-clock timers (each phase also recorded as a
+    ``phase.<name>`` telemetry span)."""
 
     totals: Dict[str, float] = field(default_factory=dict)
     counts: Dict[str, int] = field(default_factory=dict)
@@ -26,7 +37,8 @@ class PhaseTimers:
     def phase(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
         try:
-            yield
+            with get_run_record().span(f"phase.{name}"):
+                yield
         finally:
             elapsed = time.perf_counter() - start
             self.totals[name] = self.totals.get(name, 0.0) + elapsed
@@ -38,7 +50,12 @@ class PhaseTimers:
 
 @dataclass
 class Throughput:
-    """Candidate-checking throughput counter (candidates/sec)."""
+    """Candidate-checking throughput counter (candidates/sec).
+
+    Fed by the sweep driver's window-drain loop with (candidates, interval)
+    pairs; ``per_second`` is the drain-interval rate — setup and blocking
+    compiles excluded, unlike the end-to-end ``candidates_per_sec`` stat.
+    """
 
     candidates: int = 0
     seconds: float = 0.0

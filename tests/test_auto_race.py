@@ -275,6 +275,44 @@ class TestRaceWinnerSelection:
         assert not _join_race_threads()
 
 
+class TestRaceTelemetry:
+    """ISSUE 2: both race outcomes land a `race` span in the run record
+    with winner/loser attributes — the machine-readable twin of
+    res.stats["race"]."""
+
+    @pytest.mark.parametrize("oracle_fast,winner", [
+        (True, "oracle"), (False, "sweep"),
+    ])
+    def test_race_span_both_outcomes(self, oracle_fast, winner):
+        from quorum_intersection_tpu.utils import telemetry
+
+        rec = telemetry.reset_run_record()
+        try:
+            res = solve(
+                majority_fbas(9), backend=_fake_auto([], oracle_fast=oracle_fast)
+            )
+            assert res.intersects is True
+            race_spans = [sp for sp in rec.spans if sp.name == "race"]
+            assert len(race_spans) == 1
+            attrs = race_spans[0].attrs
+            assert attrs["winner"] == winner
+            assert attrs["oracle_outcome"] == (
+                "verdict" if winner == "oracle" else "cancelled"
+            )
+            assert "loser_joined" in attrs
+            # The race event mirrors the span's verdict attributes.
+            race_events = [e for e in rec.events if e["name"] == "race"]
+            assert race_events and race_events[0]["attrs"]["winner"] == winner
+            # Nested under the routing span, which is stamped with the
+            # engine that actually answered.
+            route = next(sp for sp in rec.spans if sp.name == "route")
+            assert race_spans[0].parent_id == route.span_id
+            assert route.attrs["backend"] == res.stats["backend"]
+        finally:
+            telemetry.reset_run_record()
+        assert not _join_race_threads()
+
+
 class TestRaceLatency:
     """ISSUE 1 acceptance: time-to-verdict within 1.2x of the faster
     engine in both race outcomes (the sequential chain measured 3.4x at

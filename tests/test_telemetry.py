@@ -1,0 +1,371 @@
+"""Unified run-record telemetry (ISSUE 2 tentpole): span nesting, counter
+atomicity under the race's two threads, JSONL sink round-trip, stderr
+summary format, the CLI ``--metrics-json`` acceptance stream, and the
+``QI_LOG_LEVEL`` / ``QI_LOG_JSON`` logging satellites."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.utils import telemetry
+from quorum_intersection_tpu.utils.telemetry import (
+    JsonlSink,
+    PromFileSink,
+    RunRecord,
+)
+
+CLI = [sys.executable, "-m", "quorum_intersection_tpu"]
+
+
+@pytest.fixture
+def fresh_record():
+    """A fresh process-wide record (so in-memory assertions see only this
+    test's spans/events), restored on exit for later tests."""
+    rec = telemetry.reset_run_record()
+    yield rec
+    telemetry.reset_run_record()
+
+
+class TestRunRecord:
+    def test_span_nesting_parent_ids(self):
+        rec = RunRecord()
+        with rec.span("outer") as outer:
+            with rec.span("inner") as inner:
+                with rec.span("leaf") as leaf:
+                    pass
+        assert outer.parent_id is None
+        assert inner.parent_id == outer.span_id
+        assert leaf.parent_id == inner.span_id
+        names = [sp.name for sp in rec.spans]
+        assert names == ["leaf", "inner", "outer"]  # finish order
+        assert all(sp.seconds is not None and sp.seconds >= 0 for sp in rec.spans)
+
+    def test_span_attrs_and_set(self):
+        rec = RunRecord()
+        with rec.span("s", scc=9) as sp:
+            sp.set(backend="cpp", winner="oracle")
+        assert rec.spans[0].attrs == {
+            "scc": 9, "backend": "cpp", "winner": "oracle",
+        }
+
+    def test_worker_thread_spans_are_roots(self):
+        # Nesting is per-thread: a race worker's spans must not claim the
+        # main thread's open span as parent (they run concurrently).
+        rec = RunRecord()
+        seen = {}
+
+        def worker():
+            with rec.span("worker-span") as sp:
+                seen["parent"] = sp.parent_id
+
+        with rec.span("main-span"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["parent"] is None
+
+    def test_explicit_cross_thread_parent(self):
+        rec = RunRecord()
+        with rec.span("race") as race_sp:
+            with rec.span("sweep", parent_id=race_sp.span_id) as sp:
+                pass
+        assert sp.parent_id == race_sp.span_id
+
+    def test_counter_atomicity_two_threads(self):
+        # The race's two engines increment concurrently; no update may be
+        # lost (a bare += on a shared dict would drop some under contention).
+        rec = RunRecord()
+        n, per = 4, 25_000
+
+        def hammer():
+            for _ in range(per):
+                rec.add("native.bnb_calls")
+                rec.add("sweep.candidates_checked", 2)
+
+        threads = [threading.Thread(target=hammer) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rec.counters["native.bnb_calls"] == n * per
+        assert rec.counters["sweep.candidates_checked"] == 2 * n * per
+
+    def test_declared_counters_always_emitted(self):
+        # The compile-cache pair is pre-declared: a run that never touches
+        # the cache still carries hits=0 / misses=0 in its final lines.
+        rec = RunRecord()
+        names = {ln["name"] for ln in rec.final_lines() if ln["kind"] == "counter"}
+        assert {"compile_cache.hits", "compile_cache.misses"} <= names
+
+    def test_summary_lines_format(self):
+        rec = RunRecord()
+        with rec.span("phase.search"):
+            pass
+        rec.add("native.bnb_calls", 7)
+        rec.gauge("sweep.candidates_per_sec", 123.4)
+        lines = rec.summary_lines()
+        assert any(
+            l.startswith("[telemetry] span phase.search: ") and l.endswith(" ms")
+            for l in lines
+        )
+        assert "[telemetry] counter native.bnb_calls: 7" in lines
+        assert "[telemetry] gauge sweep.candidates_per_sec: 123.4" in lines
+
+    def test_finish_idempotent_and_event_cap(self):
+        rec = RunRecord()
+        rec.event("e", x=1)
+        rec.finish()
+        rec.finish()  # second finish must be a no-op, not a double-flush
+        assert rec.events[0]["attrs"] == {"x": 1}
+
+
+class TestSinks:
+    def test_jsonl_sink_round_trip(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        rec = RunRecord()
+        rec.add_sink(JsonlSink(str(path)))
+        with rec.span("phase.parse"):
+            rec.event("race", winner="oracle")
+        rec.add("native.bnb_calls", 3)
+        rec.gauge("g", 1.5)
+        rec.finish()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        kinds = [l["kind"] for l in lines]
+        assert kinds[0] == "meta"
+        assert lines[0]["schema"] == "qi-telemetry/1"
+        ev = next(l for l in lines if l["kind"] == "event")
+        sp = next(l for l in lines if l["kind"] == "span")
+        assert ev["name"] == "race" and ev["attrs"]["winner"] == "oracle"
+        assert ev["span_id"] == sp["span_id"]  # event attributed to its span
+        assert sp["name"] == "phase.parse" and sp["seconds"] >= 0
+        counters = {
+            l["name"]: l["value"] for l in lines if l["kind"] == "counter"
+        }
+        assert counters["native.bnb_calls"] == 3
+        gauges = {l["name"]: l["value"] for l in lines if l["kind"] == "gauge"}
+        assert gauges["g"] == 1.5
+
+    def test_jsonl_sink_streams_before_finish(self, tmp_path):
+        # A crashed run must leave a parseable prefix: span/event lines are
+        # written as they happen, not buffered to finish.
+        path = tmp_path / "m.jsonl"
+        rec = RunRecord()
+        rec.add_sink(JsonlSink(str(path)))
+        with rec.span("phase.scc"):
+            pass
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert any(l["kind"] == "span" for l in lines)
+
+    def test_jsonl_sink_coerces_unserializable_attrs(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        rec = RunRecord()
+        rec.add_sink(JsonlSink(str(path)))
+        rec.event("weird", obj=object(), path=tmp_path)
+        rec.finish()
+        ev = next(
+            json.loads(l) for l in path.read_text().splitlines()
+            if json.loads(l)["kind"] == "event"
+        )
+        assert isinstance(ev["attrs"]["obj"], str)
+
+    def test_prom_textfile_sink(self, tmp_path):
+        path = tmp_path / "qi.prom"
+        rec = RunRecord()
+        rec.add_sink(PromFileSink(str(path)))
+        rec.add("sweep.candidates_checked", 42)
+        rec.gauge("sweep.candidates_per_sec", 99.5)
+        with rec.span("phase.search"):
+            pass
+        rec.finish()
+        text = path.read_text()
+        assert "# TYPE qi_sweep_candidates_checked counter" in text
+        assert "qi_sweep_candidates_checked 42" in text
+        assert "qi_sweep_candidates_per_sec 99.5" in text
+        assert "qi_span_phase_search_seconds_count 1" in text
+
+    def test_env_var_sink(self, tmp_path):
+        # QI_METRICS_JSON: the zero-plumbing hook CI uses — a subprocess
+        # solve must append its stream without any flag.
+        path = tmp_path / "env.jsonl"
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_METRICS_JSON=str(path)),
+        )
+        assert proc.returncode == 0
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert {l["kind"] for l in lines} >= {"meta", "span", "counter"}
+
+
+def _env(**extra):
+    import os
+
+    env = dict(os.environ)
+    env.update(extra)
+    return env
+
+
+class TestCliAcceptance:
+    """ISSUE 2 acceptance: one solve with --metrics-json yields spans for
+    parse/scc/route/search, a race event, per-window sweep progress with
+    candidates/sec, and compile-cache hit/miss counters; metrics_report
+    renders the stream without error."""
+
+    def test_auto_solve_stream(self, tmp_path):
+        path = tmp_path / "solve.jsonl"
+        proc = subprocess.run(
+            CLI + ["--metrics-json", str(path)],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        span_names = {l["name"] for l in lines if l["kind"] == "span"}
+        assert {"phase.parse", "phase.scc", "route", "phase.search"} <= span_names
+        race_events = [
+            l for l in lines if l["kind"] == "event" and l["name"] == "race"
+        ]
+        assert race_events and race_events[0]["attrs"]["winner"] in (
+            "oracle", "sweep",
+        )
+        counters = {l["name"] for l in lines if l["kind"] == "counter"}
+        assert {"compile_cache.hits", "compile_cache.misses"} <= counters
+
+    def test_sweep_solve_has_window_progress(self, tmp_path):
+        path = tmp_path / "sweep.jsonl"
+        proc = subprocess.run(
+            CLI + ["--backend", "tpu-sweep", "--metrics-json", str(path)],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        windows = [
+            l for l in lines if l["kind"] == "event" and l["name"] == "sweep.window"
+        ]
+        assert windows
+        attrs = windows[0]["attrs"]
+        assert attrs["candidates"] > 0 and "rate" in attrs
+        gauges = {l["name"] for l in lines if l["kind"] == "gauge"}
+        assert "sweep.candidates_per_sec" in gauges
+
+    def test_metrics_report_renders(self, tmp_path):
+        import pathlib
+
+        path = tmp_path / "solve.jsonl"
+        proc = subprocess.run(
+            CLI + ["--metrics-json", str(path)],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        report = subprocess.run(
+            [sys.executable,
+             str(pathlib.Path(__file__).resolve().parent.parent
+                 / "tools" / "metrics_report.py"),
+             str(path), "--windows", "4"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert report.returncode == 0, report.stderr
+        assert "per-phase spans" in report.stdout
+        assert "phase.search" in report.stdout
+
+    def test_timing_legacy_lines_unchanged_plus_telemetry(self):
+        proc = subprocess.run(
+            CLI + ["--timing", "--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        err = proc.stderr.splitlines()
+        legacy = [l for l in err if l.startswith(("[timing]", "[stats]"))]
+        telem = [l for l in err if l.startswith("[telemetry]")]
+        assert legacy and telem
+        # Legacy block stays contiguous and FIRST (byte-compatible prefix:
+        # a consumer parsing the old format sees exactly the old lines
+        # before any new ones).
+        first_telem = err.index(telem[0])
+        assert all(err.index(l) < first_telem for l in legacy)
+
+    def test_prom_flag(self, tmp_path):
+        prom = tmp_path / "qi.prom"
+        proc = subprocess.run(
+            CLI + ["--backend", "python", "--metrics-prom", str(prom)],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        assert "# TYPE qi_" in prom.read_text()
+
+
+class TestPipelineInstrumentation:
+    def test_solve_emits_phase_spans_in_process(self, fresh_record):
+        from quorum_intersection_tpu.pipeline import solve
+
+        res = solve(majority_fbas(5), backend="python")
+        assert res.intersects is True
+        names = [sp.name for sp in fresh_record.spans]
+        for phase in ("phase.parse", "phase.graph", "phase.scc",
+                      "phase.scc_scan", "phase.search"):
+            assert phase in names, names
+        # Timers facade unchanged: SolveResult.timers still carries the
+        # legacy dict the --timing output is built from.
+        assert "search" in res.timers
+
+    def test_sweep_feeds_throughput_counter(self, fresh_record):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+        from quorum_intersection_tpu.pipeline import solve
+
+        res = solve(majority_fbas(9), backend=TpuSweepBackend())
+        assert res.intersects is True
+        assert res.stats["window_candidates_per_sec"] > 0
+        assert fresh_record.counters["sweep.candidates_checked"] == 256
+        assert fresh_record.counters["sweep.windows_dispatched"] >= 1
+        windows = [e for e in fresh_record.events if e["name"] == "sweep.window"]
+        assert windows
+
+
+class TestLoggingSatellites:
+    def test_qi_log_level_debug(self):
+        # QI_LOG_LEVEL=DEBUG must surface debug narration without -t.
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_LOG_LEVEL="DEBUG"),
+        )
+        assert proc.returncode == 0
+        assert "B&B call" in proc.stderr
+
+    def test_qi_log_level_quiet(self):
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_LOG_LEVEL="ERROR"),
+        )
+        assert proc.returncode == 0
+
+    def test_qi_log_json_formatter(self):
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_LOG_JSON="1", QI_LOG_LEVEL="DEBUG"),
+        )
+        assert proc.returncode == 0
+        json_logs = []
+        for line in proc.stderr.splitlines():
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and obj.get("kind") == "log":
+                json_logs.append(obj)
+        assert json_logs, proc.stderr
+        assert {"level", "logger", "msg", "t_wall"} <= set(json_logs[0])

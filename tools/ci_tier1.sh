@@ -8,7 +8,12 @@
 #   - pipefail + a DOTS_PASSED count parsed from the progress dots, so the
 #     driver can compare pass totals across runs even when the exit code
 #     alone would hide a shrinking suite;
-#   - hard timeout (870 s) with SIGKILL escalation.
+#   - hard timeout (870 s) with SIGKILL escalation;
+#   - run-record telemetry (docs/OBSERVABILITY.md) streamed to
+#     $TIER1_METRICS (default /tmp/_t1_metrics.jsonl): every in-process
+#     solve and every CLI subprocess the suite spawns appends to one
+#     qi-telemetry/1 JSONL file, so a perf regression spotted in CI is
+#     inspectable (tools/metrics_report.py) instead of anecdotal.
 #
 # Usage: tools/ci_tier1.sh [extra pytest args...]
 set -o pipefail
@@ -16,12 +21,17 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
-rm -f "$LOG"
+METRICS="${TIER1_METRICS:-/tmp/_t1_metrics.jsonl}"
+rm -f "$LOG" "$METRICS"
 
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+timeout -k 10 870 env JAX_PLATFORMS=cpu QI_METRICS_JSON="$METRICS" \
+    python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly "$@" 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+if [ -s "$METRICS" ]; then
+    echo "TELEMETRY=$METRICS ($(wc -l < "$METRICS") lines)"
+fi
 exit "$rc"

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Render a ``qi-telemetry/1`` JSONL stream into per-phase / per-window
+tables (ISSUE 2 tentpole — the read side of utils/telemetry.py).
+
+The stream may span multiple processes (the bench driver's phase children,
+CLI subprocesses under the test suite): spans and counters aggregate across
+all of them, with the process count reported up front.  Malformed lines are
+counted and skipped, never fatal — a SIGKILLed run leaves a ragged tail.
+
+Usage::
+
+    python tools/metrics_report.py metrics.jsonl            # full report
+    python tools/metrics_report.py metrics.jsonl --windows 8  # + window tail
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+
+def load_stream(path: str) -> dict:
+    """Parse one JSONL file into {spans, events, counters, gauges, meta}."""
+    spans: List[dict] = []
+    events: List[dict] = []
+    counters: Dict[str, float] = defaultdict(float)
+    gauges: Dict[str, object] = {}
+    meta: List[dict] = []
+    bad = 0
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                line = json.loads(raw)
+                kind = line["kind"]
+            except (json.JSONDecodeError, TypeError, KeyError):
+                bad += 1
+                continue
+            if kind == "span":
+                spans.append(line)
+            elif kind == "event":
+                events.append(line)
+            elif kind == "counter":
+                counters[line.get("name", "?")] += line.get("value", 0) or 0
+            elif kind == "gauge":
+                gauges[line.get("name", "?")] = line.get("value")
+            elif kind == "meta":
+                meta.append(line)
+            # "log" lines (QI_LOG_JSON interleaving) pass through silently
+    return {
+        "spans": spans,
+        "events": events,
+        "counters": dict(counters),
+        "gauges": gauges,
+        "meta": meta,
+        "bad_lines": bad,
+    }
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    def fmt(row):
+        return "  ".join(str(c).ljust(w) for c, w in zip(row, widths)).rstrip()
+    lines = [fmt(header), fmt(["-" * w for w in widths])]
+    lines += [fmt(r) for r in rows]
+    return "\n".join(lines)
+
+
+def span_table(spans: List[dict]) -> str:
+    agg: Dict[str, List[float]] = {}
+    for sp in spans:
+        sec = sp.get("seconds")
+        if sec is None:
+            continue
+        cur = agg.setdefault(sp.get("name", "?"), [0, 0.0, 0.0])
+        cur[0] += 1
+        cur[1] += sec
+        cur[2] = max(cur[2], sec)
+    rows = [
+        [name, int(c), f"{t:.3f}", f"{t / c * 1000:.2f}", f"{mx * 1000:.2f}"]
+        for name, (c, t, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1])
+    ]
+    if not rows:
+        return "(no spans)"
+    return _table(rows, ["span", "count", "total_s", "mean_ms", "max_ms"])
+
+
+def window_tables(events: List[dict], tail: int) -> str:
+    windows = [e for e in events if e.get("name") == "sweep.window"]
+    if not windows:
+        return "(no sweep windows)"
+    buckets: Dict[object, List[float]] = {}
+    total_cand = 0
+    total_sec = 0.0
+    for w in windows:
+        attrs = w.get("attrs", {})
+        cand = attrs.get("candidates", 0) or 0
+        sec = attrs.get("seconds", 0.0) or 0.0
+        total_cand += cand
+        total_sec += sec
+        cur = buckets.setdefault(attrs.get("steps_per_call", "?"), [0, 0, 0.0])
+        cur[0] += 1
+        cur[1] += cand
+        cur[2] += sec
+    rows = [
+        [str(spc), int(n), int(cand), f"{sec:.3f}",
+         f"{cand / sec:,.0f}" if sec > 0 else "-"]
+        for spc, (n, cand, sec) in sorted(
+            buckets.items(), key=lambda kv: str(kv[0])
+        )
+    ]
+    out = [
+        f"windows: {len(windows)}   candidates: {total_cand:,}   "
+        + (f"drain rate: {total_cand / total_sec:,.0f} cand/s"
+           if total_sec > 0 else "drain rate: -"),
+        _table(rows, ["steps_per_call", "windows", "candidates", "seconds",
+                      "rate_cand_s"]),
+    ]
+    if tail > 0:
+        out.append("")
+        out.append(f"last {min(tail, len(windows))} windows:")
+        rows = [
+            [f"{w.get('t_s', 0):.3f}",
+             str(w["attrs"].get("start", "?")),
+             str(w["attrs"].get("candidates", "?")),
+             str(w["attrs"].get("steps_per_call", "?")),
+             str(w["attrs"].get("rate", "?"))]
+            for w in windows[-tail:]
+        ]
+        out.append(_table(rows, ["t_s", "start", "candidates",
+                                 "steps_per_call", "rate"]))
+    return "\n".join(out)
+
+
+def event_summary(events: List[dict]) -> str:
+    lines = []
+    by_name: Dict[str, int] = defaultdict(int)
+    for e in events:
+        by_name[e.get("name", "?")] += 1
+    if by_name:
+        lines.append(_table(
+            [[n, c] for n, c in sorted(by_name.items(), key=lambda kv: -kv[1])],
+            ["event", "count"],
+        ))
+    races = [e for e in events if e.get("name") == "race"]
+    for r in races:
+        a = r.get("attrs", {})
+        lines.append(
+            f"race @ {r.get('t_s', 0):.3f}s: winner={a.get('winner')} "
+            f"oracle={a.get('oracle_outcome')} "
+            f"oracle_s={a.get('oracle_seconds')} "
+            f"sweep_s={a.get('sweep_seconds', '-')} "
+            f"loser_joined={a.get('loser_joined')} "
+            f"join_s={a.get('loser_join_seconds', '-')}"
+        )
+    for e in events:
+        if e.get("name") == "route.decision":
+            a = e.get("attrs", {})
+            lines.append(
+                f"route @ {e.get('t_s', 0):.3f}s: |scc|={a.get('scc')} -> "
+                f"{a.get('engine')} ({a.get('reason')})"
+            )
+    return "\n".join(lines) if lines else "(no events)"
+
+
+def scalar_table(counters: Dict[str, float], gauges: Dict[str, object]) -> str:
+    def pretty(v):
+        if isinstance(v, float) and v.is_integer():
+            return int(v)
+        return v
+
+    rows = [
+        [name, "counter", pretty(value)]
+        for name, value in sorted(counters.items())
+    ]
+    rows += [
+        [name, "gauge", pretty(value)] for name, value in sorted(gauges.items())
+    ]
+    if not rows:
+        return "(no counters/gauges)"
+    return _table(rows, ["name", "kind", "value"])
+
+
+def render(path: str, tail: int = 0) -> str:
+    data = load_stream(path)
+    pids = {m.get("pid") for m in data["meta"]}
+    head = (
+        f"qi-telemetry report: {path}\n"
+        f"processes: {len(pids) or 1}   spans: {len(data['spans'])}   "
+        f"events: {len(data['events'])}"
+        + (f"   malformed lines skipped: {data['bad_lines']}"
+           if data["bad_lines"] else "")
+    )
+    sections = [
+        head,
+        "\n== per-phase spans ==\n" + span_table(data["spans"]),
+        "\n== sweep windows ==\n" + window_tables(data["events"], tail),
+        "\n== events ==\n" + event_summary(data["events"]),
+        "\n== counters / gauges ==\n"
+        + scalar_table(data["counters"], data["gauges"]),
+    ]
+    return "\n".join(sections)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="qi-telemetry/1 JSONL file")
+    parser.add_argument("--windows", type=int, default=0, metavar="N",
+                        help="also list the last N sweep windows")
+    args = parser.parse_args()
+    try:
+        print(render(args.path, args.windows))
+    except OSError as exc:
+        print(f"cannot read {args.path}: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
