@@ -43,6 +43,7 @@ import argparse
 import json
 import math
 import os
+import pathlib
 import subprocess
 import sys
 import time
@@ -577,8 +578,6 @@ def parity_gate() -> dict:
     read-only checkout is present, and ALWAYS checks the self-contained
     vendored corpus (`fixtures/MANIFEST.json`), so the gate keeps running
     when this repo is detached from the reference environment."""
-    import pathlib
-
     from quorum_intersection_tpu.pipeline import solve
 
     def verdict(text: str) -> bool:

@@ -34,7 +34,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -227,9 +229,6 @@ def _subprocess_warm_pair(data):
     keeps the pair meaningful on the CPU smoke tier too (forces the cache
     on and drops jax's sub-second persistence threshold; harmless on an
     accelerator)."""
-    import subprocess
-    import tempfile
-
     payload = json.dumps(data)
     with tempfile.TemporaryDirectory(prefix="qi_warm_cache_") as cache_dir:
         env = dict(

@@ -34,6 +34,7 @@ import json
 import math
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -95,8 +96,6 @@ def main() -> int:
     native_floor_s = time.perf_counter() - t0
     print(f"native: {'completed' if native_completed else 'floor'} "
           f"{native_floor_s:.1f}s ({floor_calls} calls budgeted)", flush=True)
-
-    import tempfile
 
     from quorum_intersection_tpu.backends.tpu.frontier import (
         FrontierSearchInterrupted,

@@ -27,6 +27,7 @@ tests (``tests/test_pagerank.py``).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -149,8 +150,6 @@ def _jitted_power_loops():
     def dense(a, mf, conv, max_iterations):
         return loop(lambda s: a.T @ s, a.sum(axis=1), mf, conv,
                     max_iterations, a.shape[0])
-
-    from functools import partial
 
     @partial(jax.jit, static_argnames=("n",))
     def sparse(src, dst, outdeg_j, mf, conv, max_iterations, n):

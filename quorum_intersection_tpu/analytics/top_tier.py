@@ -19,6 +19,7 @@ exceeding it reports "not computed" rather than a partial answer.
 
 from __future__ import annotations
 
+import sys
 from typing import List, Optional, Sequence, Tuple
 
 from quorum_intersection_tpu.fbas.graph import TrustGraph
@@ -72,8 +73,6 @@ def _python_top_tier(
         return False  # keep enumerating
 
     state = _SearchState(budget_calls=budget_calls)
-    import sys
-
     needed = 4 * len(scc) + 1000
     old_limit = sys.getrecursionlimit()
     if needed > old_limit:

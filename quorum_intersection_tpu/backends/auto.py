@@ -46,13 +46,21 @@ degrade gracefully to the next option so the CLI always yields a verdict.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import threading
+import time
+from typing import Dict, List, Optional
 
-from quorum_intersection_tpu.backends.base import SccCheckResult
+from quorum_intersection_tpu.backends.base import (
+    CancelToken,
+    OracleBudgetExceeded,
+    SccCheckResult,
+    SearchBackend,
+    SearchCancelled,
+)
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.utils.logging import get_logger
-from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.telemetry import Span, get_run_record
 
 log = get_logger("backends.auto")
 
@@ -132,6 +140,26 @@ RACE_LOSER_JOIN_S = 5.0
 RACE_LOSER_JOIN_MIN_S = 0.2
 
 
+def _race_sync(point: str) -> None:
+    """Deterministic-schedule hook (ISSUE 3): a no-op in production, replaced
+    by ``tools/analyze/schedules.py`` to FORCE the race's nasty interleavings
+    — sweep-wins-then-oracle-finishes, cancel-during-compile, both-finish-
+    simultaneously — instead of hoping the wall clock finds them.  Points:
+
+    - ``sweep.started``     — worker thread entered, before any device work
+    - ``sweep.verdict``     — sweep result recorded, before cancelling the
+      oracle
+    - ``sweep.unwound``     — worker observed its cancel and is exiting
+    - ``oracle.returned``   — main thread's oracle call completed (verdict,
+      budget burn, or cancel), before the winner is decided
+
+    The hook runs on the thread that reaches the point (monkeypatch the
+    module attribute, as the harness and tests/test_race_schedules.py do); a
+    replacement may block to serialize threads but MUST eventually return
+    (the harness bounds every wait).  Keep call sites outside any lock.
+    """
+
+
 def _measured_sweep_raise() -> Optional[int]:
     """The artifact-backed accelerator sweep limit, BEFORE the device-kind
     gate: largest measured winning |scc| + headroom, capped at any
@@ -204,8 +232,8 @@ class AutoBackend:
         sweep_limit: Optional[int] = DEFAULT_SWEEP_LIMIT,
         seed: Optional[int] = None,
         randomized: bool = False,
-        checkpoint=None,
-        mesh=None,
+        checkpoint: Optional[object] = None,
+        mesh: Optional[object] = None,
         race: bool = True,
     ) -> None:
         # prefer_tpu (`--backend tpu`) is routing-neutral since the r3
@@ -223,14 +251,18 @@ class AutoBackend:
         self.race = race
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
 
-    def _sweep(self, cancel=None):
+    def _sweep(self, cancel: Optional[CancelToken] = None) -> SearchBackend:
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
         return TpuSweepBackend(
             checkpoint=self.checkpoint, mesh=self.mesh, cancel=cancel
         )
 
-    def _cpu_oracle(self, budget_s: Optional[float] = None, cancel=None):
+    def _cpu_oracle(
+        self,
+        budget_s: Optional[float] = None,
+        cancel: Optional[CancelToken] = None,
+    ) -> SearchBackend:
         """Native oracle, degrading to pure Python; with ``budget_s``, the
         instance carries a B&B call budget sized per engine speed; with
         ``cancel``, a base.CancelToken the search polls (racing mode)."""
@@ -296,11 +328,16 @@ class AutoBackend:
             accel_overhead + space / SWEEP_RATE["accel"],
         )
 
-    def _budgeted_oracle(self, graph, circuit, scc, scope_to_scc, budget_s):
+    def _budgeted_oracle(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+        budget_s: float,
+    ) -> Optional[SccCheckResult]:
         """Sequential oracle-first attempt (``--no-race``): returns a
         result, or None meaning 'fall back to the sweep' (budget burned)."""
-        from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
-
         backend = self._cpu_oracle(budget_s=budget_s)
         try:
             log.debug(
@@ -313,7 +350,14 @@ class AutoBackend:
             log.info("oracle budget burned (%s); switching to the exhaustive sweep", exc)
             return None
 
-    def _race(self, graph, circuit, scc, scope_to_scc, budget_s):
+    def _race(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+        budget_s: float,
+    ) -> Optional[SccCheckResult]:
         """Racing orchestrator: budgeted host oracle vs concurrent sweep
         spin-up; first verdict wins, the loser is cooperatively cancelled.
 
@@ -344,25 +388,24 @@ class AutoBackend:
                 graph, circuit, scc, scope_to_scc, budget_s, race_span
             )
 
-    def _race_inner(self, graph, circuit, scc, scope_to_scc, budget_s,
-                    race_span):
-        import threading
-        import time
-
-        from quorum_intersection_tpu.backends.base import (
-            CancelToken,
-            OracleBudgetExceeded,
-            SearchCancelled,
-        )
-
+    def _race_inner(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+        budget_s: float,
+        race_span: Span,
+    ) -> Optional[SccCheckResult]:
         rec = get_run_record()
         oracle_cancel = CancelToken()
         sweep_cancel = CancelToken()
-        outcome: dict = {}
+        outcome: Dict[str, object] = {}
         t0 = time.monotonic()
 
         def sweep_worker() -> None:
             try:
+                _race_sync("sweep.started")
                 if sweep_cancel.cancelled:
                     return
                 # The race's ONE device contact, off the verdict path.
@@ -383,9 +426,11 @@ class AutoBackend:
                 )
                 outcome["sweep_result"] = res
                 outcome["sweep_seconds"] = time.monotonic() - t0
+                _race_sync("sweep.verdict")
                 oracle_cancel.cancel()
             except SearchCancelled:
                 outcome["sweep_cancelled"] = True
+                _race_sync("sweep.unwound")
                 if self.checkpoint is not None:
                     # Discard this losing sweep's recorded progress FROM THE
                     # WORKER THREAD, after its engine has raised: the worker
@@ -433,6 +478,7 @@ class AutoBackend:
         except SearchCancelled:
             oracle_state = "cancelled"
         oracle_seconds = time.monotonic() - t_oracle
+        _race_sync("oracle.returned")
 
         def race_stats(winner: str, joined: bool,
                        loser_join_s: Optional[float] = None,

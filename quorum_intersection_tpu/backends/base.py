@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, runtime_checkable
 
@@ -51,8 +52,6 @@ class CancelToken:
     __slots__ = ("flag", "_event")
 
     def __init__(self) -> None:
-        import threading
-
         import numpy as np
 
         self.flag = np.zeros(1, dtype=np.int32)

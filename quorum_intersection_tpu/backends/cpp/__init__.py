@@ -25,15 +25,17 @@ import hashlib
 import logging
 import os
 import subprocess
+import tempfile
 import time
 from pathlib import Path
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from quorum_intersection_tpu.backends.base import SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
+from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 
@@ -54,7 +56,8 @@ def _so_path() -> Path:
     return _BUILD_DIR / f"qi_oracle-{digest}.so"
 
 
-def _compile(out: Path, sources, flags, what: str, force: bool) -> Path:
+def _compile(out: Path, sources: Sequence[Path], flags: Sequence[str],
+             what: str, force: bool) -> Path:
     """Shared g++ driver: idempotent content-hashed artifact, tmp-file +
     atomic rename (concurrent builders use distinct tmp names)."""
     if out.exists() and not force:
@@ -79,22 +82,88 @@ def build_library(force: bool = False) -> Path:
 
 _CLI_SRC = Path(__file__).with_name("qi_native.cpp")
 
+# Instrumented build catalog (ISSUE 3): binary-name tag → g++ flags.  "asan"
+# is the UB-hygiene check the reference never had (its own uninitialized-
+# threshold read, SURVEY §2.3-Q2, would trip MSan); "tsan" exists for the
+# threaded callers the racing auto router added — the native search itself
+# is single-threaded, but `qi_check_scc_cancel` polls a cancel flag another
+# thread flips, and TSAN is the tool that vets that access pattern once
+# multi-threaded drivers reach the native layer.
+_SANITIZER_FLAGS: Dict[str, List[str]] = {
+    "asan": ["-O1", "-g", "-fsanitize=address,undefined",
+             "-fno-sanitize-recover=all"],
+    "tsan": ["-O1", "-g", "-fsanitize=thread"],
+}
 
-def build_native_cli(force: bool = False, sanitize: bool = False) -> Path:
+
+def sanitizer_mode() -> str:
+    """The sanitizer the instrumented build uses: ``QI_SANITIZER`` ∈
+    {asan, tsan, none} (registry: utils/env.py), default asan."""
+    mode = qi_env("QI_SANITIZER").strip().lower() or "asan"
+    if mode not in ("asan", "tsan", "none"):
+        raise ValueError(
+            f"QI_SANITIZER={mode!r} not in {{asan, tsan, none}}"
+        )
+    return mode
+
+
+def _probe_sanitizer_runtime(mode: str) -> None:
+    """Compile-and-link a 2-line probe under the requested sanitizer so a
+    toolchain without the runtime fails HERE with a clear message — never by
+    silently handing callers the unsanitized binary (ISSUE 3 satellite: the
+    old behavior degraded to a plain build path on any failure, so a 'green'
+    sanitizer run could mean 'nothing was instrumented')."""
+    with tempfile.TemporaryDirectory(prefix="qi-sanprobe-") as tmp:
+        src = Path(tmp) / "probe.cpp"
+        src.write_text("int main() { return 0; }\n")
+        cmd = ["g++", "-std=c++17", *_SANITIZER_FLAGS[mode],
+               "-o", str(Path(tmp) / "probe"), str(src)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"toolchain lacks the {mode} sanitizer runtime "
+            f"(probe `{' '.join(cmd)}` failed):\n{proc.stderr.strip()}\n"
+            f"Install the lib{mode} runtime or set QI_SANITIZER=none."
+        )
+
+
+def build_native_cli(
+    force: bool = False, sanitize: Union[bool, str] = False
+) -> Path:
     """Compile the standalone native CLI (``qi_native.cpp`` + the oracle) →
     a content-hashed binary, the framework's equivalent of the reference's
     single-binary deployment (`/root/reference/quorum_intersection.cpp`
     main, C21).  Idempotent; returns the binary path.
 
-    ``sanitize=True`` builds an ASan+UBSan instrumented binary (separate
-    cache entry) — the UB-hygiene check the reference never had (its own
-    uninitialized-threshold read, SURVEY §2.3-Q2, would trip MSan); the
-    test suite runs the golden fixtures and hostile inputs through it."""
+    ``sanitize`` selects an instrumented build (separate digest-keyed cache
+    entry per sanitizer, ``qi_native-{asan,tsan}-<digest>``): ``True`` uses
+    the mode ``QI_SANITIZER`` names (default asan), or pass ``"asan"`` /
+    ``"tsan"`` explicitly.  ``QI_SANITIZER=none`` (or ``sanitize="none"``)
+    REFUSES the instrumented build with a clear error instead of silently
+    returning the plain binary — callers asked for instrumentation, and a
+    passing run must mean the instrumentation actually ran.  A toolchain
+    missing the sanitizer runtime fails the same way (probe first, so the
+    error names the missing runtime, not a linker soup)."""
     digest = hashlib.sha256(_CLI_SRC.read_bytes() + _SRC.read_bytes()).hexdigest()[:16]
     if sanitize:
-        exe = _BUILD_DIR / f"qi_native-asan-{digest}"
-        flags = ["-O1", "-g", "-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
-        return _compile(exe, [_CLI_SRC, _SRC], flags, "sanitized native CLI", force)
+        mode = sanitizer_mode() if sanitize is True else str(sanitize).lower()
+        if mode == "none":
+            raise RuntimeError(
+                "sanitized build requested but QI_SANITIZER=none — unset it "
+                "(or pick asan/tsan) to build an instrumented binary"
+            )
+        if mode not in _SANITIZER_FLAGS:
+            raise ValueError(
+                f"unknown sanitizer {mode!r}; expected one of "
+                f"{sorted(_SANITIZER_FLAGS)} or 'none'"
+            )
+        exe = _BUILD_DIR / f"qi_native-{mode}-{digest}"
+        if not exe.exists() or force:
+            _probe_sanitizer_runtime(mode)
+        return _compile(
+            exe, [_CLI_SRC, _SRC], _SANITIZER_FLAGS[mode],
+            f"{mode}-sanitized native CLI", force,
+        )
     exe = _BUILD_DIR / f"qi_native-{digest}"
     return _compile(exe, [_CLI_SRC, _SRC], ["-O2"], "native CLI", force)
 

@@ -150,11 +150,9 @@ class TpuFrontierBackend:
             # Env override (QI_FRONTIER_CKPT_INTERVAL_S) exists for the real
             # process-death tests, which must shrink the write cadence of a
             # CLI child they cannot construct in-process.
-            import os
+            from quorum_intersection_tpu.utils.env import qi_env_float
 
-            checkpoint_interval_s = float(
-                os.environ.get("QI_FRONTIER_CKPT_INTERVAL_S", 5.0)
-            )
+            checkpoint_interval_s = qi_env_float("QI_FRONTIER_CKPT_INTERVAL_S")
         self.checkpoint_interval_s = checkpoint_interval_s
         # Preemption simulation for kill/resume tests (retired-hybrid
         # interrupt_after_batches contract): after this many chunks, force a

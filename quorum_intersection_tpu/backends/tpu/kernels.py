@@ -40,6 +40,8 @@ large, amortizing the overhead to noise (measured ~40× end-to-end).
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -368,9 +370,6 @@ def make_aot_dispatch(step, zeros_hi: jnp.ndarray, cast) -> Callable:
     call alone — the bucket the persistent compilation cache elides (trace +
     lowering always run; sweep.py sums it into the warm-start stat the
     cache-hit acceptance test pins)."""
-    import threading
-    import time
-
     state: dict = {}
     lock = threading.Lock()
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import deque
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -430,8 +431,6 @@ class TpuSweepBackend:
         # globally smallest hit candidate.  Program size ramps through
         # STEPS_RAMP as the sweep proves large (shape cache: one compile per
         # ramp level actually reached).
-        from collections import deque
-
         steps = 0
         candidates = 0
         found = False

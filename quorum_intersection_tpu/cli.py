@@ -28,6 +28,7 @@ sinks — docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
@@ -233,8 +234,6 @@ def _main(args, record) -> int:
             minimum_splitting_set,
         )
         from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
-
-        import json
 
         raw = json.loads(stdin_text)
         # Candidate pool from the graph already built under the user's

@@ -90,7 +90,7 @@ class Circuit:
         return (self.n + 31) // 32
 
 
-def _check_qset_depth(qsets) -> None:
+def _check_qset_depth(qsets: List[IndexedQSet]) -> None:
     """Iterative depth guard: the interning recursion below (and the frozen
     dataclass hashes it triggers) must never see a tree deeper than the
     schema-level cap — graphs built through ``parse_fbas`` are pre-capped,

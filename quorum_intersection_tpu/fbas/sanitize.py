@@ -35,7 +35,8 @@ import sys
 from typing import Iterable, List, Mapping, Optional, Set
 
 
-def _qset_sane(q, *, recursive: bool, flag_zero_threshold: bool) -> bool:
+def _qset_sane(q: Optional[Mapping], *, recursive: bool,
+               flag_zero_threshold: bool) -> bool:
     if q is None or not q:
         return True  # null/empty qset: never satisfiable but harmless
     threshold = q.get("threshold")

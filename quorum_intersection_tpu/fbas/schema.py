@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import IO, Iterable, Mapping, Optional, Sequence, Union
+from typing import IO, Iterable, Iterator, Mapping, Optional, Sequence, Union
 
 
 class FbasSchemaError(ValueError):
@@ -115,7 +115,7 @@ class Fbas:
     def __len__(self) -> int:
         return len(self.nodes)
 
-    def __iter__(self):
+    def __iter__(self) -> "Iterator[FbasNode]":
         return iter(self.nodes)
 
     def __getitem__(self, i: int) -> FbasNode:
@@ -127,7 +127,7 @@ class Fbas:
         return node.name if node.name else node.public_key
 
 
-def _parse_qset(value, where: str, depth: int = 0) -> QSet:
+def _parse_qset(value: object, where: str, depth: int = 0) -> QSet:
     if depth > MAX_QSET_DEPTH:
         raise FbasSchemaError(
             f"{where}: quorumSet nesting exceeds depth {MAX_QSET_DEPTH}"

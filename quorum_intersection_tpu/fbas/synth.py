@@ -16,7 +16,7 @@ import random
 from typing import Dict, List, Optional
 
 
-def _node(key: str, name: str, qset) -> Dict:
+def _node(key: str, name: str, qset: Dict) -> Dict:
     return {"publicKey": key, "name": name, "quorumSet": qset}
 
 

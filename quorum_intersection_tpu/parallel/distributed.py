@@ -35,6 +35,8 @@ mesh equals the local one.
 
 from __future__ import annotations
 
+import os
+
 from typing import Optional, Sequence
 
 import numpy as np
@@ -76,8 +78,6 @@ def initialize(
             _initialized = True
             return
     if coordinator_address is None and num_processes is None:
-        import os
-
         # No explicit topology and no multi-host pod environment ⇒ single
         # process.  TPU_WORKER_HOSTNAMES counts only with >1 entry (tunneled
         # single-chip images export it as "localhost").

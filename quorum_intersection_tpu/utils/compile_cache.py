@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 from pathlib import Path
 
+from quorum_intersection_tpu.utils.env import qi_env_flag
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 
@@ -60,14 +61,14 @@ def _install_cache_listener() -> None:
 def enable_compilation_cache() -> None:
     """Install a persistent compilation cache (idempotent, best-effort)."""
     global _installed
-    if _installed or os.environ.get("QI_NO_COMPILE_CACHE"):
+    if _installed or qi_env_flag("QI_NO_COMPILE_CACHE"):
         return
     _installed = True
     _install_cache_listener()
     try:
         import jax
 
-        force_cpu = bool(os.environ.get("QI_COMPILE_CACHE_CPU"))
+        force_cpu = qi_env_flag("QI_COMPILE_CACHE_CPU")
         if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             if force_cpu:
                 # The user-chosen dir rides jax's own env handling; only the

@@ -1,0 +1,151 @@
+"""One registry for every ``QI_*`` environment variable the framework reads.
+
+Before this module, each env knob was an ad-hoc ``os.environ.get`` scattered
+through the codebase — docs/OBSERVABILITY.md and the README listed what the
+author *remembered*, not what the code *read*, and the two drifted (the
+static-analysis ISSUE 3 motivation).  Now every read goes through
+:func:`qi_env` against a declared :class:`EnvVar`, so:
+
+- the registry below IS the documentation — ``python -m tools.analyze``'s
+  ``no-bare-env-read`` lint rule flags any ``os.environ`` read of a ``QI_*``
+  key outside this module, and :func:`qi_env` raises on undeclared names, so
+  a new knob cannot ship without a description;
+- defaults live in exactly one place (the call sites stop hand-carrying
+  them);
+- ``registry()`` gives tooling (docs generators, ``--help`` epilogues) the
+  machine-readable catalog.
+
+stdlib-only and import-free of the rest of the package: ``utils/logging.py``
+reads :data:`QI_LOG_LEVEL` here during its own bootstrap, so this module
+must sit below everything else in the import graph.
+
+Reads are deliberately **not cached**: tests monkeypatch ``os.environ`` and
+expect the next read to see it, exactly as the scattered ``environ.get``
+calls behaved.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared environment knob: its name, default, and contract."""
+
+    name: str
+    default: Optional[str]
+    description: str
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _declare(name: str, default: Optional[str], description: str) -> EnvVar:
+    var = EnvVar(name=name, default=default, description=description)
+    _REGISTRY[name] = var
+    return var
+
+
+# ---- the catalog -----------------------------------------------------------
+
+QI_LOG_LEVEL = _declare(
+    "QI_LOG_LEVEL", "",
+    "Initial log level by name (DEBUG/INFO/WARNING/ERROR/CRITICAL) or "
+    "numeric value; the CLI's -t still overrides it (utils/logging.py).",
+)
+QI_LOG_JSON = _declare(
+    "QI_LOG_JSON", "",
+    "Truthy: one JSON object per log line, interleavable with the "
+    "qi-telemetry/1 stream (utils/logging.py).",
+)
+QI_METRICS_JSON = _declare(
+    "QI_METRICS_JSON", "",
+    "Path of a qi-telemetry/1 JSONL stream every process appends to "
+    "(utils/telemetry.py env sink; the CLI flag --metrics-json plumbs the "
+    "same sink explicitly).",
+)
+QI_METRICS_PROM = _declare(
+    "QI_METRICS_PROM", "",
+    "Path of a Prometheus textfile rewritten at process finish "
+    "(utils/telemetry.py env sink; CLI flag --metrics-prom).",
+)
+QI_NO_COMPILE_CACHE = _declare(
+    "QI_NO_COMPILE_CACHE", "",
+    "Truthy: disable the persistent XLA compilation cache "
+    "(utils/compile_cache.py).",
+)
+QI_COMPILE_CACHE_CPU = _declare(
+    "QI_COMPILE_CACHE_CPU", "",
+    "Truthy: force the compile cache ON for the CPU backend and drop jax's "
+    "min-compile-time threshold to zero — warm-start tests only, not for "
+    "production CPU use (utils/compile_cache.py).",
+)
+QI_FRONTIER_CKPT_INTERVAL_S = _declare(
+    "QI_FRONTIER_CKPT_INTERVAL_S", "5.0",
+    "Frontier checkpoint write cadence in seconds — exists so process-death "
+    "tests can shrink the cadence of a CLI child they cannot construct "
+    "in-process (backends/tpu/frontier.py).",
+)
+QI_SANITIZER = _declare(
+    "QI_SANITIZER", "asan",
+    "Which sanitizer the instrumented native build uses: 'asan' "
+    "(address+undefined, the default), 'tsan' (thread), or 'none' "
+    "(sanitized builds refused with a clear error) — "
+    "backends/cpp/build_native_cli(sanitize=True).",
+)
+QI_TEST_PLATFORM = _declare(
+    "QI_TEST_PLATFORM", "cpu",
+    "Platform the test suite pins via JAX_PLATFORMS before jax loads: "
+    "'cpu' (default), 'tpu', or 'axon' (tests/conftest.py).",
+)
+
+
+# ---- reads -----------------------------------------------------------------
+
+
+def qi_env(name: str) -> str:
+    """The declared variable's value (its registered default when unset).
+
+    Raises ``KeyError`` for an undeclared name — the runtime twin of the
+    ``no-bare-env-read`` lint rule: a knob that is not in the catalog above
+    does not exist.
+    """
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise KeyError(
+            f"{name!r} is not a declared QI_* environment variable; "
+            f"add it to quorum_intersection_tpu/utils/env.py"
+        )
+    value = os.environ.get(var.name)
+    return (var.default or "") if value is None else value
+
+
+def qi_env_flag(name: str) -> bool:
+    """Boolean read: any non-empty value counts as set (the semantics every
+    pre-registry call site used — ``QI_LOG_JSON=0`` is still truthy, and the
+    docs say 'set'/'unset', not '1'/'0')."""
+    return bool(qi_env(name))
+
+
+def qi_env_float(name: str, fallback: Optional[float] = None) -> float:
+    """Float read; malformed values fall back to the registered default
+    (or ``fallback`` when the default itself is unparseable)."""
+    raw = qi_env(name)
+    try:
+        return float(raw)
+    except ValueError:
+        default = _REGISTRY[name].default
+        try:
+            return float(default if default is not None else "")
+        except ValueError:
+            if fallback is None:
+                raise
+            return fallback
+
+
+def registry() -> Tuple[EnvVar, ...]:
+    """The full declared catalog, in declaration order."""
+    return tuple(_REGISTRY.values())

@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
+
+from quorum_intersection_tpu.utils.env import qi_env, qi_env_flag
 
 _ROOT_NAME = "quorum_intersection_tpu"
 _configured = False
@@ -45,7 +46,7 @@ class _JsonFormatter(logging.Formatter):
 
 def _env_level() -> int:
     """Level named by QI_LOG_LEVEL (default INFO; bad values ignored)."""
-    raw = os.environ.get("QI_LOG_LEVEL", "").strip()
+    raw = qi_env("QI_LOG_LEVEL").strip()
     if not raw:
         return logging.INFO
     if raw.isdigit():
@@ -60,7 +61,7 @@ def _configure() -> None:
         return
     logger = logging.getLogger(_ROOT_NAME)
     handler = logging.StreamHandler(sys.stderr)
-    if os.environ.get("QI_LOG_JSON"):
+    if qi_env_flag("QI_LOG_JSON"):
         handler.setFormatter(_JsonFormatter())
     else:
         handler.setFormatter(logging.Formatter("[%(levelname)s] %(name)s: %(message)s"))

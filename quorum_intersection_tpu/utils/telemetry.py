@@ -52,12 +52,14 @@ import atexit
 import io
 import json
 import os
+import sys
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Protocol, Tuple
 
+from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("utils.telemetry")
@@ -71,7 +73,15 @@ MAX_SPANS = 100_000
 MAX_EVENTS = 100_000
 
 
-def _jsonable(value):
+class Sink(Protocol):
+    """What the record needs from a sink: streaming lines + a final flush."""
+
+    def emit(self, line: dict) -> None: ...
+
+    def finish(self, record: "RunRecord") -> None: ...
+
+
+def _jsonable(value: object) -> object:
     """Best-effort JSON coercion — telemetry must never crash a solve."""
     if isinstance(value, (str, int, float, bool)) or value is None:
         return value
@@ -93,7 +103,7 @@ class Span:
     seconds: Optional[float] = None
     attrs: Dict[str, object] = field(default_factory=dict)
 
-    def set(self, **attrs) -> "Span":
+    def set(self, **attrs: object) -> "Span":
         self.attrs.update(attrs)
         return self
 
@@ -117,7 +127,7 @@ class JsonlSink:
         self._lock = threading.Lock()
         self._fh: Optional[io.TextIOBase] = None
 
-    def _handle(self):
+    def _handle(self) -> io.TextIOBase:
         if self._fh is None:
             self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
         return self._fh
@@ -196,8 +206,6 @@ class StderrSummarySink:
         pass
 
     def finish(self, record: "RunRecord") -> None:
-        import sys
-
         for line in record.summary_lines():
             sys.stderr.write(line + "\n")
 
@@ -216,7 +224,7 @@ class RunRecord:
         self.gauges: Dict[str, object] = {}
         self.dropped = 0
         self._next_id = 0
-        self._sinks: list = []
+        self._sinks: List[Sink] = []
         self._finished = False
         # Always-present counters (acceptance: one solve's stream carries the
         # compile-cache hit/miss pair even when the cache saw no traffic).
@@ -225,9 +233,7 @@ class RunRecord:
 
     # ---- sinks -----------------------------------------------------------
 
-    def add_sink(self, sink) -> None:
-        import sys
-
+    def add_sink(self, sink: "Sink") -> None:
         with self._lock:
             self._sinks.append(sink)
         # Every sink gets its own meta/schema header on attach — a sink
@@ -266,7 +272,7 @@ class RunRecord:
 
     @contextmanager
     def span(self, name: str, parent_id: Optional[int] = None,
-             **attrs) -> Iterator[Span]:
+             **attrs: object) -> Iterator[Span]:
         """Open a nested span.  Nesting is per-thread (a worker thread's
         spans are roots unless ``parent_id`` carries one across)."""
         stack = self._stack()
@@ -297,7 +303,7 @@ class RunRecord:
 
     # ---- events / counters / gauges -------------------------------------
 
-    def event(self, name: str, **attrs) -> None:
+    def event(self, name: str, **attrs: object) -> None:
         ev = {
             "kind": "event",
             "name": name,
@@ -322,13 +328,13 @@ class RunRecord:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
-    def gauge(self, name: str, value) -> None:
+    def gauge(self, name: str, value: object) -> None:
         with self._lock:
             self.gauges[name] = value
 
     # ---- rollups / finish -------------------------------------------------
 
-    def span_rollup(self) -> List[tuple]:
+    def span_rollup(self) -> List[Tuple[str, float, int]]:
         """``[(name, total_seconds, count), ...]`` sorted by total desc."""
         with self._lock:
             totals: Dict[str, List[float]] = {}
@@ -401,10 +407,10 @@ def _attach_env_sinks(record: RunRecord) -> None:
     """Honor QI_METRICS_JSON / QI_METRICS_PROM: the env-var hook the test
     suite and CI use (tools/ci_tier1.sh) — every process in a run appends to
     one shared stream without any flag plumbing."""
-    jsonl = os.environ.get("QI_METRICS_JSON")
+    jsonl = qi_env("QI_METRICS_JSON")
     if jsonl:
         record.add_sink(JsonlSink(jsonl))
-    prom = os.environ.get("QI_METRICS_PROM")
+    prom = qi_env("QI_METRICS_PROM")
     if prom:
         record.add_sink(PromFileSink(prom))
 

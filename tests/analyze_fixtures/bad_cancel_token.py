@@ -1,0 +1,10 @@
+"""qi-lint fixture: a worker thread spawned with no CancelToken anywhere in
+reach — once the race is decided, nobody can stop this work."""
+
+import threading
+
+
+def spawn_unstoppable_worker(job):
+    worker = threading.Thread(target=job, name="qi-fixture-worker")  # BAD
+    worker.start()
+    return worker

@@ -1,0 +1,8 @@
+"""qi-lint fixture: a bare ``QI_*`` env read — the knob exists in code but
+not in the registry, so the documented catalog silently rots."""
+
+import os
+
+
+def undocumented_knob():
+    return os.environ.get("QI_SECRET_TUNING", "0")  # BAD: not via qi_env

@@ -1,0 +1,9 @@
+"""qi-lint fixture: a cheap stdlib module imported at function level — the
+shape backends/auto.py:349 had before ISSUE 3's first satellite moved it
+to module scope."""
+
+
+def racy_section():
+    import threading  # BAD: threading costs nothing at import time
+
+    return threading.Event()

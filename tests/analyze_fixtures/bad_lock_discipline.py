@@ -1,0 +1,14 @@
+"""qi-lint fixture: a telemetry-style counter mutated outside its lock —
+the racing auto router's two threads both increment, and unlocked
+read-modify-write drops counts."""
+
+import threading
+
+
+class MiniRecord:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def add(self, name, n=1):
+        self.counters[name] = self.counters.get(name, 0) + n  # BAD: no lock

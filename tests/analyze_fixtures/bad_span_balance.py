@@ -1,0 +1,14 @@
+"""qi-lint fixture: a RunRecord span opened by hand — an exception between
+``__enter__`` and ``__exit__`` leaks the enter and the telemetry stream
+ends with a dangling span."""
+
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+
+def solve_with_leaky_span(work):
+    sp = get_run_record().span("phase.search")  # BAD: not a `with` item
+    sp.__enter__()
+    try:
+        return work()
+    finally:
+        sp.__exit__(None, None, None)

@@ -1,0 +1,17 @@
+"""qi-lint fixture: the jax-tracer-leak failure mode, distilled.
+
+Never imported — the lint pass parses it.  The Python ``if`` on a traced
+reduction is exactly the bug class that silently bakes one branch into the
+compiled program (or crashes at trace time) in encode/circuit.py-style
+kernels."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky_step(avail):
+    votes = jnp.sum(avail, axis=-1)
+    if votes > 0:  # BAD: trace-time branch on a traced value
+        return votes
+    return -votes

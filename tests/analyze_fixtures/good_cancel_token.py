@@ -1,0 +1,16 @@
+"""qi-lint fixture twin: the spawner accepts and forwards a CancelToken, so
+the race driver can reach the work it started."""
+
+import threading
+
+from quorum_intersection_tpu.backends.base import CancelToken
+
+
+def spawn_cancellable_worker(job, cancel: CancelToken):
+    def run():
+        if not cancel.cancelled:
+            job(cancel)
+
+    worker = threading.Thread(target=run, name="qi-fixture-worker")
+    worker.start()
+    return worker

@@ -1,0 +1,14 @@
+"""qi-lint fixture twin: the read goes through the registry (and non-QI_
+env vars — jax's own knobs, CI plumbing — stay out of the rule's scope)."""
+
+import os
+
+from quorum_intersection_tpu.utils.env import qi_env
+
+
+def documented_knob():
+    return qi_env("QI_LOG_LEVEL")
+
+
+def foreign_knob():
+    return os.environ.get("JAX_PLATFORMS")  # not QI_*: out of scope

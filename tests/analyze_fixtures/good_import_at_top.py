@@ -1,0 +1,23 @@
+"""qi-lint fixture twin: stdlib at module scope; jax and package-internal
+imports may stay lazy (the repo's import discipline the rule must not
+break), and the suppression syntax is honored when a lazy stdlib import is
+genuinely justified."""
+
+import threading
+
+
+def racy_section():
+    return threading.Event()
+
+
+def device_section():
+    import jax  # lazy heavyweight import: allowed by design
+
+    return jax.default_backend()
+
+
+def suppressed_section():
+    # qi-lint: allow(import-at-top) — demonstrates the suppression syntax
+    import subprocess
+
+    return subprocess.DEVNULL

@@ -1,0 +1,17 @@
+"""qi-lint fixture twin: the same counter, mutated under its lock."""
+
+import threading
+
+
+class MiniRecord:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def add(self, name, n=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.counters)  # reads copy out under the lock too

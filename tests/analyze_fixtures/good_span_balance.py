@@ -1,0 +1,11 @@
+"""qi-lint fixture twin: the span enters as a ``with`` item, so every exit
+path — including exceptions — closes it."""
+
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+
+def solve_with_balanced_span(work):
+    with get_run_record().span("phase.search") as sp:
+        result = work()
+        sp.set(ok=True)
+        return result

@@ -1,0 +1,16 @@
+"""qi-lint fixture twin: the same computation with trace-safe control flow
+(jnp.where on the traced value; Python ``if`` only on static closure
+config, which the rule must NOT flag)."""
+
+import jax
+import jax.numpy as jnp
+
+USE_ABS = True
+
+
+@jax.jit
+def safe_step(avail):
+    votes = jnp.sum(avail, axis=-1)
+    if USE_ABS:  # static module constant: fine at trace time
+        return jnp.where(votes > 0, votes, -votes)
+    return votes

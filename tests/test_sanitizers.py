@@ -1,11 +1,14 @@
-"""ASan/UBSan hygiene of the framework's own C++ (qi_oracle + qi_native).
+"""ASan/UBSan/TSan hygiene of the framework's own C++ (qi_oracle + qi_native).
 
 The reference ships latent UB (the uninitialized-threshold read of SURVEY
 §2.3-Q2) and never runs a sanitizer (CMakeLists.txt:1-15).  Here the whole
 native surface — JSON parsing, graph build, Tarjan, the B&B search, PageRank
 and Graphviz — runs under `-fsanitize=address,undefined` with recovery
 disabled, over the golden fixtures AND the hostile-input corpus, so any UB
-or memory error aborts the binary and fails the test."""
+or memory error aborts the binary and fails the test.  Since ISSUE 3 a
+`-fsanitize=thread` variant rides alongside (QI_SANITIZER selects the mode;
+'none' makes sanitized builds refuse loudly instead of silently handing
+back the plain binary)."""
 
 import subprocess
 
@@ -62,6 +65,51 @@ def test_compat_and_randomized_paths_clean(asan_cli, ref_fixture):
     data = ref_fixture("broken.json").read_text()
     assert_no_sanitizer_report(run(asan_cli, ["--compat", "-v"], data))
     assert_no_sanitizer_report(run(asan_cli, ["--seed", "7", "-t"], data))
+
+
+class TestSanitizerModes:
+    """QI_SANITIZER plumbing (ISSUE 3 satellite): tsan variant builds and
+    runs; 'none' and unknown modes fail loudly, never fall back silently."""
+
+    def test_tsan_variant_builds_and_verdicts_match(self, ref_fixture):
+        from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+        try:
+            cli = str(build_native_cli(sanitize="tsan"))
+        except Exception as exc:  # pragma: no cover - toolchain lacks tsan
+            pytest.skip(f"tsan build unavailable: {exc}")
+        assert "qi_native-tsan-" in cli  # digest-keyed like the asan entry
+        for name, code in GOLDEN:
+            proc = run(cli, [], ref_fixture(name).read_text())
+            assert proc.returncode == code, proc.stderr
+            assert "WARNING: ThreadSanitizer" not in proc.stderr
+
+    def test_env_selects_tsan(self, monkeypatch):
+        from quorum_intersection_tpu.backends.cpp import sanitizer_mode
+
+        monkeypatch.setenv("QI_SANITIZER", "tsan")
+        assert sanitizer_mode() == "tsan"
+        monkeypatch.delenv("QI_SANITIZER")
+        assert sanitizer_mode() == "asan"  # registry default
+
+    def test_none_mode_refuses_instead_of_falling_back(self, monkeypatch):
+        from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+        monkeypatch.setenv("QI_SANITIZER", "none")
+        with pytest.raises(RuntimeError, match="QI_SANITIZER=none"):
+            build_native_cli(sanitize=True)
+
+    def test_unknown_mode_rejected(self, monkeypatch):
+        from quorum_intersection_tpu.backends.cpp import (
+            build_native_cli,
+            sanitizer_mode,
+        )
+
+        monkeypatch.setenv("QI_SANITIZER", "msan")
+        with pytest.raises(ValueError, match="msan"):
+            sanitizer_mode()
+        with pytest.raises(ValueError, match="hwasan"):
+            build_native_cli(sanitize="hwasan")
 
 
 @pytest.mark.parametrize(

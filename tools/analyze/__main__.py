@@ -1,0 +1,200 @@
+"""``python -m tools.analyze`` — run the static-analysis suite.
+
+Usage::
+
+    python -m tools.analyze                      # all passes: lint typing race
+    python -m tools.analyze lint typing          # a subset
+    python -m tools.analyze --jsonl out.jsonl    # findings as qi-telemetry/1
+    python -m tools.analyze typing --update-ratchet
+
+Exit status: 0 when every pass ran clean, 1 on any finding (2 on usage
+errors).  ``--jsonl`` writes one ``qi-telemetry/1`` stream — a meta line,
+one ``analyze.finding`` event per finding, and per-pass counters — so
+``tools/metrics_report.py`` renders analyzer findings alongside run
+records and CI can upload them as the same artifact family.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from tools.analyze.lint import Finding, run_lint
+from tools.analyze.typing_gate import run_typing_gate
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+PASSES = ("lint", "typing", "race")
+
+
+def _race_pass(root: Path) -> tuple:
+    """``(findings, notes)``: forced-interleaving schedules (always) + a
+    TSAN build-and-run of the native CLI (when the toolchain has the
+    runtime — its absence is an environment note, not a finding; a
+    *requested* sanitizer that cannot run fails loudly inside
+    backends/cpp, which is the satellite's contract)."""
+    findings: List[Finding] = []
+    notes: List[str] = []
+
+    from tools.analyze.schedules import ScheduleError, run_all
+
+    try:
+        results = run_all()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/backends/auto.py",
+            line=1, message=str(exc),
+        ))
+        results = []
+    for r in results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (sequential chain says "
+                f"{r.expected}; winner={r.winner})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/backends/auto.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if results:
+        notes.append(
+            f"race schedules: {len(results)} forced interleavings, "
+            f"verdicts identical to the sequential chain"
+        )
+
+    from quorum_intersection_tpu.backends.cpp import build_native_cli
+
+    try:
+        tsan_cli = str(build_native_cli(sanitize="tsan"))
+    except Exception as exc:  # noqa: BLE001 — toolchain-dependent
+        notes.append(f"tsan build skipped: {exc}")
+        return findings, notes
+    tsan_findings_before = len(findings)
+    for name, want_rc in (("trivial_correct.json", 0), ("trivial_broken.json", 1)):
+        fixture = root / "fixtures" / name
+        proc = subprocess.run(
+            [tsan_cli], input=fixture.read_text(encoding="utf-8"),
+            capture_output=True, text=True, timeout=300,
+        )
+        if "WARNING: ThreadSanitizer" in proc.stderr:
+            findings.append(Finding(
+                rule="tsan", path=f"fixtures/{name}", line=1,
+                message="ThreadSanitizer report from the native CLI: "
+                        + proc.stderr.splitlines()[0],
+            ))
+        elif proc.returncode != want_rc:
+            findings.append(Finding(
+                rule="tsan", path=f"fixtures/{name}", line=1,
+                message=(
+                    f"tsan-instrumented CLI exited {proc.returncode}, "
+                    f"expected {want_rc}"
+                ),
+            ))
+    if len(findings) == tsan_findings_before:
+        notes.append(
+            f"tsan native CLI clean on the trivial fixture pair ({tsan_cli})"
+        )
+    return findings, notes
+
+
+def _emit_jsonl(path: str, per_pass: dict, t0: float) -> None:
+    lines: List[dict] = [{
+        "kind": "meta", "schema": "qi-telemetry/1", "pid": os.getpid(),
+        "argv0": "tools.analyze", "t_wall": round(time.time(), 3),
+    }]
+    total = 0
+    for pass_name, findings in per_pass.items():
+        for f in findings:
+            total += 1
+            lines.append({
+                "kind": "event", "name": "analyze.finding",
+                "t_s": round(time.monotonic() - t0, 6), "span_id": None,
+                "attrs": {
+                    "pass": pass_name, "rule": f.rule, "file": f.path,
+                    "line": f.line, "message": f.message,
+                },
+            })
+        lines.append({
+            "kind": "counter", "name": f"analyze.{pass_name}_findings",
+            "value": len(findings),
+        })
+    lines.append({"kind": "counter", "name": "analyze.findings", "value": total})
+    with open(path, "w", encoding="utf-8") as fh:
+        for line in lines:
+            fh.write(json.dumps(line, default=str) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument(
+        "passes", nargs="*", default=[], metavar="PASS",
+        help=f"which passes to run (default: all of {', '.join(PASSES)})",
+    )
+    parser.add_argument(
+        "--jsonl", metavar="PATH",
+        help="write findings as a qi-telemetry/1 JSONL stream",
+    )
+    parser.add_argument(
+        "--update-ratchet", action="store_true",
+        help="record improved typing measurements into the ratchet file",
+    )
+    args = parser.parse_args(argv)
+
+    passes = args.passes or list(PASSES)
+    for p in passes:
+        if p not in PASSES:
+            parser.error(f"unknown pass {p!r}; choose from {', '.join(PASSES)}")
+
+    t0 = time.monotonic()
+    per_pass: dict = {}
+    notes: List[str] = []
+    for pass_name in passes:
+        if pass_name == "lint":
+            per_pass["lint"] = run_lint(REPO_ROOT)
+        elif pass_name == "typing":
+            findings, ns = run_typing_gate(
+                REPO_ROOT, update_ratchet=args.update_ratchet
+            )
+            per_pass["typing"] = findings
+            notes.extend(ns)
+        elif pass_name == "race":
+            findings, ns = _race_pass(REPO_ROOT)
+            per_pass["race"] = findings
+            notes.extend(ns)
+
+    total = 0
+    for pass_name in passes:
+        findings = per_pass[pass_name]
+        total += len(findings)
+        status = "clean" if not findings else f"{len(findings)} finding(s)"
+        print(f"[analyze] pass {pass_name}: {status}")
+        for f in findings:
+            print(f"  {f.render()}")
+    for note in notes:
+        print(f"[analyze] note: {note}")
+
+    if args.jsonl:
+        _emit_jsonl(args.jsonl, per_pass, t0)
+        print(f"[analyze] findings stream: {args.jsonl}")
+
+    print(f"[analyze] {'CLEAN' if total == 0 else 'FAILED'} "
+          f"({total} finding(s), {time.monotonic() - t0:.1f}s)")
+    return 0 if total == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

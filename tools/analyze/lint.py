@@ -1,0 +1,572 @@
+"""qi-lint: custom AST rules for this codebase's real failure modes.
+
+Each rule is a function ``(ctx) -> Iterator[Finding]`` over one parsed
+file; the catalog with per-rule rationale lives in docs/STATIC_ANALYSIS.md.
+Suppress a single line with ``# qi-lint: allow(rule-name) — reason`` on the
+flagged line or the line directly above it (multiple rules comma-separate);
+the reason is mandatory by convention and review, not by the parser.
+
+The scanner is pure ``ast`` — fixture files under test are never imported,
+so a rule can be tested against deliberately-broken code (tests/
+analyze_fixtures/) without that code ever running.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+# ---------------------------------------------------------------------------
+# findings + per-file context
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_SUPPRESS_RE = re.compile(r"#\s*qi-lint:\s*allow\(([A-Za-z0-9_,\- ]+)\)")
+
+
+class FileContext:
+    """Parsed source + helpers shared by every rule."""
+
+    def __init__(self, path: Path, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.FunctionDef]:
+        """Innermost-first chain of defs lexically containing ``node``."""
+        return [
+            a for a in self.ancestors(node)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[ln - 1])
+                if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                    return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Iterator[Finding]:
+        line = getattr(node, "lineno", 1)
+        if not self.suppressed(rule, line):
+            yield Finding(rule=rule, path=self.rel, line=line, message=message)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    """Every bare identifier referenced anywhere under ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _idents_in(node: ast.AST) -> Set[str]:
+    """Names AND attribute components under ``node`` (for 'does anything in
+    this scope mention a cancel token' style checks)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.arg):
+            out.add(n.arg)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: import-at-top
+
+# Modules whose import cost is noise: lazy-importing them buys nothing and
+# hides a file's dependencies.  Deliberately NOT here: jax, numpy, and
+# everything under quorum_intersection_tpu — the repo's lazy-import
+# discipline keeps jax (and backends that pull it) out of pure-CPU import
+# paths, and that discipline must stay expressible.
+CHEAP_STDLIB = frozenset({
+    "abc", "argparse", "atexit", "collections", "contextlib", "dataclasses",
+    "enum", "functools", "hashlib", "io", "itertools", "json", "logging",
+    "math", "os", "pathlib", "re", "shutil", "struct", "subprocess", "sys",
+    "tempfile", "textwrap", "threading", "time", "typing",
+})
+
+
+def rule_import_at_top(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if not ctx.enclosing_functions(node):
+            continue  # module scope (or class body): fine
+        if isinstance(node, ast.ImportFrom):
+            roots = [(node.module or "").split(".")[0]] if node.level == 0 else []
+        else:
+            roots = [alias.name.split(".")[0] for alias in node.names]
+        for root in roots:
+            if root in CHEAP_STDLIB:
+                yield from ctx.finding(
+                    "import-at-top", node,
+                    f"function-level import of cheap stdlib module {root!r}; "
+                    f"move it to module scope (lazy imports are for jax/"
+                    f"device/optional deps, not the standard library)",
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
+# rule: no-bare-env-read
+
+
+def _qi_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("QI_"):
+        return node.value
+    return None
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` or a bare ``environ``."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def rule_no_bare_env_read(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.rel.endswith("utils/env.py"):
+        return  # the one module allowed to touch os.environ for QI_* keys
+    for node in ast.walk(ctx.tree):
+        key: Optional[str] = None
+        if isinstance(node, ast.Call) and node.args:
+            f = node.func
+            # os.environ.get("QI_X") / environ.get("QI_X")
+            if isinstance(f, ast.Attribute) and f.attr == "get" \
+                    and _is_environ(f.value):
+                key = _qi_literal(node.args[0])
+            # os.getenv("QI_X")
+            elif isinstance(f, ast.Attribute) and f.attr == "getenv":
+                key = _qi_literal(node.args[0])
+            elif isinstance(f, ast.Name) and f.id == "getenv":
+                key = _qi_literal(node.args[0])
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+                and _is_environ(node.value):
+            key = _qi_literal(node.slice)
+        if key is not None:
+            yield from ctx.finding(
+                "no-bare-env-read", node,
+                f"bare read of {key}; route it through the registry "
+                f"(quorum_intersection_tpu/utils/env.py qi_env/qi_env_flag) "
+                f"so the documented catalog stays true",
+            )
+
+
+# ---------------------------------------------------------------------------
+# rule: span-balance
+
+
+def rule_span_balance(ctx: FileContext) -> Iterator[Finding]:
+    """Every RunRecord span must be entered as a ``with`` context item: a
+    span opened by hand (``sp = rec.span(...)`` + manual ``__enter__``) can
+    miss its exit on an exception path, leaving the telemetry stream with a
+    dangling enter — the imbalance this rule exists to make impossible."""
+    with_items = {
+        id(item.context_expr)
+        for node in ast.walk(ctx.tree) if isinstance(node, ast.With)
+        for item in node.items
+    }
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "span"):
+            continue
+        recv = node.func.value
+        looks_like_record = (
+            (isinstance(recv, ast.Call)
+             and ((isinstance(recv.func, ast.Name)
+                   and recv.func.id == "get_run_record")
+                  or (isinstance(recv.func, ast.Attribute)
+                      and recv.func.attr == "get_run_record")))
+            or (isinstance(recv, ast.Name) and recv.id in ("rec", "record"))
+        )
+        if looks_like_record and id(node) not in with_items:
+            yield from ctx.finding(
+                "span-balance", node,
+                "RunRecord.span(...) used outside a `with` statement; a "
+                "hand-opened span can leak its enter on an exception path — "
+                "use `with rec.span(...) as sp:`",
+            )
+
+
+# ---------------------------------------------------------------------------
+# rule: lock-discipline
+
+# Attributes of lock-owning telemetry objects that must only mutate under
+# their lock (RunRecord's counters/gauges/span+event lists and bookkeeping).
+_GUARDED_ATTRS = frozenset({
+    "counters", "gauges", "spans", "events", "dropped", "_sinks", "_next_id",
+})
+_MUTATING_METHODS = frozenset({
+    "append", "setdefault", "update", "pop", "clear", "extend", "remove",
+})
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    """A with-item that acquires a lock: ``self._lock``, ``record._lock``,
+    bare ``lock`` — any terminal identifier containing 'lock'."""
+    if isinstance(node, ast.Attribute):
+        return "lock" in node.attr.lower()
+    if isinstance(node, ast.Name):
+        return "lock" in node.id.lower()
+    if isinstance(node, ast.Call):  # lock.acquire()-style helpers
+        return _is_lock_expr(node.func)
+    return False
+
+
+def _lock_owning_classes(ctx: FileContext) -> Set[str]:
+    """Classes that assign a ``*lock*`` attribute on self — only their
+    guarded attrs are policed, so a dataclass that happens to have a field
+    named ``events`` elsewhere stays out of scope."""
+    owners: Set[str] = set()
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and "lock" in tgt.attr.lower():
+                        owners.add(cls.name)
+    return owners
+
+
+def rule_lock_discipline(ctx: FileContext) -> Iterator[Finding]:
+    owners = _lock_owning_classes(ctx)
+
+    lock_depth_of: Dict[int, int] = {}
+
+    def walk(node: ast.AST, depth: int) -> None:
+        # Every node records the depth it sits at — including a With node
+        # itself (its OWN acquisition counts only for its body), so a
+        # lock-With nested in another lock-With sees depth > 0.
+        lock_depth_of[id(node)] = depth
+        if isinstance(node, ast.With):
+            inner = depth + sum(
+                1 for item in node.items if _is_lock_expr(item.context_expr)
+            )
+            for item in node.items:
+                walk(item, depth)
+            for child in node.body:
+                walk(child, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, depth)
+
+    walk(ctx.tree, 0)
+
+    def depth(node: ast.AST) -> int:
+        return lock_depth_of.get(id(node), 0)
+
+    def in_lock_owner_method(node: ast.AST) -> bool:
+        return any(
+            isinstance(a, ast.ClassDef) and a.name in owners
+            for a in ctx.ancestors(node)
+        )
+
+    def exempt(node: ast.AST) -> bool:
+        fns = ctx.enclosing_functions(node)
+        return bool(fns) and fns[0].name == "__init__"
+
+    for node in ast.walk(ctx.tree):
+        # (a) guarded-attr mutation outside the lock
+        if owners:
+            tgt_attr: Optional[ast.Attribute] = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        tgt = tgt.value
+                    if isinstance(tgt, ast.Attribute) and tgt.attr in _GUARDED_ATTRS:
+                        tgt_attr = tgt
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATING_METHODS \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and node.func.value.attr in _GUARDED_ATTRS:
+                tgt_attr = node.func.value
+            if tgt_attr is not None and in_lock_owner_method(node) \
+                    and not exempt(node) and depth(node) == 0:
+                yield from ctx.finding(
+                    "lock-discipline", node,
+                    f"mutation of guarded attribute {tgt_attr.attr!r} outside "
+                    f"its lock; the race's threads mutate these concurrently "
+                    f"— wrap in `with self._lock:`",
+                )
+        # (b) nested lock acquisition (lock-ordering hazard)
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if _is_lock_expr(item.context_expr) and depth(node) > 0:
+                    yield from ctx.finding(
+                        "lock-discipline", node,
+                        "nested lock acquisition; the telemetry record and "
+                        "its sinks each have their own lock — taking one "
+                        "inside another invites lock-order inversion",
+                    )
+        # (c) sink emit under the record lock (emit takes the sink's lock)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("emit", "_emit") and depth(node) > 0:
+            yield from ctx.finding(
+                "lock-discipline", node,
+                "sink emit while holding a lock; emit acquires the sink's "
+                "own lock — copy the data out, release, then emit",
+            )
+
+
+# ---------------------------------------------------------------------------
+# rule: cancel-token-plumbed
+
+_THREAD_SPAWNERS = frozenset({"Thread", "_thread_factory"})
+_CANCELLABLE_NATIVE = frozenset({"qi_check_scc_cancel"})
+
+
+def rule_cancel_token_plumbed(ctx: FileContext) -> Iterator[Finding]:
+    """A function that spawns a thread or enters the cancellable native
+    search must have a CancelToken within lexical reach (a parameter,
+    ``self.cancel``/``self._cancel``, or a token constructed in scope) —
+    otherwise the racing auto router cannot stop the work it started, and a
+    losing engine runs to completion on a thread nobody can reach."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name in _THREAD_SPAWNERS:
+            what = "thread spawn"
+        elif name in _CANCELLABLE_NATIVE:
+            what = f"native call {name}"
+        else:
+            continue
+        fns = ctx.enclosing_functions(node)
+        scope: ast.AST = fns[-1] if fns else ctx.tree
+        idents = _idents_in(scope)
+        if not any("cancel" in ident.lower() for ident in idents):
+            yield from ctx.finding(
+                "cancel-token-plumbed", node,
+                f"{what} with no CancelToken in reach; accept and forward a "
+                f"`cancel` token (backends/base.CancelToken) so the race "
+                f"driver can stop this work",
+            )
+
+
+# ---------------------------------------------------------------------------
+# rule: jax-tracer-leak
+
+_JIT_NAMES = frozenset({"jit"})
+_TRACED_MODULES = frozenset({"jnp", "lax", "jax"})
+_LAX_CONTROL_FLOW = frozenset({
+    "while_loop", "fori_loop", "scan", "cond", "switch",
+})
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this decorator / callee expression denote jax.jit (possibly via
+    functools.partial)?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _JIT_NAMES:
+            return True
+        if isinstance(n, ast.Name) and n.id in _JIT_NAMES:
+            return True
+    return False
+
+
+def _traced_function_defs(ctx: FileContext) -> List[ast.FunctionDef]:
+    """Functions whose bodies run under a jax trace: decorated with
+    ``@jax.jit`` (or partial thereof), or referenced by name inside a
+    ``jax.jit(...)`` call's arguments (``jax.jit(shard_map(fn, ...))``)."""
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+    traced: List[ast.FunctionDef] = []
+    seen: Set[int] = set()
+
+    def mark(fn: ast.FunctionDef) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            traced.append(fn)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args:
+                for name in _names_in(arg):
+                    for fn in defs.get(name, []):
+                        mark(fn)
+    return traced
+
+
+def _taint_flag_traced(
+    ctx: FileContext, fn: ast.FunctionDef, inherited: Set[str]
+) -> Iterator[Finding]:
+    """Walk one traced function: taint its parameters plus anything derived
+    from jnp/lax/jax expressions, flag Python control flow on tainted
+    values, and recurse into nested callbacks handed to lax control flow."""
+    a = fn.args
+    taint: Set[str] = set(inherited)
+    for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+        if arg.arg not in ("self", "cls"):
+            taint.add(arg.arg)
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in taint:
+                return True
+            if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                    and n.value.id in _TRACED_MODULES:
+                return True
+        return False
+
+    lax_callbacks: Set[str] = set()
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.FunctionDef) and node is not fn:
+            return  # nested defs handled separately below
+        if isinstance(node, ast.Assign) and expr_tainted(node.value):
+            for tgt in node.targets:
+                taint.update(_names_in(tgt))
+        elif isinstance(node, ast.AugAssign) and expr_tainted(node.value):
+            taint.update(_names_in(node.target))
+        elif isinstance(node, (ast.If, ast.While)) and expr_tainted(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.extend(ctx.finding(
+                "jax-tracer-leak", node,
+                f"Python `{kind}` on a traced value inside a @jit region; "
+                f"trace-time branching silently bakes one branch into the "
+                f"compiled program (use lax.cond / jnp.where)",
+            ))
+        elif isinstance(node, ast.Assert) and expr_tainted(node.test):
+            findings.extend(ctx.finding(
+                "jax-tracer-leak", node,
+                "Python `assert` on a traced value inside a @jit region; "
+                "the tracer cannot be truth-tested at run time",
+            ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ("bool", "int", "float") \
+                    and node.args and expr_tainted(node.args[0]):
+                findings.extend(ctx.finding(
+                    "jax-tracer-leak", node,
+                    f"`{f.id}()` on a traced value inside a @jit region "
+                    f"forces concretization and fails under trace",
+                ))
+            if isinstance(f, ast.Attribute) and f.attr in _LAX_CONTROL_FLOW:
+                for arg in node.args:
+                    lax_callbacks.update(_names_in(arg))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    yield from findings
+
+    # Nested callbacks handed to lax control flow run traced with traced
+    # arguments (loop carries): analyze them with their params tainted.
+    for node in fn.body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.FunctionDef) and sub is not fn \
+                    and sub.name in lax_callbacks:
+                yield from _taint_flag_traced(ctx, sub, taint)
+
+
+def rule_jax_tracer_leak(ctx: FileContext) -> Iterator[Finding]:
+    for fn in _traced_function_defs(ctx):
+        yield from _taint_flag_traced(ctx, fn, set())
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+RULES = {
+    "import-at-top": rule_import_at_top,
+    "no-bare-env-read": rule_no_bare_env_read,
+    "span-balance": rule_span_balance,
+    "lock-discipline": rule_lock_discipline,
+    "cancel-token-plumbed": rule_cancel_token_plumbed,
+    "jax-tracer-leak": rule_jax_tracer_leak,
+}
+
+# What the repo-wide scan covers: the package, the tooling, and the bench
+# drivers.  tests/ are deliberately out of scope — they monkeypatch, spawn
+# bare threads, and read env vars as part of their job.
+DEFAULT_SCAN = (
+    "quorum_intersection_tpu",
+    "tools",
+    "bench.py",
+    "benchmarks",
+)
+
+
+def iter_python_files(root: Path,
+                      scan: Optional[Sequence[str]] = None) -> List[Path]:
+    out: List[Path] = []
+    for entry in scan if scan is not None else DEFAULT_SCAN:
+        p = root / entry
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+    return out
+
+
+def lint_file(path: Path, root: Optional[Path] = None,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    rel = str(path.relative_to(root)) if root else str(path)
+    try:
+        source = path.read_text(encoding="utf-8")
+        ctx = FileContext(path, rel, source)
+    except (OSError, SyntaxError) as exc:
+        return [Finding(rule="parse-error", path=rel, line=getattr(exc, "lineno", 1) or 1,
+                        message=f"cannot parse: {exc}")]
+    findings: List[Finding] = []
+    for name in (rules or RULES):
+        findings.extend(RULES[name](ctx))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def run_lint(root: Path, scan: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(root, scan):
+        findings.extend(lint_file(path, root=root, rules=rules))
+    return findings

@@ -1,0 +1,324 @@
+"""Deterministic-interleaving harness for the auto-router race (ISSUE 3).
+
+The race in ``backends/auto.py`` has exactly three nasty orderings the wall
+clock almost never produces on a laptop but production traffic will:
+
+- **sweep-wins-then-oracle-finishes** — the sweep's verdict lands first,
+  its cancel reaches the oracle too late, and BOTH engines finish.  The
+  driver must prefer the oracle's result (witness-stable vs the sequential
+  path) and still report a coherent race record.
+- **cancel-during-compile** — the oracle wins while the sweep worker is
+  inside its compile/spin-up phase; the cancel must be observed there (not
+  just in the window loop) and the worker must unwind without recording
+  progress.
+- **both-finish-simultaneously** — the sweep's verdict is recorded but its
+  cancel has not fired when the oracle's own verdict completes; the driver
+  sees two finished engines in the same scheduling quantum.
+
+Instead of sleeping and hoping, this harness monkeypatches the
+``_race_sync`` hook ``backends/auto.py`` exposes and gates the fake
+engines on the hook's *reached* events, so each ordering is FORCED, every
+run, in milliseconds.  Verdicts are delegated to the real Python oracle so
+they are real; the invariant checked is the ISSUE 3 acceptance criterion —
+**identical verdicts under every interleaving**, equal to the sequential
+(``race=False``) chain's verdict.
+
+Used by ``python -m tools.analyze`` (race pass) and
+``tests/test_race_schedules.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+# Bounded waits everywhere: a schedule that deadlocks fails loudly with the
+# point name instead of hanging the analyze run or the test suite.
+WAIT_S = 30.0
+
+
+class ScheduleError(AssertionError):
+    """A forced interleaving did not complete (gate timeout / wrong path)."""
+
+
+class SyncController:
+    """Replacement for ``backends.auto._race_sync``.
+
+    Records every point the race reaches (``reached[point]`` is set the
+    moment any thread passes it) and optionally *holds* a point until
+    another event fires — the mechanism that serializes the two race
+    threads into the exact ordering a schedule wants.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reached: Dict[str, threading.Event] = {}
+        self._holds: Dict[str, threading.Event] = {}
+        self.trace: List[str] = []
+
+    def reached_event(self, point: str) -> threading.Event:
+        with self._lock:
+            return self.reached.setdefault(point, threading.Event())
+
+    def hold(self, point: str, until: threading.Event) -> None:
+        """Block any thread passing ``point`` until ``until`` fires."""
+        with self._lock:
+            self._holds[point] = until
+
+    def __call__(self, point: str) -> None:
+        with self._lock:
+            self.trace.append(point)
+            gate = self._holds.get(point)
+        self.reached_event(point).set()
+        if gate is not None and not gate.wait(WAIT_S):
+            raise ScheduleError(f"sync point {point!r} held past {WAIT_S}s")
+
+
+class FakeOracle:
+    """Host-oracle stand-in: real verdict (delegated to the Python oracle),
+    scheduled lifecycle.  ``wait_for`` delays the verdict; ``ignore_cancel``
+    models a cancel that lands after the search already finished;
+    ``burn_budget`` raises OracleBudgetExceeded instead of answering."""
+
+    name = "cpp"
+
+    def __init__(self, cancel=None, wait_for: Optional[threading.Event] = None,
+                 ignore_cancel: bool = False, burn_budget: bool = False) -> None:
+        self.cancel = cancel
+        self.wait_for = wait_for
+        self.ignore_cancel = ignore_cancel
+        self.burn_budget = burn_budget
+
+    def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+        from quorum_intersection_tpu.backends.base import (
+            OracleBudgetExceeded,
+            SearchCancelled,
+        )
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend,
+        )
+
+        if self.wait_for is not None and not self.wait_for.wait(WAIT_S):
+            raise ScheduleError("oracle gate never released")
+        if self.burn_budget:
+            raise OracleBudgetExceeded("scheduled budget burn")
+        if (not self.ignore_cancel and self.cancel is not None
+                and self.cancel.cancelled):
+            raise SearchCancelled("scheduled oracle cancel")
+        res = PythonOracleBackend().check_scc(
+            graph, circuit, scc, scope_to_scc=scope_to_scc
+        )
+        res.stats["backend"] = self.name
+        return res
+
+
+class FakeSweep:
+    """Sweep stand-in with an explicit compile phase.
+
+    ``compiling`` is set when the engine enters its (fake) spin-up;
+    ``cancel_in_compile=True`` parks it there until the cancel token fires
+    — the cancel-during-compile ordering — and raises SearchCancelled, the
+    real sweep's pre-dispatch cancel behavior.  Otherwise the engine
+    produces a real verdict (optionally after ``wait_for``)."""
+
+    name = "tpu-sweep"
+
+    def __init__(self, cancel=None, compiling: Optional[threading.Event] = None,
+                 cancel_in_compile: bool = False,
+                 wait_for: Optional[threading.Event] = None) -> None:
+        self.cancel = cancel
+        self.compiling = compiling
+        self.cancel_in_compile = cancel_in_compile
+        self.wait_for = wait_for
+
+    def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+        from quorum_intersection_tpu.backends.base import SearchCancelled
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend,
+        )
+
+        if self.compiling is not None:
+            self.compiling.set()
+        if self.cancel_in_compile:
+            assert self.cancel is not None
+            if not self.cancel._event.wait(WAIT_S):
+                raise ScheduleError("sweep was never cancelled in compile")
+            raise SearchCancelled("sweep cancelled during compile")
+        if self.wait_for is not None and not self.wait_for.wait(WAIT_S):
+            raise ScheduleError("sweep gate never released")
+        if self.cancel is not None and self.cancel.cancelled:
+            raise SearchCancelled("sweep observed cancel before verdict")
+        res = PythonOracleBackend().check_scc(
+            graph, circuit, scc, scope_to_scc=scope_to_scc
+        )
+        res.stats["backend"] = self.name
+        return res
+
+
+@dataclass
+class ScheduleResult:
+    schedule: str
+    topology: str
+    verdict: bool
+    expected: bool
+    winner: str
+    oracle_outcome: str
+    trace: List[str] = field(default_factory=list)
+    # Non-None when the interleaving did not actually happen: the worker
+    # errored (auto.py's sweep_worker swallows engine exceptions into
+    # outcome["sweep_error"] — including a ScheduleError from a timed-out
+    # gate), or a sync point the ordering is DEFINED by never fired.  A
+    # matching verdict with a broken ordering must not report clean: the
+    # whole point of the harness is that the ordering was exercised.
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.verdict == self.expected
+
+
+def _run_one(schedule: str, data: object, expected: bool,
+             topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.backends.auto as auto_mod
+    from quorum_intersection_tpu.backends.auto import AutoBackend
+    from quorum_intersection_tpu.pipeline import solve
+
+    ctl = SyncController()
+
+    if schedule == "sweep_wins_then_oracle_finishes":
+        # Sweep answers immediately; oracle waits until the sweep's verdict
+        # is recorded, then finishes anyway (its cancel arrives mid-flight
+        # and is deliberately ignored — too late to matter).
+        def make_oracle(self, budget_s=None, cancel=None):
+            return FakeOracle(
+                cancel=cancel,
+                wait_for=ctl.reached_event("sweep.verdict"),
+                ignore_cancel=True,
+            )
+
+        def make_sweep(self, cancel=None):
+            return FakeSweep(cancel=cancel)
+
+    elif schedule == "cancel_during_compile":
+        # Oracle answers the moment the sweep has entered its compile
+        # phase; the sweep parks in compile until the cancel lands.
+        compiling = threading.Event()
+
+        def make_oracle(self, budget_s=None, cancel=None):
+            return FakeOracle(cancel=cancel, wait_for=compiling)
+
+        def make_sweep(self, cancel=None):
+            return FakeSweep(
+                cancel=cancel, compiling=compiling, cancel_in_compile=True
+            )
+
+    elif schedule == "both_finish_simultaneously":
+        # Both engines produce verdicts; the worker is HELD between
+        # recording its result and firing the oracle's cancel until the
+        # oracle's own verdict has completed — the driver then sees two
+        # finished engines at once.
+        ctl.hold("sweep.verdict", ctl.reached_event("oracle.returned"))
+
+        def make_oracle(self, budget_s=None, cancel=None):
+            return FakeOracle(
+                cancel=cancel,
+                wait_for=ctl.reached_event("sweep.started"),
+                ignore_cancel=True,
+            )
+
+        def make_sweep(self, cancel=None):
+            return FakeSweep(cancel=cancel)
+
+    elif schedule == "budget_burn_then_sweep_verdict":
+        # The sequential fallback ordering, forced: the oracle burns its
+        # budget first, the already-spinning sweep then delivers.
+        def make_oracle(self, budget_s=None, cancel=None):
+            return FakeOracle(cancel=cancel, burn_budget=True)
+
+        def make_sweep(self, cancel=None):
+            return FakeSweep(
+                cancel=cancel, wait_for=ctl.reached_event("oracle.returned")
+            )
+
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+
+    class ScheduledAuto(AutoBackend):
+        _cpu_oracle = make_oracle
+        _sweep = make_sweep
+
+    old_sync = auto_mod._race_sync
+    auto_mod._race_sync = ctl
+    try:
+        res = solve(data, backend=ScheduledAuto())
+    finally:
+        auto_mod._race_sync = old_sync
+
+    race = res.stats.get("race", {})
+    error: Optional[str] = None
+    for key in ("sweep_error", "sweep_ineligible"):
+        if key in race:
+            error = f"{key}: {race[key]}"
+    missing = [p for p in _REQUIRED_POINTS[schedule] if p not in ctl.trace]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=res.intersects,
+        expected=expected,
+        winner=str(race.get("winner", "?")),
+        oracle_outcome=str(race.get("oracle_outcome", "?")),
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+SCHEDULES = (
+    "sweep_wins_then_oracle_finishes",
+    "cancel_during_compile",
+    "both_finish_simultaneously",
+    "budget_burn_then_sweep_verdict",
+)
+
+# The sync points each ordering is DEFINED by: absent from the trace, the
+# schedule degenerated (a gate timed out, an engine errored and auto.py's
+# degrade path hid it) and must be reported broken even if the verdict
+# happens to match.
+_REQUIRED_POINTS: Dict[str, tuple] = {
+    "sweep_wins_then_oracle_finishes": ("sweep.verdict", "oracle.returned"),
+    "cancel_during_compile": ("oracle.returned", "sweep.unwound"),
+    "both_finish_simultaneously": ("sweep.verdict", "oracle.returned"),
+    "budget_burn_then_sweep_verdict": ("oracle.returned", "sweep.verdict"),
+}
+
+
+def run_all(join_timeout: float = 5.0) -> List[ScheduleResult]:
+    """Every schedule × {intersecting, broken} topology.  The expected
+    verdict is computed by the sequential (race=False) chain with the real
+    engines — the ground truth every forced interleaving must reproduce.
+    Leaked race workers are a failure, not a warning."""
+    from quorum_intersection_tpu.backends.auto import AutoBackend
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend=AutoBackend(race=False)).intersects
+        for schedule in SCHEDULES:
+            results.append(_run_one(schedule, data, expected, topology))
+    leaked = [
+        t for t in threading.enumerate() if t.name == "qi-race-sweep"
+    ]
+    for t in leaked:
+        t.join(timeout=join_timeout)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        raise ScheduleError(
+            f"{len(leaked)} race worker thread(s) still alive after "
+            f"{join_timeout}s — a schedule leaked its loser"
+        )
+    return results

@@ -1,0 +1,216 @@
+"""Ratcheted typing gate over the modules where a type error costs a verdict.
+
+Targets (the data-path spine): ``fbas/``, ``encode/``,
+``utils/telemetry.py``, ``backends/auto.py``.
+
+Two engines, both driven by one ratchet file
+(``tools/analyze/typing_ratchet.json``):
+
+- **builtin** (always runs, zero dependencies): AST annotation coverage per
+  module — the fraction of module/class-level function definitions whose
+  return AND every parameter (``self``/``cls`` excluded, ``*args``/
+  ``**kwargs`` included) carry annotations.  Nested defs (jit bodies, race
+  workers, closures) are exempt: they are implementation detail whose types
+  flow from the enclosing scope.  The ratchet records each module's
+  coverage; a drop below the recorded value is a finding, and a NEW target
+  module must enter at 1.0 — annotations can only accumulate.
+- **mypy --strict** (runs when mypy is importable — CI installs it; the
+  pinned container image does not carry it, which is exactly why the
+  builtin floor exists): per-module error counts are compared against the
+  ratchet's ``mypy_errors`` map.  A module with a recorded count may never
+  exceed it; a module recorded at 0 is strict-clean forever.  Unrecorded
+  modules are reported (not failed) with the command to ratchet them:
+  ``python -m tools.analyze typing --update-ratchet``.
+
+The ratchet only tightens on ``--update-ratchet`` when the measured value
+IMPROVED; loosening it requires editing the JSON by hand in a reviewed
+diff, which is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analyze.lint import Finding
+
+RATCHET_PATH = Path(__file__).with_name("typing_ratchet.json")
+RATCHET_SCHEMA = "qi-typing-ratchet/1"
+
+TYPING_TARGETS = (
+    "quorum_intersection_tpu/fbas",
+    "quorum_intersection_tpu/encode",
+    "quorum_intersection_tpu/utils/telemetry.py",
+    "quorum_intersection_tpu/backends/auto.py",
+)
+
+
+def target_files(root: Path) -> List[Path]:
+    out: List[Path] = []
+    for entry in TYPING_TARGETS:
+        p = root / entry
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# builtin engine: annotation coverage
+
+
+def _is_annotated(fn: ast.FunctionDef) -> bool:
+    if fn.returns is None:
+        return False
+    a = fn.args
+    params = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    if params and params[0].arg in ("self", "cls"):
+        params = params[1:]
+    params += [p for p in (a.vararg, a.kwarg) if p is not None]
+    return all(p.annotation is not None for p in params)
+
+
+def annotation_coverage(path: Path) -> Tuple[float, int]:
+    """``(coverage, total)`` over module/class-level defs (nested exempt)."""
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    total = 0
+    annotated = 0
+
+    def scan(body: Sequence[ast.stmt]) -> None:
+        nonlocal total, annotated
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                total += 1
+                annotated += int(_is_annotated(node))
+            elif isinstance(node, ast.ClassDef):
+                scan(node.body)
+
+    scan(tree.body)
+    return (annotated / total if total else 1.0), total
+
+
+# ---------------------------------------------------------------------------
+# mypy engine
+
+
+def run_mypy(root: Path) -> Optional[Dict[str, int]]:
+    """Per-module strict error counts, or None when mypy is unavailable."""
+    try:
+        from mypy import api as mypy_api
+    except ImportError:
+        return None
+    targets = [str(p) for p in target_files(root)]
+    stdout, _, _ = mypy_api.run(
+        ["--strict", "--no-error-summary", "--show-error-codes", *targets]
+    )
+    counts: Dict[str, int] = {t: 0 for t in targets}
+    for line in stdout.splitlines():
+        parts = line.split(":", 2)
+        if len(parts) >= 3 and " error:" in line:
+            counts[parts[0]] = counts.get(parts[0], 0) + 1
+    return {
+        str(Path(k).resolve().relative_to(root.resolve())): v
+        for k, v in counts.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# ratchet
+
+
+def load_ratchet() -> Dict[str, object]:
+    if RATCHET_PATH.exists():
+        data = json.loads(RATCHET_PATH.read_text(encoding="utf-8"))
+        if data.get("schema") == RATCHET_SCHEMA:
+            return data
+    return {"schema": RATCHET_SCHEMA, "annotation_coverage": {}, "mypy_errors": {}}
+
+
+def run_typing_gate(root: Path, update_ratchet: bool = False) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)`` — notes are informational lines (skipped
+    engines, unratcheted modules), never failures."""
+    ratchet = load_ratchet()
+    cov_ratchet: Dict[str, float] = dict(ratchet.get("annotation_coverage", {}))  # type: ignore[arg-type]
+    mypy_ratchet: Dict[str, int] = dict(ratchet.get("mypy_errors", {}))  # type: ignore[arg-type]
+    findings: List[Finding] = []
+    notes: List[str] = []
+    changed = False
+
+    for path in target_files(root):
+        rel = str(path.relative_to(root))
+        coverage, total = annotation_coverage(path)
+        recorded = cov_ratchet.get(rel)
+        if recorded is None:
+            if coverage < 1.0 and not update_ratchet:
+                findings.append(Finding(
+                    rule="typing-ratchet", path=rel, line=1,
+                    message=(
+                        f"new typing-gate module enters at full annotation "
+                        f"coverage; measured {coverage:.2%} of {total} "
+                        f"functions (annotate them, or record a baseline "
+                        f"with --update-ratchet in a reviewed diff)"
+                    ),
+                ))
+            cov_ratchet[rel] = round(coverage, 4)
+            changed = True
+        elif coverage < float(recorded) - 1e-9:
+            findings.append(Finding(
+                rule="typing-ratchet", path=rel, line=1,
+                message=(
+                    f"annotation coverage regressed: {coverage:.2%} < "
+                    f"ratcheted {float(recorded):.2%} ({total} functions) — "
+                    f"annotate the new/changed signatures"
+                ),
+            ))
+        elif coverage > float(recorded) + 1e-9 and update_ratchet:
+            cov_ratchet[rel] = round(coverage, 4)
+            changed = True
+
+    mypy_counts = run_mypy(root)
+    if mypy_counts is None:
+        notes.append(
+            "mypy not importable in this environment; strict gate deferred "
+            "to CI (the builtin annotation floor above still ran)"
+        )
+    else:
+        for rel, count in sorted(mypy_counts.items()):
+            recorded_n = mypy_ratchet.get(rel)
+            if recorded_n is None:
+                if count:
+                    notes.append(
+                        f"mypy --strict: {rel} has {count} errors "
+                        f"(unratcheted; record with --update-ratchet)"
+                    )
+                if update_ratchet:
+                    mypy_ratchet[rel] = count
+                    changed = True
+            elif count > int(recorded_n):
+                findings.append(Finding(
+                    rule="typing-ratchet", path=rel, line=1,
+                    message=(
+                        f"mypy --strict errors regressed: {count} > "
+                        f"ratcheted {recorded_n}"
+                    ),
+                ))
+            elif count < int(recorded_n) and update_ratchet:
+                mypy_ratchet[rel] = count
+                changed = True
+
+    if update_ratchet and changed:
+        RATCHET_PATH.write_text(
+            json.dumps(
+                {
+                    "schema": RATCHET_SCHEMA,
+                    "annotation_coverage": dict(sorted(cov_ratchet.items())),
+                    "mypy_errors": dict(sorted(mypy_ratchet.items())),
+                },
+                indent=2,
+            ) + "\n",
+            encoding="utf-8",
+        )
+        notes.append(f"ratchet updated: {RATCHET_PATH}")
+
+    return findings, notes

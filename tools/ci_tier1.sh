@@ -13,7 +13,11 @@
 #     $TIER1_METRICS (default /tmp/_t1_metrics.jsonl): every in-process
 #     solve and every CLI subprocess the suite spawns appends to one
 #     qi-telemetry/1 JSONL file, so a perf regression spotted in CI is
-#     inspectable (tools/metrics_report.py) instead of anecdotal.
+#     inspectable (tools/metrics_report.py) instead of anecdotal;
+#   - the static-analysis suite (docs/STATIC_ANALYSIS.md) runs after the
+#     tests: `python -m tools.analyze` must exit clean, and its findings
+#     stream to $TIER1_ANALYZE in the same qi-telemetry/1 shape.  Either
+#     gate failing fails the script.
 #
 # Usage: tools/ci_tier1.sh [extra pytest args...]
 set -o pipefail
@@ -34,4 +38,12 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | 
 if [ -s "$METRICS" ]; then
     echo "TELEMETRY=$METRICS ($(wc -l < "$METRICS") lines)"
 fi
-exit "$rc"
+
+ANALYZE_OUT="${TIER1_ANALYZE:-/tmp/_t1_analyze.jsonl}"
+rm -f "$ANALYZE_OUT"
+env JAX_PLATFORMS=cpu python -m tools.analyze --jsonl "$ANALYZE_OUT"
+arc=$?
+echo "ANALYZE=$ANALYZE_OUT (exit $arc)"
+
+[ "$rc" -ne 0 ] && exit "$rc"
+exit "$arc"

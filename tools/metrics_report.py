@@ -167,6 +167,15 @@ def event_summary(events: List[dict]) -> str:
                 f"route @ {e.get('t_s', 0):.3f}s: |scc|={a.get('scc')} -> "
                 f"{a.get('engine')} ({a.get('reason')})"
             )
+    # Static-analysis findings ride the same stream (ISSUE 3: the analyze
+    # job's artifact is qi-telemetry/1 too, so one renderer serves both).
+    for e in events:
+        if e.get("name") == "analyze.finding":
+            a = e.get("attrs", {})
+            lines.append(
+                f"finding [{a.get('pass')}/{a.get('rule')}] "
+                f"{a.get('file')}:{a.get('line')}: {a.get('message')}"
+            )
     return "\n".join(lines) if lines else "(no events)"
 
 
