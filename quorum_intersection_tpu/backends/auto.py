@@ -42,13 +42,22 @@ metric), not TPU-nativeness for its own sake:
 
 Every selection is logged; failures to import/compile an accelerator backend
 degrade gracefully to the next option so the CLI always yields a verdict.
+Since ISSUE 4 the degradation is an explicit :class:`DegradationLadder`
+(tpu-sweep → tpu-frontier → native → python-oracle): bounded retries with
+deterministic backoff for transient device errors, a watchdog + quarantine
+for the in-process native call (``QI_NATIVE_WATCHDOG_S``), and a ``degrade``
+telemetry event on every transition — exercised deterministically by
+``utils/faults.py`` injection and the chaos soak (docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set, TypeVar
+
+_T = TypeVar("_T")
 
 from quorum_intersection_tpu.backends.base import (
     CancelToken,
@@ -59,6 +68,8 @@ from quorum_intersection_tpu.backends.base import (
 )
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
+from quorum_intersection_tpu.utils.env import qi_env_float, qi_env_int
+from quorum_intersection_tpu.utils.faults import TransientDeviceFault
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import Span, get_run_record
 
@@ -160,6 +171,357 @@ def _race_sync(point: str) -> None:
     """
 
 
+# ---------------------------------------------------------------------------
+# Degradation ladder (ISSUE 4 tentpole): the explicit object behind every
+# "engine unavailable; falling back" decision this router makes.  Before it,
+# a dozen scattered `except Exception` sites each invented their own policy
+# (no retries, no record of WHY a rung was skipped); now exactly one broad
+# catch exists — inside DegradationLadder.attempt — and the qi-lint rule
+# `degrade-via-ladder` keeps it that way.  Rung order (fastest exact engine
+# first, always-available last):
+#
+#     tpu-sweep  →  tpu-frontier  →  native (C++)  →  python-oracle
+#
+# Transitions are never silent: each emits a `degrade` run-record event
+# naming the failed rung, where control went, the cause, and the attempt
+# count.  TRANSIENT device errors (RESOURCE_EXHAUSTED / OOM class — the
+# errors a busy chip throws and then stops throwing) get a bounded retry
+# budget (QI_RETRY_MAX) with exponential backoff and DETERMINISTIC jitter
+# (hash-derived, not random.random — two runs of the same schedule back off
+# identically, the same reproducibility discipline as tools/analyze's race
+# schedules); everything else degrades on the first failure, exactly like
+# the old ad-hoc sites.
+
+RUNGS = ("tpu-sweep", "tpu-frontier", "native", "python-oracle")
+# Base backoff for transient-device retries; attempt n sleeps
+# RETRY_BACKOFF_S * 2^n * (1 + jitter), jitter ∈ [0, 0.25) derived from a
+# hash of (rung, attempt) so it is deterministic per site yet decorrelated
+# across rungs (thundering-herd protection that still replays exactly).
+RETRY_BACKOFF_S = 0.05
+# Watchdog poll granularity: how often the supervising thread re-checks the
+# native call's liveness and forwards an outer (race) cancel inward.
+WATCHDOG_POLL_S = 0.05
+
+# Seam for tests: the ladder's backoff sleeps route through this module
+# attribute so retry tests run in milliseconds (the analyze suite's "no
+# sleeps in tests" discipline — patch the seam, don't wait the wall clock).
+_retry_sleep: Callable[[float], None] = time.sleep
+
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "OUT_OF_MEMORY",
+    "out of memory",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+)
+
+
+class RungFailed(RuntimeError):
+    """One ladder rung failed (retry budget included); control falls to the
+    next rung.  Typed — the router catches exactly this, never a bare
+    ``Exception`` — and carries the rung, the root cause, and how many
+    attempts were burned, so the fall-through sites stay diagnosable."""
+
+    def __init__(self, rung: str, cause: BaseException, attempts: int) -> None:
+        self.rung = rung
+        self.cause = cause
+        self.attempts = attempts
+        super().__init__(
+            f"ladder rung {rung!r} failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause}"
+        )
+
+
+class NativeWatchdogTimeout(RuntimeError):
+    """The in-process native call blew its QI_NATIVE_WATCHDOG_S deadline.
+
+    ``quarantine`` distinguishes the two severities: False — the call DID
+    unwind once the watchdog tripped its CancelToken (slow, but the cancel
+    path works; the rung stays available); True — the call ignored the
+    cancel past the grace window (wedged inside native code; the rung is
+    quarantined for the rest of the run so no later SCC can wedge on it).
+    """
+
+    def __init__(self, message: str, quarantine: bool) -> None:
+        self.quarantine = quarantine
+        super().__init__(message)
+
+
+def _is_transient_device_error(exc: BaseException) -> bool:
+    """Transient-device classification: the injected OOM class plus the
+    real XLA/runtime markers (string match — jaxlib's XlaRuntimeError type
+    is not importable on jax-free paths, and the markers are the stable
+    part of those errors across versions)."""
+    if isinstance(exc, TransientDeviceFault):
+        return True
+    text = str(exc)
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
+def _backoff_delay(rung: str, attempt: int) -> float:
+    """Exponential backoff with deterministic jitter for retry ``attempt``
+    (0-based) at ``rung``.  sha256, not ``hash()``: Python randomizes the
+    latter per process, and the whole point is that two runs of the same
+    fault schedule sleep identically."""
+    base = RETRY_BACKOFF_S * (2 ** attempt)
+    digest = hashlib.sha256(f"{rung}:{attempt}".encode()).digest()
+    return base * (1.0 + digest[0] / 1024.0)
+
+
+class DegradationLadder:
+    """Retry/degrade/quarantine policy for one run of the auto router.
+
+    One instance per :class:`AutoBackend` — quarantine is scoped to the
+    run, so a wedged native library poisons nothing beyond the process
+    that observed it.  All fallthrough flows through :meth:`attempt`; the
+    ``except Exception`` inside it is the only broad catch the
+    ``degrade-via-ladder`` lint rule permits in ``backends/``.
+    """
+
+    def __init__(self, retry_max: Optional[int] = None) -> None:
+        self.retry_max = (
+            qi_env_int("QI_RETRY_MAX") if retry_max is None else retry_max
+        )
+        self._quarantined: Set[str] = set()
+
+    def quarantined(self, rung: str) -> bool:
+        return rung in self._quarantined
+
+    def quarantine(self, rung: str, cause: object) -> None:
+        """Mark ``rung`` unusable for the rest of this run (idempotent)."""
+        if rung in self._quarantined:
+            return
+        self._quarantined.add(rung)
+        rec = get_run_record()
+        rec.add("ladder.quarantines")
+        rec.event("ladder.quarantined", rung=rung, cause=str(cause))
+        log.warning(
+            "ladder: rung %r quarantined for this run (%s)", rung, cause
+        )
+
+    def record_degrade(
+        self,
+        rung: str,
+        to: str,
+        cause: object,
+        attempts: int = 1,
+        transient: bool = False,
+    ) -> None:
+        """Emit the one-transition record every degradation must leave.
+
+        ``rung``/``to`` must come from the canonical RUNGS vocabulary —
+        consumers aggregate the degrade stream by these names, and three
+        spellings of one destination would make the ladder picture
+        unreadable.  Soft enforcement (warn, still emit): a junk name in
+        telemetry must never cost the verdict it describes.
+        """
+        for field in (rung, to):
+            if field not in RUNGS:
+                log.warning(
+                    "degrade event with non-canonical rung name %r "
+                    "(expected one of %s)", field, RUNGS,
+                )
+        rec = get_run_record()
+        rec.add("ladder.degrades")
+        rec.event(
+            "degrade", rung=rung, to=to, cause=str(cause),
+            attempts=attempts, transient=transient,
+        )
+        log.info("ladder: %s -> %s after %d attempt(s) (%s)",
+                 rung, to, attempts, cause)
+
+    def attempt(
+        self,
+        rung: str,
+        fn: Callable[[], _T],
+        fall_to: str,
+    ) -> _T:
+        """Run one rung with its retry budget.
+
+        Verdict-path flow control (``OracleBudgetExceeded``,
+        ``SearchCancelled``) passes straight through — those are scheduling
+        signals, not failures.  Transient device errors retry up to
+        ``retry_max`` times with deterministic backoff; anything else (or
+        an exhausted budget) emits the ``degrade`` event and raises
+        :class:`RungFailed` toward the caller's next rung.
+        """
+        if rung in self._quarantined:
+            raise RungFailed(
+                rung,
+                RuntimeError("rung quarantined earlier in this run"),
+                0,
+            )
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                return fn()
+            except (OracleBudgetExceeded, SearchCancelled, RungFailed):
+                raise
+            except Exception as exc:  # noqa: BLE001 — the ladder's one broad catch
+                transient = _is_transient_device_error(exc)
+                if transient and attempts <= self.retry_max:
+                    delay = _backoff_delay(rung, attempts - 1)
+                    rec = get_run_record()
+                    rec.add("ladder.retries")
+                    rec.event(
+                        "degrade.retry", rung=rung, attempt=attempts,
+                        delay_s=round(delay, 4), cause=str(exc),
+                    )
+                    log.info(
+                        "ladder: transient failure at %s (attempt %d/%d), "
+                        "retrying in %.3fs: %s",
+                        rung, attempts, self.retry_max + 1, delay, exc,
+                    )
+                    _retry_sleep(delay)
+                    continue
+                self.record_degrade(
+                    rung, fall_to, exc, attempts=attempts, transient=transient
+                )
+                raise RungFailed(rung, exc, attempts) from exc
+
+
+class _WatchedNativeOracle:
+    """The ladder's native rung: the C++ oracle supervised by a watchdog,
+    degrading to the Python oracle on ANY non-verdict failure.
+
+    With ``QI_NATIVE_WATCHDOG_S`` unset (0, the default) the native call
+    runs on the caller's thread exactly as before — zero new moving parts
+    on the production path.  With a deadline set, the call runs on a
+    supervised worker thread: past the deadline the watchdog trips the
+    native CancelToken (rung stays available if the call unwinds — the
+    cancel path works, it was just slow), and a call that ignores the
+    cancel past the grace window quarantines the native rung for the run
+    and falls to Python instead of wedging the router forever.  The worker
+    is deliberately NON-daemon (the same choice the sweep's compile
+    threads made): interpreter exit waits for a genuinely wedged call, but
+    the router's verdict does not.
+    """
+
+    def __init__(
+        self,
+        ladder: DegradationLadder,
+        native: SearchBackend,
+        python_factory: Callable[[], SearchBackend],
+        outer_cancel: Optional[CancelToken],
+        native_cancel: Optional[CancelToken],
+        watchdog_s: float,
+    ) -> None:
+        self._ladder = ladder
+        self._native = native
+        self._python_factory = python_factory
+        # outer_cancel: the race driver's token (propagated verbatim when
+        # it fires); native_cancel: the token the native search actually
+        # polls — the watchdog's trip wire, distinct from the race's so a
+        # deadline trip degrades to Python instead of masquerading as a
+        # race cancellation.
+        self._outer_cancel = outer_cancel
+        self._native_cancel = native_cancel
+        self._watchdog_s = watchdog_s
+        self.name = "cpp"
+
+    def check_scc(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        *,
+        scope_to_scc: bool = False,
+    ) -> SccCheckResult:
+        try:
+            return self._supervised(graph, circuit, scc, scope_to_scc)
+        except (OracleBudgetExceeded, SearchCancelled):
+            raise  # verdict-path flow control — the router handles these
+        except NativeWatchdogTimeout as exc:
+            if exc.quarantine:
+                self._ladder.quarantine("native", exc)
+            self._ladder.record_degrade("native", "python-oracle", exc)
+        except Exception as exc:  # noqa: BLE001 — the ladder's native→python transition
+            self._ladder.record_degrade("native", "python-oracle", exc)
+        self.name = "python"
+        return self._python_factory().check_scc(
+            graph, circuit, scc, scope_to_scc=scope_to_scc
+        )
+
+    def _supervised(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+    ) -> SccCheckResult:
+        if self._watchdog_s <= 0:
+            return self._native.check_scc(
+                graph, circuit, scc, scope_to_scc=scope_to_scc
+            )
+        holder: Dict[str, object] = {}
+
+        def work() -> None:
+            try:
+                holder["res"] = self._native.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                )
+            # Thread-boundary marshal, re-raised verbatim on the supervisor:
+            # qi-lint: allow(degrade-via-ladder) — nothing is swallowed here
+            except BaseException as exc:  # noqa: BLE001
+                holder["exc"] = exc
+
+        worker = threading.Thread(target=work, name="qi-native-watchdog")
+        worker.start()
+        deadline = time.monotonic() + self._watchdog_s
+        grace_deadline: Optional[float] = None
+        while worker.is_alive():
+            if (
+                self._outer_cancel is not None
+                and self._outer_cancel.cancelled
+                and self._native_cancel is not None
+            ):
+                # The race decided elsewhere: forward the cancel inward.
+                self._native_cancel.cancel()
+            now = time.monotonic()
+            if grace_deadline is None and now >= deadline:
+                get_run_record().event(
+                    "native.watchdog_cancel",
+                    deadline_s=self._watchdog_s, scc=len(scc),
+                )
+                log.warning(
+                    "native call exceeded %.2fs watchdog deadline; "
+                    "tripping its cancel token", self._watchdog_s,
+                )
+                if self._native_cancel is not None:
+                    self._native_cancel.cancel()
+                grace_deadline = now + min(1.0, self._watchdog_s)
+            elif grace_deadline is not None and now >= grace_deadline:
+                # Ignored the cancel: abandon the worker (non-daemon; see
+                # class docstring) and quarantine the rung.
+                raise NativeWatchdogTimeout(
+                    f"native call ignored its cancel for "
+                    f"{min(1.0, self._watchdog_s):.2f}s past the "
+                    f"{self._watchdog_s:.2f}s deadline (|scc|={len(scc)})",
+                    quarantine=True,
+                )
+            worker.join(WATCHDOG_POLL_S)
+        exc = holder.get("exc")
+        if exc is not None:
+            if (
+                isinstance(exc, SearchCancelled)
+                and grace_deadline is not None
+                and not (
+                    self._outer_cancel is not None
+                    and self._outer_cancel.cancelled
+                )
+            ):
+                # OUR trip unwound it, not the race's: a slow call, not a
+                # cancelled one — degrade to Python, keep the rung usable.
+                raise NativeWatchdogTimeout(
+                    f"native call hit the {self._watchdog_s:.2f}s watchdog "
+                    f"deadline and unwound on cancel (|scc|={len(scc)})",
+                    quarantine=False,
+                ) from exc
+            raise exc  # type: ignore[misc]
+        return holder["res"]  # type: ignore[return-value]
+
+
 def _measured_sweep_raise() -> Optional[int]:
     """The artifact-backed accelerator sweep limit, BEFORE the device-kind
     gate: largest measured winning |scc| + headroom, capped at any
@@ -250,6 +612,9 @@ class AutoBackend:
         # debugging — verdicts are identical either way.
         self.race = race
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
+        # One ladder per router instance: retry budgets and quarantine are
+        # scoped to the run (the CLI builds one AutoBackend per solve).
+        self._ladder = DegradationLadder()
 
     def _sweep(self, cancel: Optional[CancelToken] = None) -> SearchBackend:
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
@@ -263,34 +628,63 @@ class AutoBackend:
         budget_s: Optional[float] = None,
         cancel: Optional[CancelToken] = None,
     ) -> SearchBackend:
-        """Native oracle, degrading to pure Python; with ``budget_s``, the
-        instance carries a B&B call budget sized per engine speed; with
-        ``cancel``, a base.CancelToken the search polls (racing mode)."""
-        try:
+        """The host-oracle rungs of the degradation ladder: native C++
+        (watchdog-supervised, quarantinable — see _WatchedNativeOracle),
+        degrading to pure Python.  With ``budget_s``, the returned backend
+        carries a B&B call budget sized per engine speed; with ``cancel``,
+        a base.CancelToken the search polls (racing mode)."""
+
+        def python_oracle() -> SearchBackend:
+            from quorum_intersection_tpu.backends.python_oracle import (
+                PythonOracleBackend,
+            )
+
+            options = dict(self._oracle_options)
+            if budget_s is not None:
+                options["budget_calls"] = max(
+                    int(budget_s / ORACLE_SECONDS_PER_CALL["python"]),
+                    MIN_ORACLE_BUDGET,
+                )
+            if cancel is not None:
+                options["cancel"] = cancel
+            return PythonOracleBackend(**options)
+
+        watchdog_s = qi_env_float("QI_NATIVE_WATCHDOG_S", 0.0)
+
+        def native_oracle() -> SearchBackend:
             from quorum_intersection_tpu.backends.cpp import CppOracleBackend
 
             options = dict(self._oracle_options)
             if budget_s is not None:
                 options["budget_calls"] = max(
-                    int(budget_s / ORACLE_SECONDS_PER_CALL["cpp"]), MIN_ORACLE_BUDGET
+                    int(budget_s / ORACLE_SECONDS_PER_CALL["cpp"]),
+                    MIN_ORACLE_BUDGET,
                 )
-            if cancel is not None:
-                options["cancel"] = cancel
+            # Under a watchdog the native search polls its OWN token (the
+            # watchdog's trip wire); the race's token is forwarded inward
+            # by the supervisor.  Without one, the race token goes straight
+            # through, exactly as before.
+            native_cancel = CancelToken() if watchdog_s > 0 else cancel
+            if native_cancel is not None:
+                options["cancel"] = native_cancel
             backend = CppOracleBackend(**options)
             backend.ensure_built()
-            return backend
-        except Exception as exc:  # noqa: BLE001 — degrade to pure Python
-            log.info("native C++ oracle unavailable (%s); using Python oracle", exc)
-            from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+            return _WatchedNativeOracle(
+                self._ladder, backend, python_oracle,
+                outer_cancel=cancel, native_cancel=native_cancel,
+                watchdog_s=watchdog_s,
+            )
 
-            options = dict(self._oracle_options)
-            if budget_s is not None:
-                options["budget_calls"] = max(
-                    int(budget_s / ORACLE_SECONDS_PER_CALL["python"]), MIN_ORACLE_BUDGET
-                )
-            if cancel is not None:
-                options["cancel"] = cancel
-            return PythonOracleBackend(**options)
+        try:
+            return self._ladder.attempt(
+                "native", native_oracle, fall_to="python-oracle"
+            )
+        except RungFailed as fail:
+            log.info(
+                "native C++ oracle unavailable (%s); using Python oracle",
+                fail.cause,
+            )
+        return python_oracle()
 
     def _estimated_sweep_seconds(self, s: int) -> float:
         """Probe-free budget: the MIN of the per-platform sweep estimates.
@@ -420,9 +814,12 @@ class AutoBackend:
                     return
                 if sweep_cancel.cancelled:
                     return
-                backend = self._sweep(cancel=sweep_cancel)
-                res = backend.check_scc(
-                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                res = self._ladder.attempt(
+                    "tpu-sweep",
+                    lambda: self._sweep(cancel=sweep_cancel).check_scc(
+                        graph, circuit, scc, scope_to_scc=scope_to_scc
+                    ),
+                    fall_to="native",
                 )
                 outcome["sweep_result"] = res
                 outcome["sweep_seconds"] = time.monotonic() - t0
@@ -441,11 +838,14 @@ class AutoBackend:
                     # re-create the residue it removes).
                     try:
                         self.checkpoint.clear()
+                    # qi-lint: allow(degrade-via-ladder) — cleanup, not routing
                     except Exception:  # noqa: BLE001 — cleanup is best-effort
                         pass
-            except Exception as exc:  # noqa: BLE001 — degrade like sequential
-                outcome["sweep_error"] = str(exc)
-                log.info("race: sweep engine unavailable (%s)", exc)
+            except RungFailed as fail:
+                # The ladder burned the sweep rung's retries (degrade event
+                # already on the record); the racing oracle IS the fallback.
+                outcome["sweep_error"] = str(fail.cause)
+                log.info("race: sweep engine unavailable (%s)", fail.cause)
 
         # Non-daemon (see RACE_LOSER_JOIN_S): the verdict itself never
         # waits on this thread beyond the adaptive join, but interpreter
@@ -544,6 +944,7 @@ class AutoBackend:
                 # engine's last possible record.
                 try:
                     self.checkpoint.clear()
+                # qi-lint: allow(degrade-via-ladder) — cleanup, not routing
                 except Exception:  # noqa: BLE001 — cleanup must not cost the verdict
                     pass
             oracle_res.stats["race"] = race_stats("oracle", joined, loser_join_s)
@@ -575,6 +976,7 @@ class AutoBackend:
             return False
         try:
             return bool(probe(1 << max(len(scc) - 1, 0)))
+        # qi-lint: allow(degrade-via-ladder) — probe, not an engine rung
         except Exception:  # noqa: BLE001 — a broken probe must not block solves
             return False
 
@@ -658,7 +1060,10 @@ class AutoBackend:
                 else _platform_sweep_limit()
             )
             if len(scc) <= limit:
-                try:
+                def run_sweep() -> SccCheckResult:
+                    # Construct FIRST: the route.decision event fires only
+                    # for a sweep that actually exists (a jax-free box must
+                    # not record engine=tpu-sweep for a host-oracle verdict).
                     backend = self._sweep()
                     log.debug("auto: sweep backend for |scc|=%d", len(scc))
                     get_run_record().event(
@@ -671,8 +1076,16 @@ class AutoBackend:
                     return backend.check_scc(
                         graph, circuit, scc, scope_to_scc=scope_to_scc
                     )
-                except Exception as exc:  # noqa: BLE001
-                    log.info("sweep backend unavailable (%s); falling back", exc)
+
+                try:
+                    return self._ladder.attempt(
+                        "tpu-sweep", run_sweep, fall_to="native"
+                    )
+                except RungFailed as fail:
+                    log.info(
+                        "sweep backend unavailable (%s); falling back",
+                        fail.cause,
+                    )
         # Large SCC: the device-resident frontier takes it ONLY inside a
         # MEASURED on-chip win region (CALIBRATION.frontier_win_min_scc,
         # derived from the newest crossover_tpu_r*.txt artifact with
@@ -698,7 +1111,7 @@ class AutoBackend:
             and backend_kind() == CALIBRATION.frontier_win_device
         )
         if in_region:
-            try:
+            def run_frontier() -> SccCheckResult:
                 from quorum_intersection_tpu.backends.tpu.frontier import (
                     TpuFrontierBackend,
                 )
@@ -741,8 +1154,13 @@ class AutoBackend:
                 return backend.check_scc(
                     graph, circuit, scc, scope_to_scc=scope_to_scc
                 )
-            except Exception as exc:  # noqa: BLE001 — degrade to the host oracle
-                log.info("frontier unavailable (%s); falling back", exc)
+
+            try:
+                return self._ladder.attempt(
+                    "tpu-frontier", run_frontier, fall_to="native"
+                )
+            except RungFailed as fail:
+                log.info("frontier unavailable (%s); falling back", fail.cause)
         if self.prefer_tpu:
             # `--backend tpu` is honest about where large SCCs outside the
             # measured win regions actually go — see the module docstring.

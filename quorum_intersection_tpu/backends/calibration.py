@@ -89,6 +89,7 @@ def _iter_records(paths: Iterable[pathlib.Path]):
     for path in paths:
         try:
             doc = json.loads(path.read_text())
+        # qi-lint: allow(degrade-via-ladder) — artifact parsing, not routing
         except Exception:  # noqa: BLE001 — unreadable artifact: skip
             continue
         if not isinstance(doc, dict):
@@ -470,6 +471,7 @@ def calibrate(
         if warm is not None:
             (cal.sweep_warm_ratio, cal.sweep_warm_device,
              cal.provenance["warm_start"]) = warm
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass
     try:
@@ -478,6 +480,7 @@ def calibrate(
             (cal.frontier_win_min_scc, cal.frontier_win_max_scc,
              cal.frontier_win_device, cal.frontier_config,
              cal.provenance["frontier"]) = win
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass
     try:
@@ -485,11 +488,13 @@ def calibrate(
         if sw is not None:
             (cal.sweep_win_max_scc, cal.sweep_win_cap_scc,
              cal.sweep_win_device, cal.provenance["sweep_window"]) = sw
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass
 
     try:
         records = list(_iter_records(_artifact_paths() if paths is None else paths))
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         return cal
 

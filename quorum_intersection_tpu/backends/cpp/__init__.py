@@ -36,6 +36,7 @@ from quorum_intersection_tpu.backends.base import SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
 from quorum_intersection_tpu.utils.env import qi_env
+from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 
@@ -43,6 +44,13 @@ log = get_logger("backends.cpp")
 
 _SRC = Path(__file__).with_name("qi_oracle.cpp")
 _BUILD_DIR = Path(__file__).with_name("_build")
+
+# Hard ceiling on one g++ invocation (ISSUE 4 satellite): the oracle builds
+# in ~2 s and the sanitized CLI in ~10 s on the slowest measured box, so ten
+# minutes means a wedged compiler (NFS stall, fork bomb, OOM thrash) — fail
+# loudly with whatever stderr the compiler produced instead of hanging the
+# solve that triggered the on-demand build forever.
+BUILD_TIMEOUT_S = 600
 
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
@@ -56,6 +64,25 @@ def _so_path() -> Path:
     return _BUILD_DIR / f"qi_oracle-{digest}.so"
 
 
+def _run_gxx(cmd: Sequence[str], what: str) -> "subprocess.CompletedProcess":
+    """One g++ invocation under the build timeout.  A wedged compiler
+    surfaces whatever stderr it produced — a silent timeout is
+    undebuggable, and the degradation ladder's log line would otherwise
+    just read "TimeoutExpired"."""
+    try:
+        return subprocess.run(
+            cmd, capture_output=True, text=True, timeout=BUILD_TIMEOUT_S
+        )
+    except subprocess.TimeoutExpired as exc:
+        stderr = exc.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        raise RuntimeError(
+            f"{what} timed out after {BUILD_TIMEOUT_S}s "
+            f"(`{' '.join(cmd)}`):\n{stderr.strip()}"
+        ) from exc
+
+
 def _compile(out: Path, sources: Sequence[Path], flags: Sequence[str],
              what: str, force: bool) -> Path:
     """Shared g++ driver: idempotent content-hashed artifact, tmp-file +
@@ -66,7 +93,8 @@ def _compile(out: Path, sources: Sequence[Path], flags: Sequence[str],
     tmp = out.with_name(out.name + f".tmp{os.getpid()}")
     cmd = ["g++", "-std=c++17", *flags, "-o", str(tmp), *map(str, sources)]
     log.info("building %s: %s", what, " ".join(cmd))
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    fault_point("native.build")
+    proc = _run_gxx(cmd, f"{what} build")
     if proc.returncode != 0:
         raise RuntimeError(f"{what} build failed (exit {proc.returncode}):\n{proc.stderr}")
     tmp.replace(out)
@@ -118,7 +146,7 @@ def _probe_sanitizer_runtime(mode: str) -> None:
         src.write_text("int main() { return 0; }\n")
         cmd = ["g++", "-std=c++17", *_SANITIZER_FLAGS[mode],
                "-o", str(Path(tmp) / "probe"), str(src)]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
+        proc = _run_gxx(cmd, f"{mode} sanitizer probe")
     if proc.returncode != 0:
         raise RuntimeError(
             f"toolchain lacks the {mode} sanitizer runtime "
@@ -333,6 +361,10 @@ class CppOracleBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
+        # Injectable native-entry boundary (utils/faults.py): `error`
+        # simulates a crashed call, `hang` a wedged one — the auto router's
+        # watchdog/quarantine hardening is exercised exactly here.
+        fault_point("native.call")
         lib = _load()
         flat = FlatGraph(graph)
         scc_arr = np.asarray(scc, dtype=np.int32)

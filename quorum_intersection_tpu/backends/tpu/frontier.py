@@ -70,6 +70,7 @@ from quorum_intersection_tpu.backends.base import SccCheckResult
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 
 log = get_logger("backends.tpu.frontier")
@@ -204,6 +205,7 @@ class TpuFrontierBackend:
             from quorum_intersection_tpu.backends.cpp import NativeMaxQuorum
 
             nmq = NativeMaxQuorum(graph)
+        # qi-lint: allow(degrade-via-ladder) — engine-internal helper choice
         except Exception as exc:  # noqa: BLE001 — no g++ etc.
             log.info("native max-quorum unavailable (%s); host checks use "
                      "the Python semantics", exc)
@@ -782,6 +784,11 @@ class TpuFrontierBackend:
         t_chunk = time.perf_counter()  # first interval includes trace+compile
         inflight, inflight_fe = dispatch(T_dev, D_dev, top_dev)
         while witness is None:
+            # Injectable device-chunk boundary (utils/faults.py): `oom` /
+            # `error` simulate the chip failing mid-search — routed through
+            # the auto ladder this degrades to the host oracle; driven
+            # directly it is a typed, loud failure, never a wrong verdict.
+            fault_point("frontier.chunk")
             spec, spec_fe = dispatch(inflight[0], inflight[1], inflight[2])
             # Overlap: host-check the PREVIOUS chunk's flags while the
             # device crunches the current + speculative ones.

@@ -42,6 +42,7 @@ from quorum_intersection_tpu.backends.base import (
 from quorum_intersection_tpu.encode.circuit import Circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 from quorum_intersection_tpu.utils.timers import Throughput
@@ -468,6 +469,10 @@ class TpuSweepBackend:
 
         def dispatch(lo: int, hi: int, steps_per_call: int):
             nonlocal compile_seconds, t_first_dispatch
+            # Injectable device-dispatch boundary (utils/faults.py): `oom`
+            # simulates RESOURCE_EXHAUSTED — the transient class the auto
+            # router's ladder retries with backoff before degrading.
+            fault_point("sweep.dispatch")
             if t_first_dispatch is None:
                 t_first_dispatch = time.monotonic()
             fn = dispatchers.get(steps_per_call)
@@ -475,6 +480,7 @@ class TpuSweepBackend:
                 # First call per shape blocks on trace+compile (subsequent
                 # dispatches of the same shape are asynchronous); charge that
                 # synchronous wall time to the compile bucket.
+                fault_point("sweep.compile")
                 fn = dispatchers[steps_per_call] = make_dispatch(steps_per_call)
                 tc = time.monotonic()
                 out = fn(lo, hi_row(hi))
@@ -554,6 +560,7 @@ class TpuSweepBackend:
                         return
                     precompile()
                     dispatchers[target] = fn
+                # qi-lint: allow(degrade-via-ladder) — engine-internal retry
                 except Exception as exc:  # noqa: BLE001 — fall back to sync
                     log.info("async ramp compile failed (%s); will compile inline", exc)
                 finally:
@@ -615,6 +622,11 @@ class TpuSweepBackend:
             ])
         while start < total:
             check_cancel()
+            # Injectable window boundary: `preempt` simulates the scheduler
+            # revoking the chip mid-enumeration (any recorded checkpoint
+            # stays on disk, so the preempted run resumes — exactly the
+            # contract checkpoints exist for).
+            fault_point("sweep.window")
             # Grow the program only once the remaining work would fill at
             # least a couple of programs at the next size (never compile
             # shapes a small sweep won't use) — and then jump straight to

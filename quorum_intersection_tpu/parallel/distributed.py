@@ -36,17 +36,46 @@ mesh equals the local one.
 from __future__ import annotations
 
 import os
+import time
 
 from typing import Optional, Sequence
 
 import numpy as np
 
 from quorum_intersection_tpu.parallel.mesh import CANDIDATE_AXIS, candidate_mesh
+from quorum_intersection_tpu.utils.env import qi_env_float
+from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("parallel.distributed")
 
 _initialized = False
+
+# First-retry backoff for coordinator-join failures; doubles per attempt,
+# capped below so the bounded window (QI_DIST_INIT_TIMEOUT_S) is spent on
+# retries rather than one long sleep.  A transient coordinator (restarting
+# pod, DNS lag) usually answers within a few doublings; a dead one burns
+# the window and degrades loudly to single-process.
+_INIT_BACKOFF_S = 0.5
+_INIT_BACKOFF_CAP_S = 5.0
+
+# Seam for tests (mirrors backends/auto._retry_sleep): retry backoff
+# sleeps route through this attribute so the bounded-retry path runs in
+# milliseconds under test.
+_retry_sleep = time.sleep
+
+# RuntimeError markers that mean the failure is UNRECOVERABLE in this
+# process — the XLA backend was already touched before init (jax's
+# "must be called before any JAX computations" / "already initialized"
+# family).  Retrying cannot help (the backend stays touched), so these
+# degrade immediately instead of burning the whole retry window asleep;
+# everything else (dead/slow coordinator) gets the bounded retries.
+_UNRECOVERABLE_INIT_MARKERS = (
+    "before any JAX computations",
+    "already initialized",
+    "backend and platform",
+)
 
 
 def initialize(
@@ -62,6 +91,13 @@ def initialize(
     manual GPU/CPU multi-process setups.  A second call, or a call in a
     plainly single-process environment, is a no-op — so library code can
     call this unconditionally.
+
+    Coordinator-join failures (dead/slow coordinator, the injected
+    ``distributed.init`` fault) retry with exponential backoff under the
+    ``QI_DIST_INIT_TIMEOUT_S`` budget before degrading to single-process —
+    and the degrade is LOUD: a warning plus a ``distributed.init_degraded``
+    run-record event naming the cause and attempt count, because a 256-chip
+    job silently running on one host is the expensive kind of "working".
     """
     global _initialized
     if _initialized:
@@ -90,18 +126,51 @@ def initialize(
             log.debug("single-process environment; distributed init skipped")
             _initialized = True
             return
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
-        )
-    except RuntimeError as exc:
-        # Most common cause: the XLA backend was already touched (device
-        # query / computation) before init.  Proceeding single-process is
-        # the only option left; make it loud.
-        log.warning("distributed init unavailable (%s); continuing single-process", exc)
+    deadline = time.monotonic() + qi_env_float("QI_DIST_INIT_TIMEOUT_S", 20.0)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            fault_point("distributed.init")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+            if attempt > 1:
+                log.info("distributed init succeeded on attempt %d", attempt)
+            break
+        except RuntimeError as exc:
+            # Two causes share this exception: the XLA backend was already
+            # touched before init (unrecoverable — degrade NOW, retrying
+            # only wastes the window), and a coordinator that is down or
+            # still coming up (recoverable — the case the bounded retry
+            # exists for).
+            unrecoverable = any(
+                marker in str(exc) for marker in _UNRECOVERABLE_INIT_MARKERS
+            )
+            delay = min(
+                _INIT_BACKOFF_S * (2 ** (attempt - 1)), _INIT_BACKOFF_CAP_S
+            )
+            if not unrecoverable and time.monotonic() + delay < deadline:
+                log.info(
+                    "distributed init failed (attempt %d: %s); retrying "
+                    "in %.1fs", attempt, exc, delay,
+                )
+                _retry_sleep(delay)
+                continue
+            # Budget burned: proceeding single-process is the only option
+            # left; make it loud AND machine-readable.
+            log.warning(
+                "distributed init unavailable after %d attempt(s) (%s); "
+                "continuing single-process", attempt, exc,
+            )
+            get_run_record().event(
+                "distributed.init_degraded", cause=str(exc),
+                attempts=attempt,
+            )
+            break
     _initialized = True
     log.info(
         "distributed runtime up: process %d/%d, %d global devices",

@@ -11,8 +11,19 @@ describes progress *for a given problem*.  The fingerprint is a hash of the
 exact enumeration (circuit tables, bit-node order, masks), so a stale file
 from a *different* FBAS that happens to share the same enumeration size is
 never resumed — resuming it would silently skip candidates ``[0, position)``
-and could flip the verdict.  Written atomically (tmp + rename) so a crash
-mid-write never corrupts it.
+and could flip the verdict.
+
+**Crash-only discipline** (ISSUE 4): a checkpoint exists to rescue a run,
+so it must never kill one.  Every write is atomic AND durable — tmp file,
+flush + fsync, rename, best-effort directory fsync (without the fsync a
+crash shortly after the rename can leave the OLD file, losing progress the
+run believed saved) — and every ``OSError`` on the save path (disk full,
+unwritable directory, the injected ``checkpoint.write`` fault) is
+downgraded to the ``checkpoint.save_errors`` counter plus a warning: the
+run continues unprotected rather than dying.  Unreadable files are renamed
+to ``<name>.corrupt`` and quarantined — never retried, never resumed, and
+the evidence is preserved for postmortems instead of being overwritten.
+The full corruption matrix is pinned by ``tests/test_checkpoint_faults.py``.
 """
 
 from __future__ import annotations
@@ -22,17 +33,104 @@ import json
 import os
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Optional, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("utils.checkpoint")
 
 
-def sweep_fingerprint(*arrays) -> str:
+def _quarantine_corrupt(path: Path, why: str) -> None:
+    """Rename an unreadable checkpoint to ``<name>.corrupt`` (overwriting a
+    previous quarantine — the newest corpse is the interesting one).  The
+    file is never retried: a checkpoint that cannot be parsed is evidence,
+    not state, and rereading it on every probe would re-pay the failure."""
+    corrupt = path.with_name(path.name + ".corrupt")
+    try:
+        os.replace(path, corrupt)
+    except OSError:
+        return  # racing unlink/rename: nothing left to quarantine
+    get_run_record().add("checkpoint.corrupt_quarantined")
+    get_run_record().event(
+        "checkpoint.corrupt_quarantined", path=str(path),
+        quarantined_to=str(corrupt), why=why,
+    )
+    log.warning("corrupt checkpoint quarantined to %s (%s)", corrupt, why)
+
+
+def _read_json(path: Path) -> Optional[Dict[str, Any]]:
+    """Parse a checkpoint file; corrupt content is quarantined, a missing
+    file is simply None."""
+    try:
+        text = path.read_text()
+    except OSError:
+        return None
+    except UnicodeDecodeError as exc:
+        # A torn write can leave arbitrary bytes — the most realistic
+        # corruption shape, and it must quarantine like any other.
+        _quarantine_corrupt(path, f"undecodable bytes: {exc}")
+        return None
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError as exc:
+        _quarantine_corrupt(path, f"unparseable JSON: {exc}")
+        return None
+    if not isinstance(data, dict):
+        _quarantine_corrupt(path, f"not a JSON object: {type(data).__name__}")
+        return None
+    return data
+
+
+def _write_json(path: Path, payload: Dict[str, Any]) -> bool:
+    """Atomic + durable checkpoint write; False (never an exception) on
+    failure.
+
+    fsync-before-rename makes the rename publish only fully-persisted
+    bytes; the directory fsync afterwards persists the rename itself.  Any
+    ``OSError`` — a full disk, a read-only volume, the injected
+    ``checkpoint.write`` fault — becomes the ``checkpoint.save_errors``
+    counter: the run this file exists to rescue is never the casualty of
+    saving it.
+    """
+    tmp = path.with_suffix(".tmp")
+    try:
+        fault_point("checkpoint.write")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(payload))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        try:
+            dir_fd = os.open(str(path.parent), os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        except OSError:
+            pass  # directory fsync is best-effort (not supported everywhere)
+    except OSError as exc:
+        get_run_record().add("checkpoint.save_errors")
+        get_run_record().event(
+            "checkpoint.save_error", path=str(path), error=str(exc),
+        )
+        log.warning(
+            "checkpoint save failed (%s); run continues without this "
+            "checkpoint update", exc,
+        )
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        return False
+    get_run_record().add("checkpoint.saves")
+    return True
+
+
+def sweep_fingerprint(*arrays: Optional[np.ndarray]) -> str:
     """Stable hash of the enumeration identity: feed the circuit tables,
     bit-node order, and availability masks; any difference ⇒ new problem."""
     h = hashlib.sha256()
@@ -71,12 +169,8 @@ class SweepCheckpoint:
             return True
         return bool(data.get("states"))
 
-    def _read(self) -> Optional[dict]:
-        try:
-            data = json.loads(self.path.read_text())
-        except (OSError, json.JSONDecodeError):
-            return None
-        return data if isinstance(data, dict) else None
+    def _read(self) -> Optional[Dict[str, Any]]:
+        return _read_json(self.path)
 
     def resume_position(
         self,
@@ -114,13 +208,10 @@ class SweepCheckpoint:
         return pos if 0 <= pos <= total else 0
 
     def record(self, position: int, total: int, fingerprint: Optional[str] = None) -> None:
-        tmp = self.path.with_suffix(".tmp")
-        payload = {"position": position, "total": total}
+        payload: Dict[str, Any] = {"position": position, "total": total}
         if fingerprint is not None:
             payload["fingerprint"] = fingerprint
-        tmp.write_text(json.dumps(payload))
-        os.replace(tmp, self.path)
-        get_run_record().add("checkpoint.saves")
+        _write_json(self.path, payload)
 
     def clear(self) -> None:
         try:
@@ -161,14 +252,12 @@ class FrontierCheckpoint:
         data = self._read()
         return data is not None and bool(data.get("states"))
 
-    def _read(self) -> Optional[dict]:
-        try:
-            data = json.loads(self.path.read_text())
-        except (OSError, json.JSONDecodeError):
-            return None
-        return data if isinstance(data, dict) else None
+    def _read(self) -> Optional[Dict[str, Any]]:
+        return _read_json(self.path)
 
-    def resume_states(self, fingerprint: str):
+    def resume_states(
+        self, fingerprint: str
+    ) -> Optional[List[List[List[int]]]]:
         """Saved frontier [(to_remove, dont_remove), ...], or None."""
         data = self._read()
         if data is None:
@@ -199,13 +288,14 @@ class FrontierCheckpoint:
             )
         return states
 
-    def record(self, states, fingerprint: str) -> None:
+    def record(
+        self, states: Sequence[Sequence[Sequence[int]]], fingerprint: str
+    ) -> None:
         if not states:
             return  # an empty frontier means the search is finishing anyway
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps({"fingerprint": fingerprint, "states": states}))
-        os.replace(tmp, self.path)
-        get_run_record().add("checkpoint.saves")
+        _write_json(
+            self.path, {"fingerprint": fingerprint, "states": list(states)}
+        )
 
     def clear(self) -> None:
         try:

@@ -101,6 +101,36 @@ QI_TEST_PLATFORM = _declare(
     "Platform the test suite pins via JAX_PLATFORMS before jax loads: "
     "'cpu' (default), 'tpu', or 'axon' (tests/conftest.py).",
 )
+QI_FAULTS = _declare(
+    "QI_FAULTS", "",
+    "Deterministic fault-injection rules, comma-separated "
+    "point=mode[:seconds][@hit[+]] (utils/faults.py — the declared "
+    "fault-point catalog and grammar live there; docs/ROBUSTNESS.md "
+    "renders it).  Empty: no injection, fault points are near-free.",
+)
+QI_NATIVE_WATCHDOG_S = _declare(
+    "QI_NATIVE_WATCHDOG_S", "0",
+    "Deadline in seconds for one in-process native oracle call under the "
+    "auto router: past it a monitor trips the CancelToken, and a call "
+    "that STILL does not return quarantines the native rung for the run "
+    "(backends/auto.py).  0 (default): watchdog off, calls run on the "
+    "caller's thread exactly as before.",
+)
+QI_RETRY_MAX = _declare(
+    "QI_RETRY_MAX", "2",
+    "Bounded retry budget per degradation-ladder rung for TRANSIENT "
+    "device errors (RESOURCE_EXHAUSTED/OOM class): retries with "
+    "exponential backoff + deterministic jitter before the ladder "
+    "degrades to the next rung (backends/auto.py DegradationLadder).",
+)
+QI_DIST_INIT_TIMEOUT_S = _declare(
+    "QI_DIST_INIT_TIMEOUT_S", "20",
+    "Total time budget for joining the multi-process JAX runtime: "
+    "coordinator-join failures retry with backoff under this deadline "
+    "before degrading loudly to single-process "
+    "(parallel/distributed.py initialize; event "
+    "distributed.init_degraded).",
+)
 
 
 # ---- reads -----------------------------------------------------------------
@@ -140,6 +170,22 @@ def qi_env_float(name: str, fallback: Optional[float] = None) -> float:
         default = _REGISTRY[name].default
         try:
             return float(default if default is not None else "")
+        except ValueError:
+            if fallback is None:
+                raise
+            return fallback
+
+
+def qi_env_int(name: str, fallback: Optional[int] = None) -> int:
+    """Integer read; malformed values fall back to the registered default
+    (or ``fallback`` when the default itself is unparseable)."""
+    raw = qi_env(name)
+    try:
+        return int(raw)
+    except ValueError:
+        default = _REGISTRY[name].default
+        try:
+            return int(default if default is not None else "")
         except ValueError:
             if fallback is None:
                 raise

@@ -1,0 +1,410 @@
+"""One registry for every named fault point the framework can inject at.
+
+The robustness claims of this pipeline — "a disk-full checkpoint write never
+kills the run", "a hung native call degrades instead of wedging", "a dead
+coordinator falls back to single-process loudly" — used to be assumptions:
+the ``except`` sites existed but nothing could *trigger* them on demand, so
+they were dead code until production found them first.  This module makes
+every such failure reproducible, the same move PR 3 made for thread
+interleavings (``tools/analyze/schedules.py``: deterministic schedules, not
+sleeps): faults are **declared**, **named**, and fired by **seeded,
+deterministic activation schedules** instead of hoping an overfull disk or a
+flaky device shows up in CI.
+
+Mirrors the :mod:`quorum_intersection_tpu.utils.env` registry discipline:
+
+- every injectable boundary calls :func:`fault_point` with a name declared
+  in the catalog below — an undeclared name raises ``KeyError`` immediately
+  (a fault point that is not in the catalog does not exist);
+- the catalog IS the documentation (docs/ROBUSTNESS.md renders it), so a
+  new boundary cannot ship without a description;
+- with no plan installed and ``QI_FAULTS`` unset, :func:`fault_point` is a
+  dict lookup and a ``None`` check — negligible on every production path.
+
+Activation comes from either source:
+
+- ``QI_FAULTS`` (env registry): ``point=mode[:seconds][@hit[+]]`` rules,
+  comma-separated — e.g. ``QI_FAULTS="checkpoint.write=oserror@3"`` fires a
+  disk-full ``OSError`` on the third checkpoint write;
+  ``QI_FAULTS="native.call=hang:0.5@1"`` hangs the first native entry for
+  half a second.  ``@N`` fires on exactly the Nth hit, ``@N+`` from the Nth
+  hit onward; omitted means every hit.
+- :func:`install_plan` — tests and the chaos soak install a
+  :class:`FaultPlan` programmatically; :func:`sample_plan` draws one from a
+  seeded RNG (same seed ⇒ same plan ⇒ same firing sequence, the
+  determinism contract ``tests/test_fault_schedules.py`` pins).
+
+Modes map to the failure they simulate:
+
+- ``error``   — generic failed dispatch/compile: raises :class:`FaultInjected`;
+- ``oom``     — transient device OOM: raises :class:`TransientDeviceFault`
+  (message carries ``RESOURCE_EXHAUSTED``, the marker the degradation
+  ladder's retry classifier keys on);
+- ``oserror`` — disk full: raises ``OSError(ENOSPC)`` (checkpoint I/O);
+- ``hang``    — blocks for ``seconds`` (bounded by :data:`HANG_CAP_S`), the
+  native-watchdog trigger;
+- ``preempt`` — sweep-window preemption: raises :class:`FaultPreempted`.
+
+Every firing lands in the run record (``fault.injected`` event +
+``faults.injected`` counter) and in the plan's ``fired`` log, so a chaos run
+can prove which faults actually exercised which paths.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from quorum_intersection_tpu.utils.env import qi_env
+from quorum_intersection_tpu.utils.logging import get_logger
+
+log = get_logger("utils.faults")
+
+# Upper bound on an injected hang: a typo'd QI_FAULTS must not wedge a run
+# for hours — the watchdog the hang exists to exercise trips in well under
+# this, and the (non-daemon) hung thread unwinds on its own afterwards.
+HANG_CAP_S = 30.0
+
+
+# ---- typed injected failures ----------------------------------------------
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired at a declared point.
+
+    Typed (never a bare ``RuntimeError`` raised ad hoc) so the chaos soak
+    can tell a LOUD injected failure from an untyped crash: the acceptance
+    criterion is "verdict equals the fault-free chain or a typed error" —
+    this class and its subclasses are the typed errors.
+    """
+
+    def __init__(self, point: str, mode: str, hit: int,
+                 detail: str = "") -> None:
+        self.point = point
+        self.mode = mode
+        self.hit = hit
+        msg = f"injected fault at {point} (mode={mode}, hit {hit})"
+        if detail:
+            msg = f"{msg}: {detail}"
+        super().__init__(msg)
+
+
+class TransientDeviceFault(FaultInjected):
+    """Simulated transient device failure (OOM / allocation pressure).
+
+    The message carries ``RESOURCE_EXHAUSTED`` so the degradation ladder's
+    transient classifier treats it exactly like the real XLA error string —
+    the retry-with-backoff path is exercised by the same predicate
+    production errors hit.
+    """
+
+    def __init__(self, point: str, hit: int) -> None:
+        super().__init__(
+            point, "oom", hit,
+            "RESOURCE_EXHAUSTED: simulated device out-of-memory",
+        )
+
+
+class FaultPreempted(FaultInjected):
+    """Simulated sweep-window preemption (the scheduler revoked the chip)."""
+
+    def __init__(self, point: str, hit: int) -> None:
+        super().__init__(point, "preempt", hit, "window preempted")
+
+
+# ---- the catalog -----------------------------------------------------------
+
+_POINTS: Dict[str, str] = {}
+
+
+def _declare(name: str, description: str) -> str:
+    _POINTS[name] = description
+    return name
+
+
+CHECKPOINT_WRITE = _declare(
+    "checkpoint.write",
+    "Checkpoint save (utils/checkpoint.py atomic write): oserror simulates "
+    "a full disk — the hardened writer downgrades it to the "
+    "checkpoint.save_errors counter, never a crashed run.",
+)
+NATIVE_CALL = _declare(
+    "native.call",
+    "Entry into the native C++ search (backends/cpp check_scc): error "
+    "simulates a crashed library call, hang a wedged one — the auto "
+    "router's watchdog trips the CancelToken and quarantines the rung.",
+)
+NATIVE_BUILD = _declare(
+    "native.build",
+    "g++ compile of the native oracle/CLI (backends/cpp _compile): error "
+    "simulates a broken toolchain; the ladder degrades to the Python "
+    "oracle.",
+)
+SWEEP_COMPILE = _declare(
+    "sweep.compile",
+    "Synchronous XLA trace+compile of a sweep program shape "
+    "(backends/tpu/sweep.py dispatch): error simulates a compile failure.",
+)
+SWEEP_DISPATCH = _declare(
+    "sweep.dispatch",
+    "Device dispatch of one sweep program (backends/tpu/sweep.py): oom "
+    "simulates RESOURCE_EXHAUSTED — the transient class the ladder "
+    "retries with backoff before degrading.",
+)
+SWEEP_WINDOW = _declare(
+    "sweep.window",
+    "Sweep window loop (backends/tpu/sweep.py, once per dispatched "
+    "window): preempt simulates losing the chip mid-enumeration.",
+)
+FRONTIER_CHUNK = _declare(
+    "frontier.chunk",
+    "Frontier device-chunk dispatch (backends/tpu/frontier.py): oom/error "
+    "simulate a device failure mid-search.",
+)
+DISTRIBUTED_INIT = _declare(
+    "distributed.init",
+    "Coordinator join (parallel/distributed.py initialize): error "
+    "simulates a dead/unreachable coordinator — bounded retry under "
+    "QI_DIST_INIT_TIMEOUT_S, then a loud single-process degrade.",
+)
+
+
+def registry() -> Dict[str, str]:
+    """The declared catalog, name → description (docs generators)."""
+    return dict(_POINTS)
+
+
+# ---- rules and plans -------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One activation rule: fire ``mode`` at ``point`` on selected hits."""
+
+    point: str
+    mode: str  # error | oom | oserror | hang | preempt
+    first: int = 1  # first hit (1-based) the rule fires on
+    every: bool = True  # True: every hit >= first; False: exactly `first`
+    seconds: float = 0.5  # hang duration (hang mode only)
+
+    def __post_init__(self) -> None:
+        if self.point not in _POINTS:
+            raise KeyError(
+                f"{self.point!r} is not a declared fault point; add it to "
+                f"quorum_intersection_tpu/utils/faults.py"
+            )
+        if self.mode not in ("error", "oom", "oserror", "hang", "preempt"):
+            raise ValueError(f"unknown fault mode {self.mode!r}")
+        if self.first < 1:
+            raise ValueError(f"fault hit index must be >= 1, got {self.first}")
+
+    def applies(self, hit: int) -> bool:
+        return hit >= self.first if self.every else hit == self.first
+
+    def spec(self) -> str:
+        """Round-trippable ``point=mode[:seconds][@hit[+]]`` form."""
+        mode = self.mode if self.mode != "hang" else f"hang:{self.seconds:g}"
+        hits = f"@{self.first}" + ("+" if self.every else "")
+        return f"{self.point}={mode}{hits}"
+
+
+class FaultPlan:
+    """An installed set of rules plus per-point hit counters and a firing
+    log.  Thread-safe: the race's worker threads hit points concurrently."""
+
+    def __init__(self, rules: Sequence[FaultRule], label: str = "") -> None:
+        self.rules: Tuple[FaultRule, ...] = tuple(rules)
+        self.label = label or ",".join(r.spec() for r in self.rules)
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+        # Firing log [(point, mode, hit), ...] — the determinism contract's
+        # observable: same plan + same workload ⇒ identical log.
+        self.fired: List[Tuple[str, str, int]] = []
+
+    def hit(self, point: str) -> None:
+        """Count a hit at ``point``; fire the first applicable rule."""
+        with self._lock:
+            n = self.counts.get(point, 0) + 1
+            self.counts[point] = n
+            rule = next(
+                (r for r in self.rules
+                 if r.point == point and r.applies(n)),
+                None,
+            )
+            if rule is not None:
+                self.fired.append((point, rule.mode, n))
+        if rule is None:
+            return
+        self._fire(rule, n)
+
+    def _fire(self, rule: FaultRule, n: int) -> None:
+        from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+        rec = get_run_record()
+        rec.add("faults.injected")
+        rec.event(
+            "fault.injected", point=rule.point, mode=rule.mode, hit=n,
+        )
+        log.info("fault injected: %s (mode=%s, hit %d)", rule.point,
+                 rule.mode, n)
+        if rule.mode == "hang":
+            time.sleep(min(max(rule.seconds, 0.0), HANG_CAP_S))
+            return
+        if rule.mode == "oom":
+            raise TransientDeviceFault(rule.point, n)
+        if rule.mode == "preempt":
+            raise FaultPreempted(rule.point, n)
+        if rule.mode == "oserror":
+            raise OSError(
+                errno.ENOSPC,
+                f"injected disk full at {rule.point} (hit {n})",
+            )
+        raise FaultInjected(rule.point, rule.mode, n)
+
+
+# ---- active plan -----------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+# Parsed-QI_FAULTS cache keyed by the raw spec string, so the env path does
+# not reparse per hit while still honoring a monkeypatched environment the
+# moment the string changes (the env registry's no-caching contract).
+_env_cache: Tuple[str, Optional[FaultPlan]] = ("", None)
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide (tests / chaos soak); returns it."""
+    global _PLAN
+    _PLAN = plan
+    log.info("fault plan installed: %s", plan.label)
+    return plan
+
+
+def clear_plan() -> None:
+    """Remove any installed plan (the env-spec path stays live)."""
+    global _PLAN
+    _PLAN = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The plan :func:`fault_point` currently consults, if any."""
+    global _env_cache
+    if _PLAN is not None:
+        return _PLAN
+    raw = qi_env("QI_FAULTS").strip()
+    if not raw:
+        return None
+    if _env_cache[0] != raw:
+        _env_cache = (raw, parse_faults(raw))
+    return _env_cache[1]
+
+
+def fault_point(name: str) -> None:
+    """Declare-and-maybe-fire: called at every injectable boundary.
+
+    Raises ``KeyError`` for an undeclared name even with no plan installed
+    — the runtime twin of the env registry's ``qi_env``: a fault point that
+    is not in the catalog does not exist, so a typo'd call site fails in
+    the first test that reaches it, not silently never-injectable.
+    """
+    if name not in _POINTS:
+        raise KeyError(
+            f"{name!r} is not a declared fault point; add it to "
+            f"quorum_intersection_tpu/utils/faults.py"
+        )
+    plan = active_plan()
+    if plan is not None:
+        plan.hit(name)
+
+
+# ---- QI_FAULTS parsing -----------------------------------------------------
+
+
+def parse_faults(spec: str) -> FaultPlan:
+    """Parse a ``QI_FAULTS`` spec into a plan.
+
+    Grammar (rules comma- or semicolon-separated)::
+
+        rule    := point "=" mode [":" seconds] ["@" hit ["+"]]
+        mode    := "error" | "oom" | "oserror" | "hang" | "preempt"
+
+    Examples: ``checkpoint.write=oserror@3`` (third write only),
+    ``native.call=hang:0.5@1`` (first call hangs 0.5 s),
+    ``sweep.dispatch=oom`` (every dispatch).
+    """
+    rules: List[FaultRule] = []
+    for chunk in spec.replace(";", ",").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        if "=" not in chunk:
+            raise ValueError(
+                f"malformed QI_FAULTS rule {chunk!r} (expected "
+                f"point=mode[:seconds][@hit[+]])"
+            )
+        point, _, rhs = chunk.partition("=")
+        first, every = 1, True
+        if "@" in rhs:
+            rhs, _, hits = rhs.partition("@")
+            hits = hits.strip()
+            if hits.endswith("+"):
+                hits = hits[:-1]
+            else:
+                every = False
+            first = int(hits)
+        seconds = 0.5
+        if ":" in rhs:
+            rhs, _, secs = rhs.partition(":")
+            seconds = float(secs)
+        rules.append(FaultRule(
+            point=point.strip(), mode=rhs.strip(), first=first,
+            every=every, seconds=seconds,
+        ))
+    return FaultPlan(rules, label=spec)
+
+
+# ---- seeded chaos sampling -------------------------------------------------
+
+# What the chaos soak can draw: every entry simulates a production failure
+# on a path the auto router's degradation ladder (or the crash-only
+# checkpoint writer) must absorb without flipping the verdict.  Hang rules
+# stay sub-second — the soak enables a short QI_NATIVE_WATCHDOG_S so the
+# watchdog, not the sleep, bounds the stall.
+_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
+    (SWEEP_DISPATCH, "oom", 0.0),
+    (SWEEP_WINDOW, "preempt", 0.0),
+    (SWEEP_COMPILE, "error", 0.0),
+    (NATIVE_CALL, "error", 0.0),
+    (NATIVE_CALL, "hang", 0.8),
+    (NATIVE_BUILD, "error", 0.0),
+    (CHECKPOINT_WRITE, "oserror", 0.0),
+    (FRONTIER_CHUNK, "oom", 0.0),
+)
+
+
+def sample_plan(seed: int) -> FaultPlan:
+    """Draw a deterministic fault schedule from ``seed``.
+
+    Same seed ⇒ same rules in the same order with the same hit selectors —
+    the chaos soak's reproducibility contract (re-running ``--chaos --seed
+    N`` replays the identical schedule).
+    """
+    rng = random.Random(seed)
+    n_rules = 1 if rng.random() < 0.7 else 2
+    picks = rng.sample(range(len(_CHAOS_CHOICES)), n_rules)
+    rules = []
+    for ix in picks:
+        point, mode, seconds = _CHAOS_CHOICES[ix]
+        # Bias toward the first hit and toward every-hit rules: small soak
+        # instances touch most points only once or twice, and a rule that
+        # never fires soaks nothing.
+        first = 1 if rng.random() < 0.6 else rng.randint(2, 3)
+        every = rng.random() < 0.7
+        rules.append(FaultRule(
+            point=point, mode=mode, first=first, every=every,
+            seconds=seconds,
+        ))
+    return FaultPlan(rules, label=f"chaos(seed={seed})")

@@ -1,0 +1,11 @@
+"""Fixture: ad-hoc broad catch-and-fall-through in ``backends/`` — the
+pre-ISSUE-4 pattern the ``degrade-via-ladder`` rule forbids (an engine
+failure silently swallowed with no retry budget, no quarantine, and no
+``degrade`` telemetry event)."""
+
+
+def route(backend):
+    try:
+        return backend.check_scc()
+    except Exception:  # BAD: swallowed degradation outside the ladder
+        return None

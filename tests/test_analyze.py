@@ -38,13 +38,22 @@ RULE_FIXTURES = {
     "cancel-token-plumbed": "cancel_token",
     "no-bare-env-read": "env_read",
     "import-at-top": "import_at_top",
+    # Path-gated rule: its fixture pair lives under a backends/ subdir so
+    # the relative path matches the gate (the rule is scoped to engines).
+    "degrade-via-ladder": "backends/degrade_via_ladder",
 }
+
+
+def fixture_path(kind, stem):
+    """``bad``/``good`` fixture path for a stem that may carry a subdir."""
+    rel = Path(stem)
+    return FIXTURES / rel.parent / f"{kind}_{rel.name}.py"
 
 
 class TestRuleFixtures:
     @pytest.mark.parametrize("rule,stem", sorted(RULE_FIXTURES.items()))
     def test_bad_fixture_yields_exactly_one_finding(self, rule, stem):
-        path = FIXTURES / f"bad_{stem}.py"
+        path = fixture_path("bad", stem)
         findings = lint_file(path, root=REPO_ROOT, rules=[rule])
         assert len(findings) == 1, findings
         assert findings[0].rule == rule
@@ -60,14 +69,14 @@ class TestRuleFixtures:
 
     @pytest.mark.parametrize("rule,stem", sorted(RULE_FIXTURES.items()))
     def test_good_fixture_is_clean(self, rule, stem):
-        path = FIXTURES / f"good_{stem}.py"
+        path = fixture_path("good", stem)
         assert lint_file(path, root=REPO_ROOT) == []
 
     def test_every_rule_has_a_fixture_pair(self):
         assert set(RULE_FIXTURES) == set(RULES)
         for stem in RULE_FIXTURES.values():
-            assert (FIXTURES / f"bad_{stem}.py").is_file()
-            assert (FIXTURES / f"good_{stem}.py").is_file()
+            assert fixture_path("bad", stem).is_file()
+            assert fixture_path("good", stem).is_file()
 
 
 class TestSuppression:

@@ -1,0 +1,186 @@
+"""Crash-only checkpointing (ISSUE 4): the corruption matrix — truncated
+JSON, empty file, non-object JSON, foreign fingerprint, leftover ``.tmp``,
+unwritable directory, injected disk-full — plus the durability ordering
+(fsync before rename) and the contract that a checkpoint never kills the
+run it exists to rescue."""
+
+import json
+import os
+
+import pytest
+
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.utils import faults, telemetry
+from quorum_intersection_tpu.utils.checkpoint import (
+    FrontierCheckpoint,
+    SweepCheckpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.clear_plan()
+    rec = telemetry.reset_run_record()
+    yield rec
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+@pytest.fixture
+def rec(_clean):
+    return _clean
+
+
+class TestCorruptionMatrix:
+    def test_truncated_json_is_quarantined(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text('{"position": 12, "tot')
+        assert SweepCheckpoint(p).resume_position(100) == 0
+        assert not p.exists()
+        corpse = tmp_path / "c.ckpt.corrupt"
+        assert corpse.exists()
+        assert rec.counters.get("checkpoint.corrupt_quarantined") == 1
+        ev = [e for e in rec.events
+              if e["name"] == "checkpoint.corrupt_quarantined"]
+        assert ev and "unparseable JSON" in ev[0]["attrs"]["why"]
+
+    def test_empty_file_is_quarantined(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text("")
+        assert SweepCheckpoint(p).has_progress(100) is False
+        assert not p.exists() and (tmp_path / "c.ckpt.corrupt").exists()
+
+    def test_undecodable_bytes_are_quarantined(self, tmp_path, rec):
+        # A torn write can leave arbitrary bytes — the most realistic
+        # corruption shape must quarantine, not raise UnicodeDecodeError.
+        p = tmp_path / "c.ckpt"
+        p.write_bytes(b"\xff\xfe\x00garbage from a torn write")
+        assert SweepCheckpoint(p).resume_position(100) == 0
+        assert not p.exists() and (tmp_path / "c.ckpt.corrupt").exists()
+        ev = [e for e in rec.events
+              if e["name"] == "checkpoint.corrupt_quarantined"]
+        assert ev and "undecodable bytes" in ev[0]["attrs"]["why"]
+
+    def test_non_object_json_is_quarantined(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text("[1, 2, 3]")
+        assert SweepCheckpoint(p).resume_position(100) == 0
+        assert (tmp_path / "c.ckpt.corrupt").exists()
+
+    def test_quarantined_file_is_never_retried(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text("{broken")
+        ck = SweepCheckpoint(p)
+        assert ck.resume_position(100) == 0
+        assert ck.resume_position(100) == 0  # second probe: file is gone
+        assert rec.counters.get("checkpoint.corrupt_quarantined") == 1
+
+    def test_foreign_fingerprint_ignored_not_quarantined(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text(json.dumps(
+            {"position": 64, "total": 100, "fingerprint": "deadbeef"}
+        ))
+        assert SweepCheckpoint(p).resume_position(100, fingerprint="cafe") == 0
+        assert p.exists(), "a VALID foreign checkpoint is evidence, not corruption"
+        assert rec.counters.get("checkpoint.corrupt_quarantined", 0) == 0
+
+    def test_frontier_corrupt_is_quarantined(self, tmp_path, rec):
+        p = tmp_path / "f.ckpt"
+        p.write_text('{"fingerprint": "x", "states": [[')
+        assert FrontierCheckpoint(p).resume_states("x") is None
+        assert (tmp_path / "f.ckpt.corrupt").exists()
+
+    def test_leftover_tmp_is_harmless_and_replaced(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        stale = p.with_suffix(".tmp")
+        stale.write_text("half-written garbage from a crashed run")
+        ck = SweepCheckpoint(p)
+        ck.record(32, 100, fingerprint="fp")
+        assert not stale.exists(), "the stale tmp must be overwritten away"
+        assert ck.resume_position(100, fingerprint="fp") == 32
+
+    def test_newest_corpse_wins_the_quarantine_slot(self, tmp_path, rec):
+        p = tmp_path / "c.ckpt"
+        p.write_text("{first corpse")
+        SweepCheckpoint(p).resume_position(100)
+        p.write_text("{second corpse")
+        SweepCheckpoint(p).resume_position(100)
+        assert (tmp_path / "c.ckpt.corrupt").read_text() == "{second corpse"
+
+
+class TestSaveErrors:
+    def test_unwritable_directory_counts_instead_of_raising(self, tmp_path, rec):
+        blocker = tmp_path / "dir"
+        blocker.write_text("")  # a FILE where the parent dir should be
+        ck = SweepCheckpoint(blocker / "c.ckpt")
+        ck.record(5, 10)  # must not raise
+        assert rec.counters.get("checkpoint.save_errors") == 1
+        assert rec.counters.get("checkpoint.saves", 0) == 0
+
+    def test_injected_disk_full_counts_and_cleans_tmp(self, tmp_path, rec):
+        faults.install_plan(faults.parse_faults("checkpoint.write=oserror@1+"))
+        p = tmp_path / "c.ckpt"
+        SweepCheckpoint(p).record(5, 10)
+        assert rec.counters.get("checkpoint.save_errors") == 1
+        assert not p.exists() and not p.with_suffix(".tmp").exists()
+        ev = [e for e in rec.events if e["name"] == "checkpoint.save_error"]
+        assert ev and "injected disk full" in ev[0]["attrs"]["error"]
+
+    def test_frontier_record_is_error_safe(self, tmp_path, rec):
+        faults.install_plan(faults.parse_faults("checkpoint.write=oserror@1+"))
+        FrontierCheckpoint(tmp_path / "f.ckpt").record(
+            [[[1], [2]]], fingerprint="fp"
+        )
+        assert rec.counters.get("checkpoint.save_errors") == 1
+
+    def test_fsync_before_rename(self, tmp_path, monkeypatch):
+        order = []
+        real_fsync, real_replace = os.fsync, os.replace
+        monkeypatch.setattr(
+            os, "fsync",
+            lambda fd: (order.append("fsync"), real_fsync(fd))[1],
+        )
+        monkeypatch.setattr(
+            os, "replace",
+            lambda a, b: (order.append("replace"), real_replace(a, b))[1],
+        )
+        SweepCheckpoint(tmp_path / "c.ckpt").record(5, 10)
+        # Data fsync strictly before the publishing rename; the (best-
+        # effort) directory fsync follows it.
+        assert order[:2] == ["fsync", "replace"]
+
+    def test_partial_write_counter_zero_on_happy_path(self, tmp_path, rec):
+        SweepCheckpoint(tmp_path / "c.ckpt").record(5, 10)
+        assert rec.counters.get("checkpoint.save_errors", 0) == 0
+        assert rec.counters.get("checkpoint.saves") == 1
+
+
+class TestRunSurvival:
+    """The first fault the harness exercises end-to-end: a sweep whose
+    every checkpoint write hits a full disk must still deliver the exact
+    verdict — a checkpoint must never kill the run it exists to rescue."""
+
+    def test_sweep_survives_disk_full_checkpointing(self, tmp_path, rec):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+
+        faults.install_plan(faults.parse_faults("checkpoint.write=oserror@1+"))
+        ck = SweepCheckpoint(tmp_path / "c.ckpt")
+        res = solve(
+            majority_fbas(9),
+            backend=TpuSweepBackend(checkpoint=ck, batch=32),
+        )
+        assert res.intersects is True
+        assert rec.counters.get("checkpoint.save_errors", 0) >= 1
+        assert not (tmp_path / "c.ckpt").exists()
+
+    def test_sweep_verdict_identical_with_and_without_faults(self, tmp_path, rec):
+        from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+
+        data = majority_fbas(9, broken=True)
+        clean = solve(data, backend=TpuSweepBackend(batch=32))
+        faults.install_plan(faults.parse_faults("checkpoint.write=oserror@1+"))
+        ck = SweepCheckpoint(tmp_path / "c.ckpt")
+        faulted = solve(data, backend=TpuSweepBackend(checkpoint=ck, batch=32))
+        assert faulted.intersects is clean.intersects is False
+        assert faulted.q1 == clean.q1 and faulted.q2 == clean.q2

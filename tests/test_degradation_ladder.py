@@ -1,0 +1,412 @@
+"""The degradation ladder (ISSUE 4 tentpole): bounded retries with
+deterministic backoff for transient device errors, typed ``RungFailed``
+fall-through, ``degrade`` telemetry on every transition, the native-call
+watchdog (trip → grace → quarantine), the distributed-init bounded retry,
+the native build timeout, and a chaos-soak smoke."""
+
+import subprocess
+import threading
+
+import pytest
+
+from quorum_intersection_tpu.backends import auto as auto_mod
+from quorum_intersection_tpu.backends.auto import (
+    AutoBackend,
+    DegradationLadder,
+    RungFailed,
+    _backoff_delay,
+)
+from quorum_intersection_tpu.backends.base import (
+    CancelToken,
+    OracleBudgetExceeded,
+    SearchCancelled,
+)
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.utils import faults, telemetry
+from quorum_intersection_tpu.utils.faults import TransientDeviceFault
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear_plan()
+    rec = telemetry.reset_run_record()
+    yield rec
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+@pytest.fixture
+def rec(_clean):
+    return _clean
+
+
+@pytest.fixture
+def no_sleep(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(auto_mod, "_retry_sleep", sleeps.append)
+    return sleeps
+
+
+class _InstantBurn:
+    """Budgeted-oracle stand-in that burns immediately, forcing the router
+    onto the sweep rung (mirrors tools/soak.py's chaos driver)."""
+
+    name = "burn"
+
+    def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+        raise OracleBudgetExceeded("test: forced sweep rung")
+
+
+class _SweepFirstAuto(AutoBackend):
+    def _cpu_oracle(self, budget_s=None, cancel=None):
+        if budget_s is not None:
+            return _InstantBurn()
+        return super()._cpu_oracle(budget_s=budget_s, cancel=cancel)
+
+
+class TestLadderAttempt:
+    def test_transient_retries_then_succeeds(self, no_sleep, rec):
+        ladder = DegradationLadder(retry_max=2)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientDeviceFault("sweep.dispatch", calls["n"])
+            return "verdict"
+
+        assert ladder.attempt("tpu-sweep", flaky, fall_to="native") == "verdict"
+        assert calls["n"] == 3
+        assert no_sleep == [
+            _backoff_delay("tpu-sweep", 0), _backoff_delay("tpu-sweep", 1),
+        ]
+        assert rec.counters.get("ladder.retries") == 2
+        assert rec.counters.get("ladder.degrades", 0) == 0
+
+    def test_transient_budget_exhausted_degrades(self, no_sleep, rec):
+        ladder = DegradationLadder(retry_max=2)
+
+        def always_oom():
+            raise TransientDeviceFault("sweep.dispatch", 1)
+
+        with pytest.raises(RungFailed) as err:
+            ladder.attempt("tpu-sweep", always_oom, fall_to="native")
+        assert err.value.attempts == 3  # 1 try + 2 retries
+        assert len(no_sleep) == 2
+        ev = [e for e in rec.events if e["name"] == "degrade"]
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["rung"] == "tpu-sweep"
+        assert ev[0]["attrs"]["to"] == "native"
+        assert ev[0]["attrs"]["transient"] is True
+        assert ev[0]["attrs"]["attempts"] == 3
+
+    def test_non_transient_degrades_without_retry(self, no_sleep, rec):
+        ladder = DegradationLadder(retry_max=5)
+
+        def broken():
+            raise ValueError("no jax on this box")
+
+        with pytest.raises(RungFailed) as err:
+            ladder.attempt("tpu-frontier", broken, fall_to="native")
+        assert err.value.attempts == 1
+        assert no_sleep == []
+        assert err.value.cause.args == ("no jax on this box",)
+
+    def test_flow_signals_pass_straight_through(self, no_sleep, rec):
+        ladder = DegradationLadder(retry_max=2)
+        for signal in (OracleBudgetExceeded("burn"), SearchCancelled("stop")):
+            def raising():
+                raise signal
+
+            with pytest.raises(type(signal)):
+                ladder.attempt("native", raising, fall_to="python-oracle")
+        assert rec.counters.get("ladder.degrades", 0) == 0
+
+    def test_quarantined_rung_short_circuits(self, rec):
+        ladder = DegradationLadder(retry_max=2)
+        ladder.quarantine("native", "wedged in a test")
+        called = []
+        with pytest.raises(RungFailed, match="quarantined"):
+            ladder.attempt("native", lambda: called.append(1), fall_to="python-oracle")
+        assert called == []
+        assert rec.counters.get("ladder.quarantines") == 1
+
+    def test_retry_max_comes_from_env_registry(self, monkeypatch):
+        monkeypatch.setenv("QI_RETRY_MAX", "7")
+        assert DegradationLadder().retry_max == 7
+
+    def test_backoff_is_deterministic_and_grows(self):
+        assert _backoff_delay("tpu-sweep", 0) == _backoff_delay("tpu-sweep", 0)
+        assert _backoff_delay("tpu-sweep", 1) > _backoff_delay("tpu-sweep", 0)
+        assert _backoff_delay("tpu-sweep", 2) > _backoff_delay("tpu-sweep", 1)
+        # Jitter decorrelates rungs without breaking determinism.
+        assert _backoff_delay("native", 0) != _backoff_delay("tpu-sweep", 0)
+
+
+class TestRouterDegradation:
+    def test_native_fault_degrades_to_python_with_event(self, rec):
+        faults.install_plan(faults.parse_faults("native.call=error@1+"))
+        res = solve(majority_fbas(9), backend=AutoBackend(race=False))
+        assert res.intersects is True
+        assert res.stats["backend"] == "python"
+        ev = [e for e in rec.events if e["name"] == "degrade"]
+        assert any(
+            e["attrs"]["rung"] == "native"
+            and e["attrs"]["to"] == "python-oracle" for e in ev
+        )
+
+    def test_sweep_oom_retries_then_degrades_to_host_oracle(self, no_sleep, rec):
+        faults.install_plan(faults.parse_faults("sweep.dispatch=oom@1+"))
+        res = solve(majority_fbas(9), backend=_SweepFirstAuto(race=False))
+        assert res.intersects is True
+        assert res.stats["backend"] in ("cpp", "python")
+        assert rec.counters.get("ladder.retries", 0) >= 1
+        ev = [e for e in rec.events if e["name"] == "degrade"]
+        assert any(e["attrs"]["rung"] == "tpu-sweep" for e in ev)
+
+    def test_window_preemption_degrades_not_crashes(self, rec):
+        faults.install_plan(faults.parse_faults("sweep.window=preempt@1+"))
+        data = majority_fbas(9, broken=True)
+        res = solve(data, backend=_SweepFirstAuto(race=False))
+        assert res.intersects is False
+        assert res.q1 and res.q2 and not set(res.q1) & set(res.q2)
+
+    def test_verdicts_match_fault_free_chain(self, rec):
+        for broken in (False, True):
+            data = majority_fbas(9, broken=broken)
+            faults.clear_plan()
+            expected = solve(data, backend=AutoBackend(race=False)).intersects
+            faults.install_plan(faults.parse_faults("native.call=error@1+"))
+            got = solve(data, backend=AutoBackend(race=False)).intersects
+            assert got is expected
+
+
+class TestWatchdog:
+    def test_hang_trips_watchdog_and_quarantines(self, monkeypatch, rec):
+        monkeypatch.setenv("QI_NATIVE_WATCHDOG_S", "0.15")
+        faults.install_plan(faults.parse_faults("native.call=hang:0.8@1+"))
+        backend = AutoBackend(race=False)
+        res = solve(majority_fbas(9, broken=True), backend=backend)
+        assert res.intersects is False
+        assert res.stats["backend"] == "python"
+        assert backend._ladder.quarantined("native")
+        names = [e["name"] for e in rec.events]
+        assert "native.watchdog_cancel" in names
+        assert "ladder.quarantined" in names
+        # The whole run: one quarantine, later solves skip native silently.
+        res2 = solve(majority_fbas(9), backend=backend)
+        assert res2.stats["backend"] == "python"
+        assert rec.counters.get("ladder.quarantines") == 1
+
+    def test_responsive_cancel_degrades_without_quarantine(self, rec):
+        # A native call that honors its CancelToken once tripped: slow,
+        # not wedged — the rung must stay available.
+        ladder = DegradationLadder(retry_max=0)
+        tok = CancelToken()
+
+        class SlowButCancellable:
+            name = "cpp"
+
+            def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+                assert tok._event.wait(timeout=30.0)
+                raise SearchCancelled("honored the trip")
+
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend,
+        )
+        from quorum_intersection_tpu.fbas.graph import build_graph
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+
+        wrapper = auto_mod._WatchedNativeOracle(
+            ladder, SlowButCancellable(), PythonOracleBackend,
+            outer_cancel=None, native_cancel=tok, watchdog_s=0.1,
+        )
+        graph = build_graph(parse_fbas(majority_fbas(9)))
+        res = wrapper.check_scc(graph, None, list(range(graph.n)))
+        assert res.intersects is True
+        assert wrapper.name == "python"
+        assert not ladder.quarantined("native")
+        ev = [e for e in rec.events if e["name"] == "degrade"]
+        assert len(ev) == 1 and "watchdog" in ev[0]["attrs"]["cause"]
+
+    def test_race_cancel_is_forwarded_inward(self, rec):
+        # The outer (race) token fires while the native call runs under a
+        # generous watchdog: the supervisor must forward the cancel to the
+        # native token and propagate SearchCancelled untouched.
+        ladder = DegradationLadder(retry_max=0)
+        outer, inner = CancelToken(), CancelToken()
+
+        class WaitsForCancel:
+            name = "cpp"
+
+            def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+                assert inner._event.wait(timeout=30.0)
+                raise SearchCancelled("race cancel observed")
+
+        wrapper = auto_mod._WatchedNativeOracle(
+            ladder, WaitsForCancel(), lambda: None,
+            outer_cancel=outer, native_cancel=inner, watchdog_s=30.0,
+        )
+        from quorum_intersection_tpu.fbas.graph import build_graph
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+
+        graph = build_graph(parse_fbas(majority_fbas(9)))
+        timer = threading.Timer(0.1, outer.cancel)
+        timer.start()
+        try:
+            with pytest.raises(SearchCancelled):
+                wrapper.check_scc(graph, None, list(range(graph.n)))
+        finally:
+            timer.cancel()
+        assert not ladder.quarantined("native")
+
+    def test_watchdog_disabled_runs_on_caller_thread(self, monkeypatch):
+        monkeypatch.setenv("QI_NATIVE_WATCHDOG_S", "0")
+        seen = {}
+
+        class Probe:
+            name = "cpp"
+
+            def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+                seen["thread"] = threading.current_thread().name
+                raise RuntimeError("force the python fallback")
+
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend,
+        )
+        from quorum_intersection_tpu.fbas.graph import build_graph
+        from quorum_intersection_tpu.fbas.schema import parse_fbas
+
+        ladder = DegradationLadder(retry_max=0)
+        wrapper = auto_mod._WatchedNativeOracle(
+            ladder, Probe(), PythonOracleBackend,
+            outer_cancel=None, native_cancel=None, watchdog_s=0.0,
+        )
+        graph = build_graph(parse_fbas(majority_fbas(9)))
+        res = wrapper.check_scc(graph, None, list(range(graph.n)))
+        assert res.intersects is True
+        assert seen["thread"] == threading.current_thread().name
+
+
+class TestDistributedInitRetry:
+    def test_bounded_retry_then_loud_degrade(self, monkeypatch, rec):
+        from quorum_intersection_tpu.parallel import distributed
+
+        monkeypatch.setattr(distributed, "_initialized", False)
+        monkeypatch.setattr(distributed, "_retry_sleep", lambda s: None)
+        monkeypatch.setenv("QI_DIST_INIT_TIMEOUT_S", "0")
+        faults.install_plan(faults.parse_faults("distributed.init=error@1+"))
+        distributed.initialize(
+            coordinator_address="127.0.0.1:1", num_processes=2, process_id=0
+        )
+        ev = [e for e in rec.events if e["name"] == "distributed.init_degraded"]
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["attempts"] >= 1
+        assert "injected" in ev[0]["attrs"]["cause"]
+
+    def test_unrecoverable_cause_degrades_immediately(self, monkeypatch, rec):
+        # "XLA backend already touched" cannot be fixed by retrying: the
+        # degrade must be instant, not a full retry window spent asleep.
+        import jax
+
+        from quorum_intersection_tpu.parallel import distributed
+
+        monkeypatch.setattr(distributed, "_initialized", False)
+        slept = []
+        monkeypatch.setattr(distributed, "_retry_sleep", slept.append)
+        monkeypatch.setenv("QI_DIST_INIT_TIMEOUT_S", "60")
+
+        def touched(**kw):
+            raise RuntimeError(
+                "jax.distributed.initialize() must be called before "
+                "any JAX computations are executed."
+            )
+
+        monkeypatch.setattr(jax.distributed, "initialize", touched)
+        monkeypatch.setattr(
+            jax.distributed, "is_initialized", lambda: False, raising=False
+        )
+        distributed.initialize(
+            coordinator_address="127.0.0.1:1", num_processes=2, process_id=0
+        )
+        assert slept == [], "unrecoverable cause must not burn the window"
+        ev = [e for e in rec.events if e["name"] == "distributed.init_degraded"]
+        assert len(ev) == 1 and ev[0]["attrs"]["attempts"] == 1
+
+    def test_transient_coordinator_recovers_within_budget(self, monkeypatch, rec):
+        import jax
+
+        from quorum_intersection_tpu.parallel import distributed
+
+        monkeypatch.setattr(distributed, "_initialized", False)
+        slept = []
+        monkeypatch.setattr(distributed, "_retry_sleep", slept.append)
+        monkeypatch.setenv("QI_DIST_INIT_TIMEOUT_S", "60")
+        joined = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: joined.append(kw),
+        )
+        monkeypatch.setattr(
+            jax.distributed, "is_initialized", lambda: False, raising=False
+        )
+        # First join attempt dies (injected); the retry succeeds.
+        faults.install_plan(faults.parse_faults("distributed.init=error@1"))
+        distributed.initialize(
+            coordinator_address="127.0.0.1:1", num_processes=2, process_id=0
+        )
+        assert len(joined) == 1, "the retry must reach the real join"
+        assert len(slept) == 1
+        assert not [
+            e for e in rec.events if e["name"] == "distributed.init_degraded"
+        ]
+
+
+class TestBuildTimeout:
+    def test_compile_passes_the_timeout(self, monkeypatch, tmp_path):
+        from quorum_intersection_tpu.backends import cpp
+
+        seen = {}
+
+        def fake_run(cmd, capture_output, text, timeout):
+            seen["timeout"] = timeout
+            tmp_out = cmd[cmd.index("-o") + 1]
+            with open(tmp_out, "w") as fh:
+                fh.write("")
+
+            class P:
+                returncode = 0
+                stderr = ""
+
+            return P()
+
+        monkeypatch.setattr(cpp.subprocess, "run", fake_run)
+        out = tmp_path / "fake.so"
+        assert cpp._compile(out, [cpp._SRC], ["-O2"], "test", force=True) == out
+        assert seen["timeout"] == cpp.BUILD_TIMEOUT_S
+
+    def test_timeout_surfaces_compiler_stderr(self, monkeypatch, tmp_path):
+        from quorum_intersection_tpu.backends import cpp
+
+        def fake_run(cmd, capture_output, text, timeout):
+            raise subprocess.TimeoutExpired(
+                cmd, timeout, stderr=b"cc1plus: warning: eating all RAM"
+            )
+
+        monkeypatch.setattr(cpp.subprocess, "run", fake_run)
+        with pytest.raises(RuntimeError, match="timed out") as err:
+            cpp._compile(tmp_path / "fake.so", [cpp._SRC], ["-O2"], "test",
+                         force=True)
+        assert "eating all RAM" in str(err.value)
+
+
+class TestChaosSmoke:
+    def test_chaos_soak_window_is_clean(self, monkeypatch):
+        import tools.soak as soak
+
+        monkeypatch.setenv("QI_NATIVE_WATCHDOG_S", "0.25")
+        rc = soak.main(
+            ["--chaos", "--instances", "4", "--seed", "11", "--no-ledger"]
+        )
+        assert rc == 0

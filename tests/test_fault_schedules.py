@@ -1,0 +1,181 @@
+"""Deterministic fault schedules (ISSUE 4 tentpole): the registry contract
+(undeclared points raise), the QI_FAULTS grammar, hit selection, and the
+determinism guarantee — same seed ⇒ same plan ⇒ same firing sequence —
+that makes a chaos failure exactly reproducible (the faults twin of
+tests/test_race_schedules.py's forced interleavings)."""
+
+import pytest
+
+from quorum_intersection_tpu.utils import faults, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class TestRegistry:
+    def test_undeclared_point_raises_even_without_a_plan(self):
+        with pytest.raises(KeyError, match="not a declared fault point"):
+            faults.fault_point("no.such.point")
+
+    def test_undeclared_point_in_a_rule_raises(self):
+        with pytest.raises(KeyError, match="not a declared fault point"):
+            faults.FaultRule(point="no.such.point", mode="error")
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            faults.FaultRule(point="native.call", mode="explode")
+
+    def test_catalog_is_documented(self):
+        reg = faults.registry()
+        assert reg, "empty fault-point catalog"
+        for name, description in reg.items():
+            assert "." in name
+            assert len(description) > 20, f"{name} lacks a real description"
+
+    def test_declared_point_without_plan_is_a_noop(self):
+        for name in faults.registry():
+            faults.fault_point(name)  # must not raise
+
+
+class TestFiring:
+    def test_fire_on_exactly_the_third_hit(self):
+        plan = faults.install_plan(
+            faults.parse_faults("checkpoint.write=oserror@3")
+        )
+        faults.fault_point("checkpoint.write")
+        faults.fault_point("checkpoint.write")
+        with pytest.raises(OSError):
+            faults.fault_point("checkpoint.write")
+        faults.fault_point("checkpoint.write")  # @3 exactly: 4th is clean
+        assert plan.fired == [("checkpoint.write", "oserror", 3)]
+
+    def test_fire_from_second_hit_onward(self):
+        plan = faults.install_plan(faults.parse_faults("native.call=error@2+"))
+        faults.fault_point("native.call")
+        for _ in range(3):
+            with pytest.raises(faults.FaultInjected):
+                faults.fault_point("native.call")
+        assert [hit for _, _, hit in plan.fired] == [2, 3, 4]
+
+    def test_default_is_every_hit(self):
+        faults.install_plan(faults.parse_faults("sweep.dispatch=oom"))
+        for _ in range(2):
+            with pytest.raises(faults.TransientDeviceFault):
+                faults.fault_point("sweep.dispatch")
+
+    def test_oom_carries_the_transient_marker(self):
+        faults.install_plan(faults.parse_faults("sweep.dispatch=oom@1"))
+        with pytest.raises(faults.TransientDeviceFault, match="RESOURCE_EXHAUSTED"):
+            faults.fault_point("sweep.dispatch")
+
+    def test_preempt_is_typed(self):
+        faults.install_plan(faults.parse_faults("sweep.window=preempt@1"))
+        with pytest.raises(faults.FaultPreempted):
+            faults.fault_point("sweep.window")
+
+    def test_hang_sleeps_bounded_and_records(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(faults.time, "sleep", slept.append)
+        plan = faults.install_plan(
+            faults.parse_faults("native.call=hang:0.3@1")
+        )
+        faults.fault_point("native.call")  # hangs, does not raise
+        assert slept == [0.3]
+        assert plan.fired == [("native.call", "hang", 1)]
+        # A pathological duration is capped, never an hours-long wedge.
+        slept.clear()
+        faults.install_plan(faults.parse_faults("native.call=hang:9999@1"))
+        faults.fault_point("native.call")
+        assert slept == [faults.HANG_CAP_S]
+
+    def test_counts_are_per_point(self):
+        plan = faults.install_plan(faults.parse_faults("native.call=error@2"))
+        faults.fault_point("sweep.dispatch")
+        faults.fault_point("native.call")
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("native.call")
+        assert plan.counts == {"sweep.dispatch": 1, "native.call": 2}
+
+    def test_firing_lands_in_telemetry(self):
+        rec = telemetry.reset_run_record()
+        try:
+            faults.install_plan(faults.parse_faults("native.call=error@1"))
+            with pytest.raises(faults.FaultInjected):
+                faults.fault_point("native.call")
+            assert rec.counters.get("faults.injected") == 1
+            ev = [e for e in rec.events if e["name"] == "fault.injected"]
+            assert len(ev) == 1
+            assert ev[0]["attrs"] == {
+                "point": "native.call", "mode": "error", "hit": 1,
+            }
+        finally:
+            telemetry.reset_run_record()
+
+
+class TestEnvSpec:
+    def test_qi_faults_env_drives_fault_point(self, monkeypatch):
+        monkeypatch.setenv("QI_FAULTS", "checkpoint.write=oserror@1+")
+        with pytest.raises(OSError):
+            faults.fault_point("checkpoint.write")
+        # Changing the spec re-parses (no stale cache): new rules apply.
+        monkeypatch.setenv("QI_FAULTS", "native.call=error@1+")
+        faults.fault_point("checkpoint.write")  # old rule gone
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("native.call")
+
+    def test_installed_plan_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("QI_FAULTS", "native.call=error@1+")
+        faults.install_plan(faults.FaultPlan([], label="empty"))
+        faults.fault_point("native.call")  # the (empty) plan masks the env
+
+    def test_malformed_spec_raises(self):
+        with pytest.raises(ValueError, match="malformed QI_FAULTS"):
+            faults.parse_faults("native.call")
+
+    def test_spec_roundtrip(self):
+        spec = "native.call=hang:0.5@2+,checkpoint.write=oserror@3"
+        plan = faults.parse_faults(spec)
+        assert ",".join(r.spec() for r in plan.rules) == spec
+
+
+class TestDeterminism:
+    """Same seed ⇒ same plan ⇒ same firing sequence (ISSUE 4 satellite)."""
+
+    def test_same_seed_same_plan(self):
+        for seed in range(40):
+            a = faults.sample_plan(seed)
+            b = faults.sample_plan(seed)
+            assert [r.spec() for r in a.rules] == [r.spec() for r in b.rules]
+
+    def test_seeds_actually_vary(self):
+        specs = {
+            ",".join(r.spec() for r in faults.sample_plan(s).rules)
+            for s in range(40)
+        }
+        assert len(specs) > 5, "sampler collapsed to a handful of plans"
+
+    def test_same_seed_same_firing_sequence(self, monkeypatch):
+        monkeypatch.setattr(faults.time, "sleep", lambda s: None)
+        workload_points = (
+            ["native.call", "sweep.dispatch", "sweep.window",
+             "checkpoint.write", "sweep.compile"] * 3
+        )
+
+        def run(seed):
+            plan = faults.install_plan(faults.sample_plan(seed))
+            outcomes = []
+            for point in workload_points:
+                try:
+                    faults.fault_point(point)
+                    outcomes.append((point, None))
+                except Exception as exc:  # noqa: BLE001 — recording, not hiding
+                    outcomes.append((point, type(exc).__name__))
+            faults.clear_plan()
+            return list(plan.fired), outcomes
+
+        for seed in range(25):
+            assert run(seed) == run(seed), f"seed {seed} diverged"

@@ -387,6 +387,67 @@ def rule_cancel_token_plumbed(ctx: FileContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# rule: degrade-via-ladder
+
+# The one class allowed to catch Exception broadly in backends/: the auto
+# router's explicit degradation ladder (ISSUE 4).  Handlers inside it are
+# the sanctioned fall-through; everywhere else a broad catch must re-raise
+# (typed), reference the ladder (it is reporting a transition), or carry a
+# reviewed allow() with a reason.
+_LADDER_CLASSES = frozenset({"DegradationLadder"})
+_BROAD_EXC_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _catches_broadly(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:``, ``except Exception``/``BaseException``, or a tuple
+    containing either."""
+    t = handler.type
+    if t is None:
+        return True
+    exprs = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    for e in exprs:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None
+        )
+        if name in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def rule_degrade_via_ladder(ctx: FileContext) -> Iterator[Finding]:
+    """Backend engines may not invent ad-hoc degradation policy: before the
+    ladder, every ``except Exception: log-and-fall-through`` site was an
+    untested failure path with its own (absent) retry/telemetry story — the
+    exact erosion ISSUE 4 hardened away.  In ``backends/``, a broad catch
+    must either re-raise (surfacing a typed error), run inside the
+    DegradationLadder itself, or visibly report through it (a ``ladder``
+    reference in the handler body).  Cleanup-only handlers carry an
+    ``allow()`` with a reason, reviewed like any other suppression."""
+    if "backends/" not in ctx.rel.replace("\\", "/"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _catches_broadly(node):
+            continue
+        if any(
+            isinstance(a, ast.ClassDef) and a.name in _LADDER_CLASSES
+            for a in ctx.ancestors(node)
+        ):
+            continue
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue  # the failure is surfaced, not swallowed
+        if any("ladder" in ident.lower() for ident in _idents_in(node)):
+            continue  # the handler reports through the ladder API
+        yield from ctx.finding(
+            "degrade-via-ladder", node,
+            "broad `except` that falls through without the ladder: route "
+            "engine degradation through DegradationLadder.attempt (or "
+            "record it via the ladder API) so every fallback is retried, "
+            "bounded, and emits a `degrade` event — ad-hoc catch-and-"
+            "continue sites are how the hardening erodes",
+        )
+
+
+# ---------------------------------------------------------------------------
 # rule: jax-tracer-leak
 
 _JIT_NAMES = frozenset({"jit"})
@@ -523,6 +584,7 @@ RULES = {
     "span-balance": rule_span_balance,
     "lock-discipline": rule_lock_discipline,
     "cancel-token-plumbed": rule_cancel_token_plumbed,
+    "degrade-via-ladder": rule_degrade_via_ladder,
     "jax-tracer-leak": rule_jax_tracer_leak,
 }
 

@@ -44,6 +44,11 @@ TYPING_TARGETS = (
     "quorum_intersection_tpu/encode",
     "quorum_intersection_tpu/utils/telemetry.py",
     "quorum_intersection_tpu/backends/auto.py",
+    # ISSUE 4: the fault-injection registry and the crash-only checkpoint
+    # writer join the spine — a type error in either costs exactly the
+    # robustness they exist to provide.
+    "quorum_intersection_tpu/utils/faults.py",
+    "quorum_intersection_tpu/utils/checkpoint.py",
 )
 
 

@@ -16,8 +16,11 @@
 #     inspectable (tools/metrics_report.py) instead of anecdotal;
 #   - the static-analysis suite (docs/STATIC_ANALYSIS.md) runs after the
 #     tests: `python -m tools.analyze` must exit clean, and its findings
-#     stream to $TIER1_ANALYZE in the same qi-telemetry/1 shape.  Either
-#     gate failing fails the script.
+#     stream to $TIER1_ANALYZE in the same qi-telemetry/1 shape;
+#   - a chaos-soak smoke (docs/ROBUSTNESS.md) runs last: a small fixed-seed
+#     window of `tools/soak.py --chaos` — every injected fault schedule
+#     must leave the verdict equal to the fault-free sequential chain or
+#     fail with a typed error.  Any gate failing fails the script.
 #
 # Usage: tools/ci_tier1.sh [extra pytest args...]
 set -o pipefail
@@ -45,5 +48,14 @@ env JAX_PLATFORMS=cpu python -m tools.analyze --jsonl "$ANALYZE_OUT"
 arc=$?
 echo "ANALYZE=$ANALYZE_OUT (exit $arc)"
 
+# Chaos-soak smoke: small fixed-seed window, deterministic schedules, no
+# ledger writes.  Seed/size overridable for local debugging.
+env JAX_PLATFORMS=cpu python tools/soak.py --chaos \
+    --instances "${TIER1_CHAOS_INSTANCES:-8}" \
+    --seed "${TIER1_CHAOS_SEED:-0}" --no-ledger
+crc=$?
+echo "CHAOS=exit $crc"
+
 [ "$rc" -ne 0 ] && exit "$rc"
-exit "$arc"
+[ "$arc" -ne 0 ] && exit "$arc"
+exit "$crc"

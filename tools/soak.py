@@ -28,11 +28,23 @@ Results append to a persistent ledger
 round over round instead of resetting; re-running an already-recorded
 ``(seed, instances)`` window is detected and skipped unless ``--force``.
 
+**Chaos mode** (ISSUE 4): ``--chaos`` solves each instance under a SEEDED
+fault schedule (``utils/faults.py sample_plan``) on three auto-router
+configurations — the sequential chain, the racing chain, and a forced
+sweep-rung chain (so device faults actually fire on instances the host
+oracle would otherwise answer in microseconds) — and asserts the hardened
+pipeline's contract: the verdict equals the fault-free sequential chain,
+or the run fails LOUDLY with a typed error (``FaultInjected`` family /
+``RungFailed``).  A silent verdict flip or an untyped crash is a mismatch,
+exit 1.  Same ``--seed`` ⇒ same schedules ⇒ same firing sequence, so a
+chaos failure reproduces exactly.
+
 Usage::
 
     python tools/soak.py                      # 40 instances from seed 0
     python tools/soak.py --instances 100 --seed 1000
     python tools/soak.py --no-ledger          # dry run, don't record
+    python tools/soak.py --chaos --instances 20 --seed 0
 """
 
 from __future__ import annotations
@@ -43,6 +55,7 @@ import os
 import pathlib
 import random
 import sys
+import tempfile
 import time
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -182,6 +195,125 @@ def run_instance(seed: int, profile: str = "small") -> dict:
             "mismatches": mismatches}
 
 
+def run_chaos_instance(seed: int, profile: str, workdir: pathlib.Path) -> dict:
+    """Solve one instance under a seeded fault schedule on three auto-router
+    configurations; the verdict must equal the fault-free sequential chain,
+    or the failure must be a typed error — never a silent flip."""
+    from quorum_intersection_tpu.backends.auto import AutoBackend, RungFailed
+    from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.utils import faults
+    from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+    kind, desc, data = make_instance(seed, profile)
+    faults.clear_plan()
+    expected = solve(data, backend=AutoBackend(race=False))
+
+    class _InstantBurn:
+        """Budgeted-oracle stand-in that burns immediately: forces the
+        sequential chain onto the sweep rung so device faults actually fire
+        (the real oracle answers these instances in microseconds, before
+        any sweep fault point is reached)."""
+
+        name = "burn"
+
+        def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+            raise OracleBudgetExceeded("chaos: forced sweep rung")
+
+    class SweepFirstAuto(AutoBackend):
+        def _cpu_oracle(self, budget_s=None, cancel=None):
+            if budget_s is not None:
+                return _InstantBurn()
+            return super()._cpu_oracle(budget_s=budget_s, cancel=cancel)
+
+    configs = {
+        "auto-seq": lambda: AutoBackend(race=False),
+        "auto-race": lambda: AutoBackend(),
+        "sweep-rung": lambda: SweepFirstAuto(
+            race=False,
+            checkpoint=SweepCheckpoint(workdir / f"chaos-{seed}.ckpt"),
+        ),
+    }
+    mismatches: list = []
+    typed_failures: list = []
+    fired = 0
+    schedule_label = faults.sample_plan(seed).label
+    for name, make_backend in configs.items():
+        # A fresh plan per configuration: hit counters start at zero, so
+        # every chain sees the identical schedule (determinism contract).
+        plan = faults.install_plan(faults.sample_plan(seed))
+        try:
+            res = solve(data, backend=make_backend())
+            if res.intersects is not expected.intersects:
+                mismatches.append(
+                    f"{name}: SILENT verdict flip {res.intersects} != "
+                    f"fault-free {expected.intersects} under {schedule_label}"
+                )
+        except (faults.FaultInjected, RungFailed) as exc:
+            # Loud and typed: the acceptable failure shape.  Deliberately
+            # NOT OSError: the hardened checkpoint writer swallows those,
+            # so one escaping solve() is an unhardened path — a finding.
+            typed_failures.append(f"{name}: {type(exc).__name__}: {exc}")
+        except Exception as exc:  # noqa: BLE001 — an untyped crash IS a finding
+            mismatches.append(
+                f"{name}: UNTYPED crash {type(exc).__name__}: {exc} "
+                f"under {schedule_label}"
+            )
+        finally:
+            fired += len(plan.fired)
+            faults.clear_plan()
+    return {"seed": seed, "kind": kind, "desc": desc,
+            "schedule": schedule_label, "fired": fired,
+            "typed_failures": typed_failures, "mismatches": mismatches}
+
+
+def chaos_main(args: argparse.Namespace) -> int:
+    """--chaos driver: seeded fault schedules over the instance window."""
+    # The watchdog is part of the hardened configuration under test: a
+    # sampled native hang must degrade through it, not stall the soak.
+    # (Explicit opt-in here, not a global default — production runs choose
+    # their own deadline through the env registry.)
+    os.environ.setdefault("QI_NATIVE_WATCHDOG_S", "0.25")
+    t0 = time.time()
+    bad: list = []
+    total_fired = 0
+    total_typed = 0
+    with tempfile.TemporaryDirectory(prefix="qi-chaos-") as tmp:
+        workdir = pathlib.Path(tmp)
+        for i, seed in enumerate(range(args.seed, args.seed + args.instances)):
+            rec = run_chaos_instance(seed, args.profile, workdir)
+            total_fired += rec["fired"]
+            total_typed += len(rec["typed_failures"])
+            if rec["mismatches"]:
+                bad.append(rec)
+                print(f"CHAOS MISMATCH seed={seed} {rec['desc']} "
+                      f"[{rec['schedule']}]: {rec['mismatches']}")
+            if (i + 1) % 10 == 0:
+                print(f"  ... {i + 1}/{args.instances} chaos instances "
+                      f"({time.time() - t0:.0f}s, {len(bad)} mismatches, "
+                      f"{total_fired} faults fired)", file=sys.stderr)
+    summary = {
+        "chaos": True,
+        "window": [args.seed, args.seed + args.instances],
+        "profile": args.profile,
+        "instances": args.instances,
+        "n_mismatches": len(bad),
+        "mismatches": bad,
+        "faults_fired": total_fired,
+        "typed_failures": total_typed,
+        "seconds": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", "ambient"),
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "mismatches"}))
+    if not args.no_ledger:
+        ledger = load_ledger()
+        ledger.setdefault("chaos_runs", []).append(summary)
+        LEDGER.parent.mkdir(parents=True, exist_ok=True)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: chaos run recorded -> {LEDGER}", file=sys.stderr)
+    return 1 if bad else 0
+
+
 def load_ledger() -> dict:
     if LEDGER.exists():
         return json.loads(LEDGER.read_text())
@@ -204,6 +336,11 @@ def main(argv=None) -> int:
                         help="cpu (default): pin jax to the host CPU so a dead "
                              "tunnel can never hang the soak; ambient: use "
                              "whatever JAX_PLATFORMS/the image selects (chip)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="solve each instance under a seeded fault "
+                             "schedule (utils/faults.py) and assert the "
+                             "verdict equals the fault-free sequential chain "
+                             "or fails loudly with a typed error")
     args = parser.parse_args(argv)
 
     # The differential contract is platform-independent, so the harness
@@ -214,6 +351,9 @@ def main(argv=None) -> int:
         from quorum_intersection_tpu.utils.platform import honor_platform_env
 
         honor_platform_env()
+
+    if args.chaos:
+        return chaos_main(args)
 
     ledger = load_ledger()
     window = [args.seed, args.seed + args.instances]
