@@ -112,6 +112,105 @@ def native_row(data, scc: int, cap_s: float) -> dict:
     }
 
 
+# int8 MXU peak MACs/s by device-kind substring (bench.py INT8_PEAK_MACS —
+# duplicated constant, not an import: bench.py is the driver harness and
+# pulls in its whole orchestration surface).  Kinds not listed get no MFU
+# cell rather than a wrong one.
+INT8_PEAK_MACS = {"v5 lite": 1.97e14, "v5e": 1.97e14}
+
+
+def kofn(n: int, k: int, prefix: str = "N") -> list:
+    """Symmetric k-of-n FBAS: single SCC; broken iff k <= n//2 (the
+    broken twin the sweep itself must find — synth's broken pairs are
+    guard-decided before any backend runs)."""
+    ks = [f"{prefix}{i}" for i in range(n)]
+    return [
+        {"publicKey": x, "name": x,
+         "quorumSet": {"threshold": k, "validators": ks}}
+        for x in ks
+    ]
+
+
+def packed_row(scc: int, device: str) -> dict:
+    """One lane-packing measurement: K=4 k-of-n problems (two correct, two
+    broken) swept packed vs unpacked, with the per-lane-group work
+    accounting that makes the MACs-per-verdict claim checkable off-chip:
+    MACs = rows actually dispatched x the lane-padded shape model
+    (sweep.macs_per_candidate_row), packed totals shared across the pack's
+    verdicts.  Wall-clock speedup rides along and — with verdict parity —
+    is what gates auto-engagement (calibration.pack_win_max_scc)."""
+    from quorum_intersection_tpu.backends.tpu.sweep import (
+        TpuSweepBackend,
+        macs_per_candidate_row,
+    )
+    from quorum_intersection_tpu.encode.circuit import encode_circuit
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
+
+    n = scc
+    datas = [
+        kofn(n, n // 2 + 1, "PA"), kofn(n, n // 2, "PB"),
+        kofn(n, n // 2 + 1, "PC"), kofn(n, n // 2, "PD"),
+    ]
+    jobs = []
+    for data in datas:
+        graph = build_graph(parse_fbas(data))
+        circuit = encode_circuit(graph)
+        bearing = quorum_bearing_sccs(graph, allow_native=False)
+        assert len(bearing) == 1
+        jobs.append((graph, circuit, bearing[0][1]))
+    k = len(jobs)
+
+    t0 = time.perf_counter()
+    unpacked = [TpuSweepBackend().check_scc(g, c, s) for g, c, s in jobs]
+    unpacked_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    packed = TpuSweepBackend().check_sccs(jobs)
+    packed_s = time.perf_counter() - t0
+
+    verdict_ok = all(
+        u.intersects == p.intersects and u.q1 == p.q1 and u.q2 == p.q2
+        for u, p in zip(unpacked, packed)
+    )
+    pstats = packed[0].stats
+    packed_macs = (
+        pstats["pack_rows_dispatched"] * pstats["pack_macs_per_candidate_row"]
+    )
+    unpacked_macs = 0.0
+    for res in unpacked:
+        shape = res.stats.get("padded_shape") or res.stats["device_shape"]
+        unpacked_macs += res.stats["candidates_checked"] * macs_per_candidate_row(
+            shape[0], shape[1], 0
+        )
+    row = {
+        "scc": scc, "device": device, "pack_jobs": k,
+        "pack_groups": pstats["pack_groups"],
+        "pack_fill_pct": pstats["pack_fill_pct"],
+        "packed_seconds": round(packed_s, 3),
+        "unpacked_seconds": round(unpacked_s, 3),
+        "packed_speedup_vs_unpacked": round(unpacked_s / packed_s, 2)
+        if packed_s else None,
+        "packed_macs_per_verdict": round(packed_macs / k, 1),
+        "unpacked_macs_per_verdict": round(unpacked_macs / k, 1),
+        "packed_macs_ratio": round(packed_macs / unpacked_macs, 4)
+        if unpacked_macs else None,
+        "verdict_ok": verdict_ok,
+    }
+    # Packed-MFU estimate for the qi-telemetry stream (ROADMAP telemetry
+    # item): shape-model MACs/s against the int8 peak — only on device
+    # kinds with a known peak, so a CPU-emulated row carries null here
+    # while still carrying the (platform-independent) MACs accounting.
+    peak = next(
+        (v for key, v in INT8_PEAK_MACS.items() if key in device.lower()), None
+    )
+    row["sweep_mfu_pct"] = (
+        round(packed_macs / packed_s / peak * 100, 3)
+        if peak and packed_s else None
+    )
+    return row
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -120,7 +219,20 @@ def main() -> int:
                         help="|scc| sizes (multiples of 4)")
     parser.add_argument("--native-cap", type=float, default=600.0,
                         help="seconds the native oracle may run to completion")
+    parser.add_argument("--packed", action="store_true",
+                        help="add lane-packed vs unpacked sweep rows "
+                             "(packed MACs-per-verdict accounting + "
+                             "pack_fill_pct/sweep_mfu_pct)")
+    parser.add_argument("--packed-scc", type=int, nargs="*", default=None,
+                        help="|scc| sizes for the --packed rows "
+                             "(<= 31: the packable window)")
+    parser.add_argument("--metrics-json", default=None, metavar="PATH",
+                        help="append the run's qi-telemetry/1 stream "
+                             "(sweep.pack_* counters included) to PATH")
     args = parser.parse_args()
+
+    if args.metrics_json:
+        os.environ["QI_METRICS_JSON"] = os.path.abspath(args.metrics_json)
 
     from quorum_intersection_tpu.utils.platform import honor_platform_env
 
@@ -188,6 +300,34 @@ def main() -> int:
             f"{row['sweep_cand_per_sec']:.3g} |"
         )
         print(json.dumps(row), flush=True)
+
+    if args.packed:
+        # Packed sizes stay within the packable window (bits <= 30) and the
+        # acceptance regime (n <= 48); --quick keeps CPU emulation seconds.
+        packed_sizes = [
+            s for s in (
+                args.packed_scc or ([12, 14] if args.quick else [24, 31])
+            ) if s <= 31
+        ]
+        print("\n| scc | K | packed (s) | unpacked (s) | speedup | "
+              "MACs/verdict ratio | fill % | mfu % |")
+        print("|---|---|---|---|---|---|---|---|")
+        ok = True
+        for scc in packed_sizes:
+            row = packed_row(scc, device)
+            ok = ok and row["verdict_ok"]
+            flag = "" if row["verdict_ok"] else " **INVALID: verdict mismatch**"
+            mfu = row["sweep_mfu_pct"]
+            print(
+                f"| {scc} | {row['pack_jobs']} | {row['packed_seconds']:.2f} | "
+                f"{row['unpacked_seconds']:.2f} | "
+                f"{row['packed_speedup_vs_unpacked']}x{flag} | "
+                f"{row['packed_macs_ratio']} | {row['pack_fill_pct']} | "
+                f"{mfu if mfu is not None else '—'} |"
+            )
+            print(json.dumps(row), flush=True)
+        if not ok:
+            return 1
     return 0
 
 

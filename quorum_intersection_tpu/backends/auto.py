@@ -55,7 +55,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Set, TypeVar
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, TypeVar
 
 _T = TypeVar("_T")
 
@@ -107,6 +107,7 @@ DEFAULT_SWEEP_LIMIT = None  # resolve by platform at check time
 # within the module's lazy-device-import discipline.
 from quorum_intersection_tpu.backends.tpu.sweep import (  # noqa: E402
     DEFAULT_MAX_BITS as _SWEEP_MAX_BITS,
+    LO_BITS as _SWEEP_LO_BITS,
 )
 
 SWEEP_DECODE_CEILING = _SWEEP_MAX_BITS + 1
@@ -597,6 +598,7 @@ class AutoBackend:
         checkpoint: Optional[object] = None,
         mesh: Optional[object] = None,
         race: bool = True,
+        pack: Optional[bool] = None,
     ) -> None:
         # prefer_tpu (`--backend tpu`) is routing-neutral since the r3
         # on-chip crossover: large SCCs go to the host oracle everywhere
@@ -611,6 +613,12 @@ class AutoBackend:
         # boxes (the racing sweep competes for the oracle's CPU) and for
         # debugging — verdicts are identical either way.
         self.race = race
+        # Lane packing for the batch entry (check_sccs): None (default)
+        # engages only behind a MEASURED packed-vs-unpacked win on the live
+        # device kind (calibration.pack_win_max_scc — the same recorded-
+        # measurement discipline as every other routing claim here); True
+        # forces packing (tests, benchmarks); False never packs.
+        self.pack = pack
         self._oracle_options = {"seed": seed, "randomized": randomized} if (randomized or seed is not None) else {}
         # One ladder per router instance: retry budgets and quarantine are
         # scoped to the run (the CLI builds one AutoBackend per solve).
@@ -987,19 +995,164 @@ class AutoBackend:
         scc: List[int],
         *,
         scope_to_scc: bool = False,
+        _budget_burned: bool = False,
     ) -> SccCheckResult:
         # The routing decision is a span of its own ("route"): nested under
         # the pipeline's phase.search span, wrapping the race span when one
         # runs, and stamped with the engine that actually answered — the
         # record shows WHERE the verdict came from, not just how long.
+        # ``_budget_burned`` (private; check_sccs fallback) records that
+        # this problem's oracle budget ALREADY burned in the batch entry,
+        # so the route skips straight to the post-burn engines instead of
+        # re-burning the same budget.
         with get_run_record().span(
             "route", scc=len(scc), race_enabled=self.race
         ) as route_span:
             res = self._route(
-                graph, circuit, scc, scope_to_scc=scope_to_scc
+                graph, circuit, scc, scope_to_scc=scope_to_scc,
+                budget_burned=_budget_burned,
             )
             route_span.set(backend=res.stats.get("backend", "?"))
             return res
+
+    # ---- batch entry (ISSUE 5): lane-packed multi-problem routing --------
+
+    def _pack_bound(self, sizes: List[int]) -> Optional[int]:
+        """Largest |scc| the batch entry may fuse into lane packs, or None
+        when packing must not engage at all — PROBE-FREE (no device
+        contact; the device-kind half of the auto gate is checked in
+        check_sccs only after every budgeted oracle has answered, so a
+        hung tunnel can never starve the verdict path).
+
+        pack=True forces engagement (bounded only by the platform sweep
+        limit applied later); pack=False (or a mesh/checkpoint, which the
+        packed path does not serve) forbids it; pack=None engages only
+        behind a MEASURED packed win (calibration.pack_win_max_scc), and —
+        unlike mere engagement — the returned bound (win + one grid step
+        of headroom) also CAPS which jobs may pack, so a batch that
+        engages off two small measured jobs cannot sneak an unmeasured
+        size into the pack.  Auto-gating additionally needs two jobs that
+        could actually share a pack.
+        """
+        if self.pack is False or self.mesh is not None or self.checkpoint is not None:
+            return None
+        if self.pack is True:
+            return SWEEP_DECODE_CEILING
+        win = CALIBRATION.pack_win_max_scc
+        if win is None:
+            return None
+        bound = win + SWEEP_WIN_SCC_HEADROOM
+        eligible = [s for s in sizes if s <= bound]
+        return bound if len(eligible) >= 2 else None
+
+    def check_sccs(
+        self,
+        jobs: Sequence[Tuple[TrustGraph, Optional[Circuit], List[int]]],
+        *,
+        scope_to_scc: bool = False,
+    ) -> List[SccCheckResult]:
+        """Batch entry (``pipeline.check_many``): route many SCC problems
+        at once, fusing sweep-sized ones into lane packs.
+
+        The packed engine is LADDER-VISIBLE: the packed attempt runs as
+        the ``tpu-sweep`` rung, so any failure — including an injected
+        ``sweep.pack`` fault — emits a ``degrade`` event and falls back to
+        the unpacked per-problem router with verdicts unchanged.  With
+        ``pack=None`` (auto-gated), each packable job first gets the
+        budgeted host oracle exactly as the sequential single-problem path
+        would — real topologies resolve there in microseconds and only the
+        budget-burners pay for a pack; ``pack=True`` (tests, benchmarks)
+        skips the oracle for a deterministic packed run.  Jobs outside the
+        pack window route per-job through :meth:`check_scc` (race,
+        frontier region, host oracle) unchanged.
+        """
+        jobs = list(jobs)
+        results: List[Optional[SccCheckResult]] = [None] * len(jobs)
+        rec = get_run_record()
+        packable: List[int] = []
+        burned: Set[int] = set()
+        pack_cap = self._pack_bound([len(scc) for _, _, scc in jobs])
+        if pack_cap is not None:
+            # Probe-free optimistic limit, exactly as _route's oracle-first
+            # bound: the budgeted oracles below must answer without any
+            # device contact (a hung tunnel blocks in the probe).
+            if self.sweep_limit is not None:
+                optimistic = self.sweep_limit
+            elif _resolved_platform_limit is not None:
+                optimistic = _resolved_platform_limit
+            else:
+                optimistic = max(SWEEP_LIMIT_TPU, _measured_sweep_raise() or 0)
+            for i, (graph, circuit, scc) in enumerate(jobs):
+                if (
+                    len(scc) > min(optimistic, pack_cap)
+                    or len(scc) - 1 > _SWEEP_LO_BITS
+                ):
+                    continue
+                if self.pack is None:
+                    res = self._budgeted_oracle(
+                        graph, circuit, scc, scope_to_scc,
+                        self._estimated_sweep_seconds(len(scc)),
+                    )
+                    if res is not None:
+                        results[i] = res
+                        continue
+                    burned.add(i)
+                packable.append(i)
+        if packable and self.pack is None:
+            # Every oracle has answered; the survivors head for the device
+            # anyway, so the gated platform limit and the device-kind half
+            # of the calibration gate (a TPU-measured pack win must not
+            # engage elsewhere) are checked HERE, off the verdict path.
+            from quorum_intersection_tpu.utils.platform import backend_kind
+
+            limit = (
+                self.sweep_limit if self.sweep_limit is not None
+                else _platform_sweep_limit()
+            )
+            if backend_kind() != CALIBRATION.pack_win_device:
+                packable = []
+            else:
+                packable = [i for i in packable if len(jobs[i][2]) <= limit]
+        elif packable:
+            limit = (
+                self.sweep_limit if self.sweep_limit is not None
+                else _platform_sweep_limit()
+            )
+            packable = [i for i in packable if len(jobs[i][2]) <= limit]
+        if packable:
+            def run_packed() -> List[SccCheckResult]:
+                sweep = self._sweep()
+                rec.event(
+                    "route.decision", engine="tpu-sweep",
+                    scc=max(len(jobs[i][2]) for i in packable),
+                    reason=f"lane-packed batch of {len(packable)} jobs",
+                )
+                return sweep.check_sccs(
+                    [jobs[i] for i in packable], scope_to_scc=scope_to_scc
+                )
+
+            try:
+                packed = self._ladder.attempt(
+                    "tpu-sweep", run_packed, fall_to="tpu-sweep"
+                )
+                for i, res in zip(packable, packed):
+                    results[i] = res
+            except RungFailed as fail:
+                log.info(
+                    "packed sweep unavailable (%s); falling back to the "
+                    "unpacked per-problem router", fail.cause,
+                )
+        for i, (graph, circuit, scc) in enumerate(jobs):
+            if results[i] is None:
+                # A job whose budget already burned above must not re-burn
+                # it in the per-problem route (gate-dropped or packed-rung
+                # failure): _budget_burned skips straight to the post-burn
+                # engines, the same place a --no-race burn lands.
+                results[i] = self.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc,
+                    _budget_burned=i in burned,
+                )
+        return [res for res in results if res is not None]
 
     def _route(
         self,
@@ -1008,6 +1161,7 @@ class AutoBackend:
         scc: List[int],
         *,
         scope_to_scc: bool = False,
+        budget_burned: bool = False,
     ) -> SccCheckResult:
         # Optimistic limit first (no device probe on THIS thread): the
         # oracle-vs-sweep window applies to every SCC a sweep could
@@ -1037,7 +1191,7 @@ class AutoBackend:
         else:
             optimistic = max(SWEEP_LIMIT_TPU, _measured_sweep_raise() or 0)
         if len(scc) <= optimistic:
-            if not resumable:
+            if not resumable and not budget_burned:
                 budget_s = self._estimated_sweep_seconds(len(scc))
                 attempt = self._race if self.race else self._budgeted_oracle
                 res = attempt(graph, circuit, scc, scope_to_scc, budget_s)

@@ -172,6 +172,16 @@ class Calibration:
     # for any future probe-ful consumer.
     sweep_warm_ratio: Optional[float] = None
     sweep_warm_device: Optional[str] = None
+    # Measured lane-packing win (benchmarks/sweep_vs_native.py --packed
+    # rows): the largest |scc| at which the PACKED multi-problem sweep
+    # measured >= 1x the unpacked per-problem sweeps wall-clock, with
+    # verdict parity on every packed row.  Gates when the auto router's
+    # batch entry (check_sccs) engages packing on its own — forced packing
+    # (pack=True) and the structural MACs accounting need no artifact.
+    # None = packing never auto-engages (the honest-measurement posture
+    # every routing claim in this module follows).
+    pack_win_max_scc: Optional[int] = None
+    pack_win_device: Optional[str] = None
     # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
     provenance: Dict[str, str] = field(default_factory=dict)
 
@@ -378,6 +388,83 @@ def _sweep_win_max_scc(
     )
 
 
+def _pack_win_max_scc(
+    paths: Iterable[pathlib.Path],
+) -> Optional[Tuple[int, str, str]]:
+    """Largest |scc| at which the lane-packed sweep measured >= 1x the
+    unpacked sweeps, per the newest sweep_vs_native artifact's ``--packed``
+    rows (``packed_speedup_vs_unpacked`` + ``verdict_ok``).
+
+    Same conservative discipline as the sweep window: rows group by the
+    device kind they were measured on (a TPU win never engages packing on
+    other hardware, and CPU-emulated rows never pollute a chip window —
+    when both kinds recorded wins, the accelerator's gate, the prize this
+    exists for, is the one kept); per-scc speed is the MINIMUM across that
+    scc's rows; a ``verdict_ok: false`` packed row anywhere in the chosen
+    artifact vetoes the whole gate (correctness evidence, not a slow
+    size); and a measured LOSS above the static floor caps the window from
+    below it — a win beyond a loss must not route the losing size.
+    """
+    newest: Optional[Tuple[int, str, Dict[str, Dict[int, float]], List[int]]] = None
+    for path in paths:
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        by_kind: Dict[str, Dict[int, float]] = {}
+        vetoes: List[int] = []
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            scc = rec.get("scc")
+            speed = rec.get("packed_speedup_vs_unpacked")
+            if not isinstance(scc, int) or not isinstance(speed, (int, float)):
+                continue
+            if not rec.get("verdict_ok", False):
+                vetoes.append(scc)
+                continue
+            v = float(speed)
+            kind_rows = by_kind.setdefault("tpu" if _is_tpu(rec) else "cpu", {})
+            kind_rows[scc] = min(kind_rows.get(scc, v), v)
+        if by_kind or vetoes:
+            rank = _round_rank(path.name)
+            if newest is None or rank > newest[0]:
+                newest = (rank, path.name, by_kind, vetoes)
+    if newest is None:
+        return None
+    _, name, by_kind, vetoes = newest
+    if vetoes:
+        log.warning(
+            "lane-packing gate vetoed: %s records verdict_ok=false at "
+            "packed scc %s", name, sorted(set(vetoes)),
+        )
+        return None
+    for kind in ("tpu", "cpu"):
+        by_scc = by_kind.get(kind)
+        if not by_scc:
+            continue
+        losses = [scc for scc, v in by_scc.items() if v < 1.0]
+        cap = min(losses) - 1 if losses else None
+        wins = [
+            scc for scc, v in by_scc.items()
+            if v >= 1.0 and (cap is None or scc <= cap)
+        ]
+        if not wins:
+            continue
+        win = max(wins)
+        capped = f", loss measured at scc {cap + 1}" if cap is not None else ""
+        return win, kind, (
+            f"{name}: packed sweep >= 1x unpacked up to scc {win} on "
+            f"{kind}{capped}"
+        )
+    return None
+
+
 def _sweep_warm_ratio(
     paths: Iterable[pathlib.Path],
 ) -> Optional[Tuple[float, str]]:
@@ -464,6 +551,9 @@ def calibrate(
         crossover_paths = _crossover_paths() if paths is None else []
     if sweep_window_paths is None:
         sweep_window_paths = _sweep_window_paths() if paths is None else []
+    # Consumed twice below (sweep window + pack gate): materialize so a
+    # generator argument cannot silently starve the second pass.
+    sweep_window_paths = list(sweep_window_paths)
     if auto_race_paths is None:
         auto_race_paths = _auto_race_paths() if paths is None else []
     try:
@@ -488,6 +578,14 @@ def calibrate(
         if sw is not None:
             (cal.sweep_win_max_scc, cal.sweep_win_cap_scc,
              cal.sweep_win_device, cal.provenance["sweep_window"]) = sw
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        pass
+    try:
+        pw = _pack_win_max_scc(sweep_window_paths)
+        if pw is not None:
+            (cal.pack_win_max_scc, cal.pack_win_device,
+             cal.provenance["pack"]) = pw
     # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass

@@ -121,6 +121,7 @@ class TpuFrontierBackend:
         interrupt_after_chunks: Optional[int] = None,
         mesh=None,
         flag_check: str = "auto",
+        pad_shapes: bool = True,
     ) -> None:
         if arena < 4:
             # Mirrors the mesh-path validation in check_scc: pop is clamped to
@@ -159,6 +160,13 @@ class TpuFrontierBackend:
         # interrupt_after_batches contract): after this many chunks, force a
         # checkpoint write and raise.
         self.interrupt_after_chunks = interrupt_after_chunks
+        # Canonical compile-shape bucketing (the sweep's warm-start
+        # discipline): pad the SCC lane count AND the circuit's device axes
+        # to the encode PAD_LADDER, so chunk_fn/filter_block compile once
+        # per ladder bucket instead of once per exact |scc| (ROUND5_NOTES
+        # flags 2-40 s per-shape compiles on small-SCC frontier rows).
+        # False keeps exact shapes.
+        self.pad_shapes = pad_shapes
 
     # ---- host-side exact checks (reference semantics) -------------------
 
@@ -546,6 +554,49 @@ class TpuFrontierBackend:
             probe_circuit = None if scope_to_scc else q6_c
             scc_local = list(range(s))
 
+        # Canonical compile-shape bucketing (ISSUE 5 satellite — the
+        # sweep's warm-start discipline applied to the frontier): round the
+        # SCC lane count up the encode PAD_LADDER (s -> s_dev) and the
+        # circuit's (n, units) axes to their canonical rungs, so the
+        # chunk_fn/filter_block compile shapes — which key the persistent
+        # XLA compile cache — collapse from "one per exact |scc|" into
+        # ladder buckets.  Padded lanes map to inert padded circuit columns
+        # (zero votes everywhere, Q2-unsatisfiable root units): they can
+        # never enter a quorum, never branch, and never flag, so every
+        # state/flag row keeps its support inside the real s lanes.  The
+        # checkpoint fingerprint hashes the UNPADDED arrays (fp_circuit
+        # below), so checkpoints recorded before this change keep resuming.
+        fp_circuit, fp_probe = circuit, probe_circuit
+        s_dev = s
+        scc_dev = list(scc_local)
+        padded_from = None
+        if self.pad_shapes:
+            from quorum_intersection_tpu.encode.circuit import (
+                ladder_up,
+                pad_circuit,
+            )
+
+            s_dev = ladder_up(s)
+            n_to = ladder_up(max(circuit.n, s_dev))
+            if circuit.n_units > circuit.n:
+                # Preserve the strict inner-unit marker (n_units > n) that
+                # pad_targets would collapse when the forced node axis
+                # overtakes the unit count.
+                units_to = ladder_up(max(circuit.n_units, n_to + 1))
+            else:
+                units_to = n_to
+            if (n_to, units_to) != (circuit.n, circuit.n_units) or s_dev != s:
+                padded_from = [s, circuit.n, circuit.n_units]
+                pad_base = circuit.n  # padded lanes -> inert padded columns
+                circuit = pad_circuit(circuit, n_to, units_to)
+                if probe_circuit is not None:
+                    probe_circuit = pad_circuit(probe_circuit, n_to, units_to)
+                scc_dev += list(range(pad_base, pad_base + (s_dev - s)))
+        if s_dev != s:
+            a_pad = np.zeros((s_dev, s_dev), dtype=np.int32)
+            a_pad[:s, :s] = a_scc
+            a_scc = a_pad
+
         K = self.pop
         if self.mesh is not None:
             # The double-height fixpoint batch must split evenly across the
@@ -563,7 +614,7 @@ class TpuFrontierBackend:
                 ((K + n_dev - 1) // n_dev) * n_dev,
                 (self.arena // 4 // n_dev) * n_dev,
             )
-        run_chunk = self._build_chunk(circuit, scc_local, a_scc, half, K)
+        run_chunk = self._build_chunk(circuit, scc_dev, a_scc, half, K)
         # Built lazily on the first flagged batch: majority-style searches
         # flag nothing, and the native engine behind the checker may pay a
         # one-off g++ compile that a pure device run should never wait on.
@@ -585,12 +636,19 @@ class TpuFrontierBackend:
             # from device_chunks alone would overcount coverage.
             "discarded_chunks": 0,
         }
+        if padded_from is not None:
+            # Warm-start provenance, the sweep's discipline: the canonical
+            # ladder shape this run compiled under (s_dev, n, units) and
+            # the exact (s, n, units) it would have compiled without
+            # bucketing — proves the compile-cache bucketing engaged.
+            stats["padded_from"] = padded_from
+            stats["padded_shape"] = [s_dev, circuit.n, circuit.n_units]
 
         C = self.arena  # K fixed above (mesh-rounded) — the host overflow
         # guard and the device loop's exit must use the same value or the
         # two can disagree and livelock.
-        T = np.zeros((C, s), dtype=np.int8)
-        D = np.zeros((C, s), dtype=np.int8)
+        T = np.zeros((C, s_dev), dtype=np.int8)
+        D = np.zeros((C, s_dev), dtype=np.int8)
 
         fingerprint = None
         resumed = None
@@ -603,17 +661,17 @@ class TpuFrontierBackend:
             # row is all-zero and the probe thresholds join the hash to
             # keep the two problems' fingerprints distinct (cf. the sweep's
             # fingerprint block).
-            scc_mask = np.zeros(circuit.n, dtype=np.float32)
+            scc_mask = np.zeros(fp_circuit.n, dtype=np.float32)
             scc_mask[scc_local] = 1.0
             frozen = (
-                np.zeros(circuit.n, dtype=np.float32)
-                if (scope_to_scc or probe_circuit is not None)
+                np.zeros(fp_circuit.n, dtype=np.float32)
+                if (scope_to_scc or fp_probe is not None)
                 else 1.0 - scc_mask
             )
             fingerprint = sweep_fingerprint(
-                circuit.members, circuit.child, circuit.thresholds,
+                fp_circuit.members, fp_circuit.child, fp_circuit.thresholds,
                 np.asarray(scc, dtype=np.int32), scc_mask, frozen,
-                None if probe_circuit is None else probe_circuit.thresholds,
+                None if fp_probe is None else fp_probe.thresholds,
             )
             resumed = self.checkpoint.resume_states(fingerprint)
 
@@ -621,8 +679,8 @@ class TpuFrontierBackend:
 
         def encode_states(pairs) -> Tuple[np.ndarray, np.ndarray]:
             """(toRemove, dontRemove) node-list pairs → int8 bitmask blocks."""
-            t_blk = np.zeros((len(pairs), s), dtype=np.int8)
-            d_blk = np.zeros((len(pairs), s), dtype=np.int8)
+            t_blk = np.zeros((len(pairs), s_dev), dtype=np.int8)
+            d_blk = np.zeros((len(pairs), s_dev), dtype=np.int8)
             for r, (to_remove, dont_remove) in enumerate(pairs):
                 for v in to_remove:
                     t_blk[r, scc_pos[v]] = 1
@@ -718,14 +776,14 @@ class TpuFrontierBackend:
                 return
             if flag_filter is None:
                 flag_filter = self._build_flag_filter(
-                    circuit, scc_local, scope_to_scc, flag_block,
+                    circuit, scc_dev, scope_to_scc, flag_block,
                     probe_circuit=probe_circuit,
                 )
             for start in range(0, len(rows), flag_block):
                 blk = rows[start:start + flag_block]
                 cnt = len(blk)
                 if cnt < flag_block:
-                    padded = np.zeros((flag_block, s), dtype=np.int8)
+                    padded = np.zeros((flag_block, s_dev), dtype=np.int8)
                     padded[:cnt] = blk
                 else:
                     padded = blk
@@ -864,8 +922,8 @@ class TpuFrontierBackend:
                 # Re-feed a spilled block (valid rows are the nonempty ones —
                 # spilled blocks are dense prefixes by construction).
                 live = np.nonzero((T_blk | D_blk).any(axis=1))[0]
-                T_h = np.zeros((C, s), dtype=np.int8)
-                D_h = np.zeros((C, s), dtype=np.int8)
+                T_h = np.zeros((C, s_dev), dtype=np.int8)
+                D_h = np.zeros((C, s_dev), dtype=np.int8)
                 T_h[: len(live)] = T_blk[live]
                 D_h[: len(live)] = D_blk[live]
                 T_dev, D_dev, top_dev = (

@@ -356,6 +356,134 @@ def sweep_program_factory(
     return factory
 
 
+def decode_masks_packed(
+    starts_lane: jnp.ndarray, batch: int, pos: jnp.ndarray, dtype
+) -> jnp.ndarray:
+    """Packed twin of :func:`decode_masks`: each lane decodes against its
+    OWN group's candidate index.  ``starts_lane``: (n,) int32 — the owning
+    group's current start index broadcast to that group's lanes
+    (``starts[lane_group]``, see ``encode.PackedCircuit.decode_tables``).
+    Row r of the block decodes candidate ``starts[g] + r`` for every group
+    at once; padded lanes carry ``pos`` 31 and decode to 0 as usual.
+    """
+    idx = starts_lane[None, :] + jnp.arange(batch, dtype=jnp.int32)[:, None]
+    return ((idx >> pos[None, :]) & 1).astype(dtype)
+
+
+def packed_sweep_step(
+    arrays: CircuitArrays,
+    starts_lane: jnp.ndarray,
+    batch: int,
+    pos: jnp.ndarray,
+    scc_mask: jnp.ndarray,
+    group_ind: jnp.ndarray,
+    arrays_d: Optional[CircuitArrays] = None,
+    group_ind_d: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """One contiguous candidate block over a lane-packed circuit — the
+    packed twin of :func:`sweep_step`, with PER-GROUP hit reduction.
+
+    The packed circuit is block-diagonal (``encode.pack_circuits``), so the
+    two fixpoints below compute every group's fixpoint independently in the
+    same matmuls; the per-group survivor counts come out of one
+    ``(B, n) x (n, K)`` indicator matmul instead of a lane-axis sum.
+    Packed members are SCC-restricted, so all outside availability is
+    folded into thresholds and no frozen row exists (``arrays_d`` carries
+    the Q6 fold when any member probes under whole-graph availability).
+    Returns ``hit``: (B, K) bool — group g's row r exposes a disjoint
+    quorum pair for candidate ``starts[g] + r``.
+    """
+    ad = arrays if arrays_d is None else arrays_d
+    gid = group_ind if group_ind_d is None else group_ind_d
+    avail = decode_masks_packed(starts_lane, batch, pos, arrays.dtype)
+    q = fixpoint(arrays, avail)
+    q_sizes = arrays.dot(q, group_ind)  # (B, K) per-group survivor counts
+    complement = jnp.clip(scc_mask - q, 0, 1).astype(ad.dtype)
+    d = fixpoint(ad, complement)
+    d_sizes = ad.dot(d, gid)
+    return jnp.logical_and(q_sizes > 0, d_sizes > 0)
+
+
+def packed_sweep_program_factory(
+    circuit: Circuit,
+    circuit_d: Optional[Circuit],
+    pos: np.ndarray,
+    scc_mask: np.ndarray,
+    lane_group: np.ndarray,
+    group_ind: np.ndarray,
+    batch: int,
+) -> Callable[[int], Callable]:
+    """Packed twin of :func:`sweep_program_factory`.
+
+    ``factory(steps_per_call)`` compiles a program covering ``batch ×
+    steps_per_call`` candidates PER GROUP, reduced to one (K,) int32 vector:
+    each group's smallest hit candidate index in the block, or INT32_MAX
+    for that group's clean miss.  All groups advance in lockstep inside the
+    program (``starts + i*batch``); the driver owns per-group ranges and
+    masks overshoot on the host.
+    """
+    arrays = CircuitArrays(circuit)
+    arrays_d = None if circuit_d is None else CircuitArrays(circuit_d)
+    pos_j = jnp.asarray(pos)
+    lane_group_j = jnp.asarray(lane_group)
+    scc_j = arrays.cast(scc_mask)
+    gi = arrays.cast(group_ind)
+    gi_d = gi if arrays_d is None else arrays_d.cast(group_ind)
+    k = int(group_ind.shape[1])
+
+    def block_min_hit(starts):
+        starts_lane = starts[lane_group_j]
+        hit = packed_sweep_step(
+            arrays, starts_lane, batch, pos_j, scc_j, gi,
+            arrays_d=arrays_d, group_ind_d=gi_d,
+        )
+        idx = starts[None, :] + jnp.arange(batch, dtype=jnp.int32)[:, None]
+        return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min(axis=0)
+
+    def factory(steps_per_call: int) -> Callable:
+        @jax.jit
+        def step(starts0):
+            if steps_per_call == 1:
+                return block_min_hit(starts0)
+
+            def body(i, best):
+                return jnp.minimum(best, block_min_hit(starts0 + i * batch))
+
+            return lax.fori_loop(
+                0, steps_per_call, body,
+                jnp.full((k,), INT32_MAX, dtype=jnp.int32),
+            )
+
+        return make_packed_aot_dispatch(step, k)
+
+    return factory
+
+
+def make_packed_aot_dispatch(step, k: int) -> Callable:
+    """:func:`make_aot_dispatch` for packed programs: the input is the
+    (K,) per-group starts vector instead of a scalar + hi mask.  Same
+    contract otherwise (``.precompile`` ramp hook, ``.xla_compile_seconds``
+    warm-start stat, compile-once lock)."""
+    state: dict = {}
+    lock = threading.Lock()
+
+    def precompile():
+        with lock:
+            if "compiled" not in state:
+                lowered = step.lower(jax.ShapeDtypeStruct((k,), jnp.int32))
+                tc = time.monotonic()
+                state["compiled"] = lowered.compile()
+                state["xla_seconds"] = time.monotonic() - tc
+        return state["compiled"]
+
+    def dispatch(starts):
+        return precompile()(jnp.asarray(starts, dtype=jnp.int32))
+
+    dispatch.precompile = precompile
+    dispatch.xla_compile_seconds = lambda: state.get("xla_seconds", 0.0)
+    return dispatch
+
+
 def make_aot_dispatch(step, zeros_hi: jnp.ndarray, cast) -> Callable:
     """Wrap a jitted ``step(start, hi_mask)`` into a dispatch function that
     AOT-compiles once and calls the Compiled object.

@@ -252,3 +252,165 @@ def pallas_sweep_program_factory(
         return dispatch
 
     return factory
+
+
+def pallas_packed_program_factory(
+    circuit: Circuit,
+    circuit_d: Optional[Circuit],
+    pos: np.ndarray,
+    scc_mask: np.ndarray,
+    lane_group: np.ndarray,
+    group_ind: np.ndarray,
+    batch: int,
+    block: int = DEFAULT_BLOCK,
+    interpret: Optional[bool] = None,
+) -> Callable[[int], Callable]:
+    """Lane-packed twin of :func:`pallas_sweep_program_factory` — the fused
+    kernel over a block-diagonal ``encode.pack_circuits`` block with
+    PER-GROUP first-hit reduction (same contract as
+    ``kernels.packed_sweep_program_factory``: ``dispatch(starts)`` takes the
+    (K,) per-group starts vector and returns the (K,) min hit indices).
+
+    Per-group mechanics inside the kernel: each lane decodes against its
+    OWN group's candidate index (a per-lane starts row replaces the scalar
+    start), survivor counts reduce through one ``(B, Np) x (Np, Kp)``
+    group-indicator matmul (lane-aligned, MXU-friendly — Mosaic has no
+    cheap segment-sum), and each grid step writes its (1, Kp) min-hit row.
+    ``circuit_d`` carries the packed Q6 thresholds (shares every other
+    array with ``circuit``); members are SCC-restricted so no frozen row
+    exists on the Q side and the D probe's fold is entirely in thresholds.
+    """
+    if not pallas_supported(circuit):
+        raise ValueError("circuit vote counts exceed int8; use the XLA sweep path")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, block = plan_batch(batch, block)
+    n_blocks = batch // block
+
+    members_np, child_np, thr_np, np_, up = pad_circuit(circuit)
+    depth = circuit.depth if child_np is not None else 0
+    if circuit_d is not None:
+        _, _, thr_d_np, np_d, up_d = pad_circuit(circuit_d)
+        assert (np_d, up_d) == (np_, up), "packed Q6 twin must share shapes"
+    else:
+        thr_d_np = thr_np
+
+    k = int(group_ind.shape[1])
+    kp = _round_up(k, LANE)
+    pos_row = _pad_row(pos, np_, 31, np.int32)
+    scc_row = _pad_row(scc_mask, np_, 0, np.int8)
+    gind = np.zeros((np_, kp), dtype=np.int8)
+    gind[: group_ind.shape[0], :k] = group_ind.astype(np.int8)
+
+    members_j = jnp.asarray(members_np)
+    thr_j = jnp.asarray(thr_np)
+    thr_d_j = jnp.asarray(thr_d_np)
+    pos_j = jnp.asarray(pos_row)
+    scc_j = jnp.asarray(scc_row)
+    gind_j = jnp.asarray(gind)
+    child_j = jnp.asarray(child_np) if child_np is not None else None
+    lane_group_h = np.asarray(lane_group, dtype=np.int64)
+
+    def kernel(sl_ref, sg_ref, pos_ref, members_ref, thr_ref, thr_d_ref,
+               scc_ref, gind_ref, *rest):
+        child_ref, out_ref = (rest[0], rest[1]) if child_j is not None else (None, rest[0])
+        row0 = pl.program_id(0) * block
+        row_n = row0 + lax.broadcasted_iota(jnp.int32, (block, np_), 0)
+        avail0 = ((sl_ref[:] + row_n) >> pos_ref[:] & 1).astype(jnp.int8)
+
+        def node_sat(total, thr):
+            base = jnp.dot(total, members_ref[:], preferred_element_type=jnp.int32)
+            sat = (base >= thr).astype(jnp.int8)
+            for _ in range(depth):
+                sat = (
+                    (base + jnp.dot(sat, child_ref[:], preferred_element_type=jnp.int32))
+                    >= thr
+                ).astype(jnp.int8)
+            return jnp.bitwise_and(sat[:, :np_], total)
+
+        def fixpoint(a0, thr):
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                a, _ = c
+                nxt = jnp.bitwise_and(node_sat(a, thr), a)
+                # Same arithmetic change detection as the unpacked kernel.
+                changed = jnp.sum(a.astype(jnp.int32) - nxt.astype(jnp.int32)) > 0
+                return nxt, changed
+
+            out, _ = lax.while_loop(cond, body, (a0, jnp.bool_(True)))
+            return out
+
+        q = fixpoint(avail0, thr_ref[:])
+        q_sizes = jnp.dot(q, gind_ref[:], preferred_element_type=jnp.int32)
+        comp = jnp.clip(scc_ref[:].astype(jnp.int32) - q, 0, 1).astype(jnp.int8)
+        d = fixpoint(comp, thr_d_ref[:])
+        d_sizes = jnp.dot(d, gind_ref[:], preferred_element_type=jnp.int32)
+        hit = jnp.logical_and(q_sizes > 0, d_sizes > 0)  # (B, Kp)
+        row_k = row0 + lax.broadcasted_iota(jnp.int32, (block, kp), 0)
+        idx = sg_ref[:] + row_k
+        out_ref[...] = jnp.min(
+            jnp.where(hit, idx, jnp.int32(INT32_MAX)), axis=0, keepdims=True
+        )
+
+    const_spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    in_specs = [
+        const_spec(),  # starts per lane (1, Np)
+        const_spec(),  # starts per group (1, Kp)
+        const_spec(),  # pos
+        const_spec(),  # members
+        const_spec(),  # thresholds (Q side)
+        const_spec(),  # thresholds (D probe)
+        const_spec(),  # scc mask
+        const_spec(),  # group indicator
+    ]
+    operands = [pos_j, members_j, thr_j, thr_d_j, scc_j, gind_j]
+    if child_j is not None:
+        in_specs.append(const_spec())
+        operands.append(child_j)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, kp), jnp.int32),
+        interpret=interpret,
+    )
+
+    def one_call(starts_lane, starts_grp):
+        return jnp.min(call(starts_lane, starts_grp, *operands), axis=0)
+
+    def factory(steps_per_call: int) -> Callable:
+        @jax.jit
+        def step(starts_lane, starts_grp):
+            if steps_per_call == 1:
+                return one_call(starts_lane, starts_grp)[:k]
+
+            def body(i, best):
+                off = i * batch
+                return jnp.minimum(
+                    best, one_call(starts_lane + off, starts_grp + off)
+                )
+
+            return lax.fori_loop(
+                0, steps_per_call, body,
+                jnp.full((kp,), INT32_MAX, dtype=jnp.int32),
+            )[:k]
+
+        def dispatch(starts):
+            starts_h = np.asarray(starts, dtype=np.int32)
+            sl = np.zeros((1, np_), dtype=np.int32)
+            sl[0, : lane_group_h.shape[0]] = starts_h[lane_group_h]
+            sg = np.zeros((1, kp), dtype=np.int32)
+            sg[0, :k] = starts_h
+            return step(jnp.asarray(sl), jnp.asarray(sg))
+
+        return dispatch
+
+    return factory

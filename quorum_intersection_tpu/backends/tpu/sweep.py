@@ -30,7 +30,8 @@ from __future__ import annotations
 import logging
 import time
 from collections import deque
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,7 +40,14 @@ from quorum_intersection_tpu.backends.base import (
     SccCheckResult,
     SearchCancelled,
 )
-from quorum_intersection_tpu.encode.circuit import Circuit
+from quorum_intersection_tpu.encode.circuit import (
+    LANE_TILE,
+    Circuit,
+    ladder_up,
+    pack_circuits,
+    plan_packs,
+    restrict_circuit_pair,
+)
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.faults import fault_point
@@ -133,15 +141,135 @@ def _jump_target_ix(ramp, ix: int, base_block: int, remaining: int) -> int:
     return best
 
 
-def _pallas_ok(circuit: Circuit) -> bool:
-    """Pallas engine eligibility; ineligible circuits (int8-overflowing vote
-    counts) fall back to the XLA path as pallas_sweep's docs promise."""
+@dataclass(frozen=True)
+class EngineResolution:
+    """Typed outcome of sweep-engine selection (ISSUE 5 satellite).
+
+    Replaces the old warn-and-swerve sites (``engine="pallas"`` with a mesh
+    silently ran the XLA path behind a log line): selection is now ONE
+    routing decision with a documented precedence, recorded as a
+    ``sweep.engine_resolved`` telemetry event, so a run can always answer
+    "which kernel engine actually executed, and why".
+    """
+
+    requested: str
+    resolved: str
+    reason: str
+
+
+def resolve_engine(
+    requested: str,
+    *,
+    mesh: bool,
+    wide: bool,
+    restricted: bool,
+    circuit: Circuit,
+) -> EngineResolution:
+    """The single source of truth for which kernel engine a sweep runs.
+
+    Precedence (first matching rule wins; every ``pallas`` request that
+    cannot be honored resolves to ``xla`` with the reason recorded):
+
+    1. ``xla`` requested — always honored (it is the universal engine);
+    2. mesh sharding — the pallas kernel has no sharded program;
+    3. wide (two-level, > 2^lo_bits) enumeration — the pallas kernel takes
+       no hi-mask input;
+    4. SCC-restricted circuit — the unpacked pallas kernel carries no
+       separate D-probe thresholds (the packed driver resolves with
+       ``restricted=False``: its pallas kernel does);
+    5. vote counts beyond int8 — the pallas kernel is int8-only;
+    6. otherwise — ``pallas`` as requested.
+    """
+    if requested == "xla":
+        return EngineResolution(requested, "xla", "as requested")
+    if mesh:
+        return EngineResolution(
+            requested, "xla", "mesh sharding: the pallas kernel has no sharded program"
+        )
+    if wide:
+        return EngineResolution(
+            requested, "xla", "wide (two-level) enumeration: the pallas kernel has no hi-mask input"
+        )
+    if restricted:
+        return EngineResolution(
+            requested, "xla", "SCC-restricted sweep: the unpacked pallas kernel has no D-probe thresholds"
+        )
     from quorum_intersection_tpu.backends.tpu import pallas_sweep
 
-    if pallas_sweep.pallas_supported(circuit):
-        return True
-    log.warning("pallas engine unsupported for this circuit; using XLA path")
-    return False
+    if not pallas_sweep.pallas_supported(circuit):
+        return EngineResolution(
+            requested, "xla", "vote counts exceed int8: the pallas kernel is int8-only"
+        )
+    return EngineResolution(requested, "pallas", "as requested")
+
+
+def _emit_engine_resolution(resolution: EngineResolution, packed: bool = False) -> None:
+    """One ``sweep.engine_resolved`` event per check — the explicit routing
+    record the old warning lines never left."""
+    get_run_record().event(
+        "sweep.engine_resolved",
+        requested=resolution.requested,
+        resolved=resolution.resolved,
+        reason=resolution.reason,
+        packed=packed,
+    )
+    if resolution.resolved != resolution.requested:
+        log.info(
+            "sweep engine %r resolved to %r: %s",
+            resolution.requested, resolution.resolved, resolution.reason,
+        )
+
+
+def macs_per_candidate_row(n: int, n_units: int, depth: int, lane: int = 128) -> int:
+    """Shape-model MACs one candidate row costs per fixpoint iteration on a
+    lane-tiled accelerator: the direct-vote matmul streams the (n, U)
+    operand at lane-padded width, plus ``depth`` child-propagation passes
+    over the (U, U) operand.  The LANE PADDING is counted deliberately —
+    XLA pads the lane axis to 128 "for free" (encode/circuit.py PAD_LADDER
+    note) and that padding is 100% wasted compute, which is exactly the
+    waste lane packing reclaims.  Iteration counts and the Q/D factor are
+    workload-dependent and near-identical packed vs unpacked (the packed
+    fixpoint is the product of the per-group fixpoints), so they cancel in
+    the packed-vs-unpacked MACs-per-verdict ratio this model exists to make
+    checkable off-chip (benchmarks/sweep_vs_native.py --packed).
+    """
+    wn = lane * ((max(n, 1) + lane - 1) // lane)
+    wu = lane * ((max(n_units, 1) + lane - 1) // lane)
+    return wn * wu + depth * wu * wu
+
+
+@dataclass
+class _SweepJob:
+    """One sweep problem prepared for lane packing: SCC-restricted circuit
+    pair plus the graph-space decode data for witness reconstruction."""
+
+    graph: TrustGraph
+    nodes: List[int]  # graph-space scc ids (enumeration order)
+    scope_to_scc: bool
+    circuit: Circuit  # scoped (Q-side) restriction
+    circuit_d: Optional[Circuit]  # Q6 fold for the D probe (None: scoped)
+    bits: int
+    total: int
+    candidates: int = 0
+    first_hit: Optional[int] = None
+    resolved: bool = False
+    intersects: Optional[bool] = None
+    result: Optional[SccCheckResult] = None
+
+
+@dataclass
+class _PackGroup:
+    """One lane group: a contiguous candidate window ``[lo, hi)`` of one
+    job.  A job with one group sweeps its whole enumeration; extra groups
+    (spare pack lanes) split it into ascending contiguous windows, and the
+    job's first hit is the first hit of the LOWEST window whose every
+    predecessor swept clean — identical to the unpacked FIFO order."""
+
+    job: int
+    lo: int
+    hi: int
+    hit: Optional[int] = None
+    done: bool = False
 
 
 def clamp_batch_to_index_ceiling(batch: int, lo_total: int) -> int:
@@ -285,11 +413,8 @@ class TpuSweepBackend:
         # graph-space ids for witness reconstruction.
         nodes = list(scc)
         circuit_d = None
-        engine = self.engine
         restricted = circuit.n > s
         if restricted:
-            from quorum_intersection_tpu.encode.circuit import restrict_circuit_pair
-
             scoped_c, q6_c = restrict_circuit_pair(circuit, scc)
             log.debug(
                 "sweep restricted to |scc|=%d: n %d->%d, units %d->%d",
@@ -299,11 +424,6 @@ class TpuSweepBackend:
             if not scope_to_scc:
                 circuit_d = q6_c
             scc = list(range(s))
-            if engine == "pallas":
-                log.warning(
-                    "pallas engine requested but SCC-restricted sweeps use the XLA path"
-                )
-                engine = "xla"
 
         n = circuit.n
         scc_mask = np.zeros(n, dtype=np.float32)
@@ -320,6 +440,19 @@ class TpuSweepBackend:
         lo_bits = min(bits, self.lo_bits)
         lo_total = 1 << lo_bits if lo_bits > 0 else 1
         hi_nodes = scc[1 + lo_bits :]
+
+        # Engine selection is ONE typed routing decision (resolve_engine's
+        # documented precedence) recorded as a sweep.engine_resolved event
+        # — never a warning that swerves control flow behind the log.
+        resolution = resolve_engine(
+            self.engine,
+            mesh=self.mesh is not None,
+            wide=bool(hi_nodes),
+            restricted=restricted,
+            circuit=circuit,
+        )
+        _emit_engine_resolution(resolution)
+        engine = resolution.resolved
 
         total = 1 << bits if bits > 0 else 1
         start0 = 0
@@ -393,20 +526,13 @@ class TpuSweepBackend:
             # the drain masks aliased hit indices.
             batch = 1 << (min(batch, lo_total).bit_length() - 1)
         lo_nodes = np.asarray(scc[1 : 1 + lo_bits], dtype=np.int32)
-        if engine == "pallas" and self.mesh is not None:
-            log.warning("pallas engine requested but mesh sharding uses the XLA path")
-        elif engine == "pallas" and hi_nodes:
-            log.warning(
-                "pallas engine requested but wide (>2^%d) sweeps use the XLA path",
-                lo_bits,
-            )
         if self.mesh is not None:
             base_block, make_dispatch = self._build_sharded_step(
                 circuit, lo_nodes, scc_mask, frozen, batch, circuit_d=circuit_d
             )
-        elif engine == "pallas" and not hi_nodes and _pallas_ok(circuit):
-            # (wide sweeps use the XLA path: the pallas kernel has no
-            # hi-mask input and wide enumerations are its weak spot anyway)
+        elif engine == "pallas":
+            # resolve_engine already ruled out mesh/wide/restricted/int8
+            # conflicts — a pallas resolution here is unconditionally usable.
             from quorum_intersection_tpu.backends.tpu import pallas_sweep
 
             base_block, _ = pallas_sweep.plan_batch(min(batch, max(total, 1)))
@@ -729,6 +855,10 @@ class TpuSweepBackend:
             "backend": self.name,
             "candidates_checked": candidates,
             "device_steps": steps,
+            # The (n, units) shape the device programs actually ran —
+            # post-restriction, post-padding — for shape-model work
+            # accounting (macs_per_candidate_row; the packed bench row).
+            "device_shape": [circuit.n, circuit.n_units],
             "enumeration_total": total,
             "seconds": seconds,
             "candidates_per_sec": candidates / seconds if seconds > 0 else 0.0,
@@ -788,6 +918,371 @@ class TpuSweepBackend:
         # Reference witness convention (cpp:372-373): q1 = the probe result,
         # q2 = the enumerated quorum.
         return SccCheckResult(intersects=False, q1=disjoint, q2=q, stats=stats)
+
+    # ---- lane-packed multi-problem sweep (ISSUE 5 tentpole) -------------
+
+    def _prepare_job(
+        self,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+    ) -> _SweepJob:
+        """Restrict one problem onto its SCC for packing.  Restriction runs
+        UNCONDITIONALLY (even at circuit.n == |scc|): it guarantees the
+        root-unit layout and scc-order lanes pack_circuits requires, and
+        folds all outside availability into thresholds so the packed block
+        needs no frozen row."""
+        if circuit is None:
+            raise ValueError("sweep backend requires the encoded circuit")
+        s = len(scc)
+        bits = s - 1
+        if bits > self.max_bits:
+            raise SccTooLargeError(
+                f"|scc|={s} exceeds sweep width {self.max_bits}+1; use the frontier backend"
+            )
+        scoped_c, q6_c = restrict_circuit_pair(circuit, scc)
+        return _SweepJob(
+            graph=graph,
+            nodes=list(scc),
+            scope_to_scc=scope_to_scc,
+            circuit=scoped_c,
+            circuit_d=None if scope_to_scc else q6_c,
+            bits=bits,
+            total=1 << bits if bits > 0 else 1,
+        )
+
+    def check_sccs(
+        self,
+        jobs: Sequence[Tuple[TrustGraph, Optional[Circuit], List[int]]],
+        *,
+        scope_to_scc: bool = False,
+    ) -> List[SccCheckResult]:
+        """Batched multi-problem sweep with LANE PACKING: K independent
+        problems fuse into one block-diagonal circuit whose padded lane
+        tile they fill together (encode.pack_circuits), so one device
+        program resolves up to K verdicts per matmul instead of wasting
+        the XLA lane padding on one.
+
+        Packs fill from the three sources the dispatch loop sees, in
+        order: whole problems first (queued snapshot requests via
+        pipeline.check_many, and multiple quorum-bearing SCCs of one
+        snapshot, arrive here as separate jobs), then any spare lanes are
+        filled with extra in-flight WINDOWS of the packed jobs' own
+        enumerations (ascending contiguous ranges — _PackGroup).  Verdict,
+        witness, and first-hit index are byte-identical to running
+        :meth:`check_scc` per job (tests/test_lane_packing.py pins it).
+
+        Jobs the packed path cannot serve stay on the plain sweep: wide
+        (> 2^lo_bits) enumerations, and any run carrying a mesh or a
+        checkpoint (packing has no sharded program and no multi-problem
+        checkpoint format).  The ``sweep.pack`` fault point fires before
+        any pack is built — injected failures surface here and the auto
+        router's DegradationLadder degrades to the unpacked sweep.
+        """
+        jobs = list(jobs)
+        results: List[Optional[SccCheckResult]] = [None] * len(jobs)
+        prepared: Dict[int, _SweepJob] = {}
+        if self.mesh is None and self.checkpoint is None:
+            if self.cancel is not None and self.cancel.cancelled:
+                raise SearchCancelled(
+                    f"packed sweep cancelled before setup ({len(jobs)} jobs)"
+                )
+            packable: List[int] = []
+            for i, (graph, circuit, scc) in enumerate(jobs):
+                if len(scc) - 1 > min(self.lo_bits, LO_BITS):
+                    continue  # wide two-level enumerations stay unpacked
+                prepared[i] = self._prepare_job(graph, circuit, scc, scope_to_scc)
+                packable.append(i)
+            if packable:
+                # Injectable pack boundary (utils/faults.py sweep.pack):
+                # `error` simulates a packing failure — routed through the
+                # auto ladder this degrades to the unpacked per-problem
+                # sweep with verdicts unchanged.
+                fault_point("sweep.pack")
+                from quorum_intersection_tpu.utils.compile_cache import (
+                    enable_compilation_cache,
+                )
+
+                enable_compilation_cache()
+                for pack_ixs in plan_packs(
+                    [prepared[i].circuit.n for i in packable]
+                ):
+                    members = [prepared[packable[ix]] for ix in pack_ixs]
+                    self._run_pack(members)
+                    for ix in pack_ixs:
+                        results[packable[ix]] = prepared[packable[ix]].result
+        for i, (graph, circuit, scc) in enumerate(jobs):
+            if results[i] is None:
+                results[i] = self.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                )
+        return [res for res in results if res is not None]
+
+    def _run_pack(self, jobs: List[_SweepJob]) -> None:
+        """Sweep one pack of jobs to verdicts (stored on each job)."""
+        t0 = time.perf_counter()
+        rec = get_run_record()
+        n_jobs = len(jobs)
+        slot = ladder_up(max(j.circuit.n for j in jobs))
+        capacity = max(1, LANE_TILE // slot)
+
+        # Spare lanes become extra windows of the jobs with the largest
+        # per-window enumerations (pack source (a): multiple in-flight
+        # windows of the current SCC) — never split below ~two blocks per
+        # window, or the extra lanes just re-sweep each other's overshoot.
+        est_batch = self.batch if self.batch is not None else _auto_batch(
+            capacity * slot
+        )
+        windows = [1] * n_jobs
+        spare = capacity - n_jobs
+        while spare > 0:
+            j = max(range(n_jobs), key=lambda x: jobs[x].total / windows[x])
+            if jobs[j].total / windows[j] < 2 * est_batch:
+                break
+            windows[j] += 1
+            spare -= 1
+
+        groups: List[_PackGroup] = []
+        members: List[Tuple[Circuit, Optional[Circuit]]] = []
+        for j, job in enumerate(jobs):
+            w = windows[j]
+            bounds = [job.total * t // w for t in range(w + 1)]
+            for t in range(w):
+                groups.append(_PackGroup(job=j, lo=bounds[t], hi=bounds[t + 1]))
+                members.append((job.circuit, job.circuit_d))
+        packed = pack_circuits(members)
+        pos, scc_mask, lane_group, group_ind = packed.decode_tables()
+        k = packed.groups
+
+        batch = self.batch if self.batch is not None else _auto_batch(packed.circuit.n)
+        # Never dispatch blocks beyond the largest window's work (the
+        # unpacked driver's min(batch, lo_total) discipline) — a small pack
+        # must not burn a 2^19-row program on a 2^11 enumeration.
+        batch = max(1, min(
+            batch,
+            max(g.hi - g.lo for g in groups),
+        ))
+        batch = clamp_batch_to_index_ceiling(batch, max(j.total for j in jobs))
+        resolution = resolve_engine(
+            self.engine, mesh=False, wide=False, restricted=False,
+            circuit=packed.circuit,
+        )
+        _emit_engine_resolution(resolution, packed=True)
+        if resolution.resolved == "pallas":
+            from quorum_intersection_tpu.backends.tpu import pallas_sweep
+
+            batch, _ = pallas_sweep.plan_batch(batch)
+            make_dispatch = pallas_sweep.pallas_packed_program_factory(
+                packed.circuit, packed.circuit_d, pos, scc_mask, lane_group,
+                group_ind, batch,
+            )
+        else:
+            from quorum_intersection_tpu.backends.tpu.kernels import (
+                packed_sweep_program_factory,
+            )
+
+            make_dispatch = packed_sweep_program_factory(
+                packed.circuit, packed.circuit_d, pos, scc_mask, lane_group,
+                group_ind, batch,
+            )
+
+        rec.add("sweep.packs_dispatched")
+        rec.gauge("sweep.pack_fill_pct", round(packed.fill_pct, 2))
+        rec.event(
+            "sweep.packed",
+            jobs=n_jobs, groups=k, slot=packed.slot, lanes=packed.circuit.n,
+            fill_pct=round(packed.fill_pct, 2), engine=resolution.resolved,
+        )
+        log.debug(
+            "packed sweep: %d jobs in %d lane groups (slot %d, %d lanes, "
+            "%.1f%% fill, engine %s)",
+            n_jobs, k, packed.slot, packed.circuit.n, packed.fill_pct,
+            resolution.resolved,
+        )
+
+        dispatchers: Dict[int, object] = {}
+
+        def dispatch(starts: np.ndarray, spc: int):
+            fault_point("sweep.dispatch")
+            fn = dispatchers.get(spc)
+            if fn is None:
+                fault_point("sweep.compile")
+                fn = dispatchers[spc] = make_dispatch(spc)
+            return fn(starts)
+
+        unresolved = set(range(n_jobs))
+        nxt = [g.lo for g in groups]
+        inflight: "deque" = deque()
+        pack_rows = 0
+        ramp = (1, 8, 64)
+        spc_ix = 0
+        depth_cap = max(1, min(self.max_inflight, 8))
+
+        def check_cancel() -> None:
+            if self.cancel is not None and self.cancel.cancelled:
+                rec.add("sweep.windows_cancelled", len(inflight))
+                rec.event(
+                    "sweep.cancelled", packed=True,
+                    windows_dropped=len(inflight),
+                    jobs_unresolved=len(unresolved),
+                )
+                raise SearchCancelled(
+                    f"packed sweep cancelled ({len(unresolved)} of "
+                    f"{n_jobs} jobs unresolved)"
+                )
+
+        def all_dispatched() -> bool:
+            return all(
+                g.done or nxt[i] >= g.hi for i, g in enumerate(groups)
+            )
+
+        def resolve_jobs() -> None:
+            """Scan each job's ascending windows: its first hit is the hit
+            of the lowest window whose every predecessor swept clean —
+            the unpacked driver's FIFO first-hit order, group-wise."""
+            for j in list(unresolved):
+                wins = [g for g in groups if g.job == j]
+                verdict: Optional[bool] = None
+                for g in wins:
+                    if g.hit is not None:
+                        jobs[j].first_hit = g.hit
+                        verdict = False
+                        break
+                    if not g.done:
+                        break
+                else:
+                    verdict = True
+                if verdict is None:
+                    continue
+                jobs[j].intersects = verdict
+                jobs[j].resolved = True
+                unresolved.discard(j)
+                for g in wins:
+                    g.done = True
+
+        def drain_one() -> None:
+            starts_snap, coverage, handle = inflight.popleft()
+            hits = np.asarray(handle)
+            for gix, g in enumerate(groups):
+                if g.done:
+                    continue
+                s0 = int(starts_snap[gix])
+                if s0 >= g.hi:
+                    continue  # frozen lane: nothing new covered
+                top = min(s0 + coverage, g.hi)
+                jobs[g.job].candidates += top - s0
+                h = int(hits[gix])
+                if h < g.hi:
+                    # In-range hit.  Overshoot rows (>= hi, aliased decode
+                    # duplicates) are masked here on the host: the window's
+                    # own range ends at hi, and whatever lies beyond belongs
+                    # to the NEXT ascending window, which sweeps it itself.
+                    g.hit = h
+                    g.done = True
+                    # Later windows of the same job can only yield LARGER
+                    # indices: stop burning lanes on them.
+                    for g2 in groups:
+                        if g2.job == g.job and g2.lo > g.lo:
+                            g2.done = True
+                elif top >= g.hi:
+                    g.done = True
+            resolve_jobs()
+
+        while unresolved:
+            check_cancel()
+            # Same injectable window boundary as the unpacked loop.
+            fault_point("sweep.window")
+            if not all_dispatched():
+                rem = max(
+                    (g.hi - nxt[i] for i, g in enumerate(groups) if not g.done),
+                    default=0,
+                )
+                while spc_ix + 1 < len(ramp) and rem >= ramp[spc_ix + 1] * batch * 2:
+                    spc_ix += 1
+                spc = ramp[spc_ix]
+                if rem < spc * batch:
+                    # Tail: the smallest program covering the remainder,
+                    # preferring an already-compiled shape (the unpacked
+                    # driver's chunk-tail discipline) — never burn a
+                    # 64x-batch program on a few surviving rows.
+                    fits = [r for r in ramp if r * batch >= rem]
+                    compiled_ok = [r for r in fits if r in dispatchers]
+                    spc = min(compiled_ok) if compiled_ok else min(fits)
+                coverage = spc * batch
+                snap = np.asarray(nxt, dtype=np.int32)
+                inflight.append((snap, coverage, dispatch(snap, spc)))
+                pack_rows += coverage
+                rec.add("sweep.pack_windows")
+                for i, g in enumerate(groups):
+                    if not g.done and nxt[i] < g.hi:
+                        nxt[i] += coverage
+                if len(inflight) >= depth_cap:
+                    drain_one()
+            elif inflight:
+                drain_one()
+            else:
+                # Defense in depth: every group drained yet a job is still
+                # unresolved would mean the accounting above lied — fail
+                # loudly, never spin.
+                raise RuntimeError(
+                    f"packed sweep drained all lane groups with "
+                    f"{len(unresolved)} job(s) unresolved"
+                )
+
+        seconds = time.perf_counter() - t0
+        xla_s = sum(
+            fn.xla_compile_seconds()
+            for fn in dispatchers.values()
+            if hasattr(fn, "xla_compile_seconds")
+        )
+        pack_stats = {
+            "packed": True,
+            "pack_jobs": n_jobs,
+            "pack_groups": k,
+            "pack_slot": packed.slot,
+            "pack_shape": [packed.circuit.n, packed.circuit.n_units],
+            "pack_fill_pct": round(packed.fill_pct, 2),
+            "pack_rows_dispatched": pack_rows,
+            "pack_macs_per_candidate_row": macs_per_candidate_row(
+                packed.circuit.n, packed.circuit.n_units, packed.circuit.depth
+            ),
+            "pack_engine": resolution.resolved,
+            "pack_seconds": round(seconds, 4),
+            "xla_compile_seconds": round(xla_s, 4),
+        }
+        for job in jobs:
+            stats = {
+                "backend": self.name,
+                "candidates_checked": job.candidates,
+                "enumeration_total": job.total,
+                "seconds": seconds,
+                **pack_stats,
+            }
+            if job.first_hit is None:
+                job.result = SccCheckResult(intersects=True, stats=stats)
+                continue
+            subset = [
+                job.nodes[1 + b]
+                for b in range(job.bits)
+                if (job.first_hit >> b) & 1
+            ]
+            q, disjoint = self._witness(
+                job.graph, job.nodes, subset, job.scope_to_scc
+            )
+            if not q or not disjoint:
+                # Same defense in depth as the unpacked driver: the host
+                # recheck uses the exact reference semantics — an empty
+                # member means the packed decode lied; fail loudly.
+                raise RuntimeError(
+                    f"packed sweep decode error: hit index {job.first_hit} "
+                    f"failed the host witness recheck "
+                    f"(|q|={len(q)}, |disjoint|={len(disjoint)})"
+                )
+            stats["hit_index"] = job.first_hit
+            job.result = SccCheckResult(
+                intersects=False, q1=disjoint, q2=q, stats=stats
+            )
 
     @staticmethod
     def _time_breakdown(t0, t_first_dispatch, compile_seconds, drain_log,

@@ -43,7 +43,7 @@ Dangling-reference policy (Q1) is resolved earlier, in
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -209,6 +209,16 @@ def encode_circuit(graph: TrustGraph) -> Circuit:
 PAD_LADDER = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768, 1024)
 
 
+def ladder_up(x: int) -> int:
+    """Smallest :data:`PAD_LADDER` rung holding ``x`` (identity beyond the
+    ladder) — the rounding primitive shared by :func:`pad_targets`, the
+    frontier's compile-shape bucketing, and the lane-packing slot planner."""
+    for rung in PAD_LADDER:
+        if x <= rung:
+            return rung
+    return x
+
+
 def pad_targets(n: int, n_units: int) -> tuple:
     """Canonical padded ``(n, n_units)`` for one circuit: each dimension
     rounds up to the smallest :data:`PAD_LADDER` rung that holds it (identity
@@ -217,17 +227,10 @@ def pad_targets(n: int, n_units: int) -> tuple:
     every padded node index needs a unit row) and the STRICT ``n_units > n``
     of a circuit with inner units (``CircuitArrays.has_inner`` — collapsing
     it to equality would silently skip the child-propagation matmuls)."""
-
-    def up(x: int) -> int:
-        for rung in PAD_LADDER:
-            if x <= rung:
-                return rung
-        return x
-
-    n_pad = up(n)
+    n_pad = ladder_up(n)
     if n_units <= n:
         return n_pad, n_pad
-    return n_pad, up(max(n_units, n_pad + 1))
+    return n_pad, ladder_up(max(n_units, n_pad + 1))
 
 
 def pad_circuit(circuit: Circuit, n_to: int, units_to: int) -> Circuit:
@@ -420,3 +423,191 @@ def max_quorum_np(circuit: Circuit, avail: np.ndarray) -> np.ndarray:
         if np.array_equal(nxt, cur):
             return cur
         cur = nxt
+
+
+# ---------------------------------------------------------------------------
+# Lane packing (ISSUE 5): the MXU multiplies 128x128 tiles, so a 31-node
+# circuit occupies a sliver of the lane axis and XLA's "free" padding (see
+# PAD_LADDER above) is 100% wasted compute.  A PackedCircuit tiles K
+# independent circuits side-by-side along the lane axis into ONE circuit
+# with block-diagonal structure, so one batched sweep resolves K verdicts
+# per matmul instead of one.
+#
+# Packing invariants (pinned by tests/test_lane_packing.py; docs/PARITY.md):
+#
+# - **block-diagonal inertness**: group g's units carry votes ONLY from
+#   group g's lane columns, and the child matrix links only units of the
+#   same group — cross-blocks are identically zero, so each group's
+#   satisfaction/fixpoint is computed exactly as it would be alone (the
+#   fused fixpoint is the product of the per-group fixpoints);
+# - **root-unit layout**: lane ``g*slot + j`` (j < n_g) is group g's node j
+#   AND unit ``g*slot + j`` is its root unit, preserving the ``sat[..., :n]``
+#   slice contract of the kernels; padded lane slots get the Q2
+#   never-satisfiable filler from :func:`pad_circuit`;
+# - **decode-map contract**: :meth:`PackedCircuit.decode_tables` is the ONE
+#   source of the per-lane-group decode — per-lane enumeration bit position
+#   (group-local bit j toggles local node j+1, node 0 fixed out exactly as
+#   in the unpacked sweep), lane→group id, the packed scc mask, and the
+#   (n, K) group-indicator used for per-group hit reduction.
+#
+# Members must be SCC-restricted circuits (encode.restrict_circuit_pair):
+# restriction guarantees the root-unit layout and folds all outside
+# availability into thresholds, so the packed block needs no frozen row.
+
+# One MXU tile along the lane axis: the packing budget one pack tries to
+# fill (a pack of K slot-wide groups targets K*slot <= LANE_TILE).
+LANE_TILE = 128
+
+
+@dataclass
+class PackedCircuit:
+    """K independent circuits fused into one block-diagonal :class:`Circuit`.
+
+    ``circuit`` is the scoped (Q-side) fusion; ``circuit_d`` the Q6-fold
+    (D-probe) twin sharing every array except thresholds (None when every
+    member was scope-to-scc).  ``slot`` is the uniform lane width per group;
+    group g's real nodes live at lanes ``[g*slot, g*slot + sizes[g])``.
+    """
+
+    circuit: Circuit
+    circuit_d: Optional[Circuit]
+    groups: int
+    slot: int
+    sizes: Tuple[int, ...]
+
+    @property
+    def fill_pct(self) -> float:
+        """Pack occupancy: verdict-bearing lanes / padded lane width."""
+        return 100.0 * float(sum(self.sizes)) / float(max(self.circuit.n, 1))
+
+    def lane_base(self, g: int) -> int:
+        return g * self.slot
+
+    def decode_tables(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Per-lane-group decode map: ``(pos, scc_mask, lane_group, group_ind)``.
+
+        - ``pos``        (n,) int32 — enumeration bit position per lane
+          (31 = not enumerated, the :func:`...kernels.bit_positions`
+          convention): group g's local node j >= 1 decodes bit j-1 of that
+          group's candidate index; local node 0 is fixed out of the
+          enumeration exactly as in the unpacked sweep;
+        - ``scc_mask``   (n,) float32 — 1 on every real lane;
+        - ``lane_group`` (n,) int32 — owning group per lane (padded lanes
+          map to group 0; their ``pos`` of 31 decodes them to 0 regardless);
+        - ``group_ind``  (n, K) float32 — indicator used to reduce per-lane
+          fixpoint survivors into per-group counts with one matmul.
+        """
+        n = self.circuit.n
+        pos = np.full((n,), 31, dtype=np.int32)
+        scc_mask = np.zeros((n,), dtype=np.float32)
+        lane_group = np.zeros((n,), dtype=np.int32)
+        group_ind = np.zeros((n, self.groups), dtype=np.float32)
+        for g, size in enumerate(self.sizes):
+            base = g * self.slot
+            scc_mask[base : base + size] = 1.0
+            lane_group[base : base + size] = g
+            group_ind[base : base + size, g] = 1.0
+            for j in range(1, size):
+                pos[base + j] = j - 1
+        return pos, scc_mask, lane_group, group_ind
+
+
+def plan_packs(sizes: Sequence[int], lane_tile: int = LANE_TILE) -> List[List[int]]:
+    """Greedy pack plan: indices into ``sizes`` grouped so each pack's
+    ``K * slot`` fits one lane tile, where ``slot`` is the ladder rung of
+    the pack's LARGEST member (descending-size order keeps slots tight —
+    mixed-size packs waste at most the rung gap per lane group).  Jobs wider
+    than a tile get a singleton pack (K=1 degenerates to the padded sweep).
+    """
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    packs: List[List[int]] = []
+    cur: List[int] = []
+    capacity = 0
+    for i in order:
+        if cur and len(cur) < capacity:
+            cur.append(i)
+            continue
+        slot = ladder_up(max(int(sizes[i]), 1))
+        capacity = max(1, lane_tile // slot)
+        cur = [i]
+        packs.append(cur)
+    return packs
+
+
+def pack_circuits(
+    members: Sequence[Tuple[Circuit, Optional[Circuit]]],
+    lane_tile: int = LANE_TILE,
+) -> PackedCircuit:
+    """Fuse K ``(scoped, q6_or_None)`` circuit pairs into one
+    :class:`PackedCircuit` (invariants in the section comment above).
+
+    Every member must have root-unit layout (unit j = node j's quorum set
+    for j < n — what :func:`encode_circuit` and
+    :func:`restrict_circuit_pair` produce) and a Q6 twin, when present,
+    sharing the scoped member's shapes.  The fused block rounds up to the
+    canonical :data:`PAD_LADDER` shape, so packed programs ride the same
+    warm-start compile-cache discipline as the unpacked sweep.
+    """
+    if not members:
+        raise ValueError("pack_circuits needs at least one circuit")
+    sizes = tuple(c.n for c, _ in members)
+    for c, d in members:
+        if d is not None and (d.n != c.n or d.n_units != c.n_units):
+            raise ValueError(
+                f"q6 twin shape {(d.n, d.n_units)} does not match scoped "
+                f"member {(c.n, c.n_units)}"
+            )
+    k = len(members)
+    slot = ladder_up(max(max(sizes), 1))
+    if k > 1 and k * slot > lane_tile:
+        raise ValueError(
+            f"{k} groups of slot {slot} exceed the {lane_tile}-lane tile; "
+            f"plan packs with plan_packs()"
+        )
+    n_raw = k * slot
+    inner_total = sum(c.n_units - c.n for c, _ in members)
+    u_raw = n_raw + inner_total
+
+    thresholds = np.ones(u_raw, dtype=np.int32)  # Q2 filler in padded slots
+    thresholds_d = np.ones(u_raw, dtype=np.int32)
+    members_m = np.zeros((u_raw, n_raw), dtype=np.uint8)
+    child = np.zeros((u_raw, u_raw), dtype=np.uint8)
+    unit_depth = np.zeros(u_raw, dtype=np.int32)
+    any_d = any(d is not None for _, d in members)
+
+    inner_base = n_raw
+    for g, (c, d) in enumerate(members):
+        base = g * slot
+        n_g = c.n
+        umap = np.concatenate([
+            np.arange(base, base + n_g, dtype=np.int64),
+            np.arange(inner_base, inner_base + (c.n_units - n_g), dtype=np.int64),
+        ])
+        thresholds[umap] = c.thresholds
+        thresholds_d[umap] = c.thresholds if d is None else d.thresholds
+        members_m[np.ix_(umap, np.arange(base, base + n_g))] = c.members
+        child[np.ix_(umap, umap)] = c.child
+        unit_depth[umap] = c.unit_depth
+        inner_base += c.n_units - n_g
+
+    depth = max(c.depth for c, _ in members)
+    fused = Circuit(
+        n=n_raw, n_units=u_raw, depth=depth, thresholds=thresholds,
+        members=members_m, child=child, unit_depth=unit_depth,
+    )
+    fused_d: Optional[Circuit] = None
+    if any_d:
+        # The Q6 twin shares every array except thresholds — the same
+        # aliasing restrict_circuit_pair uses for the unpacked pair.
+        fused_d = Circuit(
+            n=n_raw, n_units=u_raw, depth=depth, thresholds=thresholds_d,
+            members=members_m, child=child, unit_depth=unit_depth,
+        )
+
+    n_to, units_to = pad_targets(n_raw, u_raw)
+    fused = pad_circuit(fused, n_to, units_to)
+    if fused_d is not None:
+        fused_d = pad_circuit(fused_d, n_to, units_to)
+    return PackedCircuit(
+        circuit=fused, circuit_d=fused_d, groups=k, slot=slot, sizes=sizes,
+    )

@@ -16,6 +16,7 @@ Verbose narration mirrors the reference's ``-v`` messages (cpp:640, :662-664,
 from __future__ import annotations
 
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, TextIO, Tuple, Union
 
@@ -80,6 +81,41 @@ def quorum_bearing_sccs(
     ]
 
 
+def _classify_sccs(
+    graph: TrustGraph,
+    *,
+    allow_native: bool,
+    scc_select: str,
+    timers: PhaseTimers,
+) -> Tuple[int, List[List[int]], List[int], Dict[int, List[int]], List[int]]:
+    """The SCC-classification prefix shared by :func:`solve_graph` and
+    :func:`check_many`: Tarjan + per-SCC quorum scan + main-SCC selection
+    (Q5/Q8 semantics), under the same ``scc``/``scc_scan`` timer phases —
+    one implementation, so the two entry points' guard verdicts cannot
+    drift.  Returns ``(count, sccs, quorum_scc_ids, scc_quorums,
+    main_scc)``."""
+    with timers.phase("scc"):
+        count, comp = tarjan_scc(graph.n, graph.succ)
+        sccs = group_sccs(graph.n, comp, count)
+    quorum_scc_ids: List[int] = []
+    scc_quorums: Dict[int, List[int]] = {}
+    with timers.phase("scc_scan"):
+        for sid, quorum in enumerate(
+            scan_scc_quorums(graph, sccs, allow_native=allow_native)
+        ):
+            if quorum:
+                quorum_scc_ids.append(sid)
+                scc_quorums[sid] = quorum
+    # "Main" SCC: the reference labels sccs.front() the main component
+    # (cpp:675-678) — that is the *sink*, not the largest (Q8).  With the
+    # Q5 fix the main component is the quorum-bearing one when unique.
+    if scc_select == "front" or not quorum_scc_ids:
+        main_scc = sccs[0] if sccs else []
+    else:
+        main_scc = sccs[quorum_scc_ids[0]]
+    return count, sccs, quorum_scc_ids, scc_quorums, main_scc
+
+
 @dataclass
 class SolveResult:
     intersects: bool
@@ -124,9 +160,13 @@ def solve_graph(
     if isinstance(backend, str):
         backend = get_backend(backend)
 
-    with timers.phase("scc"):
-        count, comp = tarjan_scc(graph.n, graph.succ)
-        sccs = group_sccs(graph.n, comp, count)
+    # Per-SCC quorum scan (cpp:645-672): which SCCs, restricted to themselves,
+    # contain a quorum?  All minimal quorums live inside some SCC.
+    allow_native_scan = getattr(backend, "name", "") != "python"
+    count, sccs, quorum_scc_ids, scc_quorums, main_scc = _classify_sccs(
+        graph, allow_native=allow_native_scan, scc_select=scc_select,
+        timers=timers,
+    )
 
     if graphviz:
         from quorum_intersection_tpu.analytics.graphviz import write_graphviz_sccs
@@ -135,35 +175,15 @@ def solve_graph(
 
     if verbose:
         out.write(f"total number of strongly connected components: {count}\n")
-
-    # Per-SCC quorum scan (cpp:645-672): which SCCs, restricted to themselves,
-    # contain a quorum?  All minimal quorums live inside some SCC.
-    quorum_scc_ids: List[int] = []
-    scc_quorums: Dict[int, List[int]] = {}
     log.debug("%d strongly connected components; scanning for quorums", count)
-    allow_native_scan = getattr(backend, "name", "") != "python"
-    with timers.phase("scc_scan"):
-        for sid, quorum in enumerate(
-            scan_scc_quorums(graph, sccs, allow_native=allow_native_scan)
-        ):
-            if quorum:
-                quorum_scc_ids.append(sid)
-                scc_quorums[sid] = quorum
-                log.debug(
-                    "scc %d (size %d) contains a quorum (size %d)",
-                    sid, len(sccs[sid]), len(quorum),
-                )
-                if verbose:
-                    out.write("found quorum inside of a strongly connected component:\n")
-                    print_quorum(quorum, graph, out)
-
-    # "Main" SCC: the reference labels sccs.front() the main component
-    # (cpp:675-678) — that is the *sink*, not the largest (Q8).  With the Q5
-    # fix the main component is the quorum-bearing one when unique.
-    if scc_select == "front" or not quorum_scc_ids:
-        main_scc = sccs[0] if sccs else []
-    else:
-        main_scc = sccs[quorum_scc_ids[0]]
+    for sid in quorum_scc_ids:
+        log.debug(
+            "scc %d (size %d) contains a quorum (size %d)",
+            sid, len(sccs[sid]), len(scc_quorums[sid]),
+        )
+        if verbose:
+            out.write("found quorum inside of a strongly connected component:\n")
+            print_quorum(scc_quorums[sid], graph, out)
 
     if verbose:
         out.write(
@@ -235,6 +255,126 @@ def solve_graph(
         stats=dict(res.stats),
         timers=timers.summary(),
     )
+
+
+def check_many(
+    sources: List[object],
+    *,
+    backend: Union[str, SearchBackend] = "auto",
+    dangling: str = "strict",
+    scc_select: str = "quorum-bearing",
+    scope_to_scc: bool = False,
+    pack: Optional[bool] = None,
+) -> List[SolveResult]:
+    """Batch entry point (ISSUE 5): decide quorum intersection for MANY
+    FBAS sources in one call — the shape heavy multi-snapshot traffic
+    arrives in (ROADMAP north star), and the third pack-filling source of
+    the lane-packed sweep.
+
+    Each source runs the same parse → graph → SCC scan → guard pipeline as
+    :func:`solve` (minus narration); guard-decided snapshots (zero or >= 2
+    quorum-bearing SCCs) resolve immediately from the scan, and the rest
+    become ONE batched backend call.  A backend exposing a ``check_sccs``
+    batch entry (``auto``, ``tpu-sweep``) fuses sweep-sized problems into
+    lane packs so queued snapshot requests fill full MXU tiles together;
+    any other backend is called per problem.  Results come back in source
+    order with per-source timers and the backend's stats.
+
+    ``pack`` forwards to the auto router: None (default) engages packing
+    only behind a measured calibration win, True forces it, False never
+    packs.
+    """
+    caller_backend = not isinstance(backend, str)
+    if isinstance(backend, str):
+        options: Dict[str, object] = {}
+        if pack is not None and backend == "auto":
+            options["pack"] = pack
+        backend = get_backend(backend, **options)
+
+    results: List[Optional[SolveResult]] = [None] * len(sources)
+    jobs: List[Tuple[int, TrustGraph, Optional[Circuit], List[int]]] = []
+    metas: Dict[int, Tuple[int, List[int], List[int], Dict[str, float]]] = {}
+    allow_native_scan = getattr(backend, "name", "") != "python"
+    for ix, source in enumerate(sources):
+        timers = PhaseTimers()
+        with timers.phase("parse"):
+            fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+        with timers.phase("graph"):
+            graph = build_graph(fbas, dangling=dangling)
+        count, sccs, quorum_scc_ids, scc_quorums, main_scc = _classify_sccs(
+            graph, allow_native=allow_native_scan, scc_select=scc_select,
+            timers=timers,
+        )
+        if len(quorum_scc_ids) != 1:
+            # Guard-decided, exactly as solve_graph: >= 2 quorum-bearing
+            # SCCs yield the scan's witness pair, zero means no quorum.
+            q1 = q2 = None
+            if len(quorum_scc_ids) >= 2:
+                q1 = scc_quorums[quorum_scc_ids[0]]
+                q2 = scc_quorums[quorum_scc_ids[1]]
+            results[ix] = SolveResult(
+                intersects=False, n_sccs=count,
+                quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,
+                q1=q1, q2=q2, stats={"reason": "scc_guard"},
+                timers=timers.summary(),
+            )
+            continue
+        circuit: Optional[Circuit] = None
+        if getattr(backend, "needs_circuit", True):
+            with timers.phase("encode"):
+                circuit = encode_circuit(graph)
+        target_scc = sccs[0] if scc_select == "front" else sccs[quorum_scc_ids[0]]
+        jobs.append((ix, graph, circuit, target_scc))
+        metas[ix] = (count, quorum_scc_ids, main_scc, timers.summary())
+
+    restore_pack: Tuple = ()
+    if pack is not None and caller_backend and hasattr(backend, "pack"):
+        # Caller-supplied backend: apply the override for THIS call only —
+        # restored in the finally below, so a forced pack=True batch never
+        # leaks into the caller's later (default-gated) calls.
+        restore_pack = (backend, backend.pack)
+        backend.pack = pack
+    try:
+        if jobs:
+            # pack=False means NEVER packed, whatever the backend: a
+            # backend without a pack knob (e.g. a bare TpuSweepBackend,
+            # whose batch entry packs unconditionally) is dispatched
+            # per-problem instead.
+            batch = (
+                None if pack is False and not hasattr(backend, "pack")
+                else getattr(backend, "check_sccs", None)
+            )
+            t_search = time.perf_counter()
+            if batch is not None:
+                scc_results = batch(
+                    [(g, c, s) for _, g, c, s in jobs],
+                    scope_to_scc=scope_to_scc,
+                )
+            else:
+                scc_results = [
+                    backend.check_scc(g, c, s, scope_to_scc=scope_to_scc)
+                    for _, g, c, s in jobs
+                ]
+            search_s = time.perf_counter() - t_search
+            for (ix, _, _, _), res in zip(jobs, scc_results):
+                count, quorum_scc_ids, main_scc, timer_summary = metas[ix]
+                # The batched call is one shared phase: every job's timers
+                # carry the SAME "search" wall (per-job attribution of a
+                # fused pack is in res.stats["seconds"]), so solve-vs-
+                # check_many phase comparisons see the dominant phase
+                # instead of a silently absent one.
+                timer_summary = dict(timer_summary)
+                timer_summary["search"] = search_s
+                results[ix] = SolveResult(
+                    intersects=res.intersects, n_sccs=count,
+                    quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,
+                    q1=res.q1, q2=res.q2, stats=dict(res.stats),
+                    timers=timer_summary,
+                )
+    finally:
+        if restore_pack:
+            restore_pack[0].pack = restore_pack[1]
+    return [r for r in results if r is not None]
 
 
 def solve(

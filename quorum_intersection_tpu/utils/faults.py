@@ -160,6 +160,13 @@ SWEEP_WINDOW = _declare(
     "Sweep window loop (backends/tpu/sweep.py, once per dispatched "
     "window): preempt simulates losing the chip mid-enumeration.",
 )
+SWEEP_PACK = _declare(
+    "sweep.pack",
+    "Lane-pack assembly of a fused multi-problem sweep block "
+    "(backends/tpu/sweep.py check_sccs, before any pack is built): error "
+    "simulates a packing failure — the auto router's DegradationLadder "
+    "degrades to the unpacked per-problem sweep, verdicts unchanged.",
+)
 FRONTIER_CHUNK = _declare(
     "frontier.chunk",
     "Frontier device-chunk dispatch (backends/tpu/frontier.py): oom/error "

@@ -1,0 +1,421 @@
+"""Differential parity suite for the lane-packed sweep (ISSUE 5).
+
+The contract under test: packing K problems into one block-diagonal MXU
+block changes SCHEDULING ONLY — verdict, witness pair, and first-hit index
+must be byte-identical to running the unpacked sweep per problem, and both
+must agree with the python oracle.  Plus the packing invariants
+(block-diagonal inertness, decode-map contract — docs/PARITY.md), the
+work-accounting claim the bench row makes checkable off-chip, and the
+``sweep.pack`` fault degrading to the unpacked sweep with the verdict
+unchanged.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.sweep import (
+    EngineResolution,
+    TpuSweepBackend,
+    macs_per_candidate_row,
+    resolve_engine,
+)
+from quorum_intersection_tpu.encode.circuit import (
+    LANE_TILE,
+    encode_circuit,
+    node_sat_np,
+    pack_circuits,
+    plan_packs,
+    restrict_circuit_pair,
+)
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import hierarchical_fbas
+from quorum_intersection_tpu.pipeline import check_many, quorum_bearing_sccs, solve
+
+
+def kofn(n, k, prefix="N"):
+    """Symmetric k-of-n FBAS: one SCC; broken (two disjoint quorums) iff
+    k <= n // 2 — the broken twin the sweep itself must find, unlike the
+    synth ``broken=True`` pairs whose degenerate node splits into its own
+    quorum-bearing SCC and is guard-decided before any backend runs."""
+    ks = [f"{prefix}{i}" for i in range(n)]
+    return [
+        {"publicKey": x, "name": x, "quorumSet": {"threshold": k, "validators": ks}}
+        for x in ks
+    ]
+
+
+def make_job(data):
+    graph = build_graph(parse_fbas(data))
+    circuit = encode_circuit(graph)
+    bearing = quorum_bearing_sccs(graph, allow_native=False)
+    assert len(bearing) == 1, "fixture must have exactly one quorum-bearing SCC"
+    return graph, circuit, bearing[0][1]
+
+
+# Every fixture pair: (correct, broken) twins that reach the backend.
+PAIRS = [
+    (kofn(8, 5), kofn(8, 4)),
+    (kofn(11, 6, "Q"), kofn(11, 5, "Q")),
+    (hierarchical_fbas(3, 3), hierarchical_fbas(3, 4, org_threshold=1)),
+]
+
+
+def assert_parity(unpacked, packed):
+    assert unpacked.intersects == packed.intersects
+    assert unpacked.q1 == packed.q1
+    assert unpacked.q2 == packed.q2
+    assert unpacked.stats.get("hit_index") == packed.stats.get("hit_index")
+
+
+class TestPackedCircuitInvariants:
+    def test_block_diagonal_inertness_and_layout(self):
+        members = []
+        for data in [kofn(6, 4), hierarchical_fbas(3, 3), kofn(9, 5, "B")]:
+            graph, circuit, scc = make_job(data)
+            scoped, q6 = restrict_circuit_pair(circuit, scc)
+            members.append((scoped, q6))
+        packed = pack_circuits(members)
+        slot = packed.slot
+        n = packed.circuit.n
+        for g, (scoped, _) in enumerate(members):
+            base = g * slot
+            cols = np.zeros(n, dtype=bool)
+            cols[base : base + scoped.n] = True
+            rows = np.zeros(packed.circuit.n_units, dtype=bool)
+            rows[base : base + scoped.n] = True  # root units mirror lanes
+            # Root-unit layout: unit base+j is node base+j's quorum set.
+            np.testing.assert_array_equal(
+                packed.circuit.members[base : base + scoped.n, base : base + scoped.n],
+                scoped.members[: scoped.n, :],
+            )
+            np.testing.assert_array_equal(
+                packed.circuit.thresholds[base : base + scoped.n],
+                scoped.thresholds[: scoped.n],
+            )
+            # Cross-block inertness: group g's unit rows carry zero votes
+            # outside group g's lane columns.
+            np.testing.assert_array_equal(
+                packed.circuit.members[np.ix_(rows, ~cols)], 0
+            )
+        # Decode-map contract.
+        pos, scc_mask, lane_group, group_ind = packed.decode_tables()
+        for g, (scoped, _) in enumerate(members):
+            base = g * slot
+            assert pos[base] == 31  # local node 0 fixed out
+            assert list(pos[base + 1 : base + scoped.n]) == list(range(scoped.n - 1))
+            assert scc_mask[base : base + scoped.n].all()
+            assert (lane_group[base : base + scoped.n] == g).all()
+            assert group_ind[base : base + scoped.n, g].all()
+        assert group_ind.sum() == sum(packed.sizes)
+        assert 0 < packed.fill_pct <= 100.0
+
+    def test_packed_fixpoint_matches_members(self):
+        """Block-diagonal inertness, functionally: the fused node_sat equals
+        each member's own node_sat on its lane slice, for random avails."""
+        members = []
+        for data in [kofn(7, 4), hierarchical_fbas(3, 3)]:
+            graph, circuit, scc = make_job(data)
+            scoped, q6 = restrict_circuit_pair(circuit, scc)
+            members.append((scoped, q6))
+        packed = pack_circuits(members)
+        rng = np.random.default_rng(0)
+        avail = np.zeros((16, packed.circuit.n), dtype=bool)
+        for g, (scoped, _) in enumerate(members):
+            base = g * packed.slot
+            avail[:, base : base + scoped.n] = rng.random((16, scoped.n)) < 0.6
+        got = node_sat_np(packed.circuit, avail)
+        for g, (scoped, _) in enumerate(members):
+            base = g * packed.slot
+            want = node_sat_np(scoped, avail[:, base : base + scoped.n])
+            np.testing.assert_array_equal(got[:, base : base + scoped.n], want)
+        # Padded lanes stay identically zero.
+        mask = np.zeros(packed.circuit.n, dtype=bool)
+        for g, (scoped, _) in enumerate(members):
+            mask[g * packed.slot : g * packed.slot + scoped.n] = True
+        assert not got[:, ~mask].any()
+
+    def test_plan_packs_capacity_and_solo(self):
+        # 9 small jobs at slot 16 -> capacity 8: one full pack + ragged tail.
+        packs = plan_packs([12, 9, 10, 13, 11, 9, 12, 10, 9])
+        assert sorted(len(p) for p in packs) == [1, 8]
+        assert sorted(i for p in packs for i in p) == list(range(9))
+        # A job wider than the tile goes solo.
+        packs = plan_packs([LANE_TILE + 1, 8, 8])
+        assert [len(p) for p in packs] == [1, 2]
+
+
+class TestPackedSweepParity:
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    def test_mixed_pack_matches_unpacked_and_oracle(self, engine):
+        datas = [d for pair in PAIRS for d in pair]
+        jobs = [make_job(d) for d in datas]
+        unpacked = [
+            TpuSweepBackend(batch=256).check_scc(g, c, s) for g, c, s in jobs
+        ]
+        packed = TpuSweepBackend(batch=256, engine=engine).check_sccs(jobs)
+        for data, u, p in zip(datas, unpacked, packed):
+            assert_parity(u, p)
+            assert p.stats["packed"] is True
+            assert p.stats["pack_engine"] == engine
+            oracle = solve(data, backend="python")
+            assert oracle.intersects == p.intersects
+
+    def test_k1_degenerate(self):
+        # One tiny job: a single lane group (no window split below two
+        # blocks), still through the packed path, same verdict.
+        graph, circuit, scc = make_job(kofn(5, 3))
+        unpacked = TpuSweepBackend(batch=256).check_scc(graph, circuit, scc)
+        (packed,) = TpuSweepBackend(batch=256).check_sccs([(graph, circuit, scc)])
+        assert_parity(unpacked, packed)
+        assert packed.stats["pack_groups"] == 1
+
+    @pytest.mark.parametrize("broken", [False, True])
+    def test_window_split_single_scc(self, broken):
+        # One 16-node job, spare lanes: the enumeration splits into
+        # multiple in-flight windows (pack source (a)); the first-hit index
+        # must still be the global minimum, as the unpacked FIFO finds it.
+        data = kofn(16, 8 if broken else 9, "W")
+        graph, circuit, scc = make_job(data)
+        unpacked = TpuSweepBackend(batch=256).check_scc(graph, circuit, scc)
+        (packed,) = TpuSweepBackend(batch=256).check_sccs([(graph, circuit, scc)])
+        assert_parity(unpacked, packed)
+        assert packed.stats["pack_groups"] > 1
+
+    def test_ragged_last_pack(self):
+        # 9 jobs at capacity 8: two packs, the second ragged; order and
+        # verdicts preserved.
+        datas = [kofn(9 + (i % 4), 5 + (i % 2), f"R{i}") for i in range(9)]
+        jobs = [make_job(d) for d in datas]
+        unpacked = [
+            TpuSweepBackend(batch=256).check_scc(g, c, s) for g, c, s in jobs
+        ]
+        packed = TpuSweepBackend(batch=256).check_sccs(jobs)
+        for u, p in zip(unpacked, packed):
+            assert_parity(u, p)
+
+    def test_cancel_token_pre_cancelled(self):
+        from quorum_intersection_tpu.backends.base import CancelToken, SearchCancelled
+
+        cancel = CancelToken()
+        cancel.cancel()
+        graph, circuit, scc = make_job(kofn(8, 5))
+        with pytest.raises(SearchCancelled):
+            TpuSweepBackend(batch=256, cancel=cancel).check_sccs(
+                [(graph, circuit, scc)]
+            )
+
+
+class TestCheckMany:
+    def test_check_many_matches_solo_solve(self):
+        # Mix: sweep-eligible jobs, a guard-decided broken source (the
+        # degenerate node splits into its own quorum-bearing SCC), and a
+        # correct hierarchical network.
+        datas = [kofn(8, 5), kofn(8, 4), hierarchical_fbas(3, 3, broken=True),
+                 hierarchical_fbas(3, 3)]
+        many = check_many(datas, backend=TpuSweepBackend(batch=256))
+        for data, res in zip(datas, many):
+            solo = solve(data, backend="python")
+            assert res.intersects == solo.intersects
+        # The guard-decided source never reached the backend.
+        assert many[2].stats.get("reason") == "scc_guard"
+        assert many[2].q1 and many[2].q2
+
+    def test_check_many_auto_forced_pack(self):
+        datas = [kofn(8, 5), kofn(8, 4), hierarchical_fbas(3, 3)]
+        many = check_many(datas, backend="auto", pack=True)
+        for data, res in zip(datas, many):
+            assert res.intersects == solve(data, backend="python").intersects
+            assert res.stats.get("packed") is True
+            assert res.stats.get("backend") == "tpu-sweep"
+
+
+class TestPackFaultDegrade:
+    def test_injected_pack_fault_degrades_to_unpacked(self, monkeypatch):
+        monkeypatch.setenv("QI_FAULTS", "sweep.pack=error")
+        datas = [kofn(8, 5), kofn(8, 4)]
+        many = check_many(datas, backend="auto", pack=True)
+        for data, res in zip(datas, many):
+            assert res.intersects == solve(data, backend="python").intersects
+            # The packed engine never answered; the per-problem router did.
+            assert not res.stats.get("packed")
+        from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+        rec = get_run_record()
+        degrades = [
+            e for e in rec.events
+            if e.get("name") == "degrade"
+            and "sweep.pack" in str(e.get("attrs", {}).get("cause", ""))
+        ]
+        assert degrades, "expected a ladder degrade event for the pack fault"
+        assert any(
+            e.get("name") == "fault.injected"
+            and e.get("attrs", {}).get("point") == "sweep.pack"
+            for e in rec.events
+        )
+
+
+class TestWorkAccounting:
+    def test_packed_macs_per_verdict_at_most_half(self):
+        """The acceptance-criterion accounting, checkable off-chip: for
+        K >= 2 circuits with n <= 48, packed MACs-per-verdict (lane-padded
+        shape model x rows actually dispatched, shared across the pack's
+        verdicts) is at most half the unpacked sum."""
+        datas = [kofn(12, 7, "A"), kofn(12, 6, "B"),
+                 kofn(12, 7, "C"), kofn(12, 6, "D")]
+        jobs = [make_job(d) for d in datas]
+        unpacked = [
+            TpuSweepBackend(batch=256).check_scc(g, c, s) for g, c, s in jobs
+        ]
+        packed = TpuSweepBackend(batch=256).check_sccs(jobs)
+        k = len(jobs)
+        pstats = packed[0].stats
+        assert pstats["pack_jobs"] == k
+        packed_macs_per_verdict = (
+            pstats["pack_rows_dispatched"]
+            * pstats["pack_macs_per_candidate_row"] / k
+        )
+        unpacked_total = 0.0
+        for res in unpacked:
+            shape = res.stats.get("padded_shape") or res.stats["device_shape"]
+            unpacked_total += res.stats["candidates_checked"] * macs_per_candidate_row(
+                shape[0], shape[1], 0
+            )
+        assert unpacked_total > 0
+        ratio = packed_macs_per_verdict / (unpacked_total / k)
+        assert ratio <= 0.5, f"packed MACs ratio {ratio:.3f} > 1/2"
+
+
+class TestPackGate:
+    def test_pack_win_parser_loss_cap(self, tmp_path):
+        """A measured loss above a win caps the window — the sweep-window
+        discipline: headroom must never route a measured-slower size."""
+        from quorum_intersection_tpu.backends.calibration import _pack_win_max_scc
+
+        art = tmp_path / "sweep_vs_native_cpu_r9.txt"
+        rows = [
+            {"scc": 12, "device": "cpu",
+             "packed_speedup_vs_unpacked": 2.2, "verdict_ok": True},
+            {"scc": 14, "device": "cpu",
+             "packed_speedup_vs_unpacked": 0.8, "verdict_ok": True},
+            {"scc": 16, "device": "cpu",
+             "packed_speedup_vs_unpacked": 1.1, "verdict_ok": True},
+        ]
+        art.write_text("\n".join(json.dumps(r) for r in rows))
+        win, kind, _ = _pack_win_max_scc([art])
+        assert (win, kind) == (12, "cpu")
+
+    def test_pack_win_parser_partitions_device_kinds(self, tmp_path):
+        """CPU-emulated rows never merge into (or mislabel) a chip window;
+        when both kinds win, the accelerator's gate is the one recorded."""
+        from quorum_intersection_tpu.backends.calibration import _pack_win_max_scc
+
+        art = tmp_path / "sweep_vs_native_tpu_r9.txt"
+        rows = [
+            {"scc": 12, "device": "cpu",
+             "packed_speedup_vs_unpacked": 2.5, "verdict_ok": True},
+            {"scc": 20, "device": "TPU v5 lite",
+             "packed_speedup_vs_unpacked": 1.4, "verdict_ok": True},
+            {"scc": 24, "device": "TPU v5 lite",
+             "packed_speedup_vs_unpacked": 0.7, "verdict_ok": True},
+        ]
+        art.write_text("\n".join(json.dumps(r) for r in rows))
+        win, kind, _ = _pack_win_max_scc([art])
+        assert (win, kind) == (20, "tpu")
+
+    def test_pack_bound_caps_auto_gated_sizes(self, monkeypatch):
+        """Auto-gated packing caps PER-JOB sizes at the measured window +
+        headroom — engagement off two small jobs must not sneak an
+        unmeasured size into the pack.  The bound is PROBE-FREE (no device
+        contact before the budgeted oracles run); the device-kind half of
+        the gate is applied in check_sccs after every oracle answered."""
+        from quorum_intersection_tpu.backends import calibration
+        from quorum_intersection_tpu.backends.auto import (
+            SWEEP_WIN_SCC_HEADROOM,
+            AutoBackend,
+        )
+
+        monkeypatch.setattr(calibration.CALIBRATION, "pack_win_max_scc", 12)
+        monkeypatch.setattr(calibration.CALIBRATION, "pack_win_device", "cpu")
+        auto = AutoBackend()
+        bound = 12 + SWEEP_WIN_SCC_HEADROOM
+        assert auto._pack_bound([12, 13, 18]) == bound
+        assert auto._pack_bound([30, 40]) is None  # nothing in the window
+        assert auto._pack_bound([12]) is None  # needs two jobs to share
+        assert AutoBackend(pack=True)._pack_bound([50]) is not None  # forced
+        assert AutoBackend(pack=False)._pack_bound([8, 8]) is None
+        monkeypatch.setattr(calibration.CALIBRATION, "pack_win_max_scc", None)
+        assert auto._pack_bound([8, 8]) is None  # no measured win on record
+
+    def test_check_many_pack_false_never_packs(self):
+        """pack=False forbids the packed path even on a backend whose
+        batch entry packs unconditionally (no pack knob)."""
+        datas = [kofn(8, 5), kofn(8, 4)]
+        many = check_many(datas, backend=TpuSweepBackend(batch=256), pack=False)
+        for data, res in zip(datas, many):
+            assert res.intersects == solve(data, backend="python").intersects
+            assert not res.stats.get("packed")
+
+    def test_check_many_does_not_leak_forced_pack(self):
+        """A pack=True batch on a caller-supplied backend is call-scoped."""
+        from quorum_intersection_tpu.backends.auto import AutoBackend
+
+        auto = AutoBackend()
+        assert auto.pack is None
+        check_many([kofn(6, 4)], backend=auto, pack=True)
+        assert auto.pack is None
+
+
+class TestEngineResolution:
+    def test_precedence(self):
+        graph, circuit, scc = make_job(kofn(8, 5))
+        scoped, _ = restrict_circuit_pair(circuit, scc)
+        res = resolve_engine(
+            "xla", mesh=True, wide=True, restricted=True, circuit=scoped
+        )
+        assert res == EngineResolution("xla", "xla", "as requested")
+        assert resolve_engine(
+            "pallas", mesh=True, wide=False, restricted=False, circuit=scoped
+        ).resolved == "xla"
+        assert resolve_engine(
+            "pallas", mesh=False, wide=True, restricted=False, circuit=scoped
+        ).resolved == "xla"
+        assert resolve_engine(
+            "pallas", mesh=False, wide=False, restricted=True, circuit=scoped
+        ).resolved == "xla"
+        ok = resolve_engine(
+            "pallas", mesh=False, wide=False, restricted=False, circuit=scoped
+        )
+        assert ok.resolved == "pallas" and ok.reason == "as requested"
+
+    def test_event_emitted_on_engine_mismatch(self):
+        """The old sweep.py:397 warn-and-swerve is now a typed decision
+        with an explicit telemetry event (here via the restricted-sweep
+        precedence rule; the mesh rule is pinned in test_precedence)."""
+        from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+        # A pendant node outside the core SCC forces SCC restriction.
+        data = kofn(8, 5) + [{
+            "publicKey": "PENDANT", "name": "p",
+            "quorumSet": {"threshold": 5, "validators": [f"N{i}" for i in range(8)]},
+        }]
+        graph = build_graph(parse_fbas(data))
+        circuit = encode_circuit(graph)
+        bearing = quorum_bearing_sccs(graph, allow_native=False)
+        assert len(bearing) == 1 and len(bearing[0][1]) == 8
+        before = len(get_run_record().events)
+        res = TpuSweepBackend(batch=256, engine="pallas").check_scc(
+            graph, circuit, bearing[0][1]
+        )
+        assert res.intersects is True
+        resolved = [
+            e for e in get_run_record().events[before:]
+            if e.get("name") == "sweep.engine_resolved"
+        ]
+        assert resolved, "expected a sweep.engine_resolved event"
+        attrs = resolved[0]["attrs"]
+        assert attrs["requested"] == "pallas"
+        assert attrs["resolved"] == "xla"
+        assert "restricted" in attrs["reason"]

@@ -56,6 +56,17 @@ env JAX_PLATFORMS=cpu python tools/soak.py --chaos \
 crc=$?
 echo "CHAOS=exit $crc"
 
+# Packed-sweep smoke (docs/PARITY.md lane-packing invariants): the
+# lane-packed vs unpacked bench rows on CPU emulation — exits nonzero on
+# any packed/unpacked verdict mismatch; the sweep.pack_* telemetry rides
+# the shared $METRICS stream.
+env JAX_PLATFORMS=cpu QI_METRICS_JSON="$METRICS" \
+    python benchmarks/sweep_vs_native.py --quick --packed \
+    --scc 16 --packed-scc 12 14
+prc=$?
+echo "PACKED=exit $prc"
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$arc" -ne 0 ] && exit "$arc"
-exit "$crc"
+[ "$crc" -ne 0 ] && exit "$crc"
+exit "$prc"
