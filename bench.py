@@ -713,6 +713,13 @@ def _run_child_raw(phase: str, deadline: Deadline, timeout: float,
         env["JAX_PLATFORMS"] = _AMBIENT_JAX_PLATFORMS
     else:
         env.pop("JAX_PLATFORMS", None)  # parent pinned cpu; child wants ambient
+    # Trace-context propagation (qi-trace): the child's RunRecord adopts
+    # this trace_id and records the enclosing bench.<phase> span as its
+    # remote parent, so the child's whole tree stitches under it in the
+    # exported timeline (and metrics_report's span trees).
+    from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+    env["QI_TRACE_CONTEXT"] = get_run_record().trace_context().to_env()
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     cmd += extra_args or []
     proc = subprocess.Popen(

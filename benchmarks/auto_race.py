@@ -230,11 +230,16 @@ def _subprocess_warm_pair(data):
     on and drops jax's sub-second persistence threshold; harmless on an
     accelerator)."""
     payload = json.dumps(data)
+    from quorum_intersection_tpu.utils.telemetry import get_run_record
+
     with tempfile.TemporaryDirectory(prefix="qi_warm_cache_") as cache_dir:
         env = dict(
             os.environ,
             JAX_COMPILATION_CACHE_DIR=cache_dir,
             QI_COMPILE_CACHE_CPU="1",
+            # qi-trace: both the cold and warm child adopt this driver's
+            # trace_id, so a --warm-start run exports as one timeline.
+            QI_TRACE_CONTEXT=get_run_record().trace_context().to_env(),
         )
         out = []
         for _ in ("cold", "warm"):

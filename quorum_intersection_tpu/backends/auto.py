@@ -71,7 +71,11 @@ from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.utils.env import qi_env_float, qi_env_int
 from quorum_intersection_tpu.utils.faults import TransientDeviceFault
 from quorum_intersection_tpu.utils.logging import get_logger
-from quorum_intersection_tpu.utils.telemetry import Span, get_run_record
+from quorum_intersection_tpu.utils.telemetry import (
+    Span,
+    dump_flight_recorder,
+    get_run_record,
+)
 
 log = get_logger("backends.auto")
 
@@ -296,6 +300,11 @@ class DegradationLadder:
         rec = get_run_record()
         rec.add("ladder.quarantines")
         rec.event("ladder.quarantined", rung=rung, cause=str(cause))
+        # Live health (/healthz) reads the quarantine picture from this
+        # gauge; the flight recorder preserves the last-N context that led
+        # to taking a rung out for the run.
+        rec.gauge("ladder.quarantined_rungs", sorted(self._quarantined))
+        dump_flight_recorder(f"quarantine:{rung}")
         log.warning(
             "ladder: rung %r quarantined for this run (%s)", rung, cause
         )
@@ -328,6 +337,9 @@ class DegradationLadder:
             "degrade", rung=rung, to=to, cause=str(cause),
             attempts=attempts, transient=transient,
         )
+        # Every degrade event carries its last-N context out to disk (no-op
+        # unless QI_FLIGHT_RECORDER is set — docs/OBSERVABILITY.md).
+        dump_flight_recorder(f"degrade:{rung}->{to}")
         log.info("ladder: %s -> %s after %d attempt(s) (%s)",
                  rung, to, attempts, cause)
 
@@ -356,7 +368,13 @@ class DegradationLadder:
         while True:
             attempts += 1
             try:
-                return fn()
+                # One span per rung attempt (qi-trace): every rung and every
+                # retry of one run appears in the timeline under the same
+                # trace_id, so a degrade cascade reads as a cascade.
+                with get_run_record().span(
+                    "ladder.rung", rung=rung, attempt=attempts
+                ):
+                    return fn()
             except (OracleBudgetExceeded, SearchCancelled, RungFailed):
                 raise
             except Exception as exc:  # noqa: BLE001 — the ladder's one broad catch
@@ -485,6 +503,7 @@ class _WatchedNativeOracle:
                     "native.watchdog_cancel",
                     deadline_s=self._watchdog_s, scc=len(scc),
                 )
+                dump_flight_recorder("watchdog:native")
                 log.warning(
                     "native call exceeded %.2fs watchdog deadline; "
                     "tripping its cancel token", self._watchdog_s,
@@ -806,54 +825,17 @@ class AutoBackend:
         t0 = time.monotonic()
 
         def sweep_worker() -> None:
-            try:
-                _race_sync("sweep.started")
-                if sweep_cancel.cancelled:
-                    return
-                # The race's ONE device contact, off the verdict path.
-                limit = (
-                    self.sweep_limit if self.sweep_limit is not None
-                    else _platform_sweep_limit()
+            # The worker's whole arm is one span, explicitly parented under
+            # the race span (cross-THREAD trace propagation — a thread's
+            # spans are otherwise roots), so the LOSING arm appears in the
+            # same timeline as the verdict that beat it.
+            with rec.span(
+                "race.sweep", parent_id=race_span.span_id, scc=len(scc)
+            ) as arm_span:
+                self._sweep_arm(
+                    arm_span, graph, circuit, scc, scope_to_scc,
+                    oracle_cancel, sweep_cancel, outcome, t0,
                 )
-                if len(scc) > limit:
-                    outcome["sweep_ineligible"] = (
-                        f"|scc|={len(scc)} > platform sweep limit {limit}"
-                    )
-                    return
-                if sweep_cancel.cancelled:
-                    return
-                res = self._ladder.attempt(
-                    "tpu-sweep",
-                    lambda: self._sweep(cancel=sweep_cancel).check_scc(
-                        graph, circuit, scc, scope_to_scc=scope_to_scc
-                    ),
-                    fall_to="native",
-                )
-                outcome["sweep_result"] = res
-                outcome["sweep_seconds"] = time.monotonic() - t0
-                _race_sync("sweep.verdict")
-                oracle_cancel.cancel()
-            except SearchCancelled:
-                outcome["sweep_cancelled"] = True
-                _race_sync("sweep.unwound")
-                if self.checkpoint is not None:
-                    # Discard this losing sweep's recorded progress FROM THE
-                    # WORKER THREAD, after its engine has raised: the worker
-                    # is the checkpoint's only writer, so no record can land
-                    # after this clear (the driver-side clear below covers
-                    # non-cancel exits, but only once the worker is joined —
-                    # clearing while the worker might still write would
-                    # re-create the residue it removes).
-                    try:
-                        self.checkpoint.clear()
-                    # qi-lint: allow(degrade-via-ladder) — cleanup, not routing
-                    except Exception:  # noqa: BLE001 — cleanup is best-effort
-                        pass
-            except RungFailed as fail:
-                # The ladder burned the sweep rung's retries (degrade event
-                # already on the record); the racing oracle IS the fallback.
-                outcome["sweep_error"] = str(fail.cause)
-                log.info("race: sweep engine unavailable (%s)", fail.cause)
 
         # Non-daemon (see RACE_LOSER_JOIN_S): the verdict itself never
         # waits on this thread beyond the adaptive join, but interpreter
@@ -875,16 +857,22 @@ class AutoBackend:
             "spin-up for |scc|=%d", backend.name, budget_s, len(scc),
         )
         t_oracle = time.monotonic()
-        try:
-            oracle_res = backend.check_scc(
-                graph, circuit, scc, scope_to_scc=scope_to_scc
-            )
-        except OracleBudgetExceeded as exc:
-            oracle_state = "budget_exceeded"
-            rec.add("oracle.budget_burns")
-            log.info("race: oracle budget burned (%s); awaiting the sweep", exc)
-        except SearchCancelled:
-            oracle_state = "cancelled"
+        # The oracle arm mirrors the sweep arm's span (same trace, same
+        # parent) so the timeline shows BOTH racers side by side.
+        with rec.span("race.oracle", budget_s=round(budget_s, 3)) as ora_span:
+            try:
+                oracle_res = backend.check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                )
+            except OracleBudgetExceeded as exc:
+                oracle_state = "budget_exceeded"
+                rec.add("oracle.budget_burns")
+                log.info(
+                    "race: oracle budget burned (%s); awaiting the sweep", exc
+                )
+            except SearchCancelled:
+                oracle_state = "cancelled"
+            ora_span.set(outcome=oracle_state)
         oracle_seconds = time.monotonic() - t_oracle
         _race_sync("oracle.returned")
 
@@ -973,6 +961,76 @@ class AutoBackend:
         race_stats("none", True, winner_wait_s=winner_wait_s)
         return None
 
+    def _sweep_arm(
+        self,
+        arm_span: Span,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+        oracle_cancel: CancelToken,
+        sweep_cancel: CancelToken,
+        outcome: Dict[str, object],
+        t0: float,
+    ) -> None:
+        """The race's sweep arm (worker-thread body of :meth:`_race_inner`):
+        resolve the platform limit, spin up the sweep, record the outcome.
+        Runs inside the ``race.sweep`` span the worker opened."""
+        try:
+            _race_sync("sweep.started")
+            if sweep_cancel.cancelled:
+                arm_span.set(outcome="cancelled")
+                return
+            # The race's ONE device contact, off the verdict path.
+            limit = (
+                self.sweep_limit if self.sweep_limit is not None
+                else _platform_sweep_limit()
+            )
+            if len(scc) > limit:
+                outcome["sweep_ineligible"] = (
+                    f"|scc|={len(scc)} > platform sweep limit {limit}"
+                )
+                arm_span.set(outcome="ineligible")
+                return
+            if sweep_cancel.cancelled:
+                arm_span.set(outcome="cancelled")
+                return
+            res = self._ladder.attempt(
+                "tpu-sweep",
+                lambda: self._sweep(cancel=sweep_cancel).check_scc(
+                    graph, circuit, scc, scope_to_scc=scope_to_scc
+                ),
+                fall_to="native",
+            )
+            outcome["sweep_result"] = res
+            outcome["sweep_seconds"] = time.monotonic() - t0
+            arm_span.set(outcome="verdict")
+            _race_sync("sweep.verdict")
+            oracle_cancel.cancel()
+        except SearchCancelled:
+            outcome["sweep_cancelled"] = True
+            arm_span.set(outcome="cancelled")
+            _race_sync("sweep.unwound")
+            if self.checkpoint is not None:
+                # Discard this losing sweep's recorded progress FROM THE
+                # WORKER THREAD, after its engine has raised: the worker
+                # is the checkpoint's only writer, so no record can land
+                # after this clear (the driver-side clear below covers
+                # non-cancel exits, but only once the worker is joined —
+                # clearing while the worker might still write would
+                # re-create the residue it removes).
+                try:
+                    self.checkpoint.clear()
+                # qi-lint: allow(degrade-via-ladder) — cleanup, not routing
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    pass
+        except RungFailed as fail:
+            # The ladder burned the sweep rung's retries (degrade event
+            # already on the record); the racing oracle IS the fallback.
+            outcome["sweep_error"] = str(fail.cause)
+            arm_span.set(outcome="error")
+            log.info("race: sweep engine unavailable (%s)", fail.cause)
+
     def _has_recorded_progress(self, scc: List[int]) -> bool:
         """Does the attached checkpoint hold progress plausibly belonging to
         THIS problem?  Delegated to the checkpoint class (which owns the
@@ -1013,6 +1071,11 @@ class AutoBackend:
                 budget_burned=_budget_burned,
             )
             route_span.set(backend=res.stats.get("backend", "?"))
+            # The live endpoint's "which rung is serving" answer: the
+            # engine that produced the most recent verdict.
+            get_run_record().gauge(
+                "ladder.rung", res.stats.get("backend", "?")
+            )
             return res
 
     # ---- batch entry (ISSUE 5): lane-packed multi-problem routing --------

@@ -38,7 +38,7 @@ from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
 from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
-from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.telemetry import Span, get_run_record
 
 log = get_logger("backends.cpp")
 
@@ -361,6 +361,25 @@ class CppOracleBackend:
         *,
         scope_to_scc: bool = False,
     ) -> SccCheckResult:
+        # One span per native entry (qi-trace): its span_id doubles as the
+        # CALL ID, echoed back beside the B&B counters and in the result
+        # stats, so a JSONL stream ties each native counter increment to
+        # the exact call (and thread) that produced it.
+        rec = get_run_record()
+        with rec.span("native.call", scc=len(scc)) as call_span:
+            return self._check_scc_traced(
+                call_span, graph, circuit, scc, scope_to_scc
+            )
+
+    def _check_scc_traced(
+        self,
+        call_span: Span,
+        graph: TrustGraph,
+        circuit: Optional[Circuit],
+        scc: List[int],
+        scope_to_scc: bool,
+    ) -> SccCheckResult:
+        call_id = call_span.span_id
         # Injectable native-entry boundary (utils/faults.py): `error`
         # simulates a crashed call, `hang` a wedged one — the auto router's
         # watchdog/quarantine hardening is exercised exactly here.
@@ -406,11 +425,16 @@ class CppOracleBackend:
         # Native-call accounting (ISSUE 2): every entry into the C++ search
         # core lands in the run record — call count, wall time, and the B&B
         # calls actually executed (also counted on budget/cancel exits,
-        # where no SccCheckResult carries them).
+        # where no SccCheckResult carries them).  The call id rides on the
+        # span beside the same counters (ISSUE 6).
         rec = get_run_record()
         rec.add("native.check_scc_calls")
         rec.add("native.check_scc_seconds", round(seconds, 6))
         rec.add("native.bnb_calls", int(stats[0]))
+        call_span.set(
+            call_id=call_id, bnb_calls=int(stats[0]),
+            seconds=round(seconds, 6),
+        )
 
         if intersects == -2:
             from quorum_intersection_tpu.backends.base import OracleBudgetExceeded
@@ -438,6 +462,9 @@ class CppOracleBackend:
                 "minimal_quorums": int(stats[1]),
                 "fixpoint_calls": int(stats[2]),
                 "seconds": seconds,
+                # The span id of this exact native entry (qi-trace): joins
+                # the result back to its native.call span and counters.
+                "native_call_id": call_id,
             },
         )
 

@@ -746,104 +746,113 @@ class TpuSweepBackend:
             start_async_compile(STEPS_RAMP[
                 _jump_target_ix(STEPS_RAMP, ramp_ix, base_block, total - start)
             ])
-        while start < total:
-            check_cancel()
-            # Injectable window boundary: `preempt` simulates the scheduler
-            # revoking the chip mid-enumeration (any recorded checkpoint
-            # stays on disk, so the preempted run resumes — exactly the
-            # contract checkpoints exist for).
-            fault_point("sweep.window")
-            # Grow the program only once the remaining work would fill at
-            # least a couple of programs at the next size (never compile
-            # shapes a small sweep won't use) — and then jump straight to
-            # the largest such level, skipping the intermediate shapes.
-            # The jump-target shape compiles in a background thread while
-            # the current level keeps sweeping; the switch happens only when
-            # the compiled program is ready (or inline if the thread died).
-            if since_ramp >= RAMP_DISPATCHES and jump_worthwhile():
-                ct = async_compile["target"]
-                thread = async_compile["thread"]
-                if (
-                    ct is not None
-                    and ct in dispatchers
-                    and total - start >= ct * base_block
-                ):
-                    # The in-flight compile landed and still fits: jump.
-                    ramp_ix, since_ramp = STEPS_RAMP.index(ct), 0
+        # One span over the whole dispatch/drain drive (qi-trace): every
+        # per-window sweep.window progress event lands inside it, so the
+        # exported timeline shows the enumeration as one block with its
+        # windows as instant marks on the same thread track.
+        with rec.span(
+            "sweep.drive", scc=s, total=total, resumed_from=start0
+        ) as drive_span:
+            while start < total:
+                check_cancel()
+                # Injectable window boundary: `preempt` simulates the scheduler
+                # revoking the chip mid-enumeration (any recorded checkpoint
+                # stays on disk, so the preempted run resumes — exactly the
+                # contract checkpoints exist for).
+                fault_point("sweep.window")
+                # Grow the program only once the remaining work would fill at
+                # least a couple of programs at the next size (never compile
+                # shapes a small sweep won't use) — and then jump straight to
+                # the largest such level, skipping the intermediate shapes.
+                # The jump-target shape compiles in a background thread while
+                # the current level keeps sweeping; the switch happens only when
+                # the compiled program is ready (or inline if the thread died).
+                if since_ramp >= RAMP_DISPATCHES and jump_worthwhile():
+                    ct = async_compile["target"]
+                    thread = async_compile["thread"]
+                    if (
+                        ct is not None
+                        and ct in dispatchers
+                        and total - start >= ct * base_block
+                    ):
+                        # The in-flight compile landed and still fits: jump.
+                        ramp_ix, since_ramp = STEPS_RAMP.index(ct), 0
+                        async_compile["target"] = None
+                    elif thread is None or not thread.is_alive():
+                        target_ix = _jump_target_ix(
+                            STEPS_RAMP, ramp_ix, base_block, total - start
+                        )
+                        if target_ix == ramp_ix:
+                            # No level above is worth compiling for the work
+                            # that remains; drop any stale marker so the ramp
+                            # depth cap lifts (and never "compile" the current
+                            # level in a loop).
+                            async_compile["target"] = None
+                        elif ct == STEPS_RAMP[target_ix] and ct not in dispatchers:
+                            # Thread finished without registering: compile
+                            # failed; jump anyway, dispatch() compiles inline.
+                            ramp_ix, since_ramp = target_ix, 0
+                            async_compile["target"] = None
+                        else:
+                            start_async_compile(STEPS_RAMP[target_ix])
+                    # else: a compile is still in flight — keep sweeping at the
+                    # current level; the target is re-validated against the
+                    # remaining work at jump time, never re-chosen mid-compile.
+                elif async_compile["target"] is not None and not jump_worthwhile():
+                    # The remaining work shrank below the jump guard while the
+                    # compile was in flight: it will never be jumped to.  Clear
+                    # the marker so the ramp depth cap lifts for the tail.
                     async_compile["target"] = None
-                elif thread is None or not thread.is_alive():
-                    target_ix = _jump_target_ix(
-                        STEPS_RAMP, ramp_ix, base_block, total - start
+                hi, lo = start >> lo_bits, start & (lo_total - 1)
+                coverage = STEPS_RAMP[ramp_ix] * base_block
+                spc = STEPS_RAMP[ramp_ix]
+                if lo + coverage > lo_total:
+                    # Chunk tail: dispatch the smallest program that covers the
+                    # remainder, but ADVANCE/RECORD only to the chunk boundary.
+                    # The overshot indices decode as aliases of this same
+                    # chunk's prefix (bit lo_bits+ shifts hit pos 31) — already
+                    # evaluated, so harmless duplicates — while the recorded
+                    # position never claims the NEXT chunk's candidates (whose
+                    # hi mask differs).  This also makes checkpoint positions
+                    # independent of batch/lo_bits choices across resumes.
+                    rem = lo_total - lo
+                    # Prefer the smallest ALREADY-COMPILED shape that covers the
+                    # remainder (overshoot aliases are free duplicates): the
+                    # jump skips intermediate levels, so a fresh `next(...)`
+                    # pick here could stall the pipeline on a synchronous
+                    # compile of a shape used exactly once per chunk tail.
+                    compiled_ok = [
+                        r for r in STEPS_RAMP
+                        if r * base_block >= rem and r in dispatchers
+                    ]
+                    spc = (
+                        min(compiled_ok) if compiled_ok
+                        else next(r for r in STEPS_RAMP if r * base_block >= rem)
                     )
-                    if target_ix == ramp_ix:
-                        # No level above is worth compiling for the work
-                        # that remains; drop any stale marker so the ramp
-                        # depth cap lifts (and never "compile" the current
-                        # level in a loop).
-                        async_compile["target"] = None
-                    elif ct == STEPS_RAMP[target_ix] and ct not in dispatchers:
-                        # Thread finished without registering: compile
-                        # failed; jump anyway, dispatch() compiles inline.
-                        ramp_ix, since_ramp = target_ix, 0
-                        async_compile["target"] = None
-                    else:
-                        start_async_compile(STEPS_RAMP[target_ix])
-                # else: a compile is still in flight — keep sweeping at the
-                # current level; the target is re-validated against the
-                # remaining work at jump time, never re-chosen mid-compile.
-            elif async_compile["target"] is not None and not jump_worthwhile():
-                # The remaining work shrank below the jump guard while the
-                # compile was in flight: it will never be jumped to.  Clear
-                # the marker so the ramp depth cap lifts for the tail.
-                async_compile["target"] = None
-            hi, lo = start >> lo_bits, start & (lo_total - 1)
-            coverage = STEPS_RAMP[ramp_ix] * base_block
-            spc = STEPS_RAMP[ramp_ix]
-            if lo + coverage > lo_total:
-                # Chunk tail: dispatch the smallest program that covers the
-                # remainder, but ADVANCE/RECORD only to the chunk boundary.
-                # The overshot indices decode as aliases of this same
-                # chunk's prefix (bit lo_bits+ shifts hit pos 31) — already
-                # evaluated, so harmless duplicates — while the recorded
-                # position never claims the NEXT chunk's candidates (whose
-                # hi mask differs).  This also makes checkpoint positions
-                # independent of batch/lo_bits choices across resumes.
-                rem = lo_total - lo
-                # Prefer the smallest ALREADY-COMPILED shape that covers the
-                # remainder (overshoot aliases are free duplicates): the
-                # jump skips intermediate levels, so a fresh `next(...)`
-                # pick here could stall the pipeline on a synchronous
-                # compile of a shape used exactly once per chunk tail.
-                compiled_ok = [
-                    r for r in STEPS_RAMP
-                    if r * base_block >= rem and r in dispatchers
-                ]
-                spc = (
-                    min(compiled_ok) if compiled_ok
-                    else next(r for r in STEPS_RAMP if r * base_block >= rem)
+                    coverage = rem
+                inflight.append((start, coverage, hi, spc, dispatch(lo, hi, spc)))
+                rec.add("sweep.windows_dispatched")
+                since_ramp += 1
+                start += coverage
+                # While a jump compile is pending AND the current level is the
+                # first one, the queue holds only small RTT-bound programs; keep
+                # it shallow (RAMP_INFLIGHT) so the post-jump drain backlog
+                # stays bounded.  Above level 1 the queued programs are real
+                # device work — capping them would idle the chip, and a pending
+                # target that can no longer be jumped to is cleared above.
+                depth = (
+                    min(self.max_inflight, RAMP_INFLIGHT)
+                    if async_compile["target"] is not None and ramp_ix == 0
+                    else self.max_inflight
                 )
-                coverage = rem
-            inflight.append((start, coverage, hi, spc, dispatch(lo, hi, spc)))
-            rec.add("sweep.windows_dispatched")
-            since_ramp += 1
-            start += coverage
-            # While a jump compile is pending AND the current level is the
-            # first one, the queue holds only small RTT-bound programs; keep
-            # it shallow (RAMP_INFLIGHT) so the post-jump drain backlog
-            # stays bounded.  Above level 1 the queued programs are real
-            # device work — capping them would idle the chip, and a pending
-            # target that can no longer be jumped to is cleared above.
-            depth = (
-                min(self.max_inflight, RAMP_INFLIGHT)
-                if async_compile["target"] is not None and ramp_ix == 0
-                else self.max_inflight
-            )
-            if len(inflight) >= max(depth, 1) and drain_one():
-                break
-        while not found and inflight:
-            check_cancel()
-            if drain_one():
-                break
+                if len(inflight) >= max(depth, 1) and drain_one():
+                    break
+            while not found and inflight:
+                check_cancel()
+                if drain_one():
+                    break
+            drive_span.set(windows=steps, candidates=candidates,
+                           found=found)
 
         # No join here: the compile thread is non-daemon, so an early-hit
         # verdict returns immediately and only interpreter exit waits for
@@ -1189,46 +1198,59 @@ class TpuSweepBackend:
                     g.done = True
             resolve_jobs()
 
-        while unresolved:
-            check_cancel()
-            # Same injectable window boundary as the unpacked loop.
-            fault_point("sweep.window")
-            if not all_dispatched():
-                rem = max(
-                    (g.hi - nxt[i] for i, g in enumerate(groups) if not g.done),
-                    default=0,
-                )
-                while spc_ix + 1 < len(ramp) and rem >= ramp[spc_ix + 1] * batch * 2:
-                    spc_ix += 1
-                spc = ramp[spc_ix]
-                if rem < spc * batch:
-                    # Tail: the smallest program covering the remainder,
-                    # preferring an already-compiled shape (the unpacked
-                    # driver's chunk-tail discipline) — never burn a
-                    # 64x-batch program on a few surviving rows.
-                    fits = [r for r in ramp if r * batch >= rem]
-                    compiled_ok = [r for r in fits if r in dispatchers]
-                    spc = min(compiled_ok) if compiled_ok else min(fits)
-                coverage = spc * batch
-                snap = np.asarray(nxt, dtype=np.int32)
-                inflight.append((snap, coverage, dispatch(snap, spc)))
-                pack_rows += coverage
-                rec.add("sweep.pack_windows")
-                for i, g in enumerate(groups):
-                    if not g.done and nxt[i] < g.hi:
-                        nxt[i] += coverage
-                if len(inflight) >= depth_cap:
-                    drain_one()
-            elif inflight:
-                drain_one()
-            else:
-                # Defense in depth: every group drained yet a job is still
-                # unresolved would mean the accounting above lied — fail
-                # loudly, never spin.
-                raise RuntimeError(
-                    f"packed sweep drained all lane groups with "
-                    f"{len(unresolved)} job(s) unresolved"
-                )
+        # The whole pack drive is one span (qi-trace), and the live
+        # endpoint's /healthz reads the in-flight count from the gauge
+        # bracketing it — a scrape mid-pack sees packs_in_flight=1.
+        rec.gauge("sweep.packs_in_flight", 1)
+        try:
+            with rec.span(
+                "sweep.pack", jobs=n_jobs, groups=k, slot=packed.slot,
+                lanes=packed.circuit.n,
+                fill_pct=round(packed.fill_pct, 2),
+            ) as pack_span:
+                while unresolved:
+                    check_cancel()
+                    # Same injectable window boundary as the unpacked loop.
+                    fault_point("sweep.window")
+                    if not all_dispatched():
+                        rem = max(
+                            (g.hi - nxt[i] for i, g in enumerate(groups) if not g.done),
+                            default=0,
+                        )
+                        while spc_ix + 1 < len(ramp) and rem >= ramp[spc_ix + 1] * batch * 2:
+                            spc_ix += 1
+                        spc = ramp[spc_ix]
+                        if rem < spc * batch:
+                            # Tail: the smallest program covering the remainder,
+                            # preferring an already-compiled shape (the unpacked
+                            # driver's chunk-tail discipline) — never burn a
+                            # 64x-batch program on a few surviving rows.
+                            fits = [r for r in ramp if r * batch >= rem]
+                            compiled_ok = [r for r in fits if r in dispatchers]
+                            spc = min(compiled_ok) if compiled_ok else min(fits)
+                        coverage = spc * batch
+                        snap = np.asarray(nxt, dtype=np.int32)
+                        inflight.append((snap, coverage, dispatch(snap, spc)))
+                        pack_rows += coverage
+                        rec.add("sweep.pack_windows")
+                        for i, g in enumerate(groups):
+                            if not g.done and nxt[i] < g.hi:
+                                nxt[i] += coverage
+                        if len(inflight) >= depth_cap:
+                            drain_one()
+                    elif inflight:
+                        drain_one()
+                    else:
+                        # Defense in depth: every group drained yet a job is still
+                        # unresolved would mean the accounting above lied — fail
+                        # loudly, never spin.
+                        raise RuntimeError(
+                            f"packed sweep drained all lane groups with "
+                            f"{len(unresolved)} job(s) unresolved"
+                        )
+                pack_span.set(rows_dispatched=pack_rows)
+        finally:
+            rec.gauge("sweep.packs_in_flight", 0)
 
         seconds = time.perf_counter() - t0
         xla_s = sum(

@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write final counters/gauges to PATH as a "
                         "Prometheus-style textfile (node_exporter textfile "
                         "collector format) for soak runs")
+    p.add_argument("--trace-out", metavar="PATH", default=None,
+                   help="append the run's spans/events to PATH as "
+                        "Chrome/Perfetto trace-event JSON (one causal "
+                        "timeline incl. both race arms and every ladder "
+                        "rung; open in ui.perfetto.dev — "
+                        "docs/OBSERVABILITY.md); env twin: QI_TRACE_OUT")
     p.add_argument("--no-race", action="store_true",
                    help="disable the auto backend's racing orchestrator "
                         "(budgeted oracle vs concurrent sweep spin-up, first "
@@ -151,6 +157,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         record.add_sink(telemetry.JsonlSink(args.metrics_json))
     if args.metrics_prom:
         record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+    if args.trace_out:
+        record.add_sink(telemetry.ChromeTraceSink(args.trace_out))
+    # Crash flight recorder (qi-trace): with QI_FLIGHT_RECORDER set, the
+    # get_run_record() call above chained sys.excepthook, so any exception
+    # escaping _main dumps the last-N telemetry ring exactly once before
+    # the traceback prints — no catch-all needed here.
     try:
         return _main(args, record)
     finally:

@@ -128,49 +128,62 @@ def initialize(
             return
     deadline = time.monotonic() + qi_env_float("QI_DIST_INIT_TIMEOUT_S", 20.0)
     attempt = 0
-    while True:
-        attempt += 1
-        try:
-            fault_point("distributed.init")
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                local_device_ids=local_device_ids,
-            )
-            if attempt > 1:
-                log.info("distributed init succeeded on attempt %d", attempt)
-            break
-        except RuntimeError as exc:
-            # Two causes share this exception: the XLA backend was already
-            # touched before init (unrecoverable — degrade NOW, retrying
-            # only wastes the window), and a coordinator that is down or
-            # still coming up (recoverable — the case the bounded retry
-            # exists for).
-            unrecoverable = any(
-                marker in str(exc) for marker in _UNRECOVERABLE_INIT_MARKERS
-            )
-            delay = min(
-                _INIT_BACKOFF_S * (2 ** (attempt - 1)), _INIT_BACKOFF_CAP_S
-            )
-            if not unrecoverable and time.monotonic() + delay < deadline:
-                log.info(
-                    "distributed init failed (attempt %d: %s); retrying "
-                    "in %.1fs", attempt, exc, delay,
+    rec = get_run_record()
+    # One span over the whole join (qi-trace): every retry and the degrade
+    # land inside it, and the worker's RunRecord has already adopted the
+    # launcher's trace_id when QI_TRACE_CONTEXT rides the job environment —
+    # a pod's worth of workers stitches into one timeline.
+    with rec.span("distributed.init") as init_span:
+        while True:
+            attempt += 1
+            try:
+                fault_point("distributed.init")
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=local_device_ids,
                 )
-                _retry_sleep(delay)
-                continue
-            # Budget burned: proceeding single-process is the only option
-            # left; make it loud AND machine-readable.
-            log.warning(
-                "distributed init unavailable after %d attempt(s) (%s); "
-                "continuing single-process", attempt, exc,
-            )
-            get_run_record().event(
-                "distributed.init_degraded", cause=str(exc),
-                attempts=attempt,
-            )
-            break
+                if attempt > 1:
+                    log.info(
+                        "distributed init succeeded on attempt %d", attempt
+                    )
+                init_span.set(outcome="joined")
+                break
+            except RuntimeError as exc:
+                # Two causes share this exception: the XLA backend was
+                # already touched before init (unrecoverable — degrade NOW,
+                # retrying only wastes the window), and a coordinator that
+                # is down or still coming up (recoverable — the case the
+                # bounded retry exists for).
+                unrecoverable = any(
+                    marker in str(exc)
+                    for marker in _UNRECOVERABLE_INIT_MARKERS
+                )
+                delay = min(
+                    _INIT_BACKOFF_S * (2 ** (attempt - 1)),
+                    _INIT_BACKOFF_CAP_S,
+                )
+                if not unrecoverable and time.monotonic() + delay < deadline:
+                    log.info(
+                        "distributed init failed (attempt %d: %s); retrying "
+                        "in %.1fs", attempt, exc, delay,
+                    )
+                    _retry_sleep(delay)
+                    continue
+                # Budget burned: proceeding single-process is the only
+                # option left; make it loud AND machine-readable.
+                log.warning(
+                    "distributed init unavailable after %d attempt(s) (%s); "
+                    "continuing single-process", attempt, exc,
+                )
+                rec.event(
+                    "distributed.init_degraded", cause=str(exc),
+                    attempts=attempt,
+                )
+                init_span.set(outcome="degraded")
+                break
+        init_span.set(attempts=attempt)
     _initialized = True
     log.info(
         "distributed runtime up: process %d/%d, %d global devices",

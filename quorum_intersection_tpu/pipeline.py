@@ -26,6 +26,7 @@ from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph, group_sc
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 from quorum_intersection_tpu.utils.timers import PhaseTimers
 
 log = get_logger("pipeline")
@@ -345,16 +346,24 @@ def check_many(
                 else getattr(backend, "check_sccs", None)
             )
             t_search = time.perf_counter()
-            if batch is not None:
-                scc_results = batch(
-                    [(g, c, s) for _, g, c, s in jobs],
-                    scope_to_scc=scope_to_scc,
-                )
-            else:
-                scc_results = [
-                    backend.check_scc(g, c, s, scope_to_scc=scope_to_scc)
-                    for _, g, c, s in jobs
-                ]
+            # The batched search is one span (qi-trace): every job's route/
+            # pack/native span of this batch nests under it, so the serving-
+            # layer timeline shows "one request batch" as one block.
+            rec = get_run_record()
+            with rec.span(
+                "pipeline.check_many", sources=len(sources), jobs=len(jobs),
+                batched=batch is not None,
+            ):
+                if batch is not None:
+                    scc_results = batch(
+                        [(g, c, s) for _, g, c, s in jobs],
+                        scope_to_scc=scope_to_scc,
+                    )
+                else:
+                    scc_results = [
+                        backend.check_scc(g, c, s, scope_to_scc=scope_to_scc)
+                        for _, g, c, s in jobs
+                    ]
             search_s = time.perf_counter() - t_search
             for (ix, _, _, _), res in zip(jobs, scc_results):
                 count, quorum_scc_ids, main_scc, timer_summary = metas[ix]

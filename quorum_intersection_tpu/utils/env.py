@@ -131,6 +131,38 @@ QI_DIST_INIT_TIMEOUT_S = _declare(
     "(parallel/distributed.py initialize; event "
     "distributed.init_degraded).",
 )
+QI_TRACE_OUT = _declare(
+    "QI_TRACE_OUT", "",
+    "Path of a Chrome/Perfetto trace-event JSON file the run appends its "
+    "spans and events to (utils/telemetry.py ChromeTraceSink; CLI flag "
+    "--trace-out).  Multi-process runs share one file — open it in "
+    "ui.perfetto.dev to see the whole run as one timeline.",
+)
+QI_TRACE_CONTEXT = _declare(
+    "QI_TRACE_CONTEXT", "",
+    "Inherited trace context 'trace_id:span_id:pid' a parent process "
+    "exports before spawning children (bench.py phase children, "
+    "benchmarks/auto_race.py warm pairs, distributed workers): the child's "
+    "RunRecord adopts the trace_id instead of minting its own, so every "
+    "process of one run shares a single causal trace "
+    "(utils/telemetry.py TraceContext).",
+)
+QI_FLIGHT_RECORDER = _declare(
+    "QI_FLIGHT_RECORDER", "",
+    "Path the crash flight recorder dumps to: a bounded ring buffer of the "
+    "last spans/events is always on, and on fault firing, watchdog trip, "
+    "ladder degrade/quarantine, or unhandled exception its tail plus a "
+    "counter/gauge snapshot is written crash-only with fsync-before-rename "
+    "(utils/telemetry.py dump_flight_recorder).  Empty: dumps disabled, "
+    "the ring still records.",
+)
+QI_METRICS_PORT = _declare(
+    "QI_METRICS_PORT", "0",
+    "TCP port of the live observability endpoint (127.0.0.1): /healthz "
+    "serves ladder rung, quarantine state and in-flight packs as JSON, "
+    "/metrics serves the Prometheus encoding of the run record "
+    "(utils/metrics_server.py).  0 (default): no server.",
+)
 
 
 # ---- reads -----------------------------------------------------------------

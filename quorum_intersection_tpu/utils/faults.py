@@ -178,6 +178,13 @@ DISTRIBUTED_INIT = _declare(
     "simulates a dead/unreachable coordinator — bounded retry under "
     "QI_DIST_INIT_TIMEOUT_S, then a loud single-process degrade.",
 )
+TELEMETRY_DUMP = _declare(
+    "telemetry.dump",
+    "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "
+    "oserror simulates a full disk at the worst moment — mid-crash — and "
+    "the dump downgrades to the telemetry.dump_errors counter; a crash "
+    "dump must never be the crash.",
+)
 
 
 def registry() -> Dict[str, str]:
@@ -249,7 +256,10 @@ class FaultPlan:
         self._fire(rule, n)
 
     def _fire(self, rule: FaultRule, n: int) -> None:
-        from quorum_intersection_tpu.utils.telemetry import get_run_record
+        from quorum_intersection_tpu.utils.telemetry import (
+            dump_flight_recorder,
+            get_run_record,
+        )
 
         rec = get_run_record()
         rec.add("faults.injected")
@@ -258,6 +268,12 @@ class FaultPlan:
         )
         log.info("fault injected: %s (mode=%s, hit %d)", rule.point,
                  rule.mode, n)
+        # Crash flight recorder (ISSUE 6): every injected fault carries its
+        # last-N telemetry context out to disk BEFORE the failure is raised.
+        # The dump is reentrancy-guarded, so a rule on `telemetry.dump`
+        # itself cannot recurse (it fires inside the guarded dump instead,
+        # exercising the dump's own degradation path).
+        dump_flight_recorder(f"fault:{rule.point}:{rule.mode}")
         if rule.mode == "hang":
             time.sleep(min(max(rule.seconds, 0.0), HANG_CAP_S))
             return

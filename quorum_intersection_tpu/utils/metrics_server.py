@@ -1,0 +1,158 @@
+"""Live observability endpoint — ``/healthz`` + ``/metrics`` over stdlib HTTP.
+
+The first brick of the snapshot-stream serving layer (ROADMAP): before the
+long-lived query service exists, the pipeline already answers the two
+questions a fleet scheduler asks of any service — *is it healthy* and *what
+are its numbers* — from any run that sets ``QI_METRICS_PORT`` (env registry,
+utils/env.py):
+
+- ``GET /healthz`` → JSON (``qi-health/1``): degradation-ladder rung,
+  quarantined rungs, in-flight lane packs, degrade/fault counters, trace_id
+  — everything sourced from the process-wide RunRecord's gauges/counters,
+  so the endpoint never reaches into engine internals;
+- ``GET /metrics`` → the Prometheus text encoding of the same record,
+  produced by the ONE encoder the textfile sink uses
+  (:func:`quorum_intersection_tpu.utils.telemetry.prom_lines`) — scrape it
+  directly instead of (or alongside) the ``QI_METRICS_PROM`` textfile.
+
+Both endpoints render deterministically (sorted keys/metrics), so
+concurrent scrapes of an unchanged record are byte-identical —
+``tests/test_qi_trace.py`` pins it.  stdlib-only (``http.server``), bound
+to 127.0.0.1, served from a daemon thread: observability must never hold a
+verdict process alive or open the solve to the network.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from quorum_intersection_tpu.utils.env import qi_env_int
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record, prom_lines
+
+log = get_logger("utils.metrics_server")
+
+HEALTH_SCHEMA = "qi-health/1"
+
+
+def healthz_payload() -> dict:
+    """The /healthz body: run identity + the degradation picture.
+
+    Sourced purely from the run record's counters/gauges snapshot (the
+    ladder and the packed sweep keep ``ladder.rung`` /
+    ``ladder.quarantined_rungs`` / ``sweep.packs_in_flight`` current), so
+    the endpoint stays byte-stable between state changes and has no lock
+    interaction with the engines.
+    """
+    rec = get_run_record()
+    counters, gauges = rec.snapshot()
+    return {
+        "schema": HEALTH_SCHEMA,
+        "status": "ok",
+        "pid": rec.pid,
+        "trace_id": rec.trace_id,
+        "started_t_wall": round(rec.t_wall, 3),
+        "ladder_rung": gauges.get("ladder.rung"),
+        "quarantined_rungs": gauges.get("ladder.quarantined_rungs", []),
+        "packs_in_flight": gauges.get("sweep.packs_in_flight", 0),
+        "degrades": counters.get("ladder.degrades", 0),
+        "retries": counters.get("ladder.retries", 0),
+        "faults_injected": counters.get("faults.injected", 0),
+        "flight_dumps": counters.get("telemetry.dumps", 0),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Request handler for the two read-only endpoints."""
+
+    server_version = "qi-metrics/1"
+
+    def _respond(self, code: int, content_type: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server's required name
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = ("\n".join(prom_lines(get_run_record())) + "\n").encode()
+            self._respond(200, "text/plain; version=0.0.4", body)
+        elif path == "/healthz":
+            body = (
+                json.dumps(healthz_payload(), sort_keys=True) + "\n"
+            ).encode()
+            self._respond(200, "application/json", body)
+        else:
+            self._respond(404, "text/plain", b"not found\n")
+
+    def log_message(self, format: str, *args: object) -> None:
+        # Route scrape access logs to the qi logger at debug, never stderr —
+        # a scraper must not interleave noise into --timing output.
+        log.debug("metrics scrape: " + format, *args)
+
+
+class MetricsServer:
+    """One live endpoint server, bound to 127.0.0.1.
+
+    ``port=0`` binds an ephemeral port (tests); read it back via ``.port``.
+    The serving thread is a daemon — interpreter exit never waits on a
+    scraper — and :meth:`stop` shuts it down deterministically.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1") -> None:
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        # qi-lint: allow(cancel-token-plumbed) — daemon scrape server, no solve work; stop() shuts it down
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="qi-metrics-server",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("metrics endpoint serving on http://%s:%d "
+                 "(/healthz, /metrics)", host, self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_server: Optional[MetricsServer] = None
+_server_lock = threading.Lock()
+
+
+def maybe_start_from_env() -> Optional[MetricsServer]:
+    """Start the process-wide server once when ``QI_METRICS_PORT`` > 0.
+
+    Best-effort by contract: a port already taken (a bench child inheriting
+    the parent's env) logs and returns None — a scrape endpoint is never
+    worth a verdict.
+    """
+    global _server
+    with _server_lock:
+        if _server is not None:
+            return _server
+        port = qi_env_int("QI_METRICS_PORT", 0)
+        if port <= 0:
+            return None
+        try:
+            _server = MetricsServer(port=port)
+        except OSError as exc:
+            log.info("metrics endpoint not started on port %d: %s", port, exc)
+            return None
+        return _server
+
+
+def stop_server() -> None:
+    """Stop the env-started server if one is running (tests)."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None

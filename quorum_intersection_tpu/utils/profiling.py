@@ -40,6 +40,15 @@ def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
     log.info("recording jax profiler trace to %s", trace_dir)
     rec = get_run_record()
     rec.gauge("profile.trace_dir", str(trace_dir))
-    with rec.span("profile.trace", dir=str(trace_dir)):
+    # Correlation marker (qi-trace): the XProf timeline carries a named
+    # TraceAnnotation with this run's trace_id, and the profile.trace span
+    # carries the same id — so the device trace and the qi-telemetry /
+    # Perfetto timeline join on one key.
+    annotation = getattr(jax.profiler, "TraceAnnotation", None)
+    with rec.span("profile.trace", dir=str(trace_dir), trace_id=rec.trace_id):
         with jax.profiler.trace(str(trace_dir)):
-            yield
+            if annotation is None:
+                yield
+            else:
+                with annotation(f"qi-trace:{rec.trace_id}"):
+                    yield

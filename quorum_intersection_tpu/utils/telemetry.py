@@ -44,6 +44,23 @@ Schema (``qi-telemetry/1``, one JSON object per line):
 (the bench driver's phase children, CLI subprocesses under the test suite)
 append to one file; consumers group by ``pid``.  ``tools/metrics_report.py``
 renders a stream into per-phase / per-window tables.
+
+Since ISSUE 6 (qi-trace) the record also carries **cross-boundary trace
+identity and crash forensics**:
+
+- every record mints (or inherits via ``QI_TRACE_CONTEXT``) a
+  :class:`TraceContext` ``trace_id`` stamped on every span/event line, so a
+  race loser's spans, a native call, a packed-sweep window and a bench
+  child's rows all stitch into ONE causal timeline;
+- :class:`ChromeTraceSink` (CLI ``--trace-out``, env ``QI_TRACE_OUT``)
+  exports that timeline in Chrome/Perfetto trace-event JSON;
+- a bounded, lock-protected **flight-recorder ring** of the last
+  :data:`FLIGHT_RECORDER_N` span/event lines is always on;
+  :func:`dump_flight_recorder` writes it crash-only (fsync-before-rename,
+  the checkpoint discipline) on fault firing, watchdog trip, ladder
+  degrade/quarantine, or unhandled exception (``QI_FLIGHT_RECORDER``);
+- ``QI_METRICS_PORT`` starts the live ``/healthz`` + ``/metrics`` endpoint
+  (:mod:`quorum_intersection_tpu.utils.metrics_server`).
 """
 
 from __future__ import annotations
@@ -55,9 +72,11 @@ import os
 import sys
 import threading
 import time
+import uuid
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Protocol, Tuple
+from typing import Deque, Dict, Iterator, List, Optional, Protocol, Tuple
 
 from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.logging import get_logger
@@ -65,12 +84,57 @@ from quorum_intersection_tpu.utils.logging import get_logger
 log = get_logger("utils.telemetry")
 
 SCHEMA = "qi-telemetry/1"
+FLIGHT_SCHEMA = "qi-flight/1"
 
 # In-memory retention caps: a 2^44 sweep drains millions of windows; the
 # JSONL sink streams them all, but the in-process lists (used by tests and
 # the stderr summary) stay bounded.  Overflow is counted, never silent.
 MAX_SPANS = 100_000
 MAX_EVENTS = 100_000
+# Flight-recorder depth: the last N span/event lines every process retains
+# for crash dumps.  Small enough that the always-on ring is noise (a deque
+# append per emitted line), large enough that a dump shows the whole
+# degrade cascade that led to it, not just its final line.
+FLIGHT_RECORDER_N = 512
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Cross-boundary trace identity (ISSUE 6 tentpole).
+
+    One ``trace_id`` per RUN — minted at pipeline entry (record creation)
+    and threaded through every boundary: race worker threads adopt it
+    implicitly (one record per process), subprocess children inherit it via
+    the ``QI_TRACE_CONTEXT`` env hook (``to_env``/``from_env`` round-trip),
+    carrying the parent's current span id + pid so the exporter can stitch
+    processes into one timeline.
+    """
+
+    trace_id: str
+    span_id: Optional[int] = None
+    pid: Optional[int] = None
+
+    def to_env(self) -> str:
+        """``trace_id:span_id:pid`` for the QI_TRACE_CONTEXT env hook."""
+        return f"{self.trace_id}:{self.span_id or 0}:{self.pid or os.getpid()}"
+
+    @staticmethod
+    def from_env(raw: str) -> Optional["TraceContext"]:
+        """Parse a ``to_env`` string; None when empty/blank.  Lenient on
+        malformed tails — a garbled context must cost linkage, not a run."""
+        parts = (raw or "").strip().split(":")
+        if not parts or not parts[0]:
+            return None
+        span_id: Optional[int] = None
+        pid: Optional[int] = None
+        try:
+            if len(parts) > 1:
+                span_id = int(parts[1]) or None
+            if len(parts) > 2:
+                pid = int(parts[2]) or None
+        except ValueError:
+            pass
+        return TraceContext(trace_id=parts[0], span_id=span_id, pid=pid)
 
 
 class Sink(Protocol):
@@ -102,6 +166,12 @@ class Span:
     start_s: float
     seconds: Optional[float] = None
     attrs: Dict[str, object] = field(default_factory=dict)
+    # Trace identity (ISSUE 6): the run's trace_id plus the OS thread/process
+    # the span ran on — what the Perfetto exporter needs to place it on the
+    # right track and what lets a consumer assert "one run, one trace".
+    trace_id: str = ""
+    tid: int = 0
+    pid: int = 0
 
     def set(self, **attrs: object) -> "Span":
         self.attrs.update(attrs)
@@ -115,6 +185,9 @@ class Span:
             "parent_id": self.parent_id,
             "start_s": round(self.start_s, 6),
             "seconds": None if self.seconds is None else round(self.seconds, 6),
+            "trace_id": self.trace_id,
+            "pid": self.pid,
+            "tid": self.tid,
             "attrs": _jsonable(self.attrs),
         }
 
@@ -151,6 +224,39 @@ class JsonlSink:
                 self._fh = None
 
 
+def _prom_metric(name: str) -> str:
+    clean = "".join(c if c.isalnum() else "_" for c in name)
+    return f"qi_{clean}"
+
+
+def prom_lines(record: "RunRecord") -> List[str]:
+    """Prometheus text encoding of a record's counters/gauges/span rollups.
+
+    The ONE encoder behind both the textfile sink below and the live
+    ``/metrics`` endpoint (utils/metrics_server.py) — deterministic (sorted)
+    output, so two scrapes of an unchanged record are byte-identical.
+    """
+    lines: List[str] = []
+    counters, gauges = record.snapshot()
+    for name, value in sorted(counters.items()):
+        m = _prom_metric(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {value}")
+    for name, value in sorted(gauges.items()):
+        if not isinstance(value, (int, float)):
+            continue
+        m = _prom_metric(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {value}")
+    for name, total, count in record.span_rollup():
+        m = _prom_metric(f"span_{name}_seconds")
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {round(total, 6)}")
+        lines.append(f"# TYPE {m}_count counter")
+        lines.append(f"{m}_count {count}")
+    return lines
+
+
 class PromFileSink:
     """Prometheus textfile exporter: counters/gauges rewritten atomically at
     finish — point node_exporter's textfile collector at the file for soak
@@ -162,39 +268,112 @@ class PromFileSink:
     def emit(self, line: dict) -> None:  # streaming is a no-op for textfiles
         pass
 
-    @staticmethod
-    def _metric(name: str) -> str:
-        clean = "".join(c if c.isalnum() else "_" for c in name)
-        return f"qi_{clean}"
-
     def finish(self, record: "RunRecord") -> None:
-        lines: List[str] = []
-        with record._lock:
-            counters = dict(record.counters)
-            gauges = dict(record.gauges)
-        for name, value in sorted(counters.items()):
-            m = self._metric(name)
-            lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m} {value}")
-        for name, value in sorted(gauges.items()):
-            if not isinstance(value, (int, float)):
-                continue
-            m = self._metric(name)
-            lines.append(f"# TYPE {m} gauge")
-            lines.append(f"{m} {value}")
-        for name, total, count in record.span_rollup():
-            m = self._metric(f"span_{name}_seconds")
-            lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m} {round(total, 6)}")
-            lines.append(f"# TYPE {m}_count counter")
-            lines.append(f"{m}_count {count}")
         tmp = f"{self.path}.tmp{os.getpid()}"
         try:
             with open(tmp, "w", encoding="utf-8") as fh:
-                fh.write("\n".join(lines) + "\n")
+                fh.write("\n".join(prom_lines(record)) + "\n")
             os.replace(tmp, self.path)
         except OSError as exc:
             log.info("metrics textfile write failed: %s", exc)
+
+
+class ChromeTraceSink:
+    """Chrome/Perfetto trace-event JSON exporter (ISSUE 6 tentpole).
+
+    Spans become complete (``"ph": "X"``) duration events on their real
+    OS-thread track, telemetry events become instant (``"i"``) marks, and
+    each process contributes a ``process_name`` metadata record naming its
+    argv0 + pid + trace_id — so a whole run, including the losing race arm
+    and every bench subprocess child appending to the same file, opens in
+    ui.perfetto.dev / ``chrome://tracing`` as ONE timeline.
+
+    The enclosing JSON array is deliberately left unterminated: the
+    trace-event "JSON Array Format" tolerates a missing ``]``, so every
+    event is appended and flushed as it happens and a crashed run still
+    leaves a loadable trace (the JsonlSink crash-tolerance discipline).
+    Timestamps are wall-clock microseconds (the meta line's ``t_wall``
+    anchor plus record-relative ``start_s``/``t_s``), so events from
+    different processes align without any cross-process clock plumbing.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh: Optional[io.TextIOBase] = None
+        self._pid = os.getpid()
+        self._t_wall = time.time()  # refined by the meta line on attach
+
+    def _open(self) -> io.TextIOBase:
+        # Exactly ONE process writes the opening "[": O_EXCL creation
+        # decides the winner, so concurrently launched children sharing a
+        # QI_TRACE_OUT file cannot both prepend it (a second "[" mid-stream
+        # would corrupt the array for every consumer).  The tell()==0
+        # fallback covers a pre-existing empty file, where only this
+        # process's own lock matters.
+        try:
+            fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+            with os.fdopen(fd, "w", encoding="utf-8") as first:
+                first.write("[\n")
+        except FileExistsError:
+            pass
+        fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        if fh.tell() == 0:
+            fh.write("[\n")
+        return fh
+
+    def _write(self, obj: dict) -> None:
+        try:
+            with self._lock:
+                if self._fh is None:
+                    self._fh = self._open()
+                self._fh.write(json.dumps(obj, default=str) + ",\n")
+        except OSError as exc:  # telemetry must never cost the verdict
+            log.info("trace-event write failed: %s", exc)
+
+    def _ts_us(self, rel_s: object) -> float:
+        return round((self._t_wall + float(rel_s or 0.0)) * 1e6, 1)
+
+    def emit(self, line: dict) -> None:
+        kind = line.get("kind")
+        if kind == "meta":
+            try:
+                self._t_wall = float(line.get("t_wall") or self._t_wall)
+            except (TypeError, ValueError):
+                pass
+            self._write({
+                "ph": "M", "name": "process_name", "pid": self._pid,
+                "tid": 0,
+                "args": {"name": (
+                    f"{line.get('argv0') or 'python'} (pid {self._pid}, "
+                    f"trace {line.get('trace_id', '?')})"
+                )},
+            })
+        elif kind == "span" and line.get("seconds") is not None:
+            self._write({
+                "ph": "X", "cat": "span", "name": line.get("name", "?"),
+                "pid": self._pid, "tid": int(line.get("tid") or 0),
+                "ts": self._ts_us(line.get("start_s")),
+                "dur": max(round(float(line["seconds"]) * 1e6, 1), 1.0),
+                "args": line.get("attrs") or {},
+            })
+        elif kind == "event":
+            self._write({
+                "ph": "i", "cat": "event", "name": line.get("name", "?"),
+                "pid": self._pid, "tid": int(line.get("tid") or 0),
+                "ts": self._ts_us(line.get("t_s")), "s": "t",
+                "args": line.get("attrs") or {},
+            })
+        # counters/gauges stay in the JSONL stream; the timeline shows flow
+
+    def finish(self, record: "RunRecord") -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
 
 class StderrSummarySink:
@@ -218,6 +397,18 @@ class RunRecord:
         self._local = threading.local()
         self.t0 = time.monotonic()
         self.t_wall = time.time()
+        self.pid = os.getpid()
+        # Trace identity (ISSUE 6): inherit the parent process's context
+        # from QI_TRACE_CONTEXT (bench children, distributed workers) or
+        # mint a fresh trace_id — every span/event line this record emits
+        # carries it, so one RUN is one trace across threads and processes.
+        self.parent_ctx: Optional[TraceContext] = TraceContext.from_env(
+            qi_env("QI_TRACE_CONTEXT")
+        )
+        self.trace_id: str = (
+            self.parent_ctx.trace_id if self.parent_ctx is not None
+            else uuid.uuid4().hex[:16]
+        )
         self.spans: List[Span] = []
         self.events: List[dict] = []
         self.counters: Dict[str, float] = {}
@@ -226,10 +417,35 @@ class RunRecord:
         self._next_id = 0
         self._sinks: List[Sink] = []
         self._finished = False
+        # Crash flight recorder (ISSUE 6): bounded ring of the last
+        # FLIGHT_RECORDER_N emitted span/event lines, always on, guarded by
+        # its own lock (never nested with self._lock — the emit path takes
+        # them strictly in sequence).
+        self._flight_lock = threading.Lock()
+        self._flight: Deque[dict] = deque(maxlen=FLIGHT_RECORDER_N)
         # Always-present counters (acceptance: one solve's stream carries the
         # compile-cache hit/miss pair even when the cache saw no traffic).
         self.declare("compile_cache.hits")
         self.declare("compile_cache.misses")
+
+    def trace_context(self) -> TraceContext:
+        """The context to export at a process boundary (QI_TRACE_CONTEXT):
+        this trace plus the calling thread's current span as the remote
+        parent, so a child's whole tree hangs under the span that spawned
+        it."""
+        return TraceContext(self.trace_id, self.current_span_id, self.pid)
+
+    def snapshot(self) -> Tuple[Dict[str, float], Dict[str, object]]:
+        """Consistent copies of (counters, gauges) — the read API for the
+        live endpoint and the Prometheus encoder (no caller ever needs to
+        touch the record's lock)."""
+        with self._lock:
+            return dict(self.counters), dict(self.gauges)
+
+    def flight_tail(self) -> List[dict]:
+        """Copy of the flight-recorder ring, oldest first."""
+        with self._flight_lock:
+            return list(self._flight)
 
     # ---- sinks -----------------------------------------------------------
 
@@ -239,18 +455,32 @@ class RunRecord:
         # Every sink gets its own meta/schema header on attach — a sink
         # added after the env sink must still open with the schema line
         # (metrics_report groups multi-process streams by the meta pids).
+        meta = {
+            "kind": "meta",
+            "schema": SCHEMA,
+            "pid": self.pid,
+            "argv0": os.path.basename(sys.argv[0]) if sys.argv else "",
+            "t_wall": round(self.t_wall, 3),
+            "trace_id": self.trace_id,
+        }
+        if self.parent_ctx is not None:
+            # Cross-process stitch point: which span of which parent process
+            # spawned this one (the exporter and metrics_report use it to
+            # hang a child's tree under its parent's bench.<phase> span).
+            meta["parent_span"] = self.parent_ctx.span_id
+            meta["parent_pid"] = self.parent_ctx.pid
         try:
-            sink.emit({
-                "kind": "meta",
-                "schema": SCHEMA,
-                "pid": os.getpid(),
-                "argv0": os.path.basename(sys.argv[0]) if sys.argv else "",
-                "t_wall": round(self.t_wall, 3),
-            })
+            sink.emit(meta)
         except Exception as exc:  # noqa: BLE001 — never cost the verdict
             log.info("telemetry sink failed: %s", exc)
 
     def _emit(self, line: dict) -> None:
+        # Flight recorder first (bounded deque append under its own lock —
+        # the always-on cost of crash forensics is this one line), then the
+        # pluggable sinks, outside any lock.
+        if line.get("kind") in ("span", "event"):
+            with self._flight_lock:
+                self._flight.append(line)
         for sink in list(self._sinks):
             try:
                 sink.emit(line)
@@ -287,6 +517,9 @@ class RunRecord:
             ),
             start_s=time.monotonic() - self.t0,
             attrs=dict(attrs),
+            trace_id=self.trace_id,
+            tid=threading.get_native_id(),
+            pid=self.pid,
         )
         stack.append(sid)
         try:
@@ -309,6 +542,9 @@ class RunRecord:
             "name": name,
             "t_s": round(time.monotonic() - self.t0, 6),
             "span_id": self.current_span_id,
+            "trace_id": self.trace_id,
+            "pid": self.pid,
+            "tid": threading.get_native_id(),
             "attrs": _jsonable(attrs),
         }
         with self._lock:
@@ -404,15 +640,53 @@ _global_lock = threading.Lock()
 
 
 def _attach_env_sinks(record: RunRecord) -> None:
-    """Honor QI_METRICS_JSON / QI_METRICS_PROM: the env-var hook the test
-    suite and CI use (tools/ci_tier1.sh) — every process in a run appends to
-    one shared stream without any flag plumbing."""
+    """Honor QI_METRICS_JSON / QI_METRICS_PROM / QI_TRACE_OUT: the env-var
+    hooks the test suite, CI and the bench drivers use — every process in a
+    run appends to one shared stream without any flag plumbing."""
     jsonl = qi_env("QI_METRICS_JSON")
     if jsonl:
         record.add_sink(JsonlSink(jsonl))
     prom = qi_env("QI_METRICS_PROM")
     if prom:
         record.add_sink(PromFileSink(prom))
+    trace = qi_env("QI_TRACE_OUT")
+    if trace:
+        record.add_sink(ChromeTraceSink(trace))
+
+
+_crash_hook_installed = False
+
+
+def _install_crash_hook() -> None:
+    """With QI_FLIGHT_RECORDER set, chain ``sys.excepthook`` so an unhandled
+    exception dumps the flight-recorder ring BEFORE the interpreter prints
+    the traceback — the forensic record survives the crash it describes."""
+    global _crash_hook_installed
+    if _crash_hook_installed or not qi_env("QI_FLIGHT_RECORDER"):
+        return
+    _crash_hook_installed = True
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):  # nested: exempt from the typing ratchet
+        dump_flight_recorder(f"unhandled:{exc_type.__name__}")
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+
+def _maybe_start_metrics_server() -> None:
+    """Start the live /healthz + /metrics endpoint when QI_METRICS_PORT > 0
+    (best-effort: a taken port on a bench child logs and moves on)."""
+    if qi_env("QI_METRICS_PORT") in ("", "0"):
+        return
+    try:
+        from quorum_intersection_tpu.utils.metrics_server import (
+            maybe_start_from_env,
+        )
+
+        maybe_start_from_env()
+    except Exception as exc:  # noqa: BLE001 — observability never costs the verdict
+        log.info("metrics server unavailable: %s", exc)
 
 
 def get_run_record() -> RunRecord:
@@ -426,6 +700,8 @@ def get_run_record() -> RunRecord:
                 _attach_env_sinks(record)
                 atexit.register(record.finish)
                 _global = record
+        _install_crash_hook()
+        _maybe_start_metrics_server()
     return _global
 
 
@@ -444,3 +720,73 @@ def finish() -> None:
     """Finish the process-wide record if one exists (idempotent)."""
     if _global is not None:
         _global.finish()
+
+
+# ---- crash flight recorder -------------------------------------------------
+
+_dump_state = threading.local()
+
+
+def dump_flight_recorder(reason: str, path: Optional[str] = None) -> Optional[str]:
+    """Dump the flight-recorder ring crash-only: the last-N span/event lines
+    plus a counter/gauge snapshot, written with the checkpoint discipline
+    (tmp + flush + fsync + rename + best-effort dir fsync).
+
+    Called at every forensic trigger — fault firing (utils/faults.py),
+    watchdog trip / ladder degrade / quarantine (backends/auto.py), and
+    unhandled exceptions (the chained excepthook).  No-op unless ``path`` or
+    ``QI_FLIGHT_RECORDER`` names a destination.  Reentrancy-guarded: a
+    trigger firing INSIDE a dump (an injected ``telemetry.dump`` fault's own
+    event) never recurses.  Returns the path written, or None.
+    """
+    target = path or qi_env("QI_FLIGHT_RECORDER")
+    if not target:
+        return None
+    if getattr(_dump_state, "active", False):
+        return None  # one dump per trigger chain is enough
+    _dump_state.active = True
+    try:
+        rec = get_run_record()
+        counters, gauges = rec.snapshot()
+        payload = {
+            "schema": FLIGHT_SCHEMA,
+            "reason": reason,
+            "pid": rec.pid,
+            "trace_id": rec.trace_id,
+            "t_wall": round(time.time(), 3),
+            "t_s": round(time.monotonic() - rec.t0, 6),
+            "counters": counters,
+            "gauges": _jsonable(gauges),
+            "tail": rec.flight_tail(),
+        }
+        try:
+            from quorum_intersection_tpu.utils.faults import fault_point
+
+            # Injectable boundary: the dump write itself can hit a full disk
+            # mid-crash; it downgrades to a counter, never a second crash.
+            fault_point("telemetry.dump")
+            tmp = f"{target}.tmp{rec.pid}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(payload, default=str))
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, target)
+            try:
+                dir_fd = os.open(
+                    os.path.dirname(os.path.abspath(target)), os.O_RDONLY
+                )
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except OSError:
+                pass  # directory fsync is best-effort (utils/checkpoint.py)
+        except Exception as exc:  # noqa: BLE001 — a crash dump must never be the crash
+            rec.add("telemetry.dump_errors")
+            log.warning("flight-recorder dump failed (%s); run continues", exc)
+            return None
+        rec.add("telemetry.dumps")
+        rec.event("telemetry.dumped", path=str(target), reason=reason)
+        return str(target)
+    finally:
+        _dump_state.active = False

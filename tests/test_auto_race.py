@@ -462,3 +462,66 @@ class TestNoRaceCli:
         )
         assert proc.returncode == 1
         assert "--no-race" in proc.stderr
+
+
+class TestTraceIdentity:
+    """qi-trace (ISSUE 6): one trace_id across both race arms and every
+    ladder rung of one run — the cross-thread half of the propagation
+    contract (the cross-process half lives in tests/test_qi_trace.py)."""
+
+    def test_trace_id_shared_across_race_arms_and_rungs(self):
+        from quorum_intersection_tpu.utils import telemetry
+
+        rec = telemetry.reset_run_record()
+        try:
+            res = solve(majority_fbas(9), backend=AutoBackend())
+            assert res.intersects is True
+            # The losing sweep arm's span closes when the worker unwinds —
+            # join it so the assertion below sees the full tree.
+            assert not _join_race_threads()
+            spans = list(rec.spans)
+            names = {sp.name for sp in spans}
+            assert {"route", "race", "race.oracle", "race.sweep",
+                    "ladder.rung"} <= names, names
+            # ONE trace: every span of the run carries the record's id.
+            assert {sp.trace_id for sp in spans} == {rec.trace_id}
+            # The sweep arm hangs under the race span despite running on a
+            # worker thread (explicit cross-thread parenting).
+            race = next(sp for sp in spans if sp.name == "race")
+            arm = next(sp for sp in spans if sp.name == "race.sweep")
+            assert arm.parent_id == race.span_id
+            assert arm.tid != race.tid  # genuinely another OS thread
+        finally:
+            telemetry.reset_run_record()
+
+    def test_ladder_rung_spans_cover_retries(self):
+        # A transient fault burns retries: every attempt is its own
+        # ladder.rung span (attempt numbering 1..n) in the same trace.
+        from quorum_intersection_tpu.backends import auto as auto_mod
+        from quorum_intersection_tpu.backends.auto import DegradationLadder
+        from quorum_intersection_tpu.utils import telemetry
+        from quorum_intersection_tpu.utils.faults import TransientDeviceFault
+
+        rec = telemetry.reset_run_record()
+        try:
+            ladder = DegradationLadder(retry_max=2)
+            calls = {"n": 0}
+
+            def flaky():
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise TransientDeviceFault("sweep.dispatch", calls["n"])
+                return "ok"
+
+            old_sleep = auto_mod._retry_sleep
+            auto_mod._retry_sleep = lambda s: None
+            try:
+                assert ladder.attempt("tpu-sweep", flaky, "native") == "ok"
+            finally:
+                auto_mod._retry_sleep = old_sleep
+            rungs = [sp for sp in rec.spans if sp.name == "ladder.rung"]
+            assert [sp.attrs["attempt"] for sp in rungs] == [1, 2, 3]
+            assert {sp.attrs["rung"] for sp in rungs} == {"tpu-sweep"}
+            assert {sp.trace_id for sp in rungs} == {rec.trace_id}
+        finally:
+            telemetry.reset_run_record()

@@ -1,0 +1,209 @@
+"""Bench-trend regression sentinel + metrics_report satellites (ISSUE 6):
+synthetic regressed row fails, committed history passes, schema errors
+hard-fail, device partitioning keeps cross-hardware rounds out of each
+other's baselines, span TREES render with parent indentation, and --diff
+compares two streams."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # `from tools...` resolves without install
+
+from tools import bench_trend  # noqa: E402
+from tools import metrics_report  # noqa: E402
+
+
+def wrapper(n, row, rc=0):
+    """A BENCH_rNN.json driver wrapper whose tail ends in one bench row."""
+    return {
+        "n": n, "cmd": "python bench.py", "rc": rc,
+        "tail": "WARNING: noise\n" + json.dumps(row) + "\n", "parsed": None,
+    }
+
+
+def write_history(tmp_path, rows):
+    for n, row in enumerate(rows, start=1):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps(wrapper(n, row))
+        )
+
+
+GOOD = {"device": "cpu", "value": 1_000_000.0, "sweep_mfu_pct": 3.0,
+        "snapshot_verdict_seconds": 0.5}
+REGRESSED = {"device": "cpu", "value": 40_000.0, "sweep_mfu_pct": 0.1,
+             "snapshot_verdict_seconds": 9.0}
+
+
+class TestBenchTrend:
+    def test_synthetic_regressed_row_exits_nonzero(self, tmp_path, capsys):
+        write_history(tmp_path, [GOOD, REGRESSED])
+        rc = bench_trend.main(["--repo", str(tmp_path)])
+        assert rc == 1
+        out = capsys.readouterr()
+        assert "REGRESSED" in out.out
+        assert "REGRESSION" in out.err
+
+    def test_healthy_history_exits_zero(self, tmp_path, capsys):
+        improved = dict(GOOD, value=1_200_000.0, snapshot_verdict_seconds=0.4)
+        write_history(tmp_path, [GOOD, improved])
+        assert bench_trend.main(["--repo", str(tmp_path)]) == 0
+        assert "ok" in capsys.readouterr().out
+
+    def test_committed_history_exits_zero(self, capsys):
+        # Acceptance: the sentinel over the repo's own BENCH_r*.json +
+        # benchmarks/results history is clean.
+        assert bench_trend.main(["--repo", str(REPO)]) == 0
+        out = capsys.readouterr().out
+        assert "latest run:" in out
+
+    def test_informational_reports_but_exits_zero(self, tmp_path, capsys):
+        write_history(tmp_path, [GOOD, REGRESSED])
+        rc = bench_trend.main(["--repo", str(tmp_path), "--informational"])
+        assert rc == 0
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_schema_error_exits_2_even_informational(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("not json at all")
+        assert bench_trend.main(["--repo", str(tmp_path)]) == 2
+        assert bench_trend.main(
+            ["--repo", str(tmp_path), "--informational"]
+        ) == 2
+
+    def test_truncated_tail_is_skipped_not_schema_error(self, tmp_path,
+                                                        capsys):
+        # A SIGKILLed round leaves a wrapper whose tail has no complete
+        # JSON line — expected history, never a hard failure.
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "cmd": "x", "rc": 124,
+             "tail": "WARNING: half a row {\"value\": 12", "parsed": None}
+        ))
+        write_history_row = wrapper(2, GOOD)
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(write_history_row))
+        assert bench_trend.main(["--repo", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "skipped" in out and "BENCH_r01.json" in out
+
+    def test_device_partitioning(self, tmp_path):
+        # A cpu-fallback round's fast latencies must not baseline a
+        # tunneled-chip round (the committed r3-vs-r5 pair): same numbers,
+        # different device string -> no regression.
+        cpu_round = dict(GOOD, device="cpu-fallback",
+                         snapshot_verdict_seconds=0.02)
+        chip_round = dict(GOOD, device="TPU v5 lite",
+                          snapshot_verdict_seconds=1.0)
+        write_history(tmp_path, [cpu_round, chip_round])
+        assert bench_trend.main(["--repo", str(tmp_path)]) == 0
+
+    def test_tolerance_overrides(self, tmp_path):
+        mild = dict(GOOD, value=800_000.0)  # -20% vs GOOD
+        write_history(tmp_path, [GOOD, mild])
+        assert bench_trend.main(["--repo", str(tmp_path)]) == 0
+        assert bench_trend.main(
+            ["--repo", str(tmp_path), "--tolerance", "10"]
+        ) == 1
+        assert bench_trend.main(
+            ["--repo", str(tmp_path), "--tolerance", "10",
+             "--tolerance-metric", "value=30"]
+        ) == 0
+
+    def test_telemetry_section(self, tmp_path, capsys):
+        write_history(tmp_path, [GOOD])
+        stream = tmp_path / "t.jsonl"
+        stream.write_text(
+            json.dumps({"kind": "gauge", "name": "sweep.candidates_per_sec",
+                        "value": 123456.0}) + "\n"
+        )
+        assert bench_trend.main(
+            ["--repo", str(tmp_path), "--telemetry", str(stream)]
+        ) == 0
+        assert "sweep.candidates_per_sec" in capsys.readouterr().out
+
+
+class TestMetricsReportSatellites:
+    def _stream(self, path, rows):
+        path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        return str(path)
+
+    def test_span_tree_indents_children(self, tmp_path, capsys):
+        rows = [
+            {"kind": "meta", "schema": "qi-telemetry/1", "pid": 1,
+             "t_wall": 0.0},
+            {"kind": "span", "name": "route", "span_id": 1,
+             "parent_id": None, "pid": 1, "start_s": 0.0, "seconds": 1.0},
+            {"kind": "span", "name": "race", "span_id": 2, "parent_id": 1,
+             "pid": 1, "start_s": 0.1, "seconds": 0.8},
+            {"kind": "span", "name": "race.sweep", "span_id": 3,
+             "parent_id": 2, "pid": 1, "start_s": 0.2, "seconds": 0.5},
+        ]
+        text = metrics_report.render(self._stream(tmp_path / "a.jsonl", rows))
+        lines = text.splitlines()
+        route = next(l for l in lines if l.startswith("route"))
+        race = next(l for l in lines if l.lstrip().startswith("race "))
+        arm = next(l for l in lines if l.lstrip().startswith("race.sweep"))
+        # Depth = indentation: children sit under their parents.
+        assert (len(race) - len(race.lstrip())) == 2
+        assert (len(arm) - len(arm.lstrip())) == 4
+        assert route is not None
+
+    def test_span_tree_cross_pid_ids_do_not_collide(self, tmp_path):
+        # Two processes reuse span_id=1; the tree must scope parent lookup
+        # by pid instead of grafting one process's span onto the other's.
+        rows = [
+            {"kind": "span", "name": "parent_a", "span_id": 1,
+             "parent_id": None, "pid": 1, "start_s": 0, "seconds": 1.0},
+            {"kind": "span", "name": "child_a", "span_id": 2, "parent_id": 1,
+             "pid": 1, "start_s": 0, "seconds": 0.5},
+            {"kind": "span", "name": "parent_b", "span_id": 1,
+             "parent_id": None, "pid": 2, "start_s": 0, "seconds": 1.0},
+        ]
+        paths = dict(
+            (sp["name"], p)
+            for p, sp in metrics_report._span_paths(rows)
+        )
+        assert paths["child_a"] == ("parent_a", "child_a")
+        assert paths["parent_b"] == ("parent_b",)
+
+    def test_diff_mode(self, tmp_path):
+        a = self._stream(tmp_path / "a.jsonl", [
+            {"kind": "counter", "name": "native.bnb_calls", "value": 100},
+            {"kind": "gauge", "name": "sweep.candidates_per_sec",
+             "value": 1000.0},
+            {"kind": "span", "name": "phase.search", "span_id": 1,
+             "parent_id": None, "start_s": 0, "seconds": 2.0},
+        ])
+        b = self._stream(tmp_path / "b.jsonl", [
+            {"kind": "counter", "name": "native.bnb_calls", "value": 150},
+            {"kind": "gauge", "name": "sweep.candidates_per_sec",
+             "value": 500.0},
+            {"kind": "span", "name": "phase.search", "span_id": 1,
+             "parent_id": None, "start_s": 0, "seconds": 1.0},
+        ])
+        text = metrics_report.render_diff(a, b)
+        assert "native.bnb_calls" in text and "+50" in text
+        assert "-50.0%" in text  # the halved gauge and span total
+        rows = metrics_report.diff_streams(
+            metrics_report.load_stream(a), metrics_report.load_stream(b)
+        )
+        by_name = {r[0]: r for r in rows}
+        assert by_name["span:phase.search"][4] == "-1"
+
+    def test_diff_cli_flag(self, tmp_path):
+        a = self._stream(tmp_path / "a.jsonl", [
+            {"kind": "counter", "name": "c", "value": 1},
+        ])
+        b = self._stream(tmp_path / "b.jsonl", [
+            {"kind": "counter", "name": "c", "value": 3},
+        ])
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "metrics_report.py"),
+             a, "--diff", b],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "qi-telemetry diff" in proc.stdout
+        assert "+2" in proc.stdout

@@ -1,0 +1,393 @@
+"""qi-trace (ISSUE 6 tentpole): trace-context propagation across process
+boundaries, the Chrome/Perfetto trace-event exporter, the crash flight
+recorder (ring + crash-only dump + its own fault point), and the live
+/healthz + /metrics endpoint — plus the legacy ``--timing`` byte-compat
+guarantee with tracing enabled."""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.utils import telemetry
+from quorum_intersection_tpu.utils.telemetry import (
+    ChromeTraceSink,
+    FLIGHT_RECORDER_N,
+    RunRecord,
+    TraceContext,
+    dump_flight_recorder,
+)
+
+CLI = [sys.executable, "-m", "quorum_intersection_tpu"]
+
+
+def _env(**extra):
+    import os
+
+    env = dict(os.environ)
+    env.update(extra)
+    return env
+
+
+@pytest.fixture
+def fresh_record():
+    rec = telemetry.reset_run_record()
+    yield rec
+    telemetry.reset_run_record()
+
+
+def load_trace(path):
+    """Load a trace-event file the way Perfetto does: the enclosing array
+    is deliberately unterminated (crash tolerance), so close it here."""
+    text = path.read_text().strip()
+    if text.endswith(","):
+        text = text[:-1]
+    if not text.endswith("]"):
+        text += "]"
+    return json.loads(text)
+
+
+class TestTraceContext:
+    def test_env_round_trip(self):
+        ctx = TraceContext("abcd1234", span_id=7, pid=4711)
+        assert TraceContext.from_env(ctx.to_env()) == ctx
+
+    def test_from_env_blank_and_malformed(self):
+        assert TraceContext.from_env("") is None
+        assert TraceContext.from_env("   ") is None
+        # A garbled tail costs linkage, never a run.
+        ctx = TraceContext.from_env("abc:not-a-number:nope")
+        assert ctx is not None and ctx.trace_id == "abc"
+        assert ctx.span_id is None and ctx.pid is None
+
+    def test_record_mints_unique_ids(self):
+        a, b = RunRecord(), RunRecord()
+        assert a.trace_id and b.trace_id and a.trace_id != b.trace_id
+
+    def test_record_inherits_from_env(self, monkeypatch):
+        monkeypatch.setenv("QI_TRACE_CONTEXT", "feedf00d12345678:9:123")
+        rec = RunRecord()
+        assert rec.trace_id == "feedf00d12345678"
+        assert rec.parent_ctx.span_id == 9
+        assert rec.parent_ctx.pid == 123
+
+    def test_spans_and_events_stamped(self, fresh_record):
+        rec = fresh_record
+        with rec.span("s"):
+            rec.event("e")
+        assert rec.spans[0].trace_id == rec.trace_id
+        assert rec.spans[0].pid == rec.pid and rec.spans[0].tid > 0
+        assert rec.events[0]["trace_id"] == rec.trace_id
+
+    def test_child_process_adopts_trace_id(self, tmp_path):
+        # The cross-PROCESS half of the propagation contract: a CLI child
+        # handed QI_TRACE_CONTEXT joins the parent's trace and records the
+        # parent span/pid in its meta line.
+        stream = tmp_path / "child.jsonl"
+        ctx = TraceContext("cafe0123deadbeef", span_id=42, pid=1000)
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_TRACE_CONTEXT=ctx.to_env(),
+                     QI_METRICS_JSON=str(stream)),
+        )
+        assert proc.returncode == 0, proc.stderr
+        lines = [json.loads(l) for l in stream.read_text().splitlines()]
+        meta = next(l for l in lines if l["kind"] == "meta")
+        assert meta["trace_id"] == "cafe0123deadbeef"
+        assert meta["parent_span"] == 42 and meta["parent_pid"] == 1000
+        span_ids = {l["trace_id"] for l in lines if l["kind"] == "span"}
+        assert span_ids == {"cafe0123deadbeef"}
+
+
+class TestChromeTraceExporter:
+    def test_sink_converts_all_kinds(self, tmp_path, fresh_record):
+        path = tmp_path / "t.json"
+        rec = fresh_record
+        rec.add_sink(ChromeTraceSink(str(path)))
+        with rec.span("outer", scc=5):
+            rec.event("mark", x=1)
+        rec.finish()
+        events = load_trace(path)
+        phases = {e["ph"] for e in events}
+        assert {"M", "X", "i"} <= phases
+        x = next(e for e in events if e["ph"] == "X")
+        assert x["name"] == "outer" and x["dur"] >= 1.0
+        assert x["args"] == {"scc": 5}
+        assert isinstance(x["pid"], int) and isinstance(x["tid"], int)
+
+    def test_cli_trace_out_one_timeline(self, tmp_path):
+        # Acceptance: one CLI run with --trace-out produces a loadable
+        # trace in which the race winner, race loser, ladder rungs, the
+        # native call, and the routing appear as spans of ONE process
+        # timeline (the single-trace_id half is pinned via the JSONL
+        # stream, whose span lines all carry trace_id).
+        trace = tmp_path / "t.json"
+        stream = tmp_path / "m.jsonl"
+        proc = subprocess.run(
+            CLI + ["--trace-out", str(trace), "--metrics-json", str(stream)],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        events = load_trace(trace)
+        names = {e["name"] for e in events if e["ph"] == "X"}
+        assert {"route", "race", "race.oracle", "race.sweep",
+                "ladder.rung"} <= names, names
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in events)
+        lines = [json.loads(l) for l in stream.read_text().splitlines()]
+        trace_ids = {
+            l["trace_id"] for l in lines if l["kind"] in ("span", "event")
+        }
+        assert len(trace_ids) == 1
+
+    def test_packed_sweep_spans_share_trace(self, fresh_record):
+        # Acceptance: per-pack sweep spans (and their window events) carry
+        # the same trace_id as everything else in the run.
+        from quorum_intersection_tpu.pipeline import check_many
+
+        rec = fresh_record
+        res = check_many(
+            [majority_fbas(7), majority_fbas(9)], backend="tpu-sweep"
+        )
+        assert [r.intersects for r in res] == [True, True]
+        names = {sp.name for sp in rec.spans}
+        assert {"sweep.pack", "pipeline.check_many"} <= names, names
+        assert {sp.trace_id for sp in rec.spans} == {rec.trace_id}
+        assert rec.gauges.get("sweep.packs_in_flight") == 0
+
+    def test_env_hook_attaches_sink(self, tmp_path):
+        trace = tmp_path / "envt.json"
+        proc = subprocess.run(
+            CLI + ["--backend", "python"],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_TRACE_OUT=str(trace)),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert any(e["ph"] == "X" for e in load_trace(trace))
+
+    def test_timing_legacy_lines_unchanged_with_tracing(self, tmp_path):
+        # Satellite acceptance: legacy --timing lines stay byte-compatible
+        # (contiguous and FIRST) with the trace exporter enabled.
+        proc = subprocess.run(
+            CLI + ["--timing", "--backend", "python",
+                   "--trace-out", str(tmp_path / "t.json")],
+            input=json.dumps(majority_fbas(3)),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_TRACE_OUT=str(tmp_path / "t2.json"),
+                     QI_FLIGHT_RECORDER=str(tmp_path / "f.json")),
+        )
+        assert proc.returncode == 0
+        err = proc.stderr.splitlines()
+        legacy = [l for l in err if l.startswith(("[timing]", "[stats]"))]
+        telem = [l for l in err if l.startswith("[telemetry]")]
+        assert legacy and telem
+        first_telem = err.index(telem[0])
+        assert all(err.index(l) < first_telem for l in legacy)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self, fresh_record):
+        rec = fresh_record
+        for i in range(FLIGHT_RECORDER_N + 50):
+            rec.event("tick", i=i)
+        tail = rec.flight_tail()
+        assert len(tail) == FLIGHT_RECORDER_N
+        assert tail[-1]["attrs"]["i"] == FLIGHT_RECORDER_N + 49
+        assert tail[0]["attrs"]["i"] == 50  # oldest dropped first
+
+    def test_dump_tail_matches_emitted_events(self, tmp_path, fresh_record):
+        rec = fresh_record
+        with rec.span("phase.search"):
+            rec.event("route.decision", engine="cpp")
+        out = dump_flight_recorder("test", path=str(tmp_path / "fl.json"))
+        dump = json.loads((tmp_path / "fl.json").read_text())
+        assert out and dump["schema"] == "qi-flight/1"
+        assert dump["reason"] == "test"
+        assert dump["trace_id"] == rec.trace_id
+        # The dump's tail IS the emitted telemetry, line for line.
+        names = [l["name"] for l in dump["tail"]]
+        assert names == ["route.decision", "phase.search"]
+        # Counter snapshot is taken BEFORE the dump increments it.
+        assert dump["counters"].get("telemetry.dumps", 0) == 0
+        assert rec.counters["telemetry.dumps"] == 1
+
+    def test_no_path_no_dump(self, fresh_record, monkeypatch):
+        monkeypatch.delenv("QI_FLIGHT_RECORDER", raising=False)
+        assert dump_flight_recorder("nothing-configured") is None
+
+    def test_seeded_fault_mid_sweep_leaves_parseable_dump(self, tmp_path):
+        # Acceptance: a seeded QI_FAULTS schedule firing mid-sweep leaves a
+        # flight-recorder dump whose tail matches the emitted qi-telemetry
+        # events.  sweep.window=preempt on the direct sweep backend is an
+        # unhandled failure — the CLI crashes (nonzero), and the dump (from
+        # the fault trigger AND the unhandled-exception path) survives.
+        dump_path = tmp_path / "fl.json"
+        stream = tmp_path / "m.jsonl"
+        proc = subprocess.run(
+            CLI + ["--backend", "tpu-sweep"],
+            input=json.dumps(majority_fbas(9)),
+            capture_output=True, text=True, timeout=300,
+            env=_env(QI_FAULTS="sweep.window=preempt@1",
+                     QI_FLIGHT_RECORDER=str(dump_path),
+                     QI_METRICS_JSON=str(stream)),
+        )
+        assert proc.returncode != 0  # the injected preempt surfaced
+        dump = json.loads(dump_path.read_text())
+        assert dump["schema"] == "qi-flight/1"
+        assert dump["counters"]["faults.injected"] == 1
+        # Tail lines cross-check against the JSONL stream byte-for-byte
+        # content (the same dict went through both paths).
+        stream_lines = [
+            json.loads(l) for l in stream.read_text().splitlines()
+        ]
+        stream_events = [
+            l for l in stream_lines if l["kind"] in ("span", "event")
+        ]
+        tail = dump["tail"]
+        assert tail  # something was recorded before the crash
+        assert all(line in stream_events for line in tail)
+        assert any(l["name"] == "fault.injected" for l in tail)
+
+    def test_ladder_degrade_dumps(self, tmp_path):
+        # Every degrade event carries its last-N context: an injected
+        # native.call error degrades native -> python (verdict unchanged)
+        # and leaves a dump naming the transition.
+        dump_path = tmp_path / "fl.json"
+        proc = subprocess.run(
+            CLI,
+            input=json.dumps(majority_fbas(5)),
+            capture_output=True, text=True, timeout=300,
+            env=_env(QI_FAULTS="native.call=error@1+",
+                     QI_FLIGHT_RECORDER=str(dump_path)),
+        )
+        assert proc.returncode == 0, proc.stderr  # degraded, not crashed
+        assert proc.stdout.strip().endswith("true")
+        dump = json.loads(dump_path.read_text())
+        assert dump["reason"].startswith(("degrade:", "fault:"))
+        assert dump["counters"]["ladder.degrades"] >= 1
+
+    def test_injected_dump_fault_downgrades(self, tmp_path, fresh_record,
+                                            monkeypatch):
+        # The dump write is itself a declared fault point: an injected
+        # disk-full OSError becomes the telemetry.dump_errors counter,
+        # never a second crash (and never a file).
+        from quorum_intersection_tpu.utils import faults
+
+        monkeypatch.setenv("QI_FAULTS", "telemetry.dump=oserror@1")
+        faults.clear_plan()
+        try:
+            target = tmp_path / "fl.json"
+            out = dump_flight_recorder("downgrade-test", path=str(target))
+            assert out is None
+            assert not target.exists()
+            rec = telemetry.get_run_record()
+            assert rec.counters["telemetry.dump_errors"] == 1
+            # The injected firing itself was recorded (fault.injected), and
+            # its own dump attempt did not recurse.
+            assert rec.counters["faults.injected"] == 1
+        finally:
+            monkeypatch.delenv("QI_FAULTS")
+            faults.clear_plan()
+
+
+class TestMetricsEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read()
+
+    def test_healthz_and_metrics_byte_stable_under_concurrency(
+        self, fresh_record
+    ):
+        from quorum_intersection_tpu.utils.metrics_server import MetricsServer
+
+        rec = fresh_record
+        rec.add("ladder.degrades", 2)
+        rec.gauge("ladder.rung", "tpu-sweep")
+        rec.gauge("ladder.quarantined_rungs", ["native"])
+        rec.gauge("sweep.packs_in_flight", 1)
+        srv = MetricsServer(port=0)
+        try:
+            results = {"healthz": set(), "metrics": set()}
+            errors = []
+
+            def scrape():
+                try:
+                    for _ in range(5):
+                        results["healthz"].add(
+                            self._get(srv.port, "/healthz")[1]
+                        )
+                        results["metrics"].add(
+                            self._get(srv.port, "/metrics")[1]
+                        )
+                except Exception as exc:  # noqa: BLE001 — surfaced below
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=scrape) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            # Byte-stable: 30 concurrent scrapes of each endpoint, one body.
+            assert len(results["healthz"]) == 1
+            assert len(results["metrics"]) == 1
+            health = json.loads(next(iter(results["healthz"])))
+            assert health["status"] == "ok"
+            assert health["ladder_rung"] == "tpu-sweep"
+            assert health["quarantined_rungs"] == ["native"]
+            assert health["packs_in_flight"] == 1
+            assert health["degrades"] == 2
+            assert health["trace_id"] == rec.trace_id
+            prom = next(iter(results["metrics"])).decode()
+            assert "# TYPE qi_ladder_degrades counter" in prom
+            assert "qi_ladder_degrades 2" in prom
+        finally:
+            srv.stop()
+
+    def test_unknown_path_404(self, fresh_record):
+        from quorum_intersection_tpu.utils.metrics_server import MetricsServer
+
+        srv = MetricsServer(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._get(srv.port, "/nope")
+            assert exc_info.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_env_start_and_port_conflict_is_quiet(self, monkeypatch,
+                                                  fresh_record):
+        from quorum_intersection_tpu.utils import metrics_server
+
+        srv = metrics_server.MetricsServer(port=0)
+        try:
+            # A child inheriting the parent's port must log-and-continue.
+            monkeypatch.setenv("QI_METRICS_PORT", str(srv.port))
+            metrics_server.stop_server()  # clear any env-started instance
+            assert metrics_server.maybe_start_from_env() is None
+        finally:
+            srv.stop()
+            metrics_server.stop_server()
+
+    def test_prom_endpoint_matches_textfile_encoder(self, fresh_record):
+        from quorum_intersection_tpu.utils.metrics_server import MetricsServer
+        from quorum_intersection_tpu.utils.telemetry import prom_lines
+
+        rec = fresh_record
+        rec.add("native.bnb_calls", 7)
+        srv = MetricsServer(port=0)
+        try:
+            _, body = self._get(srv.port, "/metrics")
+            assert body.decode() == "\n".join(prom_lines(rec)) + "\n"
+        finally:
+            srv.stop()

@@ -1,0 +1,355 @@
+#!/usr/bin/env python
+"""Bench-trend regression sentinel (ISSUE 6 tentpole, piece 4).
+
+The repo carries its measured history — driver ``BENCH_r*.json`` wrappers
+at the root and builder-recorded rows under ``benchmarks/results/`` — but
+until now nothing *watched* it: a PR could halve ``sweep_mfu_pct`` and the
+numbers would just sit there.  This tool ingests that history, lines the
+runs up in round order, compares the newest run's tracked metrics against
+the BEST prior measurement of each, renders the trend as a table
+(``tools/metrics_report.py`` formatting), and exits nonzero when a tracked
+metric regressed past its tolerance — so the r5 carried numbers
+(``pack_fill_pct``, ``sweep_mfu_pct``, ``window_candidates_per_sec``) are
+gated, not just emitted.
+
+Sources, newest-last:
+
+- ``BENCH_r*.json`` — driver wrappers ``{n, cmd, rc, tail, parsed}``; the
+  bench summary is ``parsed`` when present, else the last parseable JSON
+  line of ``tail``.  A truncated tail or a timed-out run (rc != 0) is
+  recorded as a skipped run, never a schema error — killed history is
+  expected history.
+- ``benchmarks/results/bench_full_r*_onchip.json`` — complete builder-
+  recorded bench rows (often the only intact copy of a round the driver
+  wrapper truncated).
+- ``--telemetry A [B]`` — qi-telemetry/1 JSONL: with two streams, the
+  counter/gauge/span deltas via ``metrics_report.diff_streams``; with one,
+  its tracked gauges are printed alongside the trend.
+
+Exit codes: 0 clean (or ``--informational``), 1 regression past tolerance,
+2 schema error (malformed run file / non-numeric tracked metric) — schema
+errors hard-fail even under ``--informational`` (the CI ``bench-trend``
+job's contract).
+
+Usage::
+
+    python tools/bench_trend.py                      # committed history
+    python tools/bench_trend.py --tolerance 20       # tighter global gate
+    python tools/bench_trend.py --tolerance-metric sweep_mfu_pct=10
+    python tools/bench_trend.py --informational      # CI: report, exit 0
+    python tools/bench_trend.py --telemetry a.jsonl b.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from tools.metrics_report import _table, diff_streams, load_stream
+except ImportError:  # executed as a script: tools/ is sys.path[0]
+    from metrics_report import _table, diff_streams, load_stream
+
+# Tracked metrics: dotted-flattened key -> direction.  "higher" metrics
+# regress by dropping, "lower" (latency) metrics by growing.  Keys absent
+# from a run are simply not compared — rounds gain metrics over time.
+TRACKED: Dict[str, str] = {
+    # headline + sweep throughput
+    "value": "higher",
+    "sweep_device_cand_per_sec": "higher",
+    "wide_sweep_device_cand_per_sec": "higher",
+    "sweep_steady_rate": "higher",
+    "wide_sweep_steady_rate": "higher",
+    "sweep_cand_per_sec": "higher",
+    "window_candidates_per_sec": "higher",
+    # the r5 carried numbers (ROADMAP on-chip round)
+    "sweep_mfu_pct": "higher",
+    "wide_sweep_mfu_pct": "higher",
+    "pack_fill_pct": "higher",
+    # latency-shaped rows
+    "snapshot_verdict_seconds": "lower",
+    "verdict_256.auto_seconds": "lower",
+    "verdict_1024.auto_seconds": "lower",
+    "pagerank_jax_seconds": "lower",
+}
+
+# Default tolerance (percent).  Generous by design: the committed history
+# spans different chips, tunnel states and bench configs, and the measured
+# round-to-round wobble on healthy code reaches tens of percent (r3 vs r5
+# onchip rows) — the default gate exists to catch the order-of-magnitude
+# cliff a broken kernel or mis-routed backend produces, while --tolerance /
+# --tolerance-metric tighten specific numbers once a stable rig exists.
+DEFAULT_TOLERANCE_PCT = 50.0
+
+# The gauges a qi-telemetry stream contributes to the trend view.
+TELEMETRY_GAUGES = (
+    "sweep.candidates_per_sec",
+    "sweep.pack_fill_pct",
+    "sweep.xla_compile_seconds",
+)
+
+
+class SchemaError(ValueError):
+    """A run file that exists but cannot be trusted: hard-fail material."""
+
+
+def _flatten(obj: object, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a nested dict, dotted keys; bools excluded."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for key, value in obj.items():
+            path = f"{prefix}.{key}" if prefix else str(key)
+            if isinstance(value, bool):
+                continue
+            if isinstance(value, (int, float)):
+                out[path] = float(value)
+            elif isinstance(value, dict):
+                out.update(_flatten(value, path))
+    return out
+
+
+def _last_json_line(text: str) -> Optional[dict]:
+    """Scan backwards for the last complete JSON object line (a SIGKILL or
+    a log tail can corrupt the literal last line without invalidating the
+    rows before it — the bench driver's own salvage discipline)."""
+    for line in reversed([ln for ln in (text or "").splitlines() if ln.strip()]):
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def load_bench_wrapper(path: Path) -> Tuple[Optional[dict], str]:
+    """One ``BENCH_r*.json`` driver wrapper -> (raw bench row, note)."""
+    try:
+        wrapper = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SchemaError(f"{path.name}: unreadable run wrapper: {exc}")
+    if not isinstance(wrapper, dict) or "tail" not in wrapper:
+        raise SchemaError(
+            f"{path.name}: expected a driver wrapper with a 'tail' field"
+        )
+    row = wrapper.get("parsed")
+    if not isinstance(row, dict):
+        if wrapper.get("rc") not in (0, None):
+            # A timed-out/killed round: whatever JSON its tail happens to
+            # end in (a log line, a partial row) is not that round's bench
+            # result — skipping is the documented contract.  A driver-
+            # recorded `parsed` row (above) is still trusted.
+            return None, (
+                f"skipped (rc={wrapper.get('rc')}: run failed; tail not "
+                f"trusted as a bench row)"
+            )
+        row = _last_json_line(str(wrapper.get("tail", "")))
+        if row is not None and not ({"metric", "value"} & row.keys()):
+            # A parseable line that is not a bench headline (QI_LOG_JSON
+            # log line, intermediate phase row) must not become a baseline.
+            row = None
+    if row is None:
+        return None, (
+            f"skipped (rc={wrapper.get('rc')}: no parseable bench row in tail"
+            f" — truncated or timed-out run)"
+        )
+    return row, "ok"
+
+
+def load_result_row(path: Path) -> dict:
+    """One complete bench row under benchmarks/results/."""
+    try:
+        row = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SchemaError(f"{path.name}: unreadable bench row: {exc}")
+    if not isinstance(row, dict):
+        raise SchemaError(f"{path.name}: bench row is not a JSON object")
+    return row
+
+
+_ROUND_RE = re.compile(r"r(\d+)")
+
+
+def _round_of(name: str) -> int:
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else -1
+
+
+def load_history(
+    repo: Path,
+) -> Tuple[List[Tuple[str, Dict[str, float], str]], List[str]]:
+    """All runs in round order (builder-recorded onchip rows after the same
+    round's driver wrapper — they are the more complete record).  Returns
+    ``(runs, notes)``; each run is ``(name, flat metrics, device string)``
+    and only parseable rows are included."""
+    entries: List[Tuple[Tuple[int, int], str, Optional[dict], str]] = []
+    for path in sorted(repo.glob("BENCH_r*.json")):
+        row, note = load_bench_wrapper(path)
+        entries.append(((_round_of(path.name), 0), path.name, row, note))
+    results = repo / "benchmarks" / "results"
+    if results.is_dir():
+        for path in sorted(results.glob("bench_full_r*_onchip.json")):
+            row = load_result_row(path)
+            entries.append(((_round_of(path.name), 1), path.name, row, "ok"))
+    entries.sort(key=lambda e: e[0])
+    runs: List[Tuple[str, Dict[str, float], str]] = []
+    notes: List[str] = []
+    for _, name, row, note in entries:
+        if row is None:
+            notes.append(f"{name}: {note}")
+        else:
+            runs.append((name, _flatten(row), str(row.get("device", "?"))))
+    return runs, notes
+
+
+def trend(
+    runs: List[Tuple[str, Dict[str, float], str]],
+    tolerances: Dict[str, float],
+    default_tol: float,
+) -> Tuple[List[List[str]], List[str]]:
+    """Trend rows (latest vs best prior per tracked metric) + regressions.
+
+    Device-partitioned, the calibration module's discipline: the latest run
+    compares only against prior runs recorded on the SAME device string — a
+    cpu-fallback round's 21 ms snapshot verdict is not a baseline a
+    tunneled-chip round can regress against (they measure different
+    machines, and the committed history contains exactly that pair).
+    """
+    if not runs:
+        return [], []
+    latest_name, latest, latest_device = runs[-1]
+    prior_runs = [
+        (name, m) for name, m, device in runs[:-1] if device == latest_device
+    ]
+    rows: List[List[str]] = []
+    regressions: List[str] = []
+    for metric, direction in TRACKED.items():
+        cur = latest.get(metric)
+        prior = [
+            (name, m[metric]) for name, m in prior_runs if metric in m
+        ]
+        if cur is None and not prior:
+            continue
+        if cur is None:
+            rows.append([metric, "-", "-", "-", "absent in latest"])
+            continue
+        if not prior:
+            rows.append([metric, "-", f"{cur:.6g}", "-", "new"])
+            continue
+        best_name, best = (
+            max(prior, key=lambda p: p[1]) if direction == "higher"
+            else min(prior, key=lambda p: p[1])
+        )
+        if best == 0:
+            rows.append([metric, f"{best:.6g}", f"{cur:.6g}", "-", "ok"])
+            continue
+        delta_pct = (cur - best) / abs(best) * 100.0
+        tol = tolerances.get(metric, default_tol)
+        regressed = (
+            delta_pct < -tol if direction == "higher" else delta_pct > tol
+        )
+        status = f"REGRESSED (> {tol:g}% vs {best_name})" if regressed else "ok"
+        if regressed:
+            regressions.append(
+                f"{metric}: {cur:.6g} vs best {best:.6g} ({best_name}), "
+                f"delta {delta_pct:+.1f}% past the {tol:g}% tolerance"
+            )
+        rows.append([
+            metric, f"{best:.6g}", f"{cur:.6g}", f"{delta_pct:+.1f}%", status,
+        ])
+    return rows, regressions
+
+
+def telemetry_section(paths: List[str]) -> Tuple[str, int]:
+    """Render the telemetry half: one stream -> tracked gauges; two ->
+    the metrics_report diff table.  Returns (text, schema_rc)."""
+    try:
+        streams = [load_stream(p) for p in paths]
+    except OSError as exc:
+        return f"telemetry: cannot read stream: {exc}", 2
+    if len(streams) == 1:
+        data = streams[0]
+        rows = [
+            [name, f"{data['gauges'][name]}"]
+            for name in TELEMETRY_GAUGES if name in data["gauges"]
+        ]
+        body = _table(rows, ["gauge", "value"]) if rows else "(no tracked gauges)"
+        return f"== tier-1 telemetry: {paths[0]} ==\n{body}", 0
+    rows = diff_streams(streams[0], streams[1])
+    body = _table(rows, ["name", "kind", "a", "b", "delta", "delta_pct"]) \
+        if rows else "(nothing to compare)"
+    return f"== telemetry diff: {paths[0]} -> {paths[1]} ==\n{body}", 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--repo", default=None, metavar="DIR",
+                        help="repository root holding BENCH_r*.json "
+                             "(default: this file's repo)")
+    parser.add_argument("--tolerance", type=float,
+                        default=DEFAULT_TOLERANCE_PCT, metavar="PCT",
+                        help="global regression tolerance in percent "
+                             f"(default {DEFAULT_TOLERANCE_PCT:g})")
+    parser.add_argument("--tolerance-metric", action="append", default=[],
+                        metavar="NAME=PCT",
+                        help="per-metric tolerance override (repeatable)")
+    parser.add_argument("--informational", action="store_true",
+                        help="report regressions but exit 0 for them "
+                             "(schema errors still exit 2 — the CI mode)")
+    parser.add_argument("--telemetry", nargs="+", default=None,
+                        metavar="JSONL",
+                        help="also ingest one or two qi-telemetry/1 streams "
+                             "(two: rendered as a delta table)")
+    args = parser.parse_args(argv)
+
+    repo = Path(args.repo) if args.repo else Path(__file__).resolve().parent.parent
+    tolerances: Dict[str, float] = {}
+    for spec in args.tolerance_metric:
+        name, _, pct = spec.partition("=")
+        try:
+            tolerances[name.strip()] = float(pct)
+        except ValueError:
+            print(f"malformed --tolerance-metric {spec!r}", file=sys.stderr)
+            return 2
+
+    try:
+        runs, notes = load_history(repo)
+    except SchemaError as exc:
+        print(f"schema error: {exc}", file=sys.stderr)
+        return 2
+
+    print(f"bench-trend: {len(runs)} parseable run(s) under {repo}")
+    for note in notes:
+        print(f"  note: {note}")
+    if not runs:
+        print("no bench history to compare — nothing gated")
+        rc = 0
+    else:
+        print(f"latest run: {runs[-1][0]} (device: {runs[-1][2]})")
+        rows, regressions = trend(runs, tolerances, args.tolerance)
+        if rows:
+            print(_table(
+                rows, ["metric", "best_prior", "latest", "delta", "status"]
+            ))
+        else:
+            print("(no tracked metrics present)")
+        rc = 0
+        if regressions:
+            for reg in regressions:
+                print(f"REGRESSION: {reg}", file=sys.stderr)
+            rc = 0 if args.informational else 1
+
+    if args.telemetry:
+        text, sry = telemetry_section(args.telemetry[:2])
+        print(text)
+        if sry:
+            return sry
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

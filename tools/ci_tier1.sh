@@ -29,9 +29,20 @@ cd "$(dirname "$0")/.."
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 METRICS="${TIER1_METRICS:-/tmp/_t1_metrics.jsonl}"
-rm -f "$LOG" "$METRICS"
+TRACE="${TIER1_TRACE:-/tmp/_t1_trace.json}"
+FLIGHT="${TIER1_FLIGHT:-/tmp/_t1_flight.json}"
+rm -f "$LOG" "$METRICS" "$TRACE" "$FLIGHT"
 
-timeout -k 10 870 env JAX_PLATFORMS=cpu QI_METRICS_JSON="$METRICS" \
+# QI_METRICS_JSON / QI_TRACE_OUT / QI_FLIGHT_RECORDER (docs/OBSERVABILITY.md,
+# ISSUE 6) are exported for EVERY gate below — tests, analyze, chaos soak,
+# packed smoke — so the whole tier-1 run lands in one telemetry stream and
+# one Perfetto timeline, and any degrade/fault any gate exercises (the
+# chaos soak guarantees some) leaves a flight-recorder dump; tier1.yml
+# uploads all three as CI artifacts.
+export QI_METRICS_JSON="$METRICS" QI_TRACE_OUT="$TRACE" \
+    QI_FLIGHT_RECORDER="$FLIGHT"
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly "$@" 2>&1 | tee "$LOG"
@@ -40,6 +51,12 @@ rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
 if [ -s "$METRICS" ]; then
     echo "TELEMETRY=$METRICS ($(wc -l < "$METRICS") lines)"
+fi
+if [ -s "$TRACE" ]; then
+    echo "TRACE=$TRACE ($(wc -c < "$TRACE") bytes — open in ui.perfetto.dev)"
+fi
+if [ -s "$FLIGHT" ]; then
+    echo "FLIGHT=$FLIGHT (last crash-context dump of the run)"
 fi
 
 ANALYZE_OUT="${TIER1_ANALYZE:-/tmp/_t1_analyze.jsonl}"
@@ -59,14 +76,24 @@ echo "CHAOS=exit $crc"
 # Packed-sweep smoke (docs/PARITY.md lane-packing invariants): the
 # lane-packed vs unpacked bench rows on CPU emulation — exits nonzero on
 # any packed/unpacked verdict mismatch; the sweep.pack_* telemetry rides
-# the shared $METRICS stream.
-env JAX_PLATFORMS=cpu QI_METRICS_JSON="$METRICS" \
+# the shared (exported) $METRICS stream.
+env JAX_PLATFORMS=cpu \
     python benchmarks/sweep_vs_native.py --quick --packed \
     --scc 16 --packed-scc 12 14
 prc=$?
 echo "PACKED=exit $prc"
 
+# Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
+# BENCH_r*.json history rendered as a trend table, informational on
+# regressions (the measurement rig varies per round) but hard on schema
+# errors — a malformed run wrapper fails the gate.
+env JAX_PLATFORMS=cpu python tools/bench_trend.py --informational \
+    --telemetry "$METRICS"
+trc=$?
+echo "TREND=exit $trc"
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$arc" -ne 0 ] && exit "$arc"
 [ "$crc" -ne 0 ] && exit "$crc"
-exit "$prc"
+[ "$prc" -ne 0 ] && exit "$prc"
+exit "$trc"

@@ -11,6 +11,13 @@ Usage::
 
     python tools/metrics_report.py metrics.jsonl            # full report
     python tools/metrics_report.py metrics.jsonl --windows 8  # + window tail
+    python tools/metrics_report.py a.jsonl --diff b.jsonl   # delta table
+
+Since ISSUE 6 the per-phase section renders spans as a TREE (indent by
+parent_id, scoped per pid so multi-process id collisions never graft one
+process's spans onto another's), and ``--diff`` compares two streams'
+counters/gauges/span totals — the delta engine ``tools/bench_trend.py``
+reuses for its telemetry half.
 """
 
 from __future__ import annotations
@@ -73,19 +80,69 @@ def _table(rows: List[List[str]], header: List[str]) -> str:
     return "\n".join(lines)
 
 
-def span_table(spans: List[dict]) -> str:
-    agg: Dict[str, List[float]] = {}
+def _span_paths(spans: List[dict]) -> List[tuple]:
+    """Name-path of every span, root-first (ISSUE 6 satellite).
+
+    Parent links are ``(pid, parent_id)`` — span ids are per-process
+    counters, so a multi-process stream must scope the lookup by pid (old
+    streams without a ``pid`` field fall back to one shared scope).  A
+    parent beyond the retention cap (or in another process) roots the
+    subtree rather than dropping it.
+    """
+    by_key = {
+        (sp.get("pid", 0), sp.get("span_id")): sp
+        for sp in spans
+        if sp.get("span_id") is not None
+    }
+    paths = []
     for sp in spans:
+        chain = [sp.get("name", "?")]
+        cur = sp
+        seen = set()
+        while cur.get("parent_id") is not None:
+            key = (cur.get("pid", 0), cur.get("parent_id"))
+            if key in seen:
+                break  # defensive: a cyclic id would otherwise spin
+            seen.add(key)
+            cur = by_key.get(key)
+            if cur is None:
+                break
+            chain.append(cur.get("name", "?"))
+        paths.append((tuple(reversed(chain)), sp))
+    return paths
+
+
+def span_table(spans: List[dict]) -> str:
+    """Span TREE: aggregate by root-to-leaf name path, indent by depth —
+    a race's arms and a ladder's rungs read as the hierarchy they are,
+    not an alphabet of flat rows."""
+    agg: Dict[tuple, List[float]] = {}
+    for path, sp in _span_paths(spans):
         sec = sp.get("seconds")
         if sec is None:
             continue
-        cur = agg.setdefault(sp.get("name", "?"), [0, 0.0, 0.0])
+        cur = agg.setdefault(path, [0, 0.0, 0.0])
         cur[0] += 1
         cur[1] += sec
         cur[2] = max(cur[2], sec)
+    # Depth-first render order: a path sorts directly under its prefix;
+    # sibling subtrees order by total seconds descending.
+    totals = {p: t for p, (c, t, mx) in agg.items()}
+
+    def sort_key(path: tuple):
+        # Each ancestor segment contributes (-subtree_total, name) so heavy
+        # subtrees come first but children stay under their parent.
+        key = []
+        for d in range(len(path)):
+            prefix = path[: d + 1]
+            subtotal = sum(t for p, t in totals.items() if p[: d + 1] == prefix)
+            key.append((-subtotal, path[d]))
+        return key
+
     rows = [
-        [name, int(c), f"{t:.3f}", f"{t / c * 1000:.2f}", f"{mx * 1000:.2f}"]
-        for name, (c, t, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1])
+        ["  " * (len(path) - 1) + path[-1], int(c), f"{t:.3f}",
+         f"{t / c * 1000:.2f}", f"{mx * 1000:.2f}"]
+        for path, (c, t, mx) in sorted(agg.items(), key=lambda kv: sort_key(kv[0]))
     ]
     if not rows:
         return "(no spans)"
@@ -197,6 +254,59 @@ def scalar_table(counters: Dict[str, float], gauges: Dict[str, object]) -> str:
     return _table(rows, ["name", "kind", "value"])
 
 
+def diff_streams(a: dict, b: dict) -> List[List[str]]:
+    """Rows comparing two loaded streams (ISSUE 6 satellite): counters,
+    numeric gauges, and per-name span totals, with absolute and percentage
+    deltas (b relative to a).  Reused by ``tools/bench_trend.py`` for its
+    telemetry half — one delta implementation, one formatting."""
+    def span_totals(data: dict) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for sp in data["spans"]:
+            sec = sp.get("seconds")
+            if sec is not None:
+                out[f"span:{sp.get('name', '?')}"] = (
+                    out.get(f"span:{sp.get('name', '?')}", 0.0) + sec
+                )
+        return out
+
+    def numeric(d: Dict[str, object]) -> Dict[str, float]:
+        return {
+            k: float(v) for k, v in d.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+
+    rows: List[List[str]] = []
+    for kind, da, db in (
+        ("counter", a["counters"], b["counters"]),
+        ("gauge", numeric(a["gauges"]), numeric(b["gauges"])),
+        ("span_s", span_totals(a), span_totals(b)),
+    ):
+        for name in sorted(set(da) | set(db)):
+            va, vb = da.get(name), db.get(name)
+            if va is None or vb is None:
+                delta = pct = "-"
+            else:
+                delta = f"{vb - va:+.6g}"
+                pct = f"{(vb - va) / va * 100:+.1f}%" if va else "-"
+            rows.append([
+                name, kind,
+                "-" if va is None else f"{va:.6g}",
+                "-" if vb is None else f"{vb:.6g}",
+                delta, pct,
+            ])
+    return rows
+
+
+def render_diff(path_a: str, path_b: str) -> str:
+    rows = diff_streams(load_stream(path_a), load_stream(path_b))
+    head = f"qi-telemetry diff: {path_a} -> {path_b}"
+    if not rows:
+        return head + "\n(nothing to compare)"
+    return head + "\n" + _table(
+        rows, ["name", "kind", "a", "b", "delta", "delta_pct"]
+    )
+
+
 def render(path: str, tail: int = 0) -> str:
     data = load_stream(path)
     pids = {m.get("pid") for m in data["meta"]}
@@ -223,9 +333,16 @@ def main() -> int:
     parser.add_argument("path", help="qi-telemetry/1 JSONL file")
     parser.add_argument("--windows", type=int, default=0, metavar="N",
                         help="also list the last N sweep windows")
+    parser.add_argument("--diff", metavar="PATH_B", default=None,
+                        help="compare PATH (baseline) against PATH_B: "
+                             "counter/gauge/span-total deltas instead of "
+                             "the full report (bench_trend reuses this)")
     args = parser.parse_args()
     try:
-        print(render(args.path, args.windows))
+        if args.diff:
+            print(render_diff(args.path, args.diff))
+        else:
+            print(render(args.path, args.windows))
     except OSError as exc:
         print(f"cannot read {args.path}: {exc}", file=sys.stderr)
         return 1

@@ -1,0 +1,136 @@
+#!/bin/bash
+# Parameterized on-chip measurement driver — replaces the copy-pasted
+# onchip_r5.sh / onchip_r5b.sh / onchip_r5c.sh (ISSUE 6 satellite): one
+# script, round + phase flags, same per-step discipline the r5 scripts
+# converged on:
+#   - a down tunnel HANGS rather than errors, so probe before EVERY phase
+#     (bounding the waste if it drops mid-sequence);
+#   - run Python unbuffered (-u: a SIGTERMed step keeps its completed rows
+#     in the tee'd artifact) with a timeout on everything;
+#   - pipefail, so a step killed mid-pipe fails the script instead of
+#     exiting 0 through tee (r5 review finding — tunnel_watch.sh keys
+#     "sequence COMPLETE" off rc=0).
+# New in this round: the whole sequence exports QI_METRICS_JSON,
+# QI_TRACE_OUT and QI_FLIGHT_RECORDER (docs/OBSERVABILITY.md), so the next
+# measurement round lands a Perfetto timeline and crash forensics alongside
+# its bench rows — and `tools/bench_trend.py` gates the rows afterwards.
+#
+# Usage: tools/onchip.sh --round rN [phase ...]
+#   default phases:   crossover frontier_scaling wide_run bench soak
+#   extra phases:     sweep_vs_native wide_kill crossover_pop2048 scc36
+#                     auto_race packed
+# Examples (the r5 sequences, reproduced):
+#   tools/onchip.sh --round r5                                  # = onchip_r5.sh
+#   tools/onchip.sh --round r5 sweep_vs_native wide_kill crossover_pop2048
+#                                                               # = onchip_r5b.sh
+#   tools/onchip.sh --round r5 scc36                            # = onchip_r5c.sh
+set -x
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+ROUND=""
+PHASES=()
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --round)
+            [ $# -ge 2 ] || { echo "--round needs a value" >&2; exit 2; }
+            ROUND="$2"; shift 2 ;;
+        -h|--help) grep '^#' "$0" | sed 's/^# \{0,1\}//'; exit 0 ;;
+        *) PHASES+=("$1"); shift ;;
+    esac
+done
+if [ -z "$ROUND" ]; then
+    echo "usage: tools/onchip.sh --round rN [phase ...]" >&2
+    exit 2
+fi
+[ ${#PHASES[@]} -eq 0 ] && PHASES=(crossover frontier_scaling wide_run bench soak)
+
+R=benchmarks/results
+# One observability stream per sequence: every phase (and its subprocess
+# children, via the env hooks) appends spans/events here; traces open in
+# ui.perfetto.dev as one timeline per sequence.
+export QI_METRICS_JSON="$R/metrics_${ROUND}_onchip.jsonl"
+export QI_TRACE_OUT="$R/trace_${ROUND}_onchip.json"
+export QI_FLIGHT_RECORDER="$R/flight_${ROUND}_onchip.json"
+
+probe() {
+    timeout 100 python -c "import jax; print(jax.devices())" || {
+        echo "tunnel down before: $1" >&2; exit 1; }
+}
+
+run_phase() {
+    case "$1" in
+        crossover)
+            # three-way crossover incl. the frontier win-region rows
+            timeout 1800 python -u benchmarks/hybrid_crossover.py --large \
+                2>&1 | tee "$R/crossover_tpu_${ROUND}.txt" ;;
+        crossover_pop2048)
+            # frontier win-region rows under pop=2048 (the frontier_scaling
+            # sweet spot) — appended to the SAME round artifact so
+            # calibration takes the completed ratio over an earlier estimate
+            timeout 1800 python -u benchmarks/hybrid_crossover.py --large-only --pop 2048 \
+                2>&1 | tee -a "$R/crossover_tpu_${ROUND}.txt" ;;
+        frontier_scaling)
+            # pop-block scaling on the chip (informs the frontier's default pop)
+            timeout 1200 python -u benchmarks/frontier_scaling.py \
+                2>&1 | tee "$R/frontier_scaling_tpu_${ROUND}.txt" ;;
+        wide_run)
+            # wide-sweep ceiling: checkpointed 2^36 with a real SIGKILL + resume
+            timeout 3600 python -u tools/wide_run.py --bits 36 --kill-after 120 \
+                --resume-lo-bits 28 --tag "$ROUND" ;;
+        wide_kill)
+            # kill EARLY enough to really fire (the r5 2^36 run finished in
+            # 92 s, before the 120 s kill — VERDICT §next-6 wants a real
+            # on-chip SIGKILL + resume)
+            timeout 1800 python -u tools/wide_run.py --bits 36 --kill-after 45 \
+                --resume-lo-bits 28 --tag "${ROUND}kill" ;;
+        bench)
+            # full bench (the driver also runs this; a builder-recorded copy
+            # pins the numbers even if the driver window hits a flake)
+            timeout 1800 python -u bench.py 2>/dev/null | tail -1 \
+                > "$R/bench_full_${ROUND}_onchip.json" ;;
+        soak)
+            # soak a window on the chip (device engines on real hardware)
+            timeout 1800 python -u tools/soak.py --instances 40 --seed 1000 \
+                --platform ambient 2>&1 | tee "$R/soak_tpu_${ROUND}.txt" ;;
+        sweep_vs_native)
+            # the artifact that raises auto's accelerator sweep limit
+            # (backends/calibration.py sweep window)
+            timeout 3600 python -u benchmarks/sweep_vs_native.py --native-cap 900 \
+                2>&1 | tee "$R/sweep_vs_native_tpu_${ROUND}.txt" ;;
+        scc36)
+            # try to complete the native oracle at scc 36 so the sweep
+            # window's largest win is MEASURED, not estimated — appended to
+            # the round artifact (a new file name would tie on round rank
+            # and be ignored by calibration).  Budget ~2x the call-count
+            # model: it UNDERESTIMATES above scc 32 (r5 measured reality);
+            # even a failed run still measures a floor.
+            timeout 7200 python -u benchmarks/sweep_vs_native.py --scc 36 --native-cap 4000 \
+                2>&1 | tee -a "$R/sweep_vs_native_tpu_${ROUND}.txt" ;;
+        auto_race)
+            # ROADMAP carried debt: the row that lands calibration.sweep_warm_ratio
+            timeout 1800 python -u benchmarks/auto_race.py --real --warm-start \
+                --metrics-json "$QI_METRICS_JSON" \
+                2>&1 | tee "$R/auto_race_tpu_${ROUND}.txt" ;;
+        packed)
+            # ROADMAP carried debt: the measured packed win rows
+            # (calibration.pack_win_max_scc + the packed sweep_mfu_pct row)
+            timeout 3600 python -u benchmarks/sweep_vs_native.py --packed \
+                --metrics-json "$QI_METRICS_JSON" \
+                2>&1 | tee "$R/sweep_vs_native_packed_tpu_${ROUND}.txt" ;;
+        *)
+            echo "unknown phase: $1" >&2; return 2 ;;
+    esac
+}
+
+rc=0
+for ph in "${PHASES[@]}"; do
+    probe "$ph"
+    run_phase "$ph" || rc=1
+done
+
+# Trend gate over the freshly landed rows (informational here — the row is
+# already recorded; CI's bench-trend job holds the line on schema).
+python tools/bench_trend.py --informational || rc=1
+
+exit $rc

@@ -1,20 +1,24 @@
 #!/bin/bash
-# Round-5 tunnel watcher: probe the axon tunnel every ~9 min; the moment it
-# answers, run the full on-chip sequence (tools/onchip_r5.sh) and stop.
+# Tunnel watcher: probe the axon tunnel every ~9 min; the moment it
+# answers, run the full on-chip sequence (tools/onchip.sh) and stop.
 # Designed to live in a tmux session for the whole round — r4 lost the
 # entire round to a down tunnel, so the watcher removes the human (agent)
-# from the loop.  Log: benchmarks/results/tunnel_watch_r5.log
+# from the loop.  Round and phases parameterize like onchip.sh itself:
+#   WATCH_ROUND=r6 WATCH_PHASES="bench packed auto_race" tools/tunnel_watch.sh
+# Log: benchmarks/results/tunnel_watch_<round>.log
 cd "$(dirname "$0")/.."
-LOG=benchmarks/results/tunnel_watch_r5.log
+ROUND="${WATCH_ROUND:-r6}"
+LOG="benchmarks/results/tunnel_watch_${ROUND}.log"
 DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
 
-echo "[$(date -u +%FT%TZ)] watcher start, deadline in ${WATCH_HOURS:-11}h" >> "$LOG"
+echo "[$(date -u +%FT%TZ)] watcher start (round $ROUND), deadline in ${WATCH_HOURS:-11}h" >> "$LOG"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 100 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
-        echo "[$(date -u +%FT%TZ)] TUNNEL UP — launching onchip_r5.sh" >> "$LOG"
-        bash tools/onchip_r5.sh >> "$LOG" 2>&1
+        echo "[$(date -u +%FT%TZ)] TUNNEL UP — launching onchip.sh --round $ROUND" >> "$LOG"
+        # shellcheck disable=SC2086 — WATCH_PHASES is a deliberate word list
+        bash tools/onchip.sh --round "$ROUND" ${WATCH_PHASES:-} >> "$LOG" 2>&1
         rc=$?
-        echo "[$(date -u +%FT%TZ)] onchip_r5.sh exited rc=$rc" >> "$LOG"
+        echo "[$(date -u +%FT%TZ)] onchip.sh exited rc=$rc" >> "$LOG"
         if [ "$rc" -eq 0 ]; then
             echo "[$(date -u +%FT%TZ)] sequence COMPLETE" >> "$LOG"
             exit 0
