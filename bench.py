@@ -244,6 +244,16 @@ def phase_sweep(n_nodes: int) -> dict:
                 "ramp_profile"):
         if key in res.stats:
             out[f"sweep_{key}"] = res.stats[key]
+    # qi-cert coverage row (ISSUE 7): the ledger numbers tools/bench_trend.py
+    # gates — pruning wins must show up as a falling enumeration ratio, not
+    # just MACs/sec (ROADMAP "Prune the search space").
+    ledger = res.stats.get("cert") or {}
+    if ledger.get("window_space"):
+        out["sweep_windows_enumerated"] = ledger["windows_enumerated"]
+        out["sweep_windows_pruned"] = ledger["windows_pruned_guard"]
+        out["sweep_enumeration_ratio"] = round(
+            ledger["windows_enumerated"] / ledger["window_space"], 6
+        )
     import jax
 
     out["sweep_device"] = jax.devices()[0].device_kind

@@ -103,14 +103,37 @@ def is_splitting(
     """True iff deleting ``removed_keys`` (byzantine semantics) leaves two
     disjoint quorums — witnessed, not merely a failed verdict.  ``dangling``
     follows the caller's Q1 policy so the analysis answers the same FBAS
-    as the verdict under the same flags."""
+    as the verdict under the same flags.
+
+    Since qi-cert (ISSUE 7) the witness requirement is checked by the
+    certificate layer's per-member slice-satisfaction audit instead of a
+    bare ``q1 is not None``: a candidate counts as splitting only when
+    every member of BOTH claimed quorums is actually satisfied — the same
+    audit ``tools/check_cert.py`` performs, so the analytics and the
+    checker cannot disagree about what a witness is.  The solve itself
+    runs with ``with_cert=False``: this function sits in
+    :func:`minimum_splitting_set`'s combinatorial loop, so per-candidate
+    certificate assembly and ``cert.*`` telemetry would be pure overhead
+    — the evidence is computed directly, and only for the rare candidate
+    whose verdict is actually false."""
+    from quorum_intersection_tpu.cert import witness_evidence
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
     from quorum_intersection_tpu.pipeline import solve
 
     remaining = delete_nodes(nodes, removed_keys)
     if not remaining:
         return False
-    res = solve(remaining, backend="python", dangling=dangling)
-    return (not res.intersects) and res.q1 is not None
+    res = solve(remaining, backend="python", dangling=dangling,
+                with_cert=False)
+    if res.intersects or res.q1 is None or res.q2 is None:
+        return False
+    # Same deterministic front end the solve ran (res.q1/q2 are vertex
+    # indices of this graph), audited member-by-member.
+    graph = build_graph(parse_fbas(list(remaining)), dangling=dangling)
+    members = [*witness_evidence(graph, res.q1),
+               *witness_evidence(graph, res.q2)]
+    return bool(members) and all(m["satisfied"] for m in members)
 
 
 def quorum_scc_keys(nodes: Sequence[dict], dangling: str = "strict") -> List[str]:

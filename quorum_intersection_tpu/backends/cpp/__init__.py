@@ -465,6 +465,15 @@ class CppOracleBackend:
                 # The span id of this exact native entry (qi-trace): joins
                 # the result back to its native.call span and counters.
                 "native_call_id": call_id,
+                # qi-cert ledger: the native oracle's B&B node counts,
+                # echoed beside the call id so the certificate's coverage
+                # evidence joins back to the exact native.call span.
+                "cert": {
+                    "bnb_calls": int(stats[0]),
+                    "minimal_quorums": int(stats[1]),
+                    "fixpoint_calls": int(stats[2]),
+                    "native_call_id": call_id,
+                },
             },
         )
 

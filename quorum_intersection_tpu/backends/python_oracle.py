@@ -338,5 +338,13 @@ class PythonOracleBackend:
                 "fixpoint_calls": state.fixpoint_calls,
                 "best_node_fallback": state.best_node_fallback,
                 "seconds": seconds,
+                # qi-cert ledger: a B&B engine's coverage evidence is its
+                # node counts — echoed into the verdict certificate so
+                # "exhaustively searched" carries its search size.
+                "cert": {
+                    "bnb_calls": state.bnb_calls,
+                    "minimal_quorums": state.minimal_quorums,
+                    "fixpoint_calls": state.fixpoint_calls,
+                },
             },
         )

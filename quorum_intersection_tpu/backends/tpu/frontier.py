@@ -72,6 +72,7 @@ from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
 
 log = get_logger("backends.tpu.frontier")
 
@@ -873,6 +874,9 @@ class TpuFrontierBackend:
             stats["device_iters"] += int(iters)
             stats["states_popped"] += int(popped)
             stats["flagged"] += fcount_h
+            # qi-cert: the frontier's coverage unit is the drained chunk —
+            # the count the certificate's ledger echoes for B&B engines.
+            get_run_record().add("cert.frontier_chunks")
             log.debug(
                 "frontier chunk %d: %d iters, %d popped, top=%d, %d flagged "
                 "(exit at %d), %d spilled blocks",
@@ -978,6 +982,19 @@ class TpuFrontierBackend:
         stats["seconds"] = time.perf_counter() - t0
         stats["first_chunk_seconds"] = round(first_chunk_s, 3)
         stats["chunk_seconds"] = round(chunk_s, 3)
+        # qi-cert ledger (cert.py ledger_entry): the frontier's coverage
+        # evidence is its worklist accounting — chunks drained, states
+        # popped/flagged, and how many flagged sets passed the exact
+        # minimality/host checks.  No window space: completeness rests on
+        # the B&B invariant, which the differential suites pin.
+        stats["cert"] = {
+            "frontier_chunks_drained": stats["device_chunks"],
+            "states_popped": stats["states_popped"],
+            "flagged": stats["flagged"],
+            "minimal_quorums": stats["minimal_quorums"],
+            "host_checks": stats["host_checks"],
+            "device_flag_checks": stats["device_flag_checks"],
+        }
         if self.checkpoint is not None:
             self.checkpoint.clear()
         if witness is not None:

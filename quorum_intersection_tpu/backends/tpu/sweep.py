@@ -251,6 +251,9 @@ class _SweepJob:
     bits: int
     total: int
     candidates: int = 0
+    # qi-cert: windows of THIS job's enumeration never swept because the
+    # pack's window-splitting made them redundant (a lower window hit).
+    skipped: int = 0
     first_hit: Optional[int] = None
     resolved: bool = False
     intersects: Optional[bool] = None
@@ -633,6 +636,11 @@ class TpuSweepBackend:
             interval = max(now - prev_t, 0.0)
             throughput.add(checked, interval)
             rec.add("sweep.candidates_checked", checked)
+            # qi-cert coverage ledger (ISSUE 7): the exact enumerated-window
+            # count, maintained at the drain — sums to `total` on a clean
+            # full sweep, which is the checkable invariant behind every
+            # `true` certificate (tools/check_cert.py).
+            rec.add("cert.windows_enumerated", checked)
             rec.event(
                 "sweep.window",
                 start=start, candidates=checked, steps_per_call=spc,
@@ -712,6 +720,9 @@ class TpuSweepBackend:
             caller cancelling a genuinely long sweep may keep it."""
             if self.cancel is not None and self.cancel.cancelled:
                 rec.add("sweep.windows_cancelled", len(inflight))
+                # qi-cert: everything not yet drained is CANCELLED coverage
+                # — a later certificate must never claim these windows.
+                rec.add("cert.windows_cancelled", max(total - candidates, 0))
                 rec.event(
                     "sweep.cancelled", start=start, total=total,
                     windows_dropped=len(inflight), drained=steps,
@@ -875,8 +886,37 @@ class TpuSweepBackend:
             # the device sustained between drains, setup/compile excluded
             # (the end-to-end candidates_per_sec includes them).
             "window_candidates_per_sec": round(throughput.per_second, 1),
+            # qi-cert coverage ledger (cert.py ledger_entry): the window
+            # categories whose sum the independent checker pins to the
+            # window space on every `true` certificate.  Pruned-by-guard
+            # is reserved for the ROADMAP device-side pruning item — when
+            # pruning lands, its wins become auditable here instead of
+            # silently shrinking `windows_enumerated`.  A checkpoint-
+            # resumed run did not re-drain the fingerprint-matched prefix,
+            # so the prefix rides as its own term (the checker counts it
+            # into the sum) rather than inflating `windows_enumerated`,
+            # which stays "drained by THIS run" exactly.
+            "cert": {
+                "window_space": total,
+                "windows_enumerated": candidates,
+                "windows_pruned_guard": 0,
+                "windows_skipped_pack_fill": 0,
+                "windows_cancelled": 0,
+                "windows_resumed_prefix": start0,
+            },
         }
         rec.gauge("sweep.candidates_per_sec", round(throughput.per_second, 1))
+        # Registry definition (docs/OBSERVABILITY.md): windows_enumerated /
+        # window_space of a FULL sweep — 1.0 under pure brute force, driven
+        # down only by real pruning wins.  Early-hit (false-verdict) and
+        # checkpoint-resumed drives legitimately enumerate less than the
+        # space for reasons that are not pruning, so they must not publish
+        # a ratio the trend gate would read as a win.
+        if total and not found and not start0:
+            rec.gauge(
+                "cert.enumeration_ratio",
+                round(candidates / total, 6),
+            )
         if start0:
             # Resume provenance: lets tooling prove a run actually skipped a
             # checkpointed prefix (tools/wide_run.py kill/resume ledger).
@@ -1122,6 +1162,10 @@ class TpuSweepBackend:
 
         unresolved = set(range(n_jobs))
         nxt = [g.lo for g in groups]
+        # Per-group enumerated coverage (qi-cert): lets the skip accounting
+        # below compute exactly how much of a window was never swept when a
+        # lower window's hit retires it.
+        covered = [0] * len(groups)
         inflight: "deque" = deque()
         pack_rows = 0
         ramp = (1, 8, 64)
@@ -1131,6 +1175,12 @@ class TpuSweepBackend:
         def check_cancel() -> None:
             if self.cancel is not None and self.cancel.cancelled:
                 rec.add("sweep.windows_cancelled", len(inflight))
+                # qi-cert: the unswept remainder of every live window is
+                # CANCELLED coverage, exactly as in the unpacked drive.
+                rec.add("cert.windows_cancelled", sum(
+                    max(g.hi - g.lo - covered[i], 0)
+                    for i, g in enumerate(groups) if not g.done
+                ))
                 rec.event(
                     "sweep.cancelled", packed=True,
                     windows_dropped=len(inflight),
@@ -1173,6 +1223,7 @@ class TpuSweepBackend:
         def drain_one() -> None:
             starts_snap, coverage, handle = inflight.popleft()
             hits = np.asarray(handle)
+            drained = 0
             for gix, g in enumerate(groups):
                 if g.done:
                     continue
@@ -1181,6 +1232,8 @@ class TpuSweepBackend:
                     continue  # frozen lane: nothing new covered
                 top = min(s0 + coverage, g.hi)
                 jobs[g.job].candidates += top - s0
+                covered[gix] += top - s0
+                drained += top - s0
                 h = int(hits[gix])
                 if h < g.hi:
                     # In-range hit.  Overshoot rows (>= hi, aliased decode
@@ -1190,12 +1243,20 @@ class TpuSweepBackend:
                     g.hit = h
                     g.done = True
                     # Later windows of the same job can only yield LARGER
-                    # indices: stop burning lanes on them.
-                    for g2 in groups:
-                        if g2.job == g.job and g2.lo > g.lo:
+                    # indices: stop burning lanes on them.  Their unswept
+                    # remainder is SKIPPED-BY-PACK-FILL coverage (qi-cert):
+                    # windows that only existed because spare pack lanes
+                    # split the enumeration, retired by a lower window's
+                    # hit — counted exactly, per job.
+                    for g2ix, g2 in enumerate(groups):
+                        if g2.job == g.job and g2.lo > g.lo and not g2.done:
+                            skip = max(g2.hi - g2.lo - covered[g2ix], 0)
+                            jobs[g.job].skipped += skip
+                            rec.add("cert.windows_skipped_pack_fill", skip)
                             g2.done = True
                 elif top >= g.hi:
                     g.done = True
+            rec.add("cert.windows_enumerated", drained)
             resolve_jobs()
 
         # The whole pack drive is one span (qi-trace), and the live
@@ -1273,12 +1334,33 @@ class TpuSweepBackend:
             "pack_seconds": round(seconds, 4),
             "xla_compile_seconds": round(xla_s, 4),
         }
+        # Same registry rule as the unpacked drive: only full-coverage
+        # (no-hit) jobs speak for brute-force enumeration; a hit job's
+        # retired pack-fill windows are early-exit savings, not pruning.
+        clean_jobs = [j for j in jobs if j.first_hit is None]
+        enum_all = sum(j.candidates for j in clean_jobs)
+        total_all = sum(j.total for j in clean_jobs)
+        if total_all:
+            rec.gauge(
+                "cert.enumeration_ratio", round(enum_all / total_all, 6)
+            )
         for job in jobs:
             stats = {
                 "backend": self.name,
                 "candidates_checked": job.candidates,
                 "enumeration_total": job.total,
                 "seconds": seconds,
+                # qi-cert ledger, per packed job: a clean (true-verdict)
+                # job's windows partition its enumeration exactly, so
+                # enumerated sums to the window space; a hit job's skipped
+                # count is the pack-fill windows its hit retired.
+                "cert": {
+                    "window_space": job.total,
+                    "windows_enumerated": job.candidates,
+                    "windows_pruned_guard": 0,
+                    "windows_skipped_pack_fill": job.skipped,
+                    "windows_cancelled": 0,
+                },
                 **pack_stats,
             }
             if job.first_hit is None:

@@ -1,0 +1,289 @@
+"""qi-cert/1 — checkable verdict certificates (ISSUE 7 tentpole).
+
+The paper's algorithm answers an NP-hard question with a bare boolean.
+qi-telemetry (PR 2) and qi-trace (PR 6) made the *runtime* observable; the
+*answer* stayed opaque: a ``false`` verdict's witness pair was rechecked
+internally and thrown away, and a ``true`` verdict carried no evidence that
+the search actually covered the space it claims.  This module attaches a
+certificate to every verdict:
+
+- **``false``**: the two disjoint quorums in graph-space node ids
+  (publicKeys + vertex indices) plus per-member **slice-satisfaction
+  evidence** — for each witness member, which direct validators inside the
+  quorum and how many satisfied inner sets meet its threshold — so the
+  witness is auditable without re-running any engine.
+- **``true``**: a **coverage ledger** — per searched SCC, windows
+  enumerated / pruned-by-guard / skipped-by-pack-fill / cancelled for the
+  exhaustive sweep (invariant: they sum to the window space
+  ``2^(|scc|-1)``, docs/PARITY.md §Certificate invariants), frontier
+  chunks drained for the device-resident B&B, and the branch-and-bound
+  node counts echoed from the native/python oracles — so "intersecting"
+  is auditable as "exhaustively covered".
+- **always**: provenance — which ladder rung/engine/pack produced the
+  verdict, the run's ``trace_id``, the routing/calibration/degrade events
+  of this solve, and the front-end's sanitation decisions (dangling
+  policy + dropped refs).
+
+``tools/check_cert.py`` is the adversarial counterpart: a stdlib-only
+checker (no imports from this package) that re-validates a certificate
+against the raw stellarbeat JSON with its own minimal quorum-set
+evaluator and exits 1 on any unsound witness or ledger arithmetic that
+does not sum to the window space.
+
+Certificates are attached to every :class:`pipeline.SolveResult` (the
+``cert`` field), written to disk via the CLI ``--cert-out``, and
+summarized into the qi-telemetry/1 stream (``cert.*`` events/counters,
+docs/OBSERVABILITY.md registry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
+from quorum_intersection_tpu.utils.faults import fault_point
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+log = get_logger("cert")
+
+CERT_SCHEMA = "qi-cert/1"
+
+# Reference witness-pair convention (cpp:372-373), recorded verbatim in
+# every witness block so a consumer never has to guess which side was the
+# enumerated quorum: q1 is the disjointness-probe result, q2 the
+# enumerated/minimal quorum.  Certificate parity across engines is "same
+# pair up to this convention" (tests/test_qi_cert.py).
+WITNESS_CONVENTION = "q1=disjoint-probe, q2=enumerated (cpp:372-373)"
+
+# The event names a solve's provenance block carries out of the run record:
+# routing decisions, race verdicts, ladder transitions, calibration gates,
+# engine resolutions, pack builds, and injected faults — the "why this
+# engine answered" trail, scoped to the one solve via events_since().
+PROVENANCE_EVENTS = frozenset((
+    "route.decision",
+    "race",
+    "degrade",
+    "degrade.retry",
+    "ladder.quarantined",
+    "native.watchdog_cancel",
+    "sweep.engine_resolved",
+    "sweep.packed",
+    "sweep.cancelled",
+    "calibration.foreign_artifact_ignored",
+    "fault.injected",
+))
+
+
+def _slice_evidence(
+    owner: int,
+    qset: IndexedQSet,
+    member_set: frozenset,
+    graph: TrustGraph,
+) -> Dict[str, object]:
+    """Slice-satisfaction evidence for ``owner``'s quorum slice against a
+    witness quorum: which direct members inside the quorum and how many
+    recursively-satisfied inner sets meet the threshold.  Mirrors the
+    pinned host semantics (fbas/semantics.py slice_satisfied): Q2 null
+    qsets never satisfy, Q3 degenerate/unreachable thresholds never
+    satisfy, Q4 requires the owner itself inside the quorum."""
+    if qset.threshold is None:
+        return {"threshold": None, "satisfied": False, "reason": "null qset (Q2)"}
+    direct = [v for v in qset.members if v in member_set]
+    inner = [
+        _slice_evidence(owner, iq, member_set, graph) for iq in qset.inner
+    ]
+    inner_sat = sum(1 for ev in inner if ev["satisfied"])
+    t = qset.threshold
+    m_count = len(qset.members) + len(qset.inner)
+    satisfied = (
+        owner in member_set  # Q4 self-availability
+        and 0 < t <= m_count  # Q3 normalization
+        and len(direct) + inner_sat >= t
+    )
+    return {
+        "threshold": t,
+        "members": m_count,
+        "direct_met": [graph.node_ids[v] for v in direct],
+        "inner_satisfied": inner_sat,
+        "satisfied": satisfied,
+    }
+
+
+def witness_evidence(graph: TrustGraph, quorum: List[int]) -> List[Dict[str, object]]:
+    """Per-member slice-satisfaction evidence for one witness quorum —
+    the auditable half of a ``false`` certificate, and the validity probe
+    ``analytics/splitting.py`` reuses (a candidate set is splitting only
+    when every member of both claimed quorums is actually satisfied)."""
+    member_set = frozenset(quorum)
+    return [
+        {
+            "id": graph.node_ids[v],
+            "index": v,
+            **_slice_evidence(v, graph.qsets[v], member_set, graph),
+        }
+        for v in quorum
+    ]
+
+
+def witness_block(
+    graph: TrustGraph, q1: List[int], q2: List[int]
+) -> Dict[str, object]:
+    """The ``witness`` block of a false certificate: both quorums in
+    graph-space node ids plus per-member evidence."""
+    return {
+        "convention": WITNESS_CONVENTION,
+        "q1": [graph.node_ids[v] for v in q1],
+        "q2": [graph.node_ids[v] for v in q2],
+        "q1_index": list(q1),
+        "q2_index": list(q2),
+        "evidence": {
+            "q1": witness_evidence(graph, q1),
+            "q2": witness_evidence(graph, q2),
+        },
+    }
+
+
+def ledger_entry(
+    graph: TrustGraph, scc: List[int], stats: Dict[str, object],
+    scc_index: Optional[int] = None,
+) -> Dict[str, object]:
+    """One coverage-ledger entry for the SCC a backend searched, from the
+    backend's result stats.  Sweep engines contribute the window counters
+    maintained in their drive/pack loops (``stats["cert"]``); the frontier
+    contributes its chunk/worklist counters; the host oracles echo their
+    B&B node counts."""
+    entry: Dict[str, object] = {
+        "scc_index": scc_index,
+        "size": len(scc),
+        "nodes": [graph.node_ids[v] for v in scc],
+        "backend": stats.get("backend", "?"),
+    }
+    cert_stats = stats.get("cert")
+    if isinstance(cert_stats, dict):
+        entry.update(cert_stats)
+    # Oracle B&B counts ride along even for backends that predate the
+    # explicit cert stats (defense in depth: the ledger never goes empty).
+    for key in ("bnb_calls", "minimal_quorums", "fixpoint_calls",
+                "native_call_id"):
+        if key in stats and key not in entry:
+            entry[key] = stats[key]
+    if stats.get("packed"):
+        entry["packed"] = True
+        if "pack_engine" in stats:
+            entry["engine"] = stats["pack_engine"]
+    return entry
+
+
+def build_certificate(
+    graph: TrustGraph,
+    *,
+    intersects: bool,
+    reason: str,
+    n_sccs: int,
+    quorum_bearing: int,
+    scc_select: str,
+    scope_to_scc: bool,
+    stats: Dict[str, object],
+    q1: Optional[List[int]] = None,
+    q2: Optional[List[int]] = None,
+    target_scc: Optional[List[int]] = None,
+    target_scc_index: Optional[int] = None,
+    events: Optional[List[dict]] = None,
+    batched: bool = False,
+) -> Dict[str, object]:
+    """Assemble one ``qi-cert/1`` certificate and emit its telemetry
+    summary (``cert.emitted`` event + ``cert.certificates`` counter)."""
+    rec = get_run_record()
+    cert: Dict[str, object] = {
+        "schema": CERT_SCHEMA,
+        "verdict": bool(intersects),
+        "dangling": graph.dangling,
+        "scc_select": scc_select,
+        "scope_to_scc": bool(scope_to_scc),
+        "graph": {"n": graph.n, "edges": graph.n_edges},
+        "guard": {
+            "n_sccs": n_sccs,
+            "quorum_bearing_sccs": quorum_bearing,
+            "reason": reason,
+        },
+        "provenance": {
+            "backend": stats.get("backend", reason),
+            "trace_id": rec.trace_id,
+            "packed": bool(stats.get("packed", False)),
+            "batched": bool(batched),
+            "native_call_id": stats.get("native_call_id"),
+            "race": stats.get("race"),
+            "sanitize": {
+                "dangling_policy": graph.dangling,
+                "dangling_refs": graph.dangling_refs,
+            },
+            "events": [
+                {"name": ev.get("name"), "t_s": ev.get("t_s"),
+                 "attrs": ev.get("attrs") or {}}
+                for ev in (events or [])
+                if ev.get("name") in PROVENANCE_EVENTS
+            ],
+            # After a MAX_EVENTS overflow the slice above may be empty or
+            # clipped; without this flag a consumer cannot distinguish "no
+            # routing/degrade events happened" from "the buffer overflowed".
+            "events_truncated": rec.events_truncated(),
+        },
+    }
+    summary: Dict[str, object] = {
+        "verdict": bool(intersects),
+        "backend": stats.get("backend", reason),
+        "reason": reason,
+    }
+    if intersects:
+        entry = ledger_entry(
+            graph, target_scc or [], stats, scc_index=target_scc_index
+        )
+        cert["coverage"] = {"sccs": [entry]}
+        for key in ("window_space", "windows_enumerated",
+                    "windows_pruned_guard", "windows_skipped_pack_fill",
+                    "windows_cancelled", "frontier_chunks_drained",
+                    "bnb_calls"):
+            if key in entry:
+                summary[key] = entry[key]
+    elif q1 and q2:
+        cert["witness"] = witness_block(graph, q1, q2)
+        summary["witness_sizes"] = [len(q1), len(q2)]
+    else:
+        # Zero quorum-bearing SCCs: no quorum exists at all, so no witness
+        # pair is possible — the certificate claims (and the checker
+        # re-verifies) graph-wide quorum absence instead.
+        cert["no_quorum"] = True
+        summary["no_quorum"] = True
+    rec.add("cert.certificates")
+    rec.event("cert.emitted", **summary)
+    return cert
+
+
+def write_certificate(cert: Dict[str, object], path: str) -> Optional[str]:
+    """Write one certificate to ``path`` (atomic tmp+rename).
+
+    The write is a declared fault point (``cert.write``,
+    docs/ROBUSTNESS.md): an ``OSError`` — injected disk-full or real —
+    downgrades to the ``cert.write_errors`` counter plus a
+    ``cert.write_error`` event and returns None.  A certificate is
+    evidence about a verdict; failing to record it must never flip or
+    cost the verdict itself."""
+    rec = get_run_record()
+    try:
+        fault_point("cert.write")
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(cert, fh, indent=1, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except OSError as exc:
+        rec.add("cert.write_errors")
+        rec.event("cert.write_error", path=str(path), error=str(exc))
+        log.warning("certificate write failed (%s); verdict unaffected", exc)
+        return None
+    rec.add("cert.writes")
+    rec.event("cert.written", path=str(path))
+    return str(path)

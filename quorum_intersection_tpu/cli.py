@@ -105,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "timeline incl. both race arms and every ladder "
                         "rung; open in ui.perfetto.dev — "
                         "docs/OBSERVABILITY.md); env twin: QI_TRACE_OUT")
+    p.add_argument("--cert-out", metavar="PATH", default=None,
+                   help="write the qi-cert/1 verdict certificate to PATH: "
+                        "witness pair + per-member slice evidence for "
+                        "false, the search-coverage ledger for true, "
+                        "provenance always — independently re-validated "
+                        "by tools/check_cert.py against the raw input "
+                        "(docs/OBSERVABILITY.md §Certificates)")
     p.add_argument("--no-race", action="store_true",
                    help="disable the auto backend's racing orchestrator "
                         "(budgeted oracle vs concurrent sweep spin-up, first "
@@ -175,6 +182,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 def _main(args, record) -> int:
     dangling = args.dangling_policy or ("alias0" if args.compat else "strict")
     scc_select = args.scc_select or ("front" if args.compat else "quorum-bearing")
+
+    if args.cert_out and (
+        args.pagerank or args.top_tier or args.splitting_set or args.blocking_set
+    ):
+        # Analytics modes return before the solve that builds a certificate;
+        # reject loudly (same contract as --no-race / --checkpoint below)
+        # rather than exiting 0 with the requested file never written.
+        sys.stderr.write(
+            "--cert-out applies to verdict mode only (certificates are not "
+            "produced by --rank/--top-tier/--splitting-set/--blocking-set)\n"
+        )
+        return 1
 
     from quorum_intersection_tpu.fbas.schema import parse_fbas
     from quorum_intersection_tpu.fbas.graph import build_graph
@@ -389,6 +408,13 @@ def _main(args, record) -> int:
             scc_select=scc_select,
             scope_to_scc=args.scope_scc,
         )
+
+    if args.cert_out and result.cert is not None:
+        from quorum_intersection_tpu.cert import write_certificate
+
+        # A failed write downgrades to the cert.write_errors counter inside
+        # write_certificate — the verdict below is never at stake.
+        write_certificate(result.cert, args.cert_out)
 
     if args.timing:
         # Legacy lines first, byte-compatible with pre-telemetry builds

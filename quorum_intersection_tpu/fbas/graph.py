@@ -60,6 +60,10 @@ class TrustGraph:
     node_ids: List[str] = field(default_factory=list)  # publicKeys
     names: List[str] = field(default_factory=list)  # raw names ("" if unset)
     dangling_refs: int = 0
+    # The dangling policy this graph was BUILT under ("strict" | "alias0"):
+    # verdict certificates (qi-cert/1) record it so the independent checker
+    # evaluates the same FBAS semantics the verdict used.
+    dangling: DanglingPolicy = "strict"
 
     def label(self, v: int) -> str:
         """Display label: name if non-empty else publicKey (cpp:507, :596-597)."""
@@ -127,6 +131,7 @@ def build_graph(fbas: Fbas, dangling: DanglingPolicy = "strict") -> TrustGraph:
         node_ids=[node.public_key for node in fbas],
         names=[node.name for node in fbas],
         dangling_refs=stats[0],
+        dangling=dangling,
     )
 
 

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, TextIO, Tuple, Union
 
 from quorum_intersection_tpu.backends.base import SearchBackend, get_backend
+from quorum_intersection_tpu.cert import build_certificate
 from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph, group_sccs, tarjan_scc
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
@@ -127,6 +128,11 @@ class SolveResult:
     q2: Optional[List[int]] = None
     stats: Dict[str, object] = field(default_factory=dict)
     timers: Dict[str, float] = field(default_factory=dict)
+    # qi-cert/1 verdict certificate (cert.py): witness evidence for false,
+    # coverage ledger for true, provenance always.  Not part of `stats` so
+    # the legacy --timing [stats] lines stay byte-compatible with
+    # certificates enabled (CLI --cert-out writes it to disk).
+    cert: Optional[Dict[str, object]] = None
 
 
 def print_quorum(quorum: List[int], graph: TrustGraph, out: TextIO) -> None:
@@ -155,11 +161,23 @@ def solve_graph(
     scope_to_scc: bool = False,
     circuit: Optional[Circuit] = None,
     timers: Optional[PhaseTimers] = None,
+    with_cert: bool = True,
 ) -> SolveResult:
-    """Decide quorum intersection for a built trust graph."""
+    """Decide quorum intersection for a built trust graph.
+
+    ``with_cert=False`` skips qi-cert assembly (``SolveResult.cert`` stays
+    None): for internal analytics probes that solve in a combinatorial
+    loop (``analytics/splitting.py``), per-candidate certificate assembly
+    and its ``cert.*`` telemetry are pure overhead — and the event spam
+    would saturate the in-memory event cap that real certificates'
+    provenance slices read from."""
     timers = timers or PhaseTimers()
     if isinstance(backend, str):
         backend = get_backend(backend)
+    # qi-cert provenance anchor: the routing/degrade/calibration events of
+    # THIS solve are the record slice from here to verdict (cert.py).
+    rec = get_run_record()
+    cert_ev0 = rec.event_count()
 
     # Per-SCC quorum scan (cpp:645-672): which SCCs, restricted to themselves,
     # contain a quorum?  All minimal quorums live inside some SCC.
@@ -223,6 +241,13 @@ def solve_graph(
             q2=q2,
             stats={"reason": "scc_guard"},
             timers=timers.summary(),
+            cert=build_certificate(
+                graph, intersects=False, reason="scc_guard",
+                n_sccs=count, quorum_bearing=len(quorum_scc_ids),
+                scc_select=scc_select, scope_to_scc=scope_to_scc,
+                stats={"reason": "scc_guard"}, q1=q1, q2=q2,
+                events=rec.events_since(cert_ev0),
+            ) if with_cert else None,
         )
 
     # Backends that search on the host set-semantics directly (python, cpp via
@@ -255,6 +280,17 @@ def solve_graph(
         q2=res.q2,
         stats=dict(res.stats),
         timers=timers.summary(),
+        cert=build_certificate(
+            graph, intersects=res.intersects, reason="search",
+            n_sccs=count, quorum_bearing=len(quorum_scc_ids),
+            scc_select=scc_select, scope_to_scc=scope_to_scc,
+            stats=res.stats, q1=res.q1, q2=res.q2,
+            target_scc=target_scc,
+            target_scc_index=(
+                0 if scc_select == "front" else quorum_scc_ids[0]
+            ),
+            events=rec.events_since(cert_ev0),
+        ) if with_cert else None,
     )
 
 
@@ -296,8 +332,10 @@ def check_many(
     jobs: List[Tuple[int, TrustGraph, Optional[Circuit], List[int]]] = []
     metas: Dict[int, Tuple[int, List[int], List[int], Dict[str, float]]] = {}
     allow_native_scan = getattr(backend, "name", "") != "python"
+    rec = get_run_record()
     for ix, source in enumerate(sources):
         timers = PhaseTimers()
+        cert_ev0 = rec.event_count()
         with timers.phase("parse"):
             fbas = source if isinstance(source, Fbas) else parse_fbas(source)
         with timers.phase("graph"):
@@ -318,6 +356,13 @@ def check_many(
                 quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,
                 q1=q1, q2=q2, stats={"reason": "scc_guard"},
                 timers=timers.summary(),
+                cert=build_certificate(
+                    graph, intersects=False, reason="scc_guard",
+                    n_sccs=count, quorum_bearing=len(quorum_scc_ids),
+                    scc_select=scc_select, scope_to_scc=scope_to_scc,
+                    stats={"reason": "scc_guard"}, q1=q1, q2=q2,
+                    events=rec.events_since(cert_ev0), batched=True,
+                ),
             )
             continue
         circuit: Optional[Circuit] = None
@@ -346,10 +391,14 @@ def check_many(
                 else getattr(backend, "check_sccs", None)
             )
             t_search = time.perf_counter()
+            # One provenance slice for the whole batch (qi-cert): a fused
+            # pack's routing/degrade events cannot be attributed per job,
+            # so every batched certificate carries the batch's slice with
+            # `batched: true`.
+            batch_ev0 = rec.event_count()
             # The batched search is one span (qi-trace): every job's route/
             # pack/native span of this batch nests under it, so the serving-
             # layer timeline shows "one request batch" as one block.
-            rec = get_run_record()
             with rec.span(
                 "pipeline.check_many", sources=len(sources), jobs=len(jobs),
                 batched=batch is not None,
@@ -365,7 +414,8 @@ def check_many(
                         for _, g, c, s in jobs
                     ]
             search_s = time.perf_counter() - t_search
-            for (ix, _, _, _), res in zip(jobs, scc_results):
+            batch_events = rec.events_since(batch_ev0)
+            for (ix, graph, _, target_scc), res in zip(jobs, scc_results):
                 count, quorum_scc_ids, main_scc, timer_summary = metas[ix]
                 # The batched call is one shared phase: every job's timers
                 # carry the SAME "search" wall (per-job attribution of a
@@ -379,6 +429,19 @@ def check_many(
                     quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,
                     q1=res.q1, q2=res.q2, stats=dict(res.stats),
                     timers=timer_summary,
+                    cert=build_certificate(
+                        graph, intersects=res.intersects, reason="search",
+                        n_sccs=count,
+                        quorum_bearing=len(quorum_scc_ids),
+                        scc_select=scc_select, scope_to_scc=scope_to_scc,
+                        stats=res.stats, q1=res.q1, q2=res.q2,
+                        target_scc=target_scc,
+                        target_scc_index=(
+                            0 if scc_select == "front"
+                            else quorum_scc_ids[0]
+                        ),
+                        events=batch_events, batched=True,
+                    ),
                 )
     finally:
         if restore_pack:
@@ -396,6 +459,7 @@ def solve(
     graphviz: bool = False,
     scc_select: str = "quorum-bearing",
     scope_to_scc: bool = False,
+    with_cert: bool = True,
 ) -> SolveResult:
     """Full pipeline from JSON (stream/str/list) or a parsed :class:`Fbas` —
     parity with the reference's ``solve(istream&)`` overload (cpp:709-716)."""
@@ -413,4 +477,5 @@ def solve(
         scc_select=scc_select,
         scope_to_scc=scope_to_scc,
         timers=timers,
+        with_cert=with_cert,
     )

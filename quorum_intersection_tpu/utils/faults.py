@@ -178,6 +178,14 @@ DISTRIBUTED_INIT = _declare(
     "simulates a dead/unreachable coordinator — bounded retry under "
     "QI_DIST_INIT_TIMEOUT_S, then a loud single-process degrade.",
 )
+CERT_WRITE = _declare(
+    "cert.write",
+    "Verdict-certificate write (cert.py write_certificate, CLI "
+    "--cert-out): oserror simulates a full disk — the write downgrades to "
+    "the cert.write_errors counter and the run keeps its verdict; a "
+    "certificate is evidence about a verdict, never a precondition for "
+    "one.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "

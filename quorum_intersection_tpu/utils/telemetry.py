@@ -414,6 +414,7 @@ class RunRecord:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, object] = {}
         self.dropped = 0
+        self.events_dropped = 0
         self._next_id = 0
         self._sinks: List[Sink] = []
         self._finished = False
@@ -446,6 +447,32 @@ class RunRecord:
         """Copy of the flight-recorder ring, oldest first."""
         with self._flight_lock:
             return list(self._flight)
+
+    def event_count(self) -> int:
+        """Current in-memory event count — the snapshot anchor for
+        :meth:`events_since` (qi-cert provenance slicing)."""
+        with self._lock:
+            return len(self.events)
+
+    def events_since(self, n: int) -> List[dict]:
+        """Copies of the events recorded after snapshot position ``n``
+        (an :meth:`event_count` result).  The qi-cert builder uses the
+        slice to stamp one solve's routing/degrade/calibration decisions
+        into its certificate without consuming the whole run's stream.
+        Bounded by MAX_EVENTS: once the in-memory cap overflows, later
+        solves see an empty slice (the JSONL stream still has the lines);
+        :meth:`events_truncated` tells the cert builder to say so."""
+        with self._lock:
+            return [dict(ev) for ev in self.events[n:]]
+
+    def events_truncated(self) -> bool:
+        """Whether any event line was dropped from the in-memory buffer
+        (MAX_EVENTS overflow).  Once true, an empty/short
+        :meth:`events_since` slice no longer means "nothing happened" —
+        qi-cert stamps this into provenance so a certificate consumer can
+        tell a quiet solve from a clipped audit trail."""
+        with self._lock:
+            return self.events_dropped > 0
 
     # ---- sinks -----------------------------------------------------------
 
@@ -552,6 +579,7 @@ class RunRecord:
                 self.events.append(ev)
             else:
                 self.dropped += 1
+                self.events_dropped += 1
         self._emit(ev)
 
     def declare(self, name: str) -> None:

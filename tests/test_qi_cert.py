@@ -1,0 +1,485 @@
+"""qi-cert differential suite (ISSUE 7): certificate parity across all four
+backend rungs, ledger arithmetic, packed ``check_many`` certificates, the
+mid-sweep cancel accounting, the independent checker's accept/reject
+pinning, the ``cert.write`` fault downgrade, and ``--timing`` byte
+compatibility with certificates enabled."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from quorum_intersection_tpu.backends.base import SearchCancelled
+from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.cert import CERT_SCHEMA
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.pipeline import check_many, solve
+from quorum_intersection_tpu.utils import telemetry
+from tools.check_cert import CheckFailure, check_certificate
+from tools.check_cert import main as checker_main
+
+from tests.conftest import VENDORED_DIR
+
+CLI = [sys.executable, "-m", "quorum_intersection_tpu"]
+
+BACKENDS = ("python", "cpp", "tpu-sweep", "tpu-frontier")
+
+
+def make_backend(name):
+    if name == "tpu-sweep":
+        return TpuSweepBackend(batch=512)
+    if name == "tpu-frontier":
+        return TpuFrontierBackend(arena=4096, pop=128)
+    return name
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+@pytest.fixture
+def fresh_record():
+    rec = telemetry.reset_run_record()
+    yield rec
+    telemetry.reset_run_record()
+
+
+def _env(**extra):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("QI_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def pair_of(witness):
+    """A witness as an unordered pair of quorum sets — 'same pair up to
+    the reference convention' (docs/PARITY.md §Certificate invariants)."""
+    return {frozenset(witness["q1"]), frozenset(witness["q2"])}
+
+
+class TestDifferentialParity:
+    """All four rungs emit equivalent, independently-checkable certs."""
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    @pytest.mark.parametrize(
+        "fixture,verdict",
+        [
+            ("trivial_correct", True),
+            ("trivial_broken", False),
+            ("nested_correct", True),
+            ("nested_broken", False),
+        ],
+    )
+    def test_rung_certificates_validate(self, backend, fixture, verdict):
+        nodes = fixture_nodes(fixture)
+        res = solve(json.dumps(nodes), backend=make_backend(backend))
+        assert res.intersects is verdict
+        cert = res.cert
+        assert cert["schema"] == CERT_SCHEMA
+        assert cert["verdict"] is verdict
+        # The independent checker accepts every rung's certificate.
+        notes = check_certificate(cert, nodes)
+        assert notes
+
+    @pytest.mark.parametrize("fixture", ["trivial_broken", "nested_broken"])
+    def test_witness_pair_parity_across_rungs(self, fixture):
+        nodes = fixture_nodes(fixture)
+        pairs = {}
+        for backend in BACKENDS:
+            res = solve(json.dumps(nodes), backend=make_backend(backend))
+            assert not res.intersects
+            pairs[backend] = pair_of(res.cert["witness"])
+        assert len(set(map(frozenset, pairs.values()))) == 1, pairs
+
+    @pytest.mark.parametrize("fixture", ["trivial_correct", "nested_correct"])
+    def test_sweep_ledger_sums_to_window_space(self, fixture):
+        nodes = fixture_nodes(fixture)
+        res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
+        entry = res.cert["coverage"]["sccs"][0]
+        space = 1 << (entry["size"] - 1)
+        assert entry["window_space"] == space
+        assert (
+            entry["windows_enumerated"]
+            + entry["windows_pruned_guard"]
+            + entry["windows_skipped_pack_fill"]
+            + entry["windows_cancelled"]
+        ) == space
+        assert entry["windows_cancelled"] == 0
+
+    def test_oracle_and_frontier_ledgers(self):
+        nodes = fixture_nodes("nested_correct")
+        res_py = solve(json.dumps(nodes), backend="python")
+        entry = res_py.cert["coverage"]["sccs"][0]
+        assert entry["bnb_calls"] >= 1
+        res_fr = solve(
+            json.dumps(nodes), backend=TpuFrontierBackend(arena=4096, pop=128)
+        )
+        entry = res_fr.cert["coverage"]["sccs"][0]
+        assert entry["frontier_chunks_drained"] >= 1
+        assert entry["states_popped"] >= 1
+
+    def test_provenance_stamps_backend_and_trace(self, fresh_record):
+        res = solve(
+            json.dumps(fixture_nodes("nested_correct")),
+            backend=TpuSweepBackend(batch=512),
+        )
+        prov = res.cert["provenance"]
+        assert prov["backend"] == "tpu-sweep"
+        assert prov["trace_id"] == fresh_record.trace_id
+        assert prov["sanitize"]["dangling_policy"] == "strict"
+        assert prov["events_truncated"] is False
+        names = {ev["name"] for ev in prov["events"]}
+        assert "sweep.engine_resolved" in names
+
+    def test_event_overflow_marks_provenance_truncated(
+        self, fresh_record, monkeypatch
+    ):
+        # Once MAX_EVENTS overflows, a later solve's events_since slice is
+        # empty — the cert must say "audit trail clipped", not pass off the
+        # empty list as "no routing/degrade events happened".
+        monkeypatch.setattr(telemetry, "MAX_EVENTS", 8)
+        for _ in range(12):
+            fresh_record.event("noise")
+        res = solve(json.dumps(fixture_nodes("trivial_correct")),
+                    backend="python")
+        assert res.cert["provenance"]["events_truncated"] is True
+        assert res.cert["provenance"]["events"] == []
+
+    def test_enumeration_ratio_gauge_full_coverage_sweeps_only(
+        self, fresh_record
+    ):
+        # Registry rule (docs/OBSERVABILITY.md): the gauge is the brute-
+        # force baseline only a real pruning win may drive below 1.0 — an
+        # early-hit (false-verdict) sweep enumerates less than the space
+        # for a different reason and must not publish it.
+        solve(json.dumps(fixture_nodes("trivial_broken")),
+              backend=TpuSweepBackend(batch=512))
+        _, gauges = fresh_record.snapshot()
+        assert "cert.enumeration_ratio" not in gauges
+        solve(json.dumps(fixture_nodes("nested_correct")),
+              backend=TpuSweepBackend(batch=512))
+        _, gauges = fresh_record.snapshot()
+        assert gauges.get("cert.enumeration_ratio") == 1.0
+
+
+class TestPackedCheckMany:
+    def test_packed_batch_certificates(self, fresh_record):
+        sources = [
+            majority_fbas(8),
+            majority_fbas(9),
+            majority_fbas(8, broken=True),
+        ]
+        results = check_many(sources, backend="auto", pack=True)
+        assert [r.intersects for r in results] == [True, True, False]
+        for src, res in zip(sources, results):
+            cert = res.cert
+            assert cert["provenance"]["batched"] is True
+            check_certificate(cert, src)
+        # Both true verdicts ran packed, and their ledgers still sum.
+        for res in results[:2]:
+            entry = res.cert["coverage"]["sccs"][0]
+            assert entry.get("packed") is True
+            assert entry["windows_enumerated"] == entry["window_space"]
+        # The packed drive maintained the cert counters as it drained.
+        counters, _ = fresh_record.snapshot()
+        assert counters.get("cert.windows_enumerated", 0) >= 128 + 256
+
+    def test_guard_decided_sources_get_certs_too(self):
+        nodes = fixture_nodes("nested_broken")  # guard-decided (2 QB SCCs)
+        [res] = check_many([nodes], backend="python")
+        assert not res.intersects
+        assert res.cert["guard"]["reason"] == "scc_guard"
+        check_certificate(res.cert, nodes)
+
+
+class _TrippingCancel:
+    """CancelToken stand-in that trips after N polls (the sweep only reads
+    ``.cancelled`` on its window/drain cancel points)."""
+
+    def __init__(self, after):
+        self.after = after
+        self.polls = 0
+
+    @property
+    def cancelled(self):
+        self.polls += 1
+        return self.polls > self.after
+
+
+class TestMidSweepCancel:
+    def test_cancel_counts_unswept_windows_and_yields_no_cert(
+        self, fresh_record
+    ):
+        data = majority_fbas(16)  # 2^15 windows, several programs at batch=512
+        graph = build_graph(parse_fbas(data))
+        from quorum_intersection_tpu.encode.circuit import encode_circuit
+
+        circuit = encode_circuit(graph)
+        backend = TpuSweepBackend(
+            batch=512, max_inflight=2, cancel=_TrippingCancel(3)
+        )
+        with pytest.raises(SearchCancelled):
+            backend.check_scc(graph, circuit, list(range(graph.n)))
+        counters, _ = fresh_record.snapshot()
+        cancelled = counters.get("cert.windows_cancelled", 0)
+        enumerated = counters.get("cert.windows_enumerated", 0)
+        assert cancelled > 0
+        # Everything is accounted: nothing both enumerated and cancelled,
+        # and no full-coverage claim is possible from this run.
+        assert enumerated + cancelled >= 1 << 15
+        assert enumerated < 1 << 15
+
+
+class TestChecker:
+    def test_checker_cli_accepts_fixture_pair(self, tmp_path):
+        for fx in ("trivial_correct", "trivial_broken"):
+            nodes = fixture_nodes(fx)
+            res = solve(json.dumps(nodes), backend="python")
+            cert_path = tmp_path / f"{fx}.cert.json"
+            cert_path.write_text(json.dumps(res.cert))
+            rc = checker_main([str(cert_path), str(VENDORED_DIR / f"{fx}.json")])
+            assert rc == 0
+
+    def test_corrupted_witness_exits_1(self, tmp_path):
+        nodes = fixture_nodes("trivial_broken")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        # Forge an overlap: the witness pair is no longer disjoint.
+        bad["witness"]["q1"] = bad["witness"]["q1"] + [bad["witness"]["q2"][0]]
+        cert_path = tmp_path / "bad.cert.json"
+        cert_path.write_text(json.dumps(bad))
+        rc = checker_main(
+            [str(cert_path), str(VENDORED_DIR / "trivial_broken.json")]
+        )
+        assert rc == 1
+
+    def test_short_summed_ledger_exits_1(self, tmp_path):
+        nodes = fixture_nodes("nested_correct")
+        res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
+        bad = copy.deepcopy(res.cert)
+        bad["coverage"]["sccs"][0]["windows_enumerated"] -= 1
+        cert_path = tmp_path / "short.cert.json"
+        cert_path.write_text(json.dumps(bad))
+        rc = checker_main(
+            [str(cert_path), str(VENDORED_DIR / "nested_correct.json")]
+        )
+        assert rc == 1
+
+    def test_cancelled_windows_cannot_back_a_true_verdict(self):
+        nodes = fixture_nodes("nested_correct")
+        res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
+        bad = copy.deepcopy(res.cert)
+        entry = bad["coverage"]["sccs"][0]
+        entry["windows_enumerated"] -= 5
+        entry["windows_cancelled"] += 5  # sums, but rests on cancelled work
+        with pytest.raises(CheckFailure, match="cancelled"):
+            check_certificate(bad, nodes)
+
+    def test_pruned_guard_is_reserved_until_pruning_exists(self):
+        # No engine prunes yet, so a ledger booking unswept windows as
+        # "pruned" sums to the space but claims coverage nothing verified
+        # — the checker must reject the whole reserved term as unsound.
+        nodes = fixture_nodes("nested_correct")
+        res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
+        bad = copy.deepcopy(res.cert)
+        entry = bad["coverage"]["sccs"][0]
+        entry["windows_enumerated"] -= 7
+        entry["windows_pruned_guard"] += 7  # sums, but nothing pruned it
+        with pytest.raises(CheckFailure, match="reserved"):
+            check_certificate(bad, nodes)
+
+    def test_wrong_guard_count_is_unsound(self):
+        nodes = fixture_nodes("nested_broken")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        bad["guard"]["quorum_bearing_sccs"] = 1
+        with pytest.raises(CheckFailure, match="quorum-bearing"):
+            check_certificate(bad, nodes)
+
+    def test_unsatisfied_evidence_is_unsound(self):
+        nodes = fixture_nodes("trivial_broken")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        bad["witness"]["evidence"]["q1"][0]["satisfied"] = False
+        with pytest.raises(CheckFailure, match="unsatisfied"):
+            check_certificate(bad, nodes)
+
+    def test_resumed_prefix_counts_toward_the_window_space(self):
+        nodes = fixture_nodes("nested_correct")
+        res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
+        cert = copy.deepcopy(res.cert)
+        entry = cert["coverage"]["sccs"][0]
+        # Recast part of the enumeration as a checkpoint-resumed prefix:
+        # the sum still covers the space, so the cert stays sound.
+        entry["windows_enumerated"] -= 512
+        entry["windows_resumed_prefix"] = 512
+        notes = check_certificate(cert, nodes)
+        assert any("checkpoint-resumed" in n for n in notes)
+        # ...but the prefix cannot conjure coverage beyond the space.
+        entry["windows_resumed_prefix"] += 1
+        with pytest.raises(CheckFailure, match="ledger arithmetic"):
+            check_certificate(cert, nodes)
+
+    def test_malformed_evidence_rows_are_unsound_not_a_crash(self):
+        nodes = fixture_nodes("trivial_broken")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        bad["witness"]["evidence"]["q1"] = ["not-an-object"]
+        with pytest.raises(CheckFailure, match="not objects"):
+            check_certificate(bad, nodes)
+        bad2 = copy.deepcopy(res.cert)
+        del bad2["witness"]["evidence"]["q1"][0]["id"]
+        with pytest.raises(CheckFailure, match="do not cover"):
+            check_certificate(bad2, nodes)
+
+    def test_non_object_ledger_entry_is_unsound_not_a_crash(self):
+        nodes = fixture_nodes("trivial_correct")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        bad["coverage"]["sccs"] = ["bogus"]
+        with pytest.raises(CheckFailure, match="not an object"):
+            check_certificate(bad, nodes)
+
+    def test_hostile_structure_exits_2_never_a_traceback(self, tmp_path):
+        nodes = fixture_nodes("trivial_broken")
+        res = solve(json.dumps(nodes), backend="python")
+        bad = copy.deepcopy(res.cert)
+        bad["witness"] = ["hostile"]  # .get on a list inside the checker
+        cert_path = tmp_path / "hostile.cert.json"
+        cert_path.write_text(json.dumps(bad))
+        rc = checker_main(
+            [str(cert_path), str(VENDORED_DIR / "trivial_broken.json")]
+        )
+        assert rc == 2
+
+
+class TestResumedSweep:
+    def test_checkpoint_resumed_cert_passes_the_checker(
+        self, tmp_path, fresh_record
+    ):
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        nodes = fixture_nodes("nested_correct")
+        ck = SweepCheckpoint(tmp_path / "sweep.ckpt")
+        first = TpuSweepBackend(
+            batch=512, max_inflight=2, checkpoint=ck,
+            cancel=_TrippingCancel(8),
+        )
+        with pytest.raises(SearchCancelled):
+            solve(json.dumps(nodes), backend=first)
+        res = solve(
+            json.dumps(nodes),
+            backend=TpuSweepBackend(batch=512, checkpoint=ck),
+        )
+        assert res.intersects is True
+        entry = res.cert["coverage"]["sccs"][0]
+        # The first (cancelled) run recorded block-aligned progress; the
+        # resumed run's ledger carries that prefix as its own term and the
+        # independent checker accepts the sum.
+        assert entry["windows_resumed_prefix"] > 0
+        assert (
+            entry["windows_enumerated"] + entry["windows_resumed_prefix"]
+            == entry["window_space"]
+        )
+        notes = check_certificate(res.cert, nodes)
+        assert any("checkpoint-resumed" in n for n in notes)
+
+
+class TestCliAndFaults:
+    def test_cert_out_writes_validating_certificate(self, tmp_path):
+        cert_path = tmp_path / "cli.cert.json"
+        proc = subprocess.run(
+            CLI + ["--backend", "python", "--cert-out", str(cert_path)],
+            input=(VENDORED_DIR / "nested_broken.json").read_text(),
+            capture_output=True, text=True, timeout=120, env=_env(),
+        )
+        assert proc.returncode == 1  # false verdict
+        assert proc.stdout.strip() == "false"
+        cert = json.loads(cert_path.read_text())
+        check_certificate(cert, fixture_nodes("nested_broken"))
+
+    def test_cert_write_fault_downgrades_not_flips(self, tmp_path):
+        cert_path = tmp_path / "never.cert.json"
+        metrics = tmp_path / "m.jsonl"
+        proc = subprocess.run(
+            CLI + ["--backend", "python", "--cert-out", str(cert_path),
+                   "--metrics-json", str(metrics)],
+            input=(VENDORED_DIR / "trivial_correct.json").read_text(),
+            capture_output=True, text=True, timeout=120,
+            env=_env(QI_FAULTS="cert.write=oserror@1"),
+        )
+        assert proc.returncode == 0, proc.stderr  # verdict unaffected
+        assert proc.stdout.strip() == "true"
+        assert not cert_path.exists()
+        lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+        counters = {
+            l["name"]: l["value"] for l in lines if l["kind"] == "counter"
+        }
+        assert counters.get("cert.write_errors") == 1
+        assert counters.get("faults.injected") == 1
+
+    def test_cert_out_rejected_in_analytics_modes(self, tmp_path):
+        # Analytics modes never reach the solve that builds a certificate;
+        # accepting --cert-out and exiting 0 with the file never written
+        # would strand a CI consumer on ENOENT with nothing to diagnose.
+        cert_path = tmp_path / "c.json"
+        for flag in ("--pagerank", "--top-tier", "--splitting-set",
+                     "--blocking-set"):
+            proc = subprocess.run(
+                CLI + [flag, "--cert-out", str(cert_path)],
+                input=(VENDORED_DIR / "trivial_correct.json").read_text(),
+                capture_output=True, text=True, timeout=120, env=_env(),
+            )
+            assert proc.returncode == 1, (flag, proc.stderr)
+            assert "--cert-out" in proc.stderr
+            assert not cert_path.exists()
+
+    def test_timing_byte_compatible_with_certificates(self, tmp_path):
+        """--timing's [timing]/[stats] line KEYS are identical with and
+        without --cert-out, and the deterministic [stats] sequence keeps
+        its order.  ([timing] lines are duration-sorted by
+        PhaseTimers.summary(), so their relative order legitimately varies
+        run to run — compare them as a multiset, not a sequence.)"""
+        def run(extra):
+            proc = subprocess.run(
+                CLI + ["--timing", "--backend", "python", *extra],
+                input=(VENDORED_DIR / "trivial_correct.json").read_text(),
+                capture_output=True, text=True, timeout=120, env=_env(),
+            )
+            assert proc.returncode == 0
+            return [
+                line.split(":", 1)[0]
+                for line in proc.stderr.splitlines()
+                if line.startswith(("[timing]", "[stats]"))
+            ]
+
+        plain = run([])
+        with_cert = run(["--cert-out", str(tmp_path / "c.json")])
+        assert sorted(plain) == sorted(with_cert)
+        assert [k for k in plain if k.startswith("[stats]")] == [
+            k for k in with_cert if k.startswith("[stats]")
+        ]
+        # Legacy lines still precede any [timing]/[stats] reordering of the
+        # cert payload: the [timing] block stays contiguous and first.
+        assert plain[0].startswith("[timing]") and with_cert[0].startswith(
+            "[timing]"
+        )
+
+
+class TestSplittingReuse:
+    def test_is_splitting_validated_by_witness_evidence(self):
+        from quorum_intersection_tpu.analytics.splitting import is_splitting
+
+        nodes = fixture_nodes("trivial_broken")
+        # Already split: the empty deletion is witnessed by the cert's
+        # evidence (the splitting analytics now consume qi-cert evidence
+        # instead of a bare q1-is-not-None).
+        assert is_splitting(nodes, []) is True
+        correct = fixture_nodes("trivial_correct")
+        assert is_splitting(correct, []) is False

@@ -122,3 +122,21 @@ def test_preexisting_zero_threshold_keeps_q3_semantics():
          "quorumSet": {"threshold": 0, "validators": [], "innerQuorumSets": []}}
     ]
     assert not is_splitting(data, [])
+
+
+def test_splitting_probes_skip_certificate_assembly():
+    # is_splitting sits in minimum_splitting_set's combinatorial loop: its
+    # internal solves run with with_cert=False, so the loop never pays
+    # per-candidate certificate assembly or floods the run record with
+    # cert.* events (which would saturate the in-memory event cap real
+    # certificates' provenance slices read from).
+    from quorum_intersection_tpu.utils import telemetry
+
+    rec = telemetry.reset_run_record()
+    try:
+        data = majority_fbas(5)
+        assert minimum_splitting_set(data, max_k=2) is not None
+        counters, _ = rec.snapshot()
+        assert counters.get("cert.certificates", 0) == 0
+    finally:
+        telemetry.reset_run_record()

@@ -49,6 +49,10 @@ TYPING_TARGETS = (
     # robustness they exist to provide.
     "quorum_intersection_tpu/utils/faults.py",
     "quorum_intersection_tpu/utils/checkpoint.py",
+    # ISSUE 7: the certificate builder joins the spine — a type error in
+    # the evidence/ledger assembly is exactly the kind of silent
+    # unsoundness the independent checker exists to catch downstream.
+    "quorum_intersection_tpu/cert.py",
 )
 
 

@@ -70,6 +70,17 @@ TRACKED: Dict[str, str] = {
     "sweep_mfu_pct": "higher",
     "wide_sweep_mfu_pct": "higher",
     "pack_fill_pct": "higher",
+    # qi-cert coverage metrics (ISSUE 7): the ROADMAP pruning item's wins
+    # must land as enumeration-count ratios, so the ledger numbers are
+    # gated the moment they exist.  `sweep_enumeration_ratio` =
+    # windows_enumerated / window_space (1.0 while the sweep is pure brute
+    # force; device-side guard pruning drives it DOWN, and a regression is
+    # the ratio creeping back up).  `sweep_windows_pruned` is the
+    # pruned-by-guard count itself — higher is better once pruning lands;
+    # until then its baseline is 0 and the gate is inert.
+    "sweep_windows_enumerated": "lower",
+    "sweep_windows_pruned": "higher",
+    "sweep_enumeration_ratio": "lower",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -90,6 +101,7 @@ TELEMETRY_GAUGES = (
     "sweep.candidates_per_sec",
     "sweep.pack_fill_pct",
     "sweep.xla_compile_seconds",
+    "cert.enumeration_ratio",
 )
 
 

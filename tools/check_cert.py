@@ -1,0 +1,489 @@
+#!/usr/bin/env python3
+"""qi-cert/1 independent certificate checker (ISSUE 7 tentpole, piece 3).
+
+Re-validates a verdict certificate against the RAW stellarbeat JSON it was
+produced from, with a minimal quorum-set evaluator of its own — deliberately
+**stdlib-only and import-free of the quorum_intersection_tpu package**: the
+whole point is an adversarial counterpart that shares no code with the
+engines it audits, so a bug in the package's semantics cannot vouch for
+itself.
+
+What is checked:
+
+- schema + structural sanity (`qi-cert/1`, known node ids, sizes);
+- the **guard claim**: this checker builds its own trust graph (validators
+  at every nesting depth; `strict` dangling drops unknown refs, `alias0`
+  aliases them to vertex 0 — the certificate records which policy the
+  verdict used), runs its own iterative Tarjan, scans every SCC for a
+  contained quorum with its own greatest-fixpoint evaluator, and compares
+  the quorum-bearing count against the certificate's;
+- a **false** verdict: the witness pair must be two nonempty, disjoint,
+  self-contained quorums (every member's slice satisfied within its own
+  quorum — Q2 null qsets never satisfy, Q3 degenerate/unreachable
+  thresholds never satisfy, Q4 self-availability), and the certificate's
+  per-member evidence must agree with this checker's own evaluation; a
+  false verdict WITHOUT a witness must claim `no_quorum`, which is
+  verified by the graph-wide greatest fixpoint coming up empty;
+- a **true** verdict: exactly one quorum-bearing SCC; the coverage
+  ledger's SCC must be that SCC (under the default `quorum-bearing`
+  selection); every sweep ledger entry must satisfy the arithmetic
+  invariant `enumerated + pruned_guard + skipped_pack_fill + cancelled
+  [+ resumed_prefix] == window_space == 2^(size-1)` with `cancelled == 0`
+  and `skipped_pack_fill == 0` (a cancelled or skipped window cannot
+  support an exhaustive-coverage claim; a checkpoint-resumed run's
+  fingerprint-matched prefix counts without inflating the run's own
+  enumerated windows); B&B entries (native/python oracle) must
+  carry `bnb_calls >= 1`, frontier entries `frontier_chunks_drained >= 1`.
+
+Exit codes: 0 — certificate sound; 1 — any unsound witness, ledger
+arithmetic failure, or guard mismatch; 2 — unreadable/ill-formed inputs.
+
+Usage::
+
+    python tools/check_cert.py CERT.json FBAS.json [-q]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+MAX_DEPTH = 128  # D9: mirror the package's nesting cap, reject deeper
+
+
+class CheckFailure(Exception):
+    """One unsound certificate claim (exit 1)."""
+
+
+class InputError(Exception):
+    """Unreadable or structurally ill-formed input (exit 2)."""
+
+
+# ---------------------------------------------------------------------------
+# Minimal FBAS front end (independent re-implementation, stdlib only)
+
+
+def _threshold(raw: object) -> Optional[int]:
+    """Normalize a threshold field: ints and numeric strings (ptree
+    compat) are accepted; anything else is ill-formed."""
+    if isinstance(raw, bool) or raw is None:
+        raise InputError(f"malformed threshold {raw!r}")
+    if isinstance(raw, int):
+        return raw
+    if isinstance(raw, str):
+        try:
+            return int(raw)
+        except ValueError:
+            raise InputError(f"malformed threshold {raw!r}")
+    raise InputError(f"malformed threshold {raw!r}")
+
+
+class Evaluator:
+    """Trust graph + quorum-set evaluator over one raw node list."""
+
+    def __init__(self, nodes: Sequence[dict], dangling: str) -> None:
+        if dangling not in ("strict", "alias0"):
+            raise InputError(f"unknown dangling policy {dangling!r}")
+        self.dangling = dangling
+        self.ids: List[str] = []
+        self.index: Dict[str, int] = {}
+        for node in nodes:
+            key = node.get("publicKey")
+            if not isinstance(key, str) or not key:
+                raise InputError("node without a publicKey")
+            if key in self.index:
+                raise InputError(f"duplicate publicKey {key!r}")
+            self.index[key] = len(self.ids)
+            self.ids.append(key)
+        self.n = len(self.ids)
+        self.qsets: List[Optional[dict]] = [
+            self._resolve(node.get("quorumSet"), 0) for node in nodes
+        ]
+        self.succ: List[List[int]] = [
+            self._edges(q) for q in self.qsets
+        ]
+
+    def _resolve(self, qset: object, depth: int) -> Optional[dict]:
+        """Raw quorumSet → {t, members: [idx...], inner: [...]} with the
+        dangling policy applied (strict: unknown refs dropped; alias0:
+        aliased to vertex 0).  None ⇒ null qset (Q2, never satisfiable)."""
+        if qset is None:
+            return None
+        if not isinstance(qset, dict):
+            raise InputError(f"malformed quorumSet {type(qset).__name__}")
+        if depth > MAX_DEPTH:
+            raise InputError(f"quorumSet nesting exceeds depth {MAX_DEPTH}")
+        if qset.get("threshold") is None and not qset.get("validators") \
+                and not qset.get("innerQuorumSets"):
+            return None  # empty/null qset
+        members: List[int] = []
+        for key in qset.get("validators") or []:
+            v = self.index.get(key)
+            if v is None:
+                if self.dangling == "alias0":
+                    members.append(0)
+                continue  # strict: never-available ≡ dropped member
+            members.append(v)
+        inner = [
+            self._resolve(iq, depth + 1)
+            for iq in qset.get("innerQuorumSets") or []
+        ]
+        return {
+            "t": _threshold(qset.get("threshold")),
+            "members": members,
+            "inner": inner,
+        }
+
+    def _edges(self, qset: Optional[dict]) -> List[int]:
+        if qset is None:
+            return []
+        out = list(qset["members"])
+        for iq in qset["inner"]:
+            out.extend(self._edges(iq))
+        return out
+
+    # -- semantics ---------------------------------------------------------
+
+    def slice_satisfied(self, owner: int, avail: Sequence[bool]) -> bool:
+        if not avail[owner]:  # Q4: self-availability
+            return False
+        return self._qset_satisfied(self.qsets[owner], avail)
+
+    def _qset_satisfied(self, qset: Optional[dict], avail: Sequence[bool]) -> bool:
+        if qset is None:  # Q2
+            return False
+        t = qset["t"]
+        m_count = len(qset["members"]) + len(qset["inner"])
+        if t <= 0 or t > m_count:  # Q3 normalization
+            return False
+        met = sum(1 for v in qset["members"] if avail[v])
+        for iq in qset["inner"]:
+            if met >= t:
+                return True
+            if self._qset_satisfied(iq, avail):
+                met += 1
+        return met >= t
+
+    def max_quorum(self, candidates: Sequence[int]) -> List[int]:
+        """Greatest fixpoint of the candidate set: repeatedly drop members
+        whose slice is unsatisfied until stable."""
+        avail = [False] * self.n
+        for v in candidates:
+            avail[v] = True
+        nodes = list(candidates)
+        while True:
+            kept = [v for v in nodes if self.slice_satisfied(v, avail)]
+            if len(kept) == len(nodes):
+                return kept
+            for v in nodes:
+                if v not in kept:
+                    avail[v] = False
+            nodes = kept
+
+    def is_quorum(self, members: Sequence[int]) -> bool:
+        unique = sorted(set(members))
+        return bool(unique) and len(self.max_quorum(unique)) == len(unique)
+
+    # -- SCC structure -----------------------------------------------------
+
+    def tarjan(self) -> List[List[int]]:
+        """Iterative Tarjan: list of SCCs (each a vertex list)."""
+        UNSET = -1
+        disc = [UNSET] * self.n
+        low = [0] * self.n
+        on_stack = [False] * self.n
+        stack: List[int] = []
+        comps: List[List[int]] = []
+        timer = 0
+        for root in range(self.n):
+            if disc[root] != UNSET:
+                continue
+            work: List[Tuple[int, int]] = [(root, 0)]
+            while work:
+                v, pi = work[-1]
+                if pi == 0:
+                    disc[v] = low[v] = timer
+                    timer += 1
+                    stack.append(v)
+                    on_stack[v] = True
+                advanced = False
+                for i in range(pi, len(self.succ[v])):
+                    w = self.succ[v][i]
+                    if disc[w] == UNSET:
+                        work[-1] = (v, i + 1)
+                        work.append((w, 0))
+                        advanced = True
+                        break
+                    if on_stack[w]:
+                        low[v] = min(low[v], disc[w])
+                if advanced:
+                    continue
+                if low[v] == disc[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        comp.append(w)
+                        if w == v:
+                            break
+                    comps.append(comp)
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[v])
+        return comps
+
+    def quorum_bearing_sccs(self) -> List[List[int]]:
+        return [scc for scc in self.tarjan() if self.max_quorum(scc)]
+
+
+# ---------------------------------------------------------------------------
+# certificate validation
+
+
+def _require(cond: bool, message: str) -> None:
+    if not cond:
+        raise CheckFailure(message)
+
+
+def _check_witness_quorum(
+    ev: Evaluator, label: str, ids: Sequence[str], evidence: Sequence[dict]
+) -> Set[int]:
+    _require(bool(ids), f"witness {label} is empty")
+    indices: List[int] = []
+    for pk in ids:
+        v = ev.index.get(pk)
+        _require(v is not None, f"witness {label} names unknown node {pk!r}")
+        indices.append(v)  # type: ignore[arg-type]
+    _require(
+        len(set(indices)) == len(indices),
+        f"witness {label} repeats a node",
+    )
+    _require(
+        ev.is_quorum(indices),
+        f"witness {label} is not a self-contained quorum under this "
+        f"checker's evaluator",
+    )
+    # The certificate's own per-member evidence must agree with this
+    # checker's evaluation — a cert claiming an unsatisfied member is
+    # internally unsound even when the set happens to be a quorum.
+    _require(
+        all(isinstance(row, dict) for row in evidence),
+        f"witness {label} evidence rows are not objects",
+    )
+    ev_ids = [row.get("id") for row in evidence]
+    _require(
+        all(isinstance(pk, str) for pk in ev_ids)
+        and sorted(ev_ids) == sorted(ids),
+        f"witness {label} evidence rows do not cover its members",
+    )
+    _require(
+        all(row.get("satisfied") is True for row in evidence),
+        f"witness {label} evidence marks a member unsatisfied",
+    )
+    return set(indices)
+
+
+def _check_ledger_entry(entry: dict, qb_ids: Set[str], scc_select: str) -> str:
+    _require(isinstance(entry, dict), "coverage ledger entry is not an object")
+    size = entry.get("size")
+    nodes = entry.get("nodes") or []
+    _require(isinstance(size, int) and size >= 1, "ledger entry without a size")
+    _require(
+        len(nodes) == size and len(set(nodes)) == size,
+        "ledger entry node list does not match its size",
+    )
+    if scc_select == "quorum-bearing":
+        _require(
+            set(nodes) == qb_ids,
+            "ledger SCC is not the quorum-bearing SCC this checker found",
+        )
+    backend = str(entry.get("backend", "?"))
+    if "window_space" in entry:
+        space = entry["window_space"]
+        _require(
+            space == 1 << (size - 1),
+            f"window_space {space} != 2^(size-1) = {1 << (size - 1)}",
+        )
+        parts = {
+            key: entry.get(key)
+            for key in ("windows_enumerated", "windows_pruned_guard",
+                        "windows_skipped_pack_fill", "windows_cancelled")
+        }
+        for key, val in parts.items():
+            _require(
+                isinstance(val, int) and val >= 0,
+                f"ledger field {key} missing or negative",
+            )
+        # Optional term: a checkpoint-resumed sweep did not re-drain the
+        # fingerprint-matched prefix an earlier run already covered — the
+        # prefix counts toward the space without inflating the run's own
+        # enumerated count (docs/PARITY.md §Certificate invariants).
+        resumed = entry.get("windows_resumed_prefix", 0)
+        _require(
+            isinstance(resumed, int) and resumed >= 0,
+            "ledger field windows_resumed_prefix malformed or negative",
+        )
+        total = sum(parts.values()) + resumed  # type: ignore[arg-type]
+        _require(
+            total == space,
+            f"ledger arithmetic: enumerated+pruned+skipped+cancelled"
+            f"+resumed = {total} != window space {space}",
+        )
+        _require(
+            parts["windows_cancelled"] == 0,
+            "a true verdict cannot rest on cancelled windows",
+        )
+        _require(
+            parts["windows_skipped_pack_fill"] == 0,
+            "a true verdict cannot rest on pack-skipped windows",
+        )
+        # Reserved term: no engine implements guard pruning yet (the
+        # ROADMAP "prune the search space" item), so ANY nonzero value is
+        # by definition unsound — a mis-binned counter or a forged ledger
+        # claiming coverage it never verified.  Relax this only when
+        # pruning lands together with a rule this checker can re-verify.
+        _require(
+            parts["windows_pruned_guard"] == 0,
+            "windows_pruned_guard is reserved (no engine prunes yet); "
+            "nonzero pruned mass is unverifiable and therefore unsound",
+        )
+        note = f"sweep ledger: {parts['windows_enumerated']}/{space} windows"
+        if resumed:
+            note += f" (+{resumed} checkpoint-resumed)"
+        return note
+    if backend in ("cpp", "python"):
+        _require(
+            isinstance(entry.get("bnb_calls"), int) and entry["bnb_calls"] >= 1,
+            "oracle ledger entry without a positive bnb_calls count",
+        )
+        return f"oracle ledger: {entry['bnb_calls']} B&B calls"
+    if backend == "tpu-frontier":
+        chunks = entry.get("frontier_chunks_drained")
+        _require(
+            isinstance(chunks, int) and chunks >= 1,
+            "frontier ledger entry without a positive chunk count",
+        )
+        return f"frontier ledger: {chunks} chunks drained"
+    raise CheckFailure(f"ledger entry with unknown backend {backend!r}")
+
+
+def check_certificate(cert: dict, nodes: Sequence[dict]) -> List[str]:
+    """Validate ``cert`` against the raw node list; returns human-readable
+    notes, raises :class:`CheckFailure` on the first unsound claim."""
+    notes: List[str] = []
+    _require(cert.get("schema") == "qi-cert/1",
+             f"unknown certificate schema {cert.get('schema')!r}")
+    verdict = cert.get("verdict")
+    _require(isinstance(verdict, bool), "certificate without a boolean verdict")
+    dangling = str(cert.get("dangling", "strict"))
+    scc_select = str(cert.get("scc_select", "quorum-bearing"))
+    ev = Evaluator(nodes, dangling)
+    graph_claim = cert.get("graph") or {}
+    if "n" in graph_claim:
+        _require(graph_claim["n"] == ev.n,
+                 f"certificate graph.n {graph_claim['n']} != {ev.n} nodes")
+    qb = ev.quorum_bearing_sccs()
+    guard = cert.get("guard") or {}
+    _require(
+        guard.get("quorum_bearing_sccs") == len(qb),
+        f"guard claims {guard.get('quorum_bearing_sccs')} quorum-bearing "
+        f"SCC(s); this checker found {len(qb)}",
+    )
+    notes.append(f"guard: {len(qb)} quorum-bearing SCC(s) confirmed")
+
+    if verdict:
+        _require(len(qb) == 1,
+                 "true verdict with != 1 quorum-bearing SCC is vacuous")
+        entries = (cert.get("coverage") or {}).get("sccs") or []
+        _require(bool(entries), "true verdict without a coverage ledger")
+        qb_ids = {ev.ids[v] for v in qb[0]}
+        for entry in entries:
+            notes.append(_check_ledger_entry(entry, qb_ids, scc_select))
+        return notes
+
+    witness = cert.get("witness")
+    if witness is None:
+        _require(
+            cert.get("no_quorum") is True,
+            "false verdict without a witness must claim no_quorum",
+        )
+        _require(
+            not ev.max_quorum(list(range(ev.n))),
+            "no_quorum claimed but the graph-wide greatest fixpoint is "
+            "nonempty",
+        )
+        notes.append("no-quorum claim confirmed (graph-wide fixpoint empty)")
+        return notes
+    evidence = witness.get("evidence") or {}
+    s1 = _check_witness_quorum(ev, "q1", witness.get("q1") or [],
+                               evidence.get("q1") or [])
+    s2 = _check_witness_quorum(ev, "q2", witness.get("q2") or [],
+                               evidence.get("q2") or [])
+    _require(not (s1 & s2), "witness quorums intersect")
+    notes.append(
+        f"witness confirmed: disjoint quorums of size {len(s1)} and {len(s2)}"
+    )
+    return notes
+
+
+# ---------------------------------------------------------------------------
+
+
+def _load_nodes(path: str) -> List[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise InputError(f"cannot read FBAS JSON {path}: {exc}")
+    if isinstance(raw, dict) and isinstance(raw.get("nodes"), list):
+        raw = raw["nodes"]
+    if not isinstance(raw, list):
+        raise InputError(f"{path}: expected a stellarbeat node list")
+    return raw
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("cert", help="qi-cert/1 certificate JSON")
+    parser.add_argument("fbas", help="raw stellarbeat JSON the verdict ran on")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the per-check notes")
+    args = parser.parse_args(argv)
+    try:
+        try:
+            with open(args.cert, encoding="utf-8") as fh:
+                cert = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            raise InputError(f"cannot read certificate {args.cert}: {exc}")
+        if not isinstance(cert, dict):
+            raise InputError(f"{args.cert}: certificate is not a JSON object")
+        notes = check_certificate(cert, _load_nodes(args.fbas))
+    except CheckFailure as exc:
+        print(f"UNSOUND: {exc}", file=sys.stderr)
+        return 1
+    except InputError as exc:
+        print(f"input error: {exc}", file=sys.stderr)
+        return 2
+    except Exception as exc:  # adversarial inputs must never traceback:
+        # a certificate hostile enough to break the checker's structural
+        # assumptions is ill-formed input, and the documented contract is
+        # exit 2 — not an uncaught TypeError that a CI consumer would
+        # misread as "unsound certificate".
+        print(
+            f"input error: structurally ill-formed certificate "
+            f"({type(exc).__name__}: {exc})",
+            file=sys.stderr,
+        )
+        return 2
+    if not args.quiet:
+        print(f"certificate OK ({args.cert}, verdict={cert['verdict']})")
+        for note in notes:
+            print(f"  {note}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,9 @@
 #   - the static-analysis suite (docs/STATIC_ANALYSIS.md) runs after the
 #     tests: `python -m tools.analyze` must exit clean, and its findings
 #     stream to $TIER1_ANALYZE in the same qi-telemetry/1 shape;
+#   - a qi-cert gate (ISSUE 7): CLI-written verdict certificates for the
+#     vendored fixture pairs re-validated by the independent stdlib
+#     checker tools/check_cert.py ($TIER1_CERTS holds the artifacts);
 #   - a chaos-soak smoke (docs/ROBUSTNESS.md) runs last: a small fixed-seed
 #     window of `tools/soak.py --chaos` — every injected fault schedule
 #     must leave the verdict equal to the fault-free sequential chain or
@@ -83,6 +86,28 @@ env JAX_PLATFORMS=cpu \
 prc=$?
 echo "PACKED=exit $prc"
 
+# qi-cert gate (docs/OBSERVABILITY.md §Certificates): generate verdict
+# certificates for the vendored fixture pairs through the CLI, then
+# re-validate every one with the INDEPENDENT stdlib checker — an unsound
+# witness or a coverage ledger that does not sum to the window space fails
+# the gate.  The CLI's exit code is its verdict (0 true / 1 false); only
+# exit > 1 is a crash.
+CERTDIR="${TIER1_CERTS:-/tmp/_t1_certs}"
+rm -rf "$CERTDIR"
+mkdir -p "$CERTDIR"
+certrc=0
+for fx in trivial_correct trivial_broken nested_correct nested_broken \
+          snapshot_correct snapshot_broken; do
+    env JAX_PLATFORMS=cpu python -m quorum_intersection_tpu \
+        --cert-out "$CERTDIR/$fx.cert.json" \
+        < "fixtures/$fx.json" > /dev/null
+    vrc=$?
+    [ "$vrc" -gt 1 ] && { echo "CERT: solve crashed on $fx (rc=$vrc)"; certrc=1; }
+    env JAX_PLATFORMS=cpu python tools/check_cert.py \
+        "$CERTDIR/$fx.cert.json" "fixtures/$fx.json" || certrc=1
+done
+echo "CERTS=$CERTDIR (exit $certrc)"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -96,4 +121,5 @@ echo "TREND=exit $trc"
 [ "$arc" -ne 0 ] && exit "$arc"
 [ "$crc" -ne 0 ] && exit "$crc"
 [ "$prc" -ne 0 ] && exit "$prc"
+[ "$certrc" -ne 0 ] && exit "$certrc"
 exit "$trc"

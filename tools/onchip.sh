@@ -1,6 +1,8 @@
 #!/bin/bash
 # Parameterized on-chip measurement driver — replaces the copy-pasted
-# onchip_r5.sh / onchip_r5b.sh / onchip_r5c.sh (ISSUE 6 satellite): one
+# onchip_r5.sh / onchip_r5b.sh / onchip_r5c.sh (ISSUE 6 satellite) and the
+# leftover onchip_r4.sh (ISSUE 7 satellite: the r4 sequence IS the default
+# phase list, so `tools/onchip.sh --round r4` reproduces it exactly): one
 # script, round + phase flags, same per-step discipline the r5 scripts
 # converged on:
 #   - a down tunnel HANGS rather than errors, so probe before EVERY phase
@@ -19,11 +21,15 @@
 #   default phases:   crossover frontier_scaling wide_run bench soak
 #   extra phases:     sweep_vs_native wide_kill crossover_pop2048 scc36
 #                     auto_race packed
-# Examples (the r5 sequences, reproduced):
+# Examples (the r4/r5 sequences, reproduced):
+#   tools/onchip.sh --round r4                                  # = onchip_r4.sh
 #   tools/onchip.sh --round r5                                  # = onchip_r5.sh
 #   tools/onchip.sh --round r5 sweep_vs_native wide_kill crossover_pop2048
 #                                                               # = onchip_r5b.sh
 #   tools/onchip.sh --round r5 scc36                            # = onchip_r5c.sh
+# Round names parameterize everywhere: the tunnel watcher launches this
+# script with WATCH_ROUND (tools/tunnel_watch.sh) — keep the two in sync
+# by passing the SAME rN to both.
 set -x
 set -o pipefail
 cd "$(dirname "$0")/.."

@@ -3,7 +3,9 @@
 # answers, run the full on-chip sequence (tools/onchip.sh) and stop.
 # Designed to live in a tmux session for the whole round — r4 lost the
 # entire round to a down tunnel, so the watcher removes the human (agent)
-# from the loop.  Round and phases parameterize like onchip.sh itself:
+# from the loop.  Round and phases parameterize like onchip.sh itself
+# (ALL round-named scripts are gone — onchip_r4/r5* collapsed into
+# tools/onchip.sh — so the round here is the single name to keep in sync):
 #   WATCH_ROUND=r6 WATCH_PHASES="bench packed auto_race" tools/tunnel_watch.sh
 # Log: benchmarks/results/tunnel_watch_<round>.log
 cd "$(dirname "$0")/.."
