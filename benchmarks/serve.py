@@ -1,0 +1,234 @@
+"""Open-loop load driver for the snapshot-stream serving layer (ISSUE 8).
+
+Drives a live :class:`quorum_intersection_tpu.serve.ServeEngine` with the
+traffic shape the ROADMAP's north star describes — a continuous stream of
+stellarbeat snapshots where the overwhelmingly common query is an
+unchanged topology — and measures the serving numbers the trend sentinel
+tracks (``tools/bench_trend.py``):
+
+- ``serve_verdicts_per_sec`` (headline): completed verdicts over the
+  measurement wall;
+- ``serve_p50_ms`` / ``serve_p99_ms``: admission→delivery latency
+  percentiles over all served requests;
+- ``serve_cache_hit_pct``: verdict-cache hits as a % of admitted requests
+  (the millions-of-users ≈ millions-of-cache-hits claim, measured);
+- shed / deadline-expired / coalesced counts (typed outcomes only — a
+  silent drop is a driver failure).
+
+**Open loop**: arrivals follow a fixed-rate clock (``--rate``), never the
+completions — so overload actually builds queue depth and exercises the
+shedding path instead of self-throttling (closed-loop drivers hide
+overload by construction).
+
+Traffic comes from :func:`fbas.synth.churn_trace`: a deterministic
+snapshot stream with bounded quorum-set diffs.  Requests walk the trace
+forward with temporal locality (most requests repeat the current
+snapshot; ``--advance-every`` steps the topology), so cache hits, churn
+misses and single-flight coalescing all occur at realistic ratios.
+
+The driver doubles as a parity gate: every served verdict is compared to
+the one-shot ``pipeline.solve`` oracle verdict for its snapshot — any
+mismatch is exit 1 (the chaos-gate contract, here under pure load).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/serve.py --quick        # CI smoke
+    python benchmarks/serve.py --requests 2000 --rate 500 --backend auto
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HEADLINE_METRIC = "serve_verdicts_per_sec"
+
+
+def build_traffic(args) -> list:
+    """The request stream: a list of (step_index, snapshot) drawn from a
+    churn trace with temporal locality."""
+    from quorum_intersection_tpu.fbas import synth
+
+    if args.base == "stellar":
+        base = synth.stellar_like_fbas(
+            n_core_orgs=5, per_org=3, n_watchers=args.nodes,
+            seed=args.seed,
+        )
+    else:
+        base = synth.majority_fbas(args.nodes, prefix="SRV")
+    steps = max(args.requests // max(args.advance_every, 1), 1)
+    trace = synth.churn_trace(base, steps, seed=args.seed, max_diff=2)
+    traffic = []
+    for i in range(args.requests):
+        step = min(i // max(args.advance_every, 1), len(trace) - 1)
+        traffic.append((step, trace[step]))
+    return traffic
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--requests", type=int, default=300,
+                        help="total requests to submit (default 300)")
+    parser.add_argument("--rate", type=float, default=200.0,
+                        help="open-loop arrival rate, requests/sec "
+                             "(default 200)")
+    parser.add_argument("--advance-every", type=int, default=20,
+                        help="requests between churn-trace steps: higher = "
+                             "more cache hits (default 20)")
+    parser.add_argument("--nodes", type=int, default=9,
+                        help="base-topology size knob (majority n / stellar "
+                             "watcher count; default 9)")
+    parser.add_argument("--base", choices=("majority", "stellar"),
+                        default="majority")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--backend", default="python",
+                        help="serving backend (default python: the load "
+                             "numbers measure the SERVING layer, not engine "
+                             "latency; use auto for end-to-end rows)")
+    parser.add_argument("--deadline-s", type=float, default=None)
+    parser.add_argument("--queue-depth", type=int, default=None)
+    parser.add_argument("--batch-max", type=int, default=None)
+    parser.add_argument("--cache-max", type=int, default=None)
+    parser.add_argument("--journal", default=None,
+                        help="exercise the crash-only journal on this path")
+    parser.add_argument("--quick", action="store_true",
+                        help="CI smoke preset: 120 requests at 300/s")
+    parser.add_argument("--metrics-json", default=None, metavar="PATH")
+    parser.add_argument("--metrics-prom", default=None, metavar="PATH")
+    args = parser.parse_args(argv)
+    if args.quick:
+        args.requests, args.rate = 120, 300.0
+
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.serve import (
+        DeadlineExceeded,
+        Overloaded,
+        ServeEngine,
+        ServeError,
+        _percentile,
+    )
+    from quorum_intersection_tpu.utils import telemetry
+
+    record = telemetry.get_run_record()
+    if args.metrics_json:
+        record.add_sink(telemetry.JsonlSink(args.metrics_json))
+    if args.metrics_prom:
+        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+
+    traffic = build_traffic(args)
+
+    # Fault-free oracle chain, one solve per DISTINCT snapshot step: the
+    # parity bar every served verdict is checked against.
+    expected = {}
+    for step, snap in traffic:
+        if step not in expected:
+            expected[step] = solve(snap, backend="python").intersects
+
+    engine = ServeEngine(
+        backend=args.backend,
+        queue_depth=args.queue_depth,
+        batch_max=args.batch_max,
+        deadline_s=args.deadline_s,
+        cache_max=args.cache_max,
+        journal=args.journal,
+    )
+    engine.start()
+    tickets = []  # (step, ticket)
+    shed = 0
+    t0 = time.perf_counter()
+    with record.span("serve.bench", requests=args.requests, rate=args.rate):
+        for i, (step, snap) in enumerate(traffic):
+            target = t0 + i / args.rate
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                tickets.append((step, engine.submit(snap)))
+            except Overloaded:
+                shed += 1  # typed shed: the open-loop driver keeps going
+        engine.stop(drain=True, timeout=600.0)
+    wall_s = time.perf_counter() - t0
+
+    served = 0
+    deadline_expired = 0
+    errors = 0
+    mismatches = []
+    latencies_ms = []
+    for step, ticket in tickets:
+        try:
+            resp = ticket.result(timeout=60.0)
+        except DeadlineExceeded:
+            deadline_expired += 1
+            continue
+        except ServeError as exc:
+            errors += 1
+            print(f"typed error for step {step}: {exc}", file=sys.stderr)
+            continue
+        except TimeoutError:
+            # An unresolved ticket is the exact failure class this gate
+            # exists to catch: fall through to the lost accounting below
+            # (it is admitted - served - ... ), never a bare traceback.
+            print(f"SILENT DROP: step {step} reached no outcome after "
+                  f"60s", file=sys.stderr)
+            continue
+        served += 1
+        latencies_ms.append(resp.seconds * 1000.0)
+        if resp.intersects is not expected[step]:
+            mismatches.append(
+                f"step {step}: served {resp.intersects} != oracle "
+                f"{expected[step]}"
+            )
+
+    counters, gauges = record.snapshot()
+    hits = counters.get("serve.cache_hits", 0)
+    admitted = len(tickets)
+    latencies_ms.sort()
+
+    row = {
+        "metric": HEADLINE_METRIC,
+        "value": round(served / wall_s, 2) if wall_s > 0 else 0.0,
+        "serve_verdicts_per_sec": round(served / wall_s, 2) if wall_s else 0.0,
+        # Same nearest-rank estimator as the engine's serve.p50_ms/p99_ms
+        # gauges, so the bench rows and the live gauges stay comparable.
+        "serve_p50_ms": round(_percentile(latencies_ms, 50.0), 3),
+        "serve_p99_ms": round(_percentile(latencies_ms, 99.0), 3),
+        "serve_cache_hit_pct": round(100.0 * hits / admitted, 2) if admitted else 0.0,
+        "requests": args.requests,
+        "admitted": admitted,
+        "served": served,
+        "shed": shed,
+        "deadline_expired": deadline_expired,
+        "typed_errors": errors,
+        "coalesced": int(counters.get("serve.coalesced", 0)),
+        "cache_evictions": int(counters.get("serve.cache_evictions", 0)),
+        "distinct_topologies": len(expected),
+        "rate_per_sec": args.rate,
+        "wall_s": round(wall_s, 3),
+        "backend": args.backend,
+        "base": args.base,
+        "seed": args.seed,
+        "verdict_ok": not mismatches,
+        "device": os.environ.get("JAX_PLATFORMS", "ambient"),
+    }
+    for m in mismatches:
+        print(f"SERVE PARITY MISMATCH: {m}", file=sys.stderr)
+    # Accounting invariant: every admitted request reached exactly one
+    # typed outcome — a gap is a silent drop, the one failure shedding and
+    # deadlines exist to prevent.
+    lost = admitted - served - deadline_expired - errors
+    if lost:
+        print(f"SERVE DRIVER: {lost} request(s) reached no outcome "
+              f"(silent drop)", file=sys.stderr)
+    record.gauge("serve.bench_verdicts_per_sec", row["value"])
+    record.finish()
+    print(json.dumps(row), flush=True)
+    return 1 if (mismatches or lost) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -618,6 +618,7 @@ class AutoBackend:
         mesh: Optional[object] = None,
         race: bool = True,
         pack: Optional[bool] = None,
+        cancel: Optional[CancelToken] = None,
     ) -> None:
         # prefer_tpu (`--backend tpu`) is routing-neutral since the r3
         # on-chip crossover: large SCCs go to the host oracle everywhere
@@ -632,6 +633,23 @@ class AutoBackend:
         # boxes (the racing sweep competes for the oracle's CPU) and for
         # debugging — verdicts are identical either way.
         self.race = race
+        # External cooperative cancellation (ISSUE 8, the serving layer's
+        # deadline supervisor): a base.CancelToken threaded into every
+        # engine this router sequentially runs — the budgeted oracle's
+        # call-budget check, the sweep's window loop, the native search's
+        # poll.  A deadline-supervised solve runs the SEQUENTIAL chain:
+        # the racing orchestrator mints its own per-arm tokens (one-shot,
+        # unmergeable with an outer one), so an external token disables
+        # the race rather than silently not reaching one arm.  Verdicts
+        # are identical either way (--no-race contract).
+        self.cancel = cancel
+        if cancel is not None and race:
+            self.race = False
+            log.debug(
+                "auto: external cancel token supplied; racing orchestrator "
+                "disabled for this router (sequential chain, deadline-"
+                "cancellable)"
+            )
         # Lane packing for the batch entry (check_sccs): None (default)
         # engages only behind a MEASURED packed-vs-unpacked win on the live
         # device kind (calibration.pack_win_max_scc — the same recorded-
@@ -647,7 +665,8 @@ class AutoBackend:
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
         return TpuSweepBackend(
-            checkpoint=self.checkpoint, mesh=self.mesh, cancel=cancel
+            checkpoint=self.checkpoint, mesh=self.mesh,
+            cancel=cancel if cancel is not None else self.cancel,
         )
 
     def _cpu_oracle(
@@ -759,7 +778,7 @@ class AutoBackend:
     ) -> Optional[SccCheckResult]:
         """Sequential oracle-first attempt (``--no-race``): returns a
         result, or None meaning 'fall back to the sweep' (budget burned)."""
-        backend = self._cpu_oracle(budget_s=budget_s)
+        backend = self._cpu_oracle(budget_s=budget_s, cancel=self.cancel)
         try:
             log.debug(
                 "auto: oracle-first (%s) for |scc|=%d, budget ~%.1fs of calls",
@@ -1063,6 +1082,13 @@ class AutoBackend:
         # this problem's oracle budget ALREADY burned in the batch entry,
         # so the route skips straight to the post-burn engines instead of
         # re-burning the same budget.
+        if self.cancel is not None and self.cancel.cancelled:
+            # Pre-cancelled (a serving deadline expired before routing even
+            # started): abort before touching any engine — cancellation is
+            # an abort signal about scheduling, never a verdict.
+            raise SearchCancelled(
+                f"auto router cancelled before routing (|scc|={len(scc)})"
+            )
         with get_run_record().span(
             "route", scc=len(scc), race_enabled=self.race
         ) as route_span:
@@ -1392,7 +1418,13 @@ class AutoBackend:
                 "checkpoint not honored: |scc|=%d routed to a host oracle "
                 "(no progress will be recorded)", len(scc),
             )
-        backend = self._cpu_oracle()
+        # Deliberately NOT an unconditional cancel=self.cancel: with no
+        # external token this call stays zero-arg, the stable signature
+        # callers (and test spies) may replace _cpu_oracle with.
+        backend = (
+            self._cpu_oracle(cancel=self.cancel)
+            if self.cancel is not None else self._cpu_oracle()
+        )
         log.debug("auto: %s backend for |scc|=%d", backend.name, len(scc))
         get_run_record().event(
             "route.decision", engine=backend.name, scc=len(scc),

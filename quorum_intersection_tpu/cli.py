@@ -23,6 +23,11 @@ Superset flags (this framework only): ``--backend``, ``--dangling-policy``,
 orchestrator), ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
 profiler trace), ``--metrics-json``/``--metrics-prom`` (run-record telemetry
 sinks — docs/OBSERVABILITY.md).
+
+Subcommand (this framework only): ``serve`` — the long-lived
+snapshot-stream serving layer (``serve.py``, README §Serving): one JSON
+request per stdin line, one JSON response per stdout line, with admission
+control, deadlines, load shedding and a crash-only request journal.
 """
 
 from __future__ import annotations
@@ -151,8 +156,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     from quorum_intersection_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    arglist = sys.argv[1:] if argv is None else list(argv)
+    if arglist and arglist[0] == "serve":
+        # The long-lived serving layer (ISSUE 8): one JSON request per
+        # stdin line, one JSON response per stdout line.  Dispatched before
+        # the reference-compatible parser because the one-shot contract
+        # (stdin = ONE snapshot, exit code = verdict) does not apply to a
+        # stream — serve.py owns its own flags and exit semantics.
+        from quorum_intersection_tpu.serve import serve_main
+
+        return serve_main(arglist[1:])
     parser = build_parser()
-    args = parser.parse_args(argv)
+    args = parser.parse_args(arglist)
 
     if args.trace:
         set_trace(True)

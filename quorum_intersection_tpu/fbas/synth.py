@@ -12,6 +12,8 @@ broken twin differing by a single threshold.
 
 from __future__ import annotations
 
+import copy
+import json
 import random
 from typing import Dict, List, Optional
 
@@ -183,6 +185,68 @@ def benchmark_fbas(
         nodes.append(_node(f"NULLQ{z:04d}", f"z{z}", None))
     rng.shuffle(nodes)  # snapshot order is arbitrary; vertex 0 ≠ core
     return nodes
+
+
+def churn_trace(
+    base: List[Dict],
+    steps: int,
+    seed: int = 0,
+    *,
+    max_diff: int = 2,
+) -> List[List[Dict]]:
+    """Deterministic snapshot stream: ``steps + 1`` consecutive snapshots
+    starting at ``base``, each differing from its predecessor in at most
+    ``max_diff`` nodes' quorum sets (ROADMAP scenario-diversity item; the
+    serving layer's realistic traffic — ``benchmarks/serve.py``).
+
+    Per step the generator draws, per churned node, one of three bounded
+    mutations a live stellarbeat feed actually produces:
+
+    - **threshold wobble**: a top-level threshold moves ±1, clamped to
+      ``[1, members]`` — the most common real churn (validators tuning
+      safety margins);
+    - **validator swap**: one top-level validator reference is replaced by
+      another key drawn from the snapshot (trust-edge churn);
+    - **cosmetic rename**: the node's display name changes — a diff the
+      sanitized-SCC fingerprint (``serve.snapshot_fingerprint``) must
+      ignore, so caches stay hot across it.
+
+    Same ``(base, steps, seed)`` ⇒ byte-identical trace.  Nodes with null
+    quorum sets are never churned (there is nothing bounded to mutate).
+    Each snapshot is a deep copy: mutating one never aliases another.
+    """
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    rng = random.Random(seed)
+    trace = [copy.deepcopy(base)]
+    all_keys = [n.get("publicKey") for n in base if n.get("publicKey")]
+    for _ in range(steps):
+        snap = copy.deepcopy(trace[-1])
+        mutable = [
+            i for i, n in enumerate(snap)
+            if isinstance(n.get("quorumSet"), dict)
+            and n["quorumSet"].get("validators")
+        ]
+        for ix in (
+            rng.sample(mutable, min(max_diff, len(mutable))) if mutable else ()
+        ):
+            node = snap[ix]
+            q = node["quorumSet"]
+            kind = rng.choice(("threshold", "swap", "rename"))
+            if kind == "threshold":
+                lo, hi = 1, max(1, len(q["validators"]))
+                t = q.get("threshold", 1) + rng.choice((-1, 1))
+                q["threshold"] = min(max(t, lo), hi)
+            elif kind == "swap":
+                vix = rng.randrange(len(q["validators"]))
+                q["validators"][vix] = rng.choice(all_keys)
+            else:
+                node["name"] = f"{node.get('name', '')}~{rng.randrange(999)}"
+        trace.append(snap)
+    # Determinism belt-and-braces: the trace must be JSON-serializable as
+    # produced (the serving layer journals exactly these dicts).
+    json.dumps(trace[-1])
+    return trace
 
 
 def random_fbas(

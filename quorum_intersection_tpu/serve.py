@@ -1,0 +1,1509 @@
+"""qi-serve/1 — crash-only snapshot-stream serving layer (ISSUE 8 tentpole).
+
+The repo was shaped like a one-shot CLI: parse stdin, solve, print a
+boolean, exit.  The ROADMAP's production target is a long-lived service
+that ingests a stream of stellarbeat snapshots and answers verdict queries
+for many concurrent clients — and a service that runs for weeks must be
+robust before it is fast: the NP-hard solve (arXiv:1902.06493) means any
+individual request can blow any latency budget, so deadlines, backpressure
+and shedding are first-class semantics here, not afterthoughts.
+
+:class:`ServeEngine` is that layer, built on the primitives PRs 1-7 left:
+
+- **Admission queue, bounded** (``QI_SERVE_QUEUE_DEPTH``): compatible
+  requests accumulate and drain in batches through
+  :func:`pipeline.check_many` — which lane-packs sweep-sized problems into
+  full MXU tiles (ISSUE 5) — and a request arriving over-depth is shed
+  with a typed :class:`Overloaded`, never an unbounded queue.
+- **Per-request deadlines** (``QI_SERVE_DEADLINE_S``): wired into the
+  existing CancelToken lattice (the racing router's cancellation plumbing,
+  PR 1) — a deadline supervisor cancels an in-flight batch mid-window and
+  the expired request returns a typed :class:`DeadlineExceeded` carrying
+  its partial-coverage certificate (windows enumerated/cancelled before
+  the cancel landed), not a wedge.
+- **Verdict cache** keyed by the sanitized-SCC fingerprint
+  (:func:`snapshot_fingerprint`): the canonical graph structure — resolved
+  quorum sets in vertex order plus the SCC partition and the front-end
+  policy — so cosmetic snapshot churn (names, JSON formatting) still hits.
+  Single-flight: concurrent identical queries share one solve
+  (``serve.coalesced``).  Bounded (``QI_SERVE_CACHE_MAX``) with LRU
+  eviction counters.
+- **Crash-only request journal** (:class:`RequestJournal`,
+  ``QI_SERVE_JOURNAL``): accepted requests are journaled — fsync per
+  entry, the ``utils/checkpoint.py`` durability discipline — before
+  solving and marked ``done`` after, so ``kill -9`` + restart replays
+  in-flight work with zero lost and zero duplicated verdicts; corrupt or
+  foreign-fingerprint entries quarantine to ``<journal>.corrupt`` instead
+  of blocking startup.  ``/readyz`` (utils/metrics_server.py) reports 503
+  until replay completes.
+
+Every boundary declares a fault point (``serve.admit`` / ``serve.cache`` /
+``serve.journal`` / ``serve.drain`` / ``serve.respond`` —
+docs/ROBUSTNESS.md) and degrades instead of dying: a cache fault bypasses
+the cache, a journal fault serves un-journaled (loudly), a drain fault
+falls back to per-request solves, a respond fault turns into a typed error
+response — never a silent drop, never a flipped verdict
+(``tools/soak.py --serve --chaos`` is the gate).  Telemetry
+(``qi-telemetry/1``): ``serve.*`` spans/events/counters plus queue-depth,
+shed-state and p50/p99 latency gauges; served certificates carry a
+``provenance.serve`` stamp.
+
+CLI: ``python -m quorum_intersection_tpu serve`` (one JSON request per
+stdin line, one JSON response per stdout line — :func:`serve_main`);
+``benchmarks/serve.py`` is the open-loop load driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Deque, Dict, List, Optional, Tuple, Union
+
+from quorum_intersection_tpu.backends.base import (
+    CancelToken,
+    SearchBackend,
+    SearchCancelled,
+    get_backend,
+)
+from quorum_intersection_tpu.cert import CERT_SCHEMA
+from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
+from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.pipeline import SolveResult, check_many
+from quorum_intersection_tpu.utils.env import (
+    qi_env,
+    qi_env_float,
+    qi_env_int,
+)
+from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+log = get_logger("serve")
+
+# Deterministic-interleaving hook (tools/analyze/schedules.py, the same
+# mechanism as backends/auto.py's _race_sync): a no-op in production, the
+# schedule harness swaps in a SyncController to FORCE the admission/drain
+# orderings the wall clock almost never produces — coalesce-during-solve,
+# deadline-between-pop-and-solve, submit-racing-stop.
+_serve_sync: Callable[[str], None] = lambda point: None
+
+SERVE_SCHEMA = "qi-serve/1"
+JOURNAL_SCHEMA = "qi-serve-journal/1"
+
+# Latency window for the p50/p99 gauges: big enough to smooth scheduler
+# noise, small enough that the gauges track the CURRENT load shape (a
+# 10-minute-old latency spike must age out of a live /metrics scrape).
+LATENCY_WINDOW = 512
+
+# One deadline-cancelled batch requeues its surviving (un-expired)
+# requests for a fresh solve; past this many attempts a request returns a
+# typed error instead of cycling the queue forever.
+MAX_SOLVE_ATTEMPTS = 2
+
+
+# ---- typed request outcomes -------------------------------------------------
+
+
+class ServeError(RuntimeError):
+    """Base of the serving layer's typed request failures.
+
+    Typed (mirroring the ``FaultInjected`` family, docs/ROBUSTNESS.md): the
+    chaos contract is "a served verdict equals the fault-free chain or the
+    request fails LOUDLY with a typed error" — these classes are the typed
+    errors, and ``code`` is the machine-readable discriminator the CLI
+    emits in its JSONL error responses."""
+
+    code = "serve_error"
+
+
+class Overloaded(ServeError):
+    """Admission queue at its depth bound: the request was shed.
+
+    Load shedding is a *feature*: a bounded queue with typed rejections
+    keeps p99 latency honest under overload, where an unbounded queue
+    converts overload into unbounded latency for every client."""
+
+    code = "overloaded"
+
+    def __init__(self, depth: int, bound: int) -> None:
+        self.depth = depth
+        self.bound = bound
+        super().__init__(
+            f"admission queue full ({depth} >= bound {bound}); request shed"
+        )
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline budget expired before a verdict.
+
+    Carries the partial-coverage certificate (``cert``): a ``qi-cert/1``-
+    shaped block with ``verdict: null, partial: true`` and the window
+    coverage the cancelled search completed before the deadline supervisor
+    tripped the CancelToken — evidence of work done, never mistakable for
+    a verdict."""
+
+    code = "deadline_exceeded"
+
+    def __init__(self, request_id: str, deadline_s: float,
+                 cert: Optional[Dict[str, object]] = None) -> None:
+        self.request_id = request_id
+        self.deadline_s = deadline_s
+        self.cert = cert
+        super().__init__(
+            f"request {request_id} exceeded its {deadline_s:g}s deadline"
+        )
+
+
+class ServeClosed(ServeError):
+    """The engine is stopping and no longer admits requests."""
+
+    code = "closed"
+
+
+# ---- fingerprinting ---------------------------------------------------------
+
+
+def _qset_canonical(q: IndexedQSet) -> List[object]:
+    """Canonical nested form of one resolved quorum set (threshold, member
+    vertex indices, inner sets, dropped-dangling count) — exactly the
+    inputs the verdict and its certificate depend on."""
+    return [
+        q.threshold,
+        list(q.members),
+        [_qset_canonical(iq) for iq in q.inner],
+        q.n_dangling,
+    ]
+
+
+def snapshot_fingerprint(
+    graph: TrustGraph,
+    *,
+    scc_select: str = "quorum-bearing",
+    scope_to_scc: bool = False,
+) -> str:
+    """Sanitized-SCC fingerprint of one snapshot's verdict problem.
+
+    Hashes the canonical *sanitized* graph structure — per-vertex node id
+    + resolved quorum set in vertex order, the dangling policy the graph
+    was built under, the SCC partition, and the solve options — i.e.
+    everything the verdict AND its certificate depend on, and nothing
+    else: node *names*, JSON key order and formatting churn all hash
+    identically, so the overwhelmingly common unchanged-topology query is
+    a cache hit.  Vertex order is deliberately included: certificates
+    carry vertex indices (``q1_index``/``q2_index``), and two snapshots
+    must fingerprint equal only when their certs are interchangeable.
+    """
+    from quorum_intersection_tpu.fbas.graph import group_sccs, tarjan_scc
+
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    payload = {
+        "v": 1,
+        "dangling": graph.dangling,
+        "scc_select": scc_select,
+        "scope_to_scc": bool(scope_to_scc),
+        "nodes": [
+            [graph.node_ids[v], _qset_canonical(graph.qsets[v])]
+            for v in range(graph.n)
+        ],
+        "sccs": group_sccs(graph.n, comp, count),
+    }
+    return hashlib.sha256(
+        json.dumps(payload, separators=(",", ":")).encode()
+    ).hexdigest()[:32]
+
+
+# ---- responses and tickets --------------------------------------------------
+
+
+@dataclass
+class ServeResponse:
+    """One served verdict: the solve result plus serve-side provenance."""
+
+    request_id: str
+    intersects: bool
+    cert: Optional[Dict[str, object]]
+    stats: Dict[str, object]
+    cached: bool
+    seconds: float  # admission → delivery latency
+
+
+_Outcome = Tuple[str, object]  # ("ok", ServeResponse) | ("err", Exception)
+
+
+class Ticket:
+    """A client's handle on one submitted request (thread-safe)."""
+
+    def __init__(self, request_id: str, submitted_t: float,
+                 deadline_t: Optional[float]) -> None:
+        self.request_id = request_id
+        self.submitted_t = submitted_t
+        self.deadline_t = deadline_t  # absolute monotonic, None = no deadline
+        self._event = threading.Event()
+        self._outcome: Optional[_Outcome] = None
+        self._callbacks: List[Callable[["Ticket"], None]] = []
+        self._cb_lock = threading.Lock()
+
+    def _resolve(self, outcome: _Outcome) -> None:
+        """Deliver exactly once; later resolutions are ignored (a requeued
+        request that also expired must not flip its recorded outcome)."""
+        with self._cb_lock:
+            if self._outcome is not None:
+                return
+            self._outcome = outcome
+            # Set INSIDE the lock: add_done_callback's immediate-invoke
+            # path observes _outcome under this lock and may call
+            # result(timeout=0) from the callback — the event must already
+            # be set by then or a resolved ticket reads as timed out.
+            self._event.set()
+            callbacks = list(self._callbacks)
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception as exc:  # noqa: BLE001 — a client callback must not kill the drain
+                log.warning("ticket callback failed: %s", exc)
+
+    def add_done_callback(self, cb: Callable[["Ticket"], None]) -> None:
+        """Run ``cb(ticket)`` on delivery (immediately if already done) —
+        the CLI's streaming-output hook."""
+        with self._cb_lock:
+            if self._outcome is None:
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResponse:
+        """Block for the outcome; raises the typed error on failure."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished after {timeout}s"
+            )
+        assert self._outcome is not None
+        kind, value = self._outcome
+        if kind == "ok":
+            return value  # type: ignore[return-value]
+        raise value  # type: ignore[misc]
+
+
+@dataclass
+class _Entry:
+    """One solve unit: a fingerprint-distinct admitted request plus every
+    coalesced waiter sharing its verdict (single-flight)."""
+
+    request_id: str
+    fingerprint: str
+    fbas: Fbas
+    nodes: List[Dict[str, object]]
+    waiters: List[Ticket] = field(default_factory=list)
+    journaled: bool = False
+    replayed: bool = False
+    cache_bypass: bool = False
+    attempts: int = 0
+    done: bool = False
+    admitted_t: float = 0.0
+
+
+# ---- crash-only request journal --------------------------------------------
+
+
+class RequestJournal:
+    """Append-only JSONL request journal with the crash-only discipline.
+
+    Every append is flushed **and fsynced** before :meth:`append` returns
+    — the same durability bar as ``utils/checkpoint.py``'s
+    fsync-before-rename, adapted to an append-only log (there is no
+    rename per entry; the fsync is what makes "accepted" mean "survives a
+    power cut").  A ``kill -9`` can tear at most the final line, which
+    replay tolerates; any OSError on the write path downgrades to the
+    ``serve.journal_errors`` counter (the request proceeds un-journaled,
+    loudly) — a journal exists to rescue requests, never to reject them.
+    """
+
+    def __init__(self, path: Union[str, Path]) -> None:
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._fh: Optional[object] = None
+
+    def _append_line(self, payload: Dict[str, object]) -> bool:
+        """One durable append; False (never an exception) on failure."""
+        rec = get_run_record()
+        try:
+            fault_point("serve.journal")
+            with self._lock:
+                if self._fh is None:
+                    fresh = not self.path.exists()
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                    if fresh:
+                        self._fh.write(json.dumps({
+                            "kind": "meta", "schema": JOURNAL_SCHEMA,
+                            "pid": os.getpid(),
+                        }) + "\n")
+                self._fh.write(json.dumps(payload, default=str) + "\n")
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+        except (OSError, FaultInjected) as exc:
+            rec.add("serve.journal_errors")
+            rec.event("serve.journal_error", error=str(exc))
+            log.warning(
+                "request journal append failed (%s); request proceeds "
+                "UN-journaled — replay protection lost for it", exc,
+            )
+            return False
+        return True
+
+    def append_request(self, request_id: str, fingerprint: str,
+                       nodes: List[Dict[str, object]],
+                       deadline_s: Optional[float]) -> bool:
+        ok = self._append_line({
+            "kind": "req", "request_id": request_id,
+            "fingerprint": fingerprint, "deadline_s": deadline_s,
+            "nodes": nodes, "t_wall": round(time.time(), 3),
+        })
+        if ok:
+            get_run_record().add("serve.journal_entries")
+        return ok
+
+    def append_done(self, request_id: str, fingerprint: str,
+                    outcome: str, verdict: Optional[bool]) -> bool:
+        ok = self._append_line({
+            "kind": "done", "request_id": request_id,
+            "fingerprint": fingerprint, "outcome": outcome,
+            "verdict": verdict, "t_wall": round(time.time(), 3),
+        })
+        if ok:
+            get_run_record().add("serve.journal_done")
+        return ok
+
+    def scan(self) -> Tuple[List[Dict[str, object]], List[str], bool]:
+        """Read the journal: ``(entries, corrupt_lines, torn_tail)``.
+
+        A non-JSON **final** line is the expected ``kill -9`` artifact
+        (torn mid-append) and is reported separately; corrupt lines
+        anywhere else are returned for quarantine.  Never raises on
+        content — a journal must not block the startup it exists for.
+        """
+        try:
+            raw = self.path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            return [], [], False
+        lines = [ln for ln in raw.splitlines() if ln.strip()]
+        entries: List[Dict[str, object]] = []
+        corrupt: List[str] = []
+        torn_tail = False
+        for i, line in enumerate(lines):
+            try:
+                obj = json.loads(line)
+                if not isinstance(obj, dict) or "kind" not in obj:
+                    raise ValueError("not a journal entry object")
+            except (ValueError, json.JSONDecodeError):
+                if i == len(lines) - 1:
+                    torn_tail = True  # the one corruption a hard kill writes
+                else:
+                    corrupt.append(line)
+                continue
+            if obj.get("kind") != "meta":
+                entries.append(obj)
+        return entries, corrupt, torn_tail
+
+    def quarantine(self, lines: List[str], why: str) -> None:
+        """Append unusable journal lines to ``<journal>.corrupt`` —
+        evidence preserved for postmortems, startup never blocked."""
+        if not lines:
+            return
+        rec = get_run_record()
+        corrupt = self.path.with_name(self.path.name + ".corrupt")
+        try:
+            with open(corrupt, "a", encoding="utf-8") as fh:
+                for line in lines:
+                    fh.write(line.rstrip("\n") + "\n")
+        except OSError as exc:
+            log.warning("journal quarantine write failed (%s)", exc)
+        rec.add("serve.journal_quarantined", len(lines))
+        rec.event(
+            "serve.journal_quarantined", lines=len(lines), why=why,
+            quarantined_to=str(corrupt),
+        )
+        log.warning(
+            "%d corrupt journal line(s) quarantined to %s (%s)",
+            len(lines), corrupt, why,
+        )
+
+    def compact(self, keep: List[Dict[str, object]]) -> None:
+        """Rewrite the journal to ``meta + keep`` atomically (tmp + fsync +
+        rename + best-effort dir fsync): replayed/done pairs drop out so
+        the file stays bounded across restarts; still-pending entries
+        survive for the next replay.  Failure downgrades (the un-compacted
+        journal is larger, not wrong)."""
+        tmp = self.path.with_suffix(".tmp")
+        try:
+            with self._lock:
+                if self._fh is not None:
+                    try:
+                        self._fh.close()  # type: ignore[attr-defined]
+                    except OSError:
+                        pass
+                    self._fh = None
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(json.dumps({
+                        "kind": "meta", "schema": JOURNAL_SCHEMA,
+                        "pid": os.getpid(), "compacted": True,
+                    }) + "\n")
+                    for entry in keep:
+                        fh.write(json.dumps(entry, default=str) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, self.path)
+            try:
+                dir_fd = os.open(str(self.path.parent), os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except OSError:
+                pass  # best-effort, as in utils/checkpoint.py
+        except OSError as exc:
+            log.warning("journal compaction failed (%s); journal kept as-is", exc)
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()  # type: ignore[attr-defined]
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# ---- the engine -------------------------------------------------------------
+
+
+class ServeEngine:
+    """Long-lived snapshot-verdict service (see module docstring).
+
+    All requests of one engine share its front-end options (dangling
+    policy, SCC selection, scoping, backend), which is what makes queued
+    requests *compatible*: any subset of the queue can fuse into one
+    ``check_many`` batch.
+    """
+
+    def __init__(
+        self,
+        backend: Union[str, SearchBackend] = "auto",
+        *,
+        queue_depth: Optional[int] = None,
+        batch_max: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        cache_max: Optional[int] = None,
+        journal: Optional[Union[str, Path]] = None,
+        dangling: str = "strict",
+        scc_select: str = "quorum-bearing",
+        scope_to_scc: bool = False,
+        pack: Optional[bool] = None,
+    ) -> None:
+        self.backend = backend
+        self.queue_depth = (
+            queue_depth if queue_depth is not None
+            else max(qi_env_int("QI_SERVE_QUEUE_DEPTH", 64), 1)
+        )
+        self.batch_max = (
+            batch_max if batch_max is not None
+            else max(qi_env_int("QI_SERVE_BATCH_MAX", 8), 1)
+        )
+        self.deadline_s = (
+            deadline_s if deadline_s is not None
+            else qi_env_float("QI_SERVE_DEADLINE_S", 0.0)
+        )
+        self.cache_max = (
+            cache_max if cache_max is not None
+            else max(qi_env_int("QI_SERVE_CACHE_MAX", 1024), 1)
+        )
+        journal_path = journal if journal is not None else (
+            qi_env("QI_SERVE_JOURNAL") or None
+        )
+        self._journal = (
+            RequestJournal(journal_path) if journal_path else None
+        )
+        self.dangling = dangling
+        self.scc_select = scc_select
+        self.scope_to_scc = scope_to_scc
+        self.pack = pack
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: Deque[_Entry] = deque()
+        self._reserved = 0  # admission slots between depth check and enqueue
+        self._inflight: Dict[str, _Entry] = {}  # fingerprint → live entry
+        self._cache: "OrderedDict[str, SolveResult]" = OrderedDict()
+        self._latencies: Deque[float] = deque(maxlen=LATENCY_WINDOW)
+        self._closed = False
+        self._stopping = False
+        self._started = False
+        self._drain_thread: Optional[threading.Thread] = None
+        self._replay_report: Optional[Dict[str, object]] = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> Optional[Dict[str, object]]:
+        """Replay the journal (if any), then start the drain loop.
+
+        Returns the replay report (``None`` without a journal).  Until
+        replay completes the ``serve.replay_complete`` gauge is 0 and
+        ``/readyz`` answers 503 — a restarted instance must not take
+        traffic while its crashed predecessor's work is outstanding.
+        """
+        if self._started:
+            return self._replay_report
+        self._started = True
+        rec = get_run_record()
+        rec.gauge("serve.queue_depth", 0)
+        rec.gauge("serve.shed_state", 0)
+        if self._journal is not None:
+            rec.gauge("serve.replay_complete", 0)
+            self._replay_report = self._replay_journal()
+        rec.gauge("serve.replay_complete", 1)
+        # The drain loop arms a per-batch deadline CancelToken itself
+        # (_drain_batch) and stop() shuts the thread down; there is no
+        # outer token to forward.
+        # qi-lint: allow(cancel-token-plumbed) — drain arms its own per-batch token; stop() owns shutdown
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, name="qi-serve-drain", daemon=True,
+        )
+        self._drain_thread.start()
+        log.info(
+            "serve engine started (queue_depth=%d batch_max=%d "
+            "deadline_s=%g cache_max=%d journal=%s)",
+            self.queue_depth, self.batch_max, self.deadline_s,
+            self.cache_max,
+            self._journal.path if self._journal else "off",
+        )
+        return self._replay_report
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0) -> None:
+        """Close admission; optionally wait for the queue to drain.
+
+        ``timeout=None`` waits indefinitely for the drain thread — the
+        CLI's EOF path uses it, because "EOF drains and exits 0" must hold
+        even when the final solve is an NP-hard blowup that outlives any
+        fixed bound.  ``drain=False`` discards the queue, but every
+        discarded entry's waiters are resolved with a typed
+        :class:`ServeClosed` — a stop is never a silent drop (the soak's
+        "a ticket that never resolves" failure class)."""
+        dropped: List[_Entry] = []
+        with self._cond:
+            self._closed = True
+            if not drain:
+                dropped = list(self._queue)
+                self._queue.clear()
+            self._stopping = True
+            self._cond.notify_all()
+        for entry in dropped:
+            self._resolve_err(
+                entry,
+                ServeClosed("serve engine stopped before this request "
+                            "drained"),
+                outcome="error",
+            )
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=timeout)
+        if self._journal is not None:
+            self._journal.close()
+
+    # ---- admission -------------------------------------------------------
+
+    def submit(
+        self,
+        source: Union[str, bytes, List[Dict[str, object]], Fbas],
+        *,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit one snapshot-verdict request.
+
+        Raises typed :class:`ServeClosed` / :class:`Overloaded` (and
+        propagates an injected ``serve.admit`` fault) — admission is
+        synchronous backpressure, so a shed request costs its client one
+        exception, not a timeout.  Returns a :class:`Ticket` immediately;
+        a cache hit resolves it before this call returns.
+        """
+        rec = get_run_record()
+        fault_point("serve.admit")
+        request_id = request_id or f"req-{os.getpid()}-{id(object()):x}-{time.monotonic_ns():x}"
+        budget = deadline_s if deadline_s is not None else self.deadline_s
+        now = time.monotonic()
+        ticket = Ticket(
+            request_id, now,
+            deadline_t=(now + budget) if budget and budget > 0 else None,
+        )
+        fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+        nodes = _raw_nodes(source, fbas)
+        graph = build_graph(fbas, dangling=self.dangling)
+        fp = snapshot_fingerprint(
+            graph, scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+        )
+        rec.add("serve.requests")
+
+        # Cache probe (its own fault point: an injected cache failure
+        # bypasses the cache for this request and solves from scratch —
+        # never costs the verdict).
+        cache_bypass = False
+        hit: Optional[SolveResult] = None
+        try:
+            fault_point("serve.cache")
+        except (FaultInjected, OSError) as exc:
+            cache_bypass = True
+            rec.add("serve.cache_errors")
+            rec.event("serve.cache_error", error=str(exc), phase="lookup")
+        shed: Optional[Tuple[int, int]] = None
+        coalesced = False
+        closed = False
+        with self._lock:
+            if self._closed:
+                closed = True
+            elif not cache_bypass and fp in self._cache:
+                self._cache.move_to_end(fp)
+                hit = self._cache[fp]
+            elif fp in self._inflight and not self._inflight[fp].done:
+                self._inflight[fp].waiters.append(ticket)
+                coalesced = True
+            else:
+                depth = len(self._queue) + self._reserved
+                if depth >= self.queue_depth:
+                    shed = (depth, self.queue_depth)
+                else:
+                    self._reserved += 1
+        if closed:
+            rec.add("serve.errors")
+            raise ServeClosed("serve engine is closed to new requests")
+        if hit is not None:
+            rec.add("serve.cache_hits")
+            _serve_sync("admit.cache_hit")
+            # Deliberately NOT journaled: the journal protects requests
+            # that are accepted-but-unanswered (a ticket returned pending),
+            # where a kill strands a client mid-wait.  A cache hit resolves
+            # before submit() returns — the client holds the verdict the
+            # moment it holds the ticket — and an fsync per hit would put
+            # the durability tax on exactly the path the cache exists to
+            # make cheap.
+            self._resolve_ok(ticket, hit, fp, cached=True)
+            return ticket
+        if coalesced:
+            rec.add("serve.coalesced")
+            # A coalesced request is ACCEPTED: it must survive a hard kill
+            # like any queued request (the zero-lost contract), so it
+            # journals its own req entry and marks its own done on
+            # delivery.  The done-mark callback registers BEFORE the
+            # caller can attach response emission (add_done_callback runs
+            # callbacks in registration order, immediately if already
+            # resolved), preserving done-before-response durability.
+            if self._journal is not None and self._journal.append_request(
+                request_id, fp, nodes,
+                budget if budget and budget > 0 else None,
+            ):
+                journal = self._journal
+
+                def _mark_done(t: Ticket, _fp: str = fp) -> None:
+                    try:
+                        resp = t.result(timeout=0)
+                    except Exception:  # noqa: BLE001 — any failure outcome journals as error
+                        journal.append_done(t.request_id, _fp, "error", None)
+                        return
+                    journal.append_done(
+                        t.request_id, _fp, "verdict", bool(resp.intersects),
+                    )
+
+                ticket.add_done_callback(_mark_done)
+            _serve_sync("admit.coalesced")
+            return ticket
+        rec.add("serve.cache_misses")
+        if shed is not None:
+            rec.add("serve.shed")
+            # A shed is a DELIVERED typed failure: it counts toward the
+            # requests == verdicts + errors invariant like every other
+            # terminal outcome.
+            rec.add("serve.errors")
+            rec.gauge("serve.shed_state", 1)
+            rec.event("serve.shed", request_id=request_id,
+                      depth=shed[0], bound=shed[1])
+            raise Overloaded(*shed)
+
+        # Journal BEFORE the queue: an accepted request must survive a hard
+        # kill from this point on (the crash-only contract).
+        entry = _Entry(
+            request_id=request_id, fingerprint=fp, fbas=fbas, nodes=nodes,
+            waiters=[ticket], cache_bypass=cache_bypass, admitted_t=now,
+        )
+        if self._journal is not None:
+            entry.journaled = self._journal.append_request(
+                request_id, fp, nodes,
+                budget if budget and budget > 0 else None,
+            )
+        with self._cond:
+            self._reserved -= 1
+            if self._closed:
+                # stop() won the race between the depth check and this
+                # enqueue: the drain thread may already be gone, so an
+                # enqueue here would wedge the ticket forever.  Deliver the
+                # typed rejection instead (the journaled entry is balanced
+                # below so a restart does not replay a request its client
+                # already saw rejected).
+                closed = True
+            else:
+                self._queue.append(entry)
+                self._inflight[fp] = entry
+                depth = len(self._queue)
+                self._cond.notify()
+        if closed:
+            if self._journal is not None and entry.journaled:
+                self._journal.append_done(request_id, fp, "error", None)
+            rec.add("serve.errors")
+            raise ServeClosed("serve engine closed while admitting")
+        rec.gauge("serve.queue_depth", depth)
+        if depth < self.queue_depth:
+            rec.gauge("serve.shed_state", 0)
+        _serve_sync("admit.queued")
+        return ticket
+
+    # ---- drain loop ------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait(timeout=0.1)
+                if self._stopping and not self._queue:
+                    return
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(len(self._queue), self.batch_max))
+                ]
+                depth = len(self._queue)
+            rec = get_run_record()
+            rec.gauge("serve.queue_depth", depth)
+            if depth < self.queue_depth:
+                rec.gauge("serve.shed_state", 0)
+            # Held by the schedule harness to force coalesce-during-solve
+            # and deadline-between-pop-and-solve orderings; outside the
+            # engine lock, so a parked drain never blocks admission.
+            _serve_sync("drain.popped")
+            try:
+                self._drain_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — the drain loop must survive anything
+                # Whatever escaped _drain_batch's own handling becomes each
+                # waiter's (typed or not) outcome — never a dead loop with
+                # wedged clients.
+                log.warning("drain batch failed (%s); delivering error", exc)
+                for entry in batch:
+                    self._resolve_err(entry, exc, outcome="error")
+
+    def _make_backend(self, cancel: Optional[CancelToken]) -> SearchBackend:
+        """One backend per batch.  A string spec is constructed fresh with
+        the deadline token threaded in where the engine supports it; a
+        caller-supplied instance is used as-is (deadlines then enforce
+        only at queue boundaries)."""
+        if not isinstance(self.backend, str):
+            return self.backend
+        options: Dict[str, object] = {}
+        if cancel is not None and self.backend in (
+            "auto", "tpu", "python", "cpp", "tpu-sweep",
+        ):
+            options["cancel"] = cancel
+        if self.pack is not None and self.backend in ("auto", "tpu"):
+            options["pack"] = self.pack
+        return get_backend(self.backend, **options)
+
+    def _split_expired(
+        self, entry: _Entry, now: float
+    ) -> Tuple[List[Ticket], List[Ticket]]:
+        """Partition ``entry``'s waiters into (expired, alive) and retire
+        the entry when nothing stays alive — in ONE lock acquisition:
+        coalescers append to ``entry.waiters`` under the same lock, so
+        each waiter lands on exactly one side of the split and an emptied
+        entry can't absorb a waiter between the split and the retire (the
+        invariant documented at :meth:`_finish_entry_locked`)."""
+        with self._lock:
+            expired = [
+                t for t in entry.waiters
+                if t.deadline_t is not None and now >= t.deadline_t
+            ]
+            alive = [t for t in entry.waiters if t not in expired]
+            entry.waiters = alive
+            if not alive:
+                self._finish_entry_locked(entry)
+        return expired, alive
+
+    def _partition_expired(
+        self, entries: List[_Entry], now: float
+    ) -> List[_Entry]:
+        """Resolve every already-expired waiter with DeadlineExceeded;
+        return the entries that still have live waiters."""
+        live: List[_Entry] = []
+        for entry in entries:
+            expired, alive = self._split_expired(entry, now)
+            for t in expired:
+                self._resolve_deadline(entry, t, partial=None)
+            if alive:
+                live.append(entry)
+            elif self._journal is not None and entry.journaled:
+                self._journal.append_done(
+                    entry.request_id, entry.fingerprint,
+                    "error", None,
+                )
+        return live
+
+    def _drain_batch(self, batch: List[_Entry]) -> None:
+        rec = get_run_record()
+        per_request = False
+        try:
+            fault_point("serve.drain")
+        except (FaultInjected, OSError) as exc:
+            # Degrade, don't die: the batched path is an optimization; the
+            # per-request path answers the same questions one at a time.
+            per_request = True
+            rec.add("serve.drain_faults")
+            rec.event("serve.drain_degraded", error=str(exc))
+        live = self._partition_expired(batch, time.monotonic())
+        if not live:
+            return
+        deadlines = [
+            t.deadline_t for e in live for t in e.waiters
+            if t.deadline_t is not None
+        ]
+        deadline_cancel = CancelToken() if deadlines else None
+        timer: Optional[threading.Timer] = None
+        counters0, _ = rec.snapshot()
+        with rec.span(
+            "serve.batch", requests=len(live),
+            waiters=sum(len(e.waiters) for e in live),
+            per_request=per_request,
+        ):
+            try:
+                if deadline_cancel is not None:
+                    # qi-lint: allow(cancel-token-plumbed) — this Timer IS
+                    # the deadline supervisor: its whole job is to trip the
+                    # batch's CancelToken; the finally below disarms it.
+                    timer = threading.Timer(
+                        max(min(deadlines) - time.monotonic(), 0.001),
+                        deadline_cancel.cancel,
+                    )
+                    timer.daemon = True
+                    timer.start()
+                if per_request:
+                    self._solve_per_request(live, deadline_cancel, counters0)
+                else:
+                    self._solve_batch(live, deadline_cancel, counters0)
+            finally:
+                if timer is not None:
+                    timer.cancel()
+
+    def _solve_batch(
+        self,
+        live: List[_Entry],
+        cancel: Optional[CancelToken],
+        counters0: Dict[str, float],
+    ) -> None:
+        backend = self._make_backend(cancel)
+        try:
+            results = check_many(
+                [e.fbas for e in live], backend=backend,
+                dangling=self.dangling, scc_select=self.scc_select,
+                scope_to_scc=self.scope_to_scc, pack=self.pack,
+            )
+        except SearchCancelled:
+            self._after_deadline_cancel(live, counters0)
+            return
+        except Exception as exc:  # noqa: BLE001 — degrade to per-request, never wedge the batch
+            get_run_record().add("serve.drain_errors")
+            log.info(
+                "batched drain failed (%s: %s); degrading to per-request "
+                "solves", type(exc).__name__, exc,
+            )
+            self._solve_per_request(live, cancel, counters0)
+            return
+        for entry, res in zip(live, results):
+            self._deliver_ok(entry, res)
+
+    def _solve_per_request(
+        self,
+        live: List[_Entry],
+        cancel: Optional[CancelToken],
+        counters0: Dict[str, float],
+    ) -> None:
+        for ix, entry in enumerate(live):
+            if cancel is not None and cancel.cancelled:
+                self._after_deadline_cancel(live[ix:], counters0)
+                return
+            backend = self._make_backend(cancel)
+            try:
+                results = check_many(
+                    [entry.fbas], backend=backend, dangling=self.dangling,
+                    scc_select=self.scc_select,
+                    scope_to_scc=self.scope_to_scc, pack=self.pack,
+                )
+            except SearchCancelled:
+                self._after_deadline_cancel(live[ix:], counters0)
+                return
+            except Exception as exc:  # noqa: BLE001 — one bad request must not starve the rest
+                get_run_record().add("serve.drain_errors")
+                self._resolve_err(entry, exc, outcome="error")
+                continue
+            self._deliver_ok(entry, results[0])
+
+    def _after_deadline_cancel(
+        self, entries: List[_Entry], counters0: Dict[str, float]
+    ) -> None:
+        """The deadline supervisor tripped the CancelToken mid-solve:
+        expired waiters get DeadlineExceeded with the partial-coverage
+        certificate; survivors requeue for a fresh solve (bounded by
+        MAX_SOLVE_ATTEMPTS)."""
+        rec = get_run_record()
+        counters1, _ = rec.snapshot()
+        partial = {
+            "schema": CERT_SCHEMA,
+            "verdict": None,
+            "partial": True,
+            "coverage": {
+                # Batch-level attribution, like batched certs' shared event
+                # slice: the cancelled solve's window accounting cannot be
+                # split per fused lane.
+                "batch_level": True,
+                "windows_enumerated": int(
+                    counters1.get("cert.windows_enumerated", 0)
+                    - counters0.get("cert.windows_enumerated", 0)
+                ),
+                "windows_cancelled": int(
+                    counters1.get("cert.windows_cancelled", 0)
+                    - counters0.get("cert.windows_cancelled", 0)
+                ),
+            },
+            "provenance": {"trace_id": rec.trace_id},
+        }
+        now = time.monotonic()
+        requeue: List[_Entry] = []
+        for entry in entries:
+            expired, alive = self._split_expired(entry, now)
+            for t in expired:
+                self._resolve_deadline(entry, t, partial=partial)
+            if not alive:
+                if self._journal is not None and entry.journaled:
+                    self._journal.append_done(
+                        entry.request_id, entry.fingerprint, "error", None,
+                    )
+                continue
+            entry.attempts += 1
+            if entry.attempts >= MAX_SOLVE_ATTEMPTS:
+                self._resolve_err(
+                    entry,
+                    ServeError(
+                        f"request {entry.request_id} cancelled "
+                        f"{entry.attempts} times by co-batched deadlines"
+                    ),
+                    outcome="error",
+                )
+                continue
+            requeue.append(entry)
+        if requeue:
+            rec.add("serve.requeues", len(requeue))
+            with self._cond:
+                for entry in reversed(requeue):
+                    self._queue.appendleft(entry)
+                self._cond.notify()
+
+    # ---- delivery --------------------------------------------------------
+
+    def _finish_entry_locked(self, entry: _Entry) -> None:
+        """Retire ``entry`` from single-flight.  Caller holds ``_lock`` —
+        and MUST snapshot ``entry.waiters`` in the SAME lock acquisition:
+        a submit that coalesces between a waiter snapshot and this retire
+        would be appended to a list nobody will ever resolve (a silent
+        drop — the exact bug the serve chaos soak caught under a
+        ``serve.cache`` fault, where the cache can't mask the window)."""
+        entry.done = True
+        if self._inflight.get(entry.fingerprint) is entry:
+            del self._inflight[entry.fingerprint]
+
+    def _deliver_ok(self, entry: _Entry, res: SolveResult) -> None:
+        """One solved entry: cache, journal-done, respond to every waiter."""
+        rec = get_run_record()
+        evicted = 0
+        if not entry.cache_bypass:
+            try:
+                fault_point("serve.cache")
+                with self._lock:
+                    self._cache[entry.fingerprint] = res
+                    self._cache.move_to_end(entry.fingerprint)
+                    while len(self._cache) > self.cache_max:
+                        self._cache.popitem(last=False)
+                        evicted += 1
+            except (FaultInjected, OSError) as exc:
+                rec.add("serve.cache_errors")
+                rec.event("serve.cache_error", error=str(exc), phase="insert")
+        if evicted:
+            rec.add("serve.cache_evictions", evicted)
+        with self._lock:
+            cache_size = len(self._cache)
+            # Atomic with the retire: a coalescer lands either in this
+            # snapshot (resolved below) or after the retire (fresh entry /
+            # cache hit) — never in a gap between the two.
+            waiters = list(entry.waiters)
+            self._finish_entry_locked(entry)
+        rec.gauge("serve.cache_size", cache_size)
+        if self._journal is not None and entry.journaled:
+            self._journal.append_done(
+                entry.request_id, entry.fingerprint, "verdict",
+                bool(res.intersects),
+            )
+        # Deadline enforcement at delivery: a waiter that coalesced onto
+        # this entry AFTER the batch's deadline supervisor was armed was
+        # never supervised — its expiry must still be honored here, or a
+        # late coalescer silently outlives its budget.  (The verdict is
+        # cached above, so the typed error costs one retry, not a solve.)
+        now = time.monotonic()
+        for ticket in waiters:
+            if ticket.deadline_t is not None and now >= ticket.deadline_t:
+                self._resolve_deadline(entry, ticket, partial=None)
+            else:
+                self._resolve_ok(ticket, res, entry.fingerprint,
+                                 cached=False, replayed=entry.replayed)
+        _serve_sync("drain.delivered")
+
+    def _resolve_ok(
+        self,
+        ticket: Ticket,
+        res: SolveResult,
+        fingerprint: str,
+        *,
+        cached: bool,
+        replayed: bool = False,
+    ) -> None:
+        rec = get_run_record()
+        seconds = time.monotonic() - ticket.submitted_t
+        cert = res.cert
+        if cert is not None:
+            # Per-delivery copy: two waiters (or a later cache hit) each
+            # get their own serve stamp without mutating the shared cert.
+            cert = dict(cert)
+            prov = dict(cert.get("provenance") or {})
+            prov["serve"] = {
+                "schema": SERVE_SCHEMA,
+                "request_id": ticket.request_id,
+                "fingerprint": fingerprint,
+                "cached": cached,
+                "replayed": replayed,
+                "journaled": self._journal is not None,
+                "latency_s": round(seconds, 6),
+            }
+            cert["provenance"] = prov
+        response = ServeResponse(
+            request_id=ticket.request_id,
+            intersects=bool(res.intersects),
+            cert=cert,
+            stats=dict(res.stats),
+            cached=cached,
+            seconds=seconds,
+        )
+        outcome_err: Optional[BaseException] = None
+        try:
+            fault_point("serve.respond")
+        except (FaultInjected, OSError) as exc:
+            # The verdict exists (cached + journaled); this CLIENT's copy
+            # failed to deliver — a typed error, never a silent drop, and a
+            # retry of the same snapshot is a cache hit.
+            rec.add("serve.respond_errors")
+            rec.event(
+                "serve.respond_error", request_id=ticket.request_id,
+                error=str(exc),
+            )
+            outcome_err = exc
+        if outcome_err is not None:
+            rec.add("serve.errors")
+            ticket._resolve(("err", outcome_err))
+            return
+        rec.add("serve.verdicts")
+        self._note_latency(seconds)
+        ticket._resolve(("ok", response))
+
+    def _resolve_deadline(
+        self, entry: _Entry, ticket: Ticket,
+        partial: Optional[Dict[str, object]],
+    ) -> None:
+        rec = get_run_record()
+        budget = (
+            (ticket.deadline_t - ticket.submitted_t)
+            if ticket.deadline_t is not None else 0.0
+        )
+        cert = None
+        if partial is not None:
+            cert = dict(partial)
+            prov = dict(cert.get("provenance") or {})
+            prov["serve"] = {
+                "schema": SERVE_SCHEMA,
+                "request_id": ticket.request_id,
+                "fingerprint": entry.fingerprint,
+                "deadline_s": round(budget, 6),
+            }
+            cert["provenance"] = prov
+        rec.add("serve.deadline_expired")
+        rec.add("serve.errors")
+        rec.event(
+            "serve.deadline", request_id=ticket.request_id,
+            deadline_s=round(budget, 6),
+            mid_solve=partial is not None,
+        )
+        ticket._resolve(("err", DeadlineExceeded(
+            ticket.request_id, budget, cert=cert,
+        )))
+
+    def _resolve_err(
+        self, entry: _Entry, exc: BaseException, *, outcome: str
+    ) -> None:
+        rec = get_run_record()
+        with self._lock:
+            waiters = list(entry.waiters)
+            self._finish_entry_locked(entry)
+        if self._journal is not None and entry.journaled:
+            self._journal.append_done(
+                entry.request_id, entry.fingerprint, outcome, None,
+            )
+        rec.add("serve.errors", len(waiters))
+        for ticket in waiters:
+            ticket._resolve(("err", exc))
+
+    def _note_latency(self, seconds: float) -> None:
+        # Snapshot under the lock, sort outside it: the O(W log W) sort
+        # must not serialize against admission on the hot delivery path.
+        with self._lock:
+            self._latencies.append(seconds * 1000.0)
+            samples = list(self._latencies)
+        samples.sort()
+        rec = get_run_record()
+        rec.gauge("serve.p50_ms", round(_percentile(samples, 50.0), 3))
+        rec.gauge("serve.p99_ms", round(_percentile(samples, 99.0), 3))
+
+    # ---- journal replay --------------------------------------------------
+
+    def _replay_journal(self) -> Dict[str, object]:
+        """Crash-only restart: re-solve every journaled request that never
+        reached ``done`` — zero lost (every ``req`` reaches an outcome),
+        zero duplicated (a ``done`` entry is final; replay skips it)."""
+        assert self._journal is not None
+        rec = get_run_record()
+        entries, corrupt, torn_tail = self._journal.scan()
+        if corrupt:
+            self._journal.quarantine(corrupt, "unparseable journal line")
+        if torn_tail:
+            rec.add("serve.journal_torn_tail")
+            log.info(
+                "journal tail torn (expected after a hard kill mid-append); "
+                "final partial line ignored"
+            )
+        done_ids = {
+            e.get("request_id") for e in entries if e.get("kind") == "done"
+        }
+        pending: List[Dict[str, object]] = []
+        foreign: List[str] = []
+        for e in entries:
+            if e.get("kind") != "req" or e.get("request_id") in done_ids:
+                continue
+            nodes = e.get("nodes")
+            try:
+                if not isinstance(nodes, list):
+                    raise ValueError(
+                        "journaled nodes payload is not a node array"
+                    )
+                fbas = parse_fbas(nodes)
+                graph = build_graph(fbas, dangling=self.dangling)
+                fp = snapshot_fingerprint(
+                    graph, scc_select=self.scc_select,
+                    scope_to_scc=self.scope_to_scc,
+                )
+            except (ValueError, TypeError, KeyError, AttributeError) as exc:
+                foreign.append(json.dumps(e, default=str))
+                log.warning(
+                    "journaled request %s unparseable on replay (%s); "
+                    "quarantined", e.get("request_id"), exc,
+                )
+                continue
+            if fp != e.get("fingerprint"):
+                # Foreign fingerprint: the entry's recorded identity does
+                # not match its own payload (bit rot, a hand-edited file, a
+                # journal from a different engine configuration) — replaying
+                # it could serve a verdict under the wrong cache key.
+                foreign.append(json.dumps(e, default=str))
+                log.warning(
+                    "journaled request %s has a foreign fingerprint "
+                    "(recorded %s != recomputed %s); quarantined",
+                    e.get("request_id"), e.get("fingerprint"), fp,
+                )
+                continue
+            pending.append({"entry": e, "fbas": fbas, "fingerprint": fp})
+        if foreign:
+            self._journal.quarantine(foreign, "foreign fingerprint / payload")
+        report: Dict[str, object] = {
+            "schema": SERVE_SCHEMA,
+            "journal": str(self._journal.path),
+            "entries": len(entries),
+            "already_done": len([
+                e for e in entries
+                if e.get("kind") == "req" and e.get("request_id") in done_ids
+            ]),
+            "pending": len(pending),
+            "quarantined": len(corrupt) + len(foreign),
+            "torn_tail": torn_tail,
+            "verdicts": {},
+            "errors": {},
+        }
+        rec.event(
+            "serve.replay_started", pending=len(pending),
+            already_done=report["already_done"],
+            quarantined=report["quarantined"],
+        )
+        still_pending: List[Dict[str, object]] = []
+        with rec.span("serve.replay", pending=len(pending)):
+            for i in range(0, len(pending), self.batch_max):
+                chunk = pending[i:i + self.batch_max]
+                try:
+                    results = check_many(
+                        [p["fbas"] for p in chunk],
+                        backend=self._make_backend(None),
+                        dangling=self.dangling, scc_select=self.scc_select,
+                        scope_to_scc=self.scope_to_scc, pack=self.pack,
+                    )
+                except Exception as exc:  # noqa: BLE001 — replay must not block startup
+                    for p in chunk:
+                        rid = str(p["entry"].get("request_id"))
+                        report["errors"][rid] = (  # type: ignore[index]
+                            f"{type(exc).__name__}: {exc}"
+                        )
+                        still_pending.append(p["entry"])  # type: ignore[arg-type]
+                    rec.add("serve.replay_errors")
+                    continue
+                for p, res in zip(chunk, results):
+                    rid = str(p["entry"].get("request_id"))
+                    fp = str(p["fingerprint"])
+                    with self._lock:
+                        self._cache[fp] = res
+                        self._cache.move_to_end(fp)
+                        while len(self._cache) > self.cache_max:
+                            self._cache.popitem(last=False)
+                    self._journal.append_done(
+                        rid, fp, "verdict", bool(res.intersects),
+                    )
+                    rec.add("serve.journal_replayed")
+                    report["verdicts"][rid] = bool(  # type: ignore[index]
+                        res.intersects
+                    )
+        # Compact: resolved pairs drop out, unresolved req entries survive
+        # for the next restart's replay.
+        self._journal.compact(still_pending)
+        rec.event(
+            "serve.replay_done", replayed=len(report["verdicts"]),  # type: ignore[arg-type]
+            errors=len(report["errors"]),  # type: ignore[arg-type]
+        )
+        log.info(
+            "journal replay complete: %d replayed, %d already done, %d "
+            "quarantined", len(report["verdicts"]),  # type: ignore[arg-type]
+            report["already_done"], report["quarantined"],
+        )
+        return report
+
+
+def _percentile(sorted_samples: List[float], pct: float) -> float:
+    """Nearest-rank percentile of an ascending sample list (0 if empty):
+    ``ceil(pct/100 * N)`` — a true ceiling, because ``round(x + 0.5)``
+    banker's-rounds exact-integer ranks one slot too high (p99 of exactly
+    100 samples would report the maximum)."""
+    if not sorted_samples:
+        return 0.0
+    rank = max(math.ceil(pct / 100.0 * len(sorted_samples)) - 1, 0)
+    return sorted_samples[min(rank, len(sorted_samples) - 1)]
+
+
+def _qset_raw(q) -> Optional[Dict[str, object]]:
+    """Stellarbeat-shaped dict of one parsed QSet (``None`` for the
+    never-satisfiable null qset) — the inverse of ``schema._parse_qset``."""
+    if q is None or q.threshold is None:
+        return None
+    return {
+        "threshold": q.threshold,
+        "validators": list(q.validators),
+        "innerQuorumSets": [_qset_raw(iq) for iq in q.inner],
+    }
+
+
+def _raw_nodes(
+    source: Union[str, bytes, List[Dict[str, object]], Fbas],
+    fbas: Fbas,
+) -> List[Dict[str, object]]:
+    """The raw node list to journal for ``source`` (re-parsed on replay)."""
+    if isinstance(source, list):
+        return source
+    if isinstance(source, (str, bytes)):
+        # parse_fbas already accepted this source, so its top level is a
+        # JSON array (anything else raised before we got here).
+        data = json.loads(source)
+        if isinstance(data, list):
+            return data
+    # A pre-parsed Fbas: rebuild raw dicts from the parsed nodes —
+    # ``parse_fbas(_raw_nodes(...))`` round-trips to the same graph, which
+    # is all replay needs.
+    return [
+        {
+            "publicKey": node.public_key,
+            "name": node.name,
+            "quorumSet": _qset_raw(node.qset),
+        }
+        for node in fbas
+    ]
+
+
+# ---- CLI subcommand ---------------------------------------------------------
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m quorum_intersection_tpu serve",
+        description=(
+            "Long-lived snapshot-verdict service: one JSON request per "
+            "stdin line (a raw stellarbeat node array, or "
+            '{"request_id": ..., "nodes": [...]}), one JSON response per '
+            "stdout line in completion order.  EOF drains the queue and "
+            "exits 0."
+        ),
+    )
+    p.add_argument("--journal", metavar="PATH", default=None,
+                   help="crash-only request journal (env twin: "
+                        "QI_SERVE_JOURNAL): accepted requests are "
+                        "journaled before solving; a hard kill + restart "
+                        "replays unfinished work")
+    p.add_argument("--deadline-s", type=float, default=None, metavar="F",
+                   help="per-request deadline budget in seconds (env twin: "
+                        "QI_SERVE_DEADLINE_S; 0 = none)")
+    p.add_argument("--queue-depth", type=int, default=None, metavar="N",
+                   help="admission-queue bound; over-depth requests are "
+                        "shed with a typed 'overloaded' error (env twin: "
+                        "QI_SERVE_QUEUE_DEPTH)")
+    p.add_argument("--batch-max", type=int, default=None, metavar="N",
+                   help="most requests one drain cycle batches into "
+                        "pipeline.check_many (env twin: QI_SERVE_BATCH_MAX)")
+    p.add_argument("--cache-max", type=int, default=None, metavar="N",
+                   help="verdict-cache capacity (env twin: "
+                        "QI_SERVE_CACHE_MAX)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep",
+                            "tpu-frontier"],
+                   help="search backend for served solves (default auto)")
+    p.add_argument("--dangling-policy", default="strict",
+                   choices=["strict", "alias0"],
+                   help="unknown validator refs (default strict)")
+    p.add_argument("--scc-select", default="quorum-bearing",
+                   choices=["quorum-bearing", "front"],
+                   help="which SCC to search (default quorum-bearing)")
+    p.add_argument("--scope-scc", action="store_true",
+                   help="scope availability to the searched SCC")
+    p.add_argument("--replay-only", action="store_true",
+                   help="replay the journal, print the report, exit "
+                        "(restart-recovery probe; no requests accepted)")
+    p.add_argument("--metrics-json", metavar="PATH", default=None,
+                   help="stream qi-telemetry/1 JSONL to PATH")
+    p.add_argument("--metrics-prom", metavar="PATH", default=None,
+                   help="write final counters/gauges to PATH "
+                        "(Prometheus textfile)")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    """The ``serve`` subcommand body (dispatched from cli.py)."""
+    from quorum_intersection_tpu.utils import telemetry
+
+    args = build_serve_parser().parse_args(argv)
+    record = telemetry.get_run_record()
+    if args.metrics_json:
+        record.add_sink(telemetry.JsonlSink(args.metrics_json))
+    if args.metrics_prom:
+        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+    engine = ServeEngine(
+        backend=args.backend,
+        queue_depth=args.queue_depth,
+        batch_max=args.batch_max,
+        deadline_s=args.deadline_s,
+        cache_max=args.cache_max,
+        journal=args.journal,
+        dangling=args.dangling_policy,
+        scc_select=args.scc_select,
+        scope_to_scc=args.scope_scc,
+    )
+    out_lock = threading.Lock()
+
+    def emit(obj: Dict[str, object]) -> None:
+        with out_lock:
+            sys.stdout.write(json.dumps(obj, default=str) + "\n")
+            sys.stdout.flush()
+
+    def on_done(ticket: Ticket) -> None:
+        try:
+            resp = ticket.result(timeout=0)
+        except ServeError as exc:
+            emit({"request_id": ticket.request_id,
+                  "error": {"code": exc.code, "message": str(exc)}})
+            return
+        except Exception as exc:  # noqa: BLE001 — an untyped failure still gets a response line
+            emit({"request_id": ticket.request_id,
+                  "error": {"code": "internal", "message": str(exc)}})
+            return
+        emit({"request_id": resp.request_id,
+              "verdict": resp.intersects, "cached": resp.cached,
+              "seconds": round(resp.seconds, 6)})
+
+    try:
+        report = engine.start()
+        if report is not None:
+            emit({"kind": "replay", **report})
+        if args.replay_only:
+            return 0
+        for n, line in enumerate(sys.stdin):
+            line = line.strip()
+            if not line:
+                continue
+            request_id: Optional[str] = None
+            try:
+                obj = json.loads(line)
+                nodes = obj
+                if isinstance(obj, dict):
+                    request_id = obj.get("request_id")
+                    nodes = obj.get("nodes")
+                if not isinstance(nodes, list):
+                    raise ValueError("expected a node array or "
+                                     '{"request_id", "nodes"}')
+                ticket = engine.submit(nodes, request_id=request_id)
+            except ServeError as exc:
+                emit({"request_id": request_id or f"line-{n + 1}",
+                      "error": {"code": exc.code, "message": str(exc)}})
+                continue
+            except (ValueError, FaultInjected) as exc:
+                emit({"request_id": request_id or f"line-{n + 1}",
+                      "error": {"code": "invalid", "message": str(exc)}})
+                continue
+            ticket.add_done_callback(on_done)
+        # No drain bound at EOF: every accepted request gets its response
+        # line before exit, however long its solve runs (deadlines, not
+        # timeouts, are the latency control here).
+        engine.stop(drain=True, timeout=None)
+        return 0
+    finally:
+        engine.stop(drain=False, timeout=5.0)
+        record.finish()

@@ -160,8 +160,45 @@ QI_METRICS_PORT = _declare(
     "QI_METRICS_PORT", "0",
     "TCP port of the live observability endpoint (127.0.0.1): /healthz "
     "serves ladder rung, quarantine state and in-flight packs as JSON, "
-    "/metrics serves the Prometheus encoding of the run record "
-    "(utils/metrics_server.py).  0 (default): no server.",
+    "/readyz serves the serving layer's admission picture (503 while "
+    "journal replay is in progress), /metrics serves the Prometheus "
+    "encoding of the run record (utils/metrics_server.py).  0 (default): "
+    "no server.",
+)
+QI_SERVE_DEADLINE_S = _declare(
+    "QI_SERVE_DEADLINE_S", "0",
+    "Default per-request deadline budget in seconds for the serving layer "
+    "(serve.py): past it an in-flight solve is cancelled through the "
+    "CancelToken lattice and the request returns a typed DeadlineExceeded "
+    "with its partial-coverage certificate.  0 (default): no deadline.",
+)
+QI_SERVE_QUEUE_DEPTH = _declare(
+    "QI_SERVE_QUEUE_DEPTH", "64",
+    "Admission-queue depth bound of the serving layer (serve.py): a "
+    "request arriving with this many solve units already queued is shed "
+    "with a typed Overloaded rejection instead of growing the queue "
+    "without bound.",
+)
+QI_SERVE_BATCH_MAX = _declare(
+    "QI_SERVE_BATCH_MAX", "8",
+    "Most solve units one serving drain cycle hands to "
+    "pipeline.check_many at once (serve.py): queued compatible requests "
+    "accumulate into one batched backend call (which lane-packs "
+    "sweep-sized problems together).",
+)
+QI_SERVE_CACHE_MAX = _declare(
+    "QI_SERVE_CACHE_MAX", "1024",
+    "Verdict-cache capacity of the serving layer (serve.py): distinct "
+    "snapshot fingerprints retained before LRU eviction "
+    "(serve.cache_evictions counter).",
+)
+QI_SERVE_JOURNAL = _declare(
+    "QI_SERVE_JOURNAL", "",
+    "Path of the serving layer's crash-only request journal (serve.py): "
+    "accepted requests are journaled (fsync per entry) before solving and "
+    "marked done after, so a hard kill + restart replays in-flight work "
+    "with no lost or duplicated verdicts.  Empty (default): journaling "
+    "off (the CLI serve subcommand's --journal flag sets it explicitly).",
 )
 
 

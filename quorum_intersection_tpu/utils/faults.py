@@ -186,6 +186,41 @@ CERT_WRITE = _declare(
     "certificate is evidence about a verdict, never a precondition for "
     "one.",
 )
+SERVE_ADMIT = _declare(
+    "serve.admit",
+    "Request admission into the serving layer (serve.py ServeEngine."
+    "submit): error simulates a broken admission path — the request is "
+    "rejected with a typed error, never silently dropped; the queue and "
+    "every already-admitted request are unaffected.",
+)
+SERVE_CACHE = _declare(
+    "serve.cache",
+    "Verdict-cache lookup/insert (serve.py): error simulates a corrupted "
+    "cache — the engine bypasses the cache for that request "
+    "(serve.cache_errors counter) and solves from scratch; a cache is an "
+    "optimization, never a precondition for a verdict.",
+)
+SERVE_JOURNAL = _declare(
+    "serve.journal",
+    "Request-journal append (serve.py RequestJournal): oserror simulates "
+    "a full disk — the write downgrades to the serve.journal_errors "
+    "counter and the request proceeds UN-journaled (loud: replay "
+    "protection is lost for it, the verdict is not).",
+)
+SERVE_DRAIN = _declare(
+    "serve.drain",
+    "Admission-queue drain into pipeline.check_many (serve.py drain "
+    "loop): error simulates a broken batch path — the engine degrades to "
+    "per-request solves; hang simulates a wedged drain (the kill-and-"
+    "replay soak's window for a mid-stream hard kill).",
+)
+SERVE_RESPOND = _declare(
+    "serve.respond",
+    "Verdict delivery to a waiting client (serve.py): error simulates a "
+    "failed response write — the client receives the typed error (never "
+    "a silent drop) while the verdict itself is already cached and "
+    "journal-marked done, so a retry is a cache hit.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "
@@ -414,6 +449,43 @@ _CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
     (CHECKPOINT_WRITE, "oserror", 0.0),
     (FRONTIER_CHUNK, "oom", 0.0),
 )
+
+
+# What the serving-layer chaos soak can draw (tools/soak.py --serve
+# --chaos): every serve.* boundary plus the engine-side points a served
+# solve routes through, so one seeded window exercises admission, cache,
+# journal, drain and respond alongside the ladder the drain degrades
+# through.  serve.drain hang stays sub-second here; the kill-and-replay
+# round uses its own explicit long-hang rule instead of a sampled one.
+_SERVE_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
+    (SERVE_ADMIT, "error", 0.0),
+    (SERVE_CACHE, "error", 0.0),
+    (SERVE_JOURNAL, "oserror", 0.0),
+    (SERVE_DRAIN, "error", 0.0),
+    (SERVE_DRAIN, "hang", 0.2),
+    (SERVE_RESPOND, "error", 0.0),
+    (NATIVE_CALL, "error", 0.0),
+    (SWEEP_DISPATCH, "oom", 0.0),
+)
+
+
+def sample_serve_plan(seed: int) -> FaultPlan:
+    """Draw a deterministic serving-layer fault schedule from ``seed`` —
+    the serve twin of :func:`sample_plan`, drawing from the serve.*
+    boundaries (same seed ⇒ same rules ⇒ same firing sequence)."""
+    rng = random.Random(seed)
+    n_rules = 1 if rng.random() < 0.6 else 2
+    picks = rng.sample(range(len(_SERVE_CHAOS_CHOICES)), n_rules)
+    rules = []
+    for ix in picks:
+        point, mode, seconds = _SERVE_CHAOS_CHOICES[ix]
+        first = 1 if rng.random() < 0.6 else rng.randint(2, 3)
+        every = rng.random() < 0.5
+        rules.append(FaultRule(
+            point=point, mode=mode, first=first, every=every,
+            seconds=seconds,
+        ))
+    return FaultPlan(rules, label=f"serve-chaos(seed={seed})")
 
 
 def sample_plan(seed: int) -> FaultPlan:

@@ -10,6 +10,14 @@ utils/env.py):
   quarantined rungs, in-flight lane packs, degrade/fault counters, trace_id
   — everything sourced from the process-wide RunRecord's gauges/counters,
   so the endpoint never reaches into engine internals;
+- ``GET /readyz`` → JSON (``qi-ready/1``, ISSUE 8): the serving layer's
+  admission picture — queue depth, shed state, journal-replay progress —
+  with proper readiness semantics: **503** while a restarted instance is
+  still replaying its crashed predecessor's journal (a scheduler must not
+  route traffic at it yet), 200 once replay completes or when no serving
+  engine runs in this process (liveness and readiness then coincide).
+  ``/healthz`` deliberately stays pure liveness: a replaying process is
+  alive (don't restart it — that would loop the replay) but not ready;
 - ``GET /metrics`` → the Prometheus text encoding of the same record,
   produced by the ONE encoder the textfile sink uses
   (:func:`quorum_intersection_tpu.utils.telemetry.prom_lines`) — scrape it
@@ -36,6 +44,7 @@ from quorum_intersection_tpu.utils.telemetry import get_run_record, prom_lines
 log = get_logger("utils.metrics_server")
 
 HEALTH_SCHEMA = "qi-health/1"
+READY_SCHEMA = "qi-ready/1"
 
 
 def healthz_payload() -> dict:
@@ -65,6 +74,37 @@ def healthz_payload() -> dict:
     }
 
 
+def readyz_payload() -> tuple:
+    """The /readyz body + status code: ``(payload, http_status)``.
+
+    Readiness is the SERVING-layer question (liveness stays /healthz):
+    sourced from the ``serve.*`` gauges ``ServeEngine`` keeps current.
+    Not ready (503) exactly while a journal replay is in progress —
+    ``serve.replay_complete`` was published as 0 at engine start and
+    flips to 1 once the crashed predecessor's work is re-solved.  A
+    process with no serving engine publishes neither gauge and reports
+    ready: for the one-shot CLI, alive == ready.
+    """
+    rec = get_run_record()
+    counters, gauges = rec.snapshot()
+    replay = gauges.get("serve.replay_complete")
+    replaying = replay is not None and not replay
+    payload = {
+        "schema": READY_SCHEMA,
+        "status": "replaying" if replaying else "ready",
+        "pid": rec.pid,
+        "trace_id": rec.trace_id,
+        "serving": "serve.queue_depth" in gauges,
+        "replay_complete": None if replay is None else bool(replay),
+        "queue_depth": gauges.get("serve.queue_depth", 0),
+        "shed_state": gauges.get("serve.shed_state", 0),
+        "shed_total": counters.get("serve.shed", 0),
+        "requests": counters.get("serve.requests", 0),
+        "verdicts": counters.get("serve.verdicts", 0),
+    }
+    return payload, (503 if replaying else 200)
+
+
 class _Handler(BaseHTTPRequestHandler):
     """Request handler for the two read-only endpoints."""
 
@@ -87,6 +127,10 @@ class _Handler(BaseHTTPRequestHandler):
                 json.dumps(healthz_payload(), sort_keys=True) + "\n"
             ).encode()
             self._respond(200, "application/json", body)
+        elif path == "/readyz":
+            payload, status = readyz_payload()
+            body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+            self._respond(status, "application/json", body)
         else:
             self._respond(404, "text/plain", b"not found\n")
 
@@ -116,7 +160,7 @@ class MetricsServer:
         )
         self._thread.start()
         log.info("metrics endpoint serving on http://%s:%d "
-                 "(/healthz, /metrics)", host, self.port)
+                 "(/healthz, /readyz, /metrics)", host, self.port)
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -1,0 +1,675 @@
+"""qi-serve differential suite (ISSUE 8): served verdicts and certificates
+identical to the one-shot pipeline across the vendored fixture pairs and
+every ladder rung, typed outcomes at every serve.* fault point, the
+admission/deadline/shed semantics, the verdict cache + single-flight
+coalescing, the crash-only journal replay matrix (torn tail / empty /
+corrupt / foreign fingerprint / already-done), a real kill-and-replay CLI
+round, /readyz readiness, and churn-trace determinism."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import churn_trace, majority_fbas
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.utils import faults, telemetry
+from quorum_intersection_tpu.utils.faults import FaultInjected
+from quorum_intersection_tpu.utils.metrics_server import readyz_payload
+import quorum_intersection_tpu.serve as serve_mod
+from quorum_intersection_tpu.serve import (
+    DeadlineExceeded,
+    Overloaded,
+    RequestJournal,
+    ServeClosed,
+    ServeEngine,
+    ServeError,
+    snapshot_fingerprint,
+)
+from tools.check_cert import check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+CLI = [sys.executable, "-m", "quorum_intersection_tpu"]
+
+# The four engines a served solve can route through — the ladder rungs.
+BACKENDS = ("python", "cpp", "tpu-sweep", "tpu-frontier")
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+
+def make_backend(name):
+    if name == "tpu-sweep":
+        return TpuSweepBackend(batch=512)
+    if name == "tpu-frontier":
+        return TpuFrontierBackend(arena=4096, pop=128)
+    return name
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+def fingerprint_of(nodes):
+    return snapshot_fingerprint(build_graph(parse_fbas(nodes)))
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+class _Engine:
+    """Context manager: a started ServeEngine that always stops."""
+
+    def __init__(self, **kw):
+        self.engine = ServeEngine(**kw)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=30.0)
+        return False
+
+
+def pair_of(witness):
+    return {frozenset(witness["q1"]), frozenset(witness["q2"])}
+
+
+class TestDifferentialParity:
+    """Served verdict + cert == one-shot pipeline, on every rung."""
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    @pytest.mark.parametrize("fixture,verdict", FIXTURE_PAIRS)
+    def test_served_equals_one_shot(self, rec, backend, fixture, verdict):
+        nodes = fixture_nodes(fixture)
+        oracle = solve(nodes, backend=make_backend(backend))
+        assert oracle.intersects is verdict
+        with _Engine(backend=make_backend(backend)) as engine:
+            resp = engine.submit(nodes).result(timeout=120.0)
+        assert resp.intersects is verdict
+        assert resp.cached is False
+        cert = resp.cert
+        assert cert is not None
+        assert cert["verdict"] is verdict
+        if not verdict:
+            assert pair_of(cert["witness"]) == pair_of(oracle.cert["witness"])
+        # The serve provenance stamp rides the cert without breaking the
+        # independent checker's soundness verdict.
+        stamp = cert["provenance"]["serve"]
+        assert stamp["schema"] == serve_mod.SERVE_SCHEMA
+        assert stamp["request_id"] == resp.request_id
+        assert stamp["cached"] is False
+        assert stamp["fingerprint"] == fingerprint_of(nodes)
+        check_certificate(cert, nodes)
+
+    @pytest.mark.slow
+    def test_snapshot_pair_served(self, rec):
+        """The big real-snapshot pair, python rung (the other rungs cover
+        it in the one-shot cert suite; serving adds no engine surface —
+        slow: ~90 s of independent-checker work on the real snapshot)."""
+        for fixture, verdict in (
+            ("snapshot_correct", True), ("snapshot_broken", False),
+        ):
+            nodes = fixture_nodes(fixture)
+            with _Engine(backend="python") as engine:
+                resp = engine.submit(nodes).result(timeout=120.0)
+            assert resp.intersects is verdict
+            check_certificate(resp.cert, nodes)
+
+    def test_batched_drain_matches_oracle(self, rec):
+        """Many queued snapshots drain through one check_many batch; every
+        verdict still equals its own one-shot solve."""
+        streams = [majority_fbas(n, broken=b)
+                   for n in (5, 7, 9) for b in (False, True)]
+        expected = [solve(s, backend="python").intersects for s in streams]
+        with _Engine(backend="python", batch_max=6) as engine:
+            tickets = [engine.submit(s) for s in streams]
+            got = [t.result(timeout=60.0).intersects for t in tickets]
+        assert got == expected
+
+
+class TestServeFaultPoints:
+    """Seeded QI_FAULTS at every serve.* boundary: typed outcome or an
+    oracle-equal verdict — never a silent drop, never a flip."""
+
+    def test_admit_fault_is_typed_and_isolated(self, rec):
+        faults.install_plan(faults.parse_faults("serve.admit=error@1"))
+        nodes = majority_fbas(5)
+        with _Engine(backend="python") as engine:
+            with pytest.raises(FaultInjected):
+                engine.submit(nodes)
+            # The queue and later requests are unaffected.
+            resp = engine.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is solve(nodes, backend="python").intersects
+
+    def test_cache_fault_bypasses_never_flips(self, rec):
+        faults.install_plan(faults.parse_faults("serve.cache=error@1+"))
+        nodes = majority_fbas(7, broken=True)
+        expected = solve(nodes, backend="python").intersects
+        with _Engine(backend="python") as engine:
+            for _ in range(3):  # every probe faulted: all solves from scratch
+                assert engine.submit(nodes).result(
+                    timeout=60.0).intersects is expected
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.cache_errors", 0) >= 3
+        assert counters.get("serve.cache_hits", 0) == 0
+
+    def test_journal_fault_serves_unjournaled(self, rec, tmp_path):
+        faults.install_plan(faults.parse_faults("serve.journal=oserror@1+"))
+        journal = tmp_path / "j.jsonl"
+        nodes = majority_fbas(5)
+        with _Engine(backend="python", journal=journal) as engine:
+            resp = engine.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.journal_errors", 0) >= 1
+        # Nothing made it into the journal — replay protection was LOUDLY
+        # lost, the verdict was not.
+        entries, _, _ = RequestJournal(journal).scan()
+        assert entries == []
+
+    def test_drain_fault_degrades_to_per_request(self, rec):
+        faults.install_plan(faults.parse_faults("serve.drain=error@1"))
+        nodes = majority_fbas(9, broken=True)
+        with _Engine(backend="python") as engine:
+            resp = engine.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is False
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.drain_faults", 0) == 1
+
+    def test_respond_fault_is_typed_then_cache_hit(self, rec):
+        faults.install_plan(faults.parse_faults("serve.respond=error@1"))
+        nodes = majority_fbas(5)
+        with _Engine(backend="python") as engine:
+            with pytest.raises(FaultInjected):
+                engine.submit(nodes).result(timeout=60.0)
+            # The verdict survived the failed delivery: the retry hits the
+            # cache and serves.
+            resp = engine.submit(nodes).result(timeout=60.0)
+        assert resp.cached is True
+        assert resp.intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.respond_errors", 0) == 1
+
+    def test_closed_engine_is_typed(self, rec):
+        engine = ServeEngine(backend="python")
+        engine.start()
+        engine.stop(drain=True, timeout=30.0)
+        with pytest.raises(ServeClosed):
+            engine.submit(majority_fbas(5))
+
+    def test_no_drain_stop_resolves_queued_tickets_typed(
+        self, rec, monkeypatch,
+    ):
+        """stop(drain=False) discards the queue but every discarded
+        waiter gets a typed ServeClosed — never an unresolved ticket."""
+        hold = _HeldDrain()
+        monkeypatch.setattr(serve_mod, "_serve_sync", hold)
+        engine = ServeEngine(backend="python")
+        engine.start()
+        try:
+            t_inflight = engine.submit(majority_fbas(5, prefix="STA"))
+            assert hold.popped.wait(10.0)  # drain parked holding t_inflight
+            t_queued = engine.submit(majority_fbas(7, prefix="STB"))
+            engine.stop(drain=False, timeout=0.1)
+            with pytest.raises(ServeClosed):
+                t_queued.result(timeout=10.0)
+        finally:
+            hold.release.set()
+            engine.stop(drain=False, timeout=30.0)
+        # The popped in-flight entry still delivers normally.
+        assert t_inflight.result(timeout=60.0).intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.errors", 0) == 1
+        assert counters.get("serve.verdicts", 0) == 1
+
+
+class _HeldDrain:
+    """Park the drain loop at drain.popped until released (the schedule
+    harness's trick, scoped to one test)."""
+
+    def __init__(self):
+        self.popped = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, point):
+        if point == "drain.popped":
+            self.popped.set()
+            self.release.wait(30.0)
+
+
+@pytest.fixture
+def held_drain(monkeypatch):
+    hold = _HeldDrain()
+    monkeypatch.setattr(serve_mod, "_serve_sync", hold)
+    yield hold
+    hold.release.set()
+
+
+class TestAdmissionAndDeadlines:
+    def test_overflow_sheds_typed_and_admitted_still_serve(
+        self, rec, held_drain,
+    ):
+        a, b, c = (majority_fbas(n, prefix=f"ADM{n}") for n in (5, 7, 9))
+        with _Engine(backend="python", queue_depth=1) as engine:
+            t_a = engine.submit(a)
+            assert held_drain.popped.wait(10.0)
+            t_b = engine.submit(b)  # fills the bounded queue
+            with pytest.raises(Overloaded) as exc:
+                engine.submit(c)
+            assert exc.value.code == "overloaded"
+            assert exc.value.depth >= exc.value.bound == 1
+            held_drain.release.set()
+            assert t_a.result(timeout=60.0).intersects is True
+            assert t_b.result(timeout=60.0).intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.shed", 0) == 1
+        # The shed is a delivered typed failure: requests == verdicts +
+        # errors (the registry's zero-silent-drops invariant).
+        assert counters.get("serve.requests") == 3
+        assert counters.get("serve.verdicts", 0) + counters.get(
+            "serve.errors", 0) == 3
+
+    def test_deadline_expiry_is_typed_never_a_wedge(self, rec, held_drain):
+        nodes = majority_fbas(5)
+        with _Engine(backend="python") as engine:
+            ticket = engine.submit(nodes, deadline_s=0.05)
+            assert held_drain.popped.wait(10.0)
+            while time.monotonic() < ticket.deadline_t:
+                time.sleep(0.005)
+            held_drain.release.set()
+            with pytest.raises(DeadlineExceeded) as exc:
+                ticket.result(timeout=60.0)
+            assert exc.value.code == "deadline_exceeded"
+            assert exc.value.request_id == ticket.request_id
+            # The engine is not wedged: the same snapshot still serves.
+            assert engine.submit(nodes).result(
+                timeout=60.0).intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.deadline_expired", 0) == 1
+
+    def test_late_coalescer_deadline_enforced_at_delivery(
+        self, rec, held_drain,
+    ):
+        """A request that coalesces onto an in-flight entry after the
+        batch's deadline supervisor was armed still gets its expiry
+        honored at delivery — never a verdict quietly past its budget."""
+        nodes = majority_fbas(9)
+        with _Engine(backend="python") as engine:
+            t_a = engine.submit(nodes)  # no deadline, will be solved
+            assert held_drain.popped.wait(10.0)
+            t_b = engine.submit(nodes, deadline_s=0.05)  # coalesces late
+            while time.monotonic() < t_b.deadline_t:
+                time.sleep(0.005)
+            held_drain.release.set()
+            assert t_a.result(timeout=60.0).intersects is True
+            with pytest.raises(DeadlineExceeded):
+                t_b.result(timeout=60.0)
+            # The verdict was cached, so B's retry is an immediate hit.
+            assert engine.submit(nodes).result(timeout=60.0).cached is True
+
+
+class TestCacheAndCoalesce:
+    def test_repeat_snapshot_is_a_cache_hit(self, rec):
+        nodes = majority_fbas(7)
+        with _Engine(backend="python") as engine:
+            first = engine.submit(nodes).result(timeout=60.0)
+            second = engine.submit(nodes).result(timeout=60.0)
+        assert first.cached is False and second.cached is True
+        assert second.intersects is first.intersects
+        assert second.cert["provenance"]["serve"]["cached"] is True
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.cache_hits", 0) == 1
+
+    def test_cosmetic_churn_hits_same_fingerprint(self):
+        nodes = majority_fbas(7)
+        renamed = json.loads(json.dumps(nodes))
+        renamed[0]["name"] = "renamed-for-cosmetics"
+        assert fingerprint_of(nodes) == fingerprint_of(renamed)
+        rethreshed = json.loads(json.dumps(nodes))
+        rethreshed[0]["quorumSet"]["threshold"] -= 1
+        assert fingerprint_of(nodes) != fingerprint_of(rethreshed)
+
+    def test_concurrent_identical_queries_coalesce(self, rec, held_drain):
+        nodes = majority_fbas(9)
+        with _Engine(backend="python") as engine:
+            t1 = engine.submit(nodes)
+            assert held_drain.popped.wait(10.0)
+            t2 = engine.submit(nodes)  # identical, mid-solve: single-flight
+            held_drain.release.set()
+            r1, r2 = t1.result(timeout=60.0), t2.result(timeout=60.0)
+        assert r1.intersects is r2.intersects
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.coalesced", 0) == 1
+
+    def test_bounded_cache_evicts_lru(self, rec):
+        a, b = majority_fbas(5, prefix="EVA"), majority_fbas(5, prefix="EVB")
+        with _Engine(backend="python", cache_max=1) as engine:
+            engine.submit(a).result(timeout=60.0)
+            engine.submit(b).result(timeout=60.0)  # evicts a
+            again = engine.submit(a).result(timeout=60.0)
+        assert again.cached is False
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.cache_evictions", 0) >= 1
+
+
+class TestJournalReplayMatrix:
+    """Crash-only journal: every corruption class quarantines instead of
+    blocking startup; pending work replays exactly once."""
+
+    def _journal_with(self, path, lines):
+        path.write_text("".join(line + "\n" for line in lines))
+        return path
+
+    def _req_line(self, rid, nodes, fingerprint=None):
+        return json.dumps({
+            "kind": "req", "request_id": rid,
+            "fingerprint": fingerprint or fingerprint_of(nodes),
+            "deadline_s": None, "nodes": nodes, "t_wall": 0.0,
+        })
+
+    def test_pending_entry_replays_to_oracle_verdict(self, rec, tmp_path):
+        nodes = majority_fbas(7, broken=True)
+        journal = self._journal_with(
+            tmp_path / "j.jsonl", [self._req_line("r1", nodes)],
+        )
+        engine = ServeEngine(backend="python", journal=journal)
+        report = engine.start()
+        try:
+            assert report["pending"] == 1
+            assert report["verdicts"] == {
+                "r1": solve(nodes, backend="python").intersects,
+            }
+            # Zero duplicated: the replayed verdict is already cached, and
+            # a second start on the compacted journal replays nothing.
+            resp = engine.submit(nodes).result(timeout=60.0)
+            assert resp.cached is True
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        with _Engine(backend="python", journal=journal) as engine2:
+            assert engine2._replay_report["pending"] == 0
+            assert engine2._replay_report["verdicts"] == {}
+
+    def test_done_entry_is_final_zero_duplicates(self, rec, tmp_path):
+        nodes = majority_fbas(5)
+        fp = fingerprint_of(nodes)
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            self._req_line("r1", nodes),
+            json.dumps({"kind": "done", "request_id": "r1",
+                        "fingerprint": fp, "outcome": "verdict",
+                        "verdict": True, "t_wall": 0.0}),
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["already_done"] == 1
+        assert report["pending"] == 0
+        assert report["verdicts"] == {}
+
+    def test_torn_tail_is_tolerated(self, rec, tmp_path):
+        nodes = majority_fbas(5)
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            self._req_line("r1", nodes),
+            '{"kind": "req", "request_id": "r2", "trunca',  # kill -9 artifact
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["torn_tail"] is True
+        assert report["verdicts"] == {"r1": True}
+        assert report["quarantined"] == 0
+
+    def test_empty_journal_replays_nothing(self, rec, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        journal.write_text("")
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["entries"] == 0
+        assert report["pending"] == 0
+
+    def test_corrupt_middle_line_quarantines(self, rec, tmp_path):
+        nodes = majority_fbas(5)
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            "not json at all {{{",
+            self._req_line("r1", nodes),
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["quarantined"] == 1
+        assert report["verdicts"] == {"r1": True}
+        corrupt = journal.with_name(journal.name + ".corrupt")
+        assert "not json at all" in corrupt.read_text()
+
+    def test_foreign_fingerprint_quarantines(self, rec, tmp_path):
+        nodes = majority_fbas(5)
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            self._req_line("r1", nodes, fingerprint="f" * 32),
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["quarantined"] == 1
+        assert report["verdicts"] == {}
+        corrupt = journal.with_name(journal.name + ".corrupt")
+        assert '"r1"' in corrupt.read_text()
+
+    def test_unparseable_nodes_quarantine(self, rec, tmp_path):
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            json.dumps({"kind": "req", "request_id": "r1",
+                        "fingerprint": "a" * 32, "deadline_s": None,
+                        "nodes": {"not": "a node array"}, "t_wall": 0.0}),
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["quarantined"] == 1
+
+    def test_live_requests_journal_and_mark_done(self, rec, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        nodes = majority_fbas(7)
+        with _Engine(backend="python", journal=journal) as engine:
+            engine.submit(nodes).result(timeout=60.0)
+        entries, corrupt, torn = RequestJournal(journal).scan()
+        assert not corrupt and not torn
+        kinds = [e["kind"] for e in entries]
+        assert kinds == ["req", "done"]
+        assert entries[1]["verdict"] is True
+        assert entries[0]["fingerprint"] == entries[1]["fingerprint"]
+
+    def test_coalesced_request_journals_its_own_pair(
+        self, rec, tmp_path, held_drain,
+    ):
+        """A coalesced (single-flight) request is ACCEPTED, so it must be
+        as kill-proof as a queued one: its own req entry before delivery,
+        its own done mark after."""
+        journal = tmp_path / "j.jsonl"
+        nodes = majority_fbas(9)
+        with _Engine(backend="python", journal=journal) as engine:
+            t1 = engine.submit(nodes, request_id="primary")
+            assert held_drain.popped.wait(10.0)
+            t2 = engine.submit(nodes, request_id="rider")  # coalesces
+            held_drain.release.set()
+            t1.result(timeout=60.0), t2.result(timeout=60.0)
+        entries, _, _ = RequestJournal(journal).scan()
+        by_kind = {}
+        for e in entries:
+            by_kind.setdefault(e["kind"], set()).add(e["request_id"])
+        assert by_kind["req"] == {"primary", "rider"}
+        assert by_kind["done"] == {"primary", "rider"}
+
+    def test_duplicate_fingerprint_entries_both_replay(self, rec, tmp_path):
+        """Two pending entries for the SAME snapshot (a kill that caught a
+        coalesced pair in flight): both replay, zero lost."""
+        nodes = majority_fbas(7)
+        journal = self._journal_with(tmp_path / "j.jsonl", [
+            self._req_line("r1", nodes),
+            self._req_line("r2", nodes),
+        ])
+        with _Engine(backend="python", journal=journal) as engine:
+            report = engine._replay_report
+        assert report["pending"] == 2
+        assert report["verdicts"] == {"r1": True, "r2": True}
+
+
+@pytest.mark.slow
+class TestKillAndReplayCLI:
+    """A real serve subprocess, SIGKILLed mid-drain: the journal replays
+    with zero lost and zero duplicated verdicts, all oracle-equal."""
+
+    def test_hard_kill_then_replay(self, tmp_path):
+        journal = tmp_path / "kill.jsonl"
+        streams = [majority_fbas(n, broken=b, prefix=f"K{n}{int(b)}")
+                   for n, b in ((5, False), (7, True), (9, False))]
+        oracle = {
+            f"kill-{i}": solve(s, backend="python").intersects
+            for i, s in enumerate(streams)
+        }
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("QI_")}
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            # Hold every drain cycle so the kill provably lands with
+            # journaled work in flight.
+            "QI_FAULTS": "serve.drain=hang:2.0@1+",
+        })
+        proc = subprocess.Popen(
+            CLI + ["serve", "--journal", str(journal),
+                   "--backend", "python"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            for i, s in enumerate(streams):
+                proc.stdin.write(json.dumps(
+                    {"request_id": f"kill-{i}", "nodes": s}) + "\n")
+            proc.stdin.flush()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if journal.exists() and sum(
+                    1 for ln in journal.read_text().splitlines()
+                    if '"kind": "req"' in ln
+                ) >= len(streams):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("requests never reached the journal")
+        finally:
+            proc.kill() if proc.poll() is None else None
+            os.kill(proc.pid, signal.SIGKILL) if proc.poll() is None else None
+            proc.wait(timeout=30.0)
+
+        answered = {}
+        out = proc.stdout.read() or ""
+        for line in out.splitlines():
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn stdout line is the kill's artifact
+            if "verdict" in obj:
+                answered[obj["request_id"]] = obj["verdict"]
+
+        replay = subprocess.run(
+            CLI + ["serve", "--journal", str(journal), "--replay-only",
+                   "--backend", "python"],
+            capture_output=True, text=True, timeout=300.0,
+            env={**env, "QI_FAULTS": ""},
+        )
+        assert replay.returncode == 0, replay.stderr[-2000:]
+        report = json.loads(replay.stdout.splitlines()[0])
+        assert report["kind"] == "replay"
+        replayed = report["verdicts"]
+
+        # Zero duplicated: a request answered before the kill was marked
+        # done before its response line, so it cannot replay again.
+        assert not set(answered) & set(replayed)
+        # Zero lost: every journaled request reached exactly one outcome.
+        assert set(answered) | set(replayed) == set(oracle)
+        for rid, verdict in {**answered, **replayed}.items():
+            assert verdict is oracle[rid], f"{rid} diverged across the kill"
+
+
+class TestReadyz:
+    def test_one_shot_process_is_ready(self, rec):
+        payload, status = readyz_payload()
+        assert status == 200
+        assert payload["schema"] == "qi-ready/1"
+        assert payload["serving"] is False
+        assert payload["replay_complete"] is None
+
+    def test_503_while_replaying_200_after(self, rec):
+        # The exact gauge protocol ServeEngine.start() drives: 0 published
+        # before replay, 1 after.
+        rec.gauge("serve.queue_depth", 0)
+        rec.gauge("serve.replay_complete", 0)
+        payload, status = readyz_payload()
+        assert status == 503
+        assert payload["status"] == "replaying"
+        rec.gauge("serve.replay_complete", 1)
+        payload, status = readyz_payload()
+        assert status == 200
+        assert payload["serving"] is True
+
+    def test_started_engine_reports_ready(self, rec, tmp_path):
+        with _Engine(backend="python", journal=tmp_path / "j.jsonl"):
+            payload, status = readyz_payload()
+            assert status == 200
+            assert payload["replay_complete"] is True
+            assert payload["serving"] is True
+
+
+class TestPercentile:
+    def test_nearest_rank_exact_integer_positions(self):
+        # ceil semantics: p50 of [10, 20] is the 1st sample, and p99 of
+        # exactly 100 samples is the 99th — not the maximum (the
+        # round-half-even overshoot this pins against).
+        assert serve_mod._percentile([10.0, 20.0], 50.0) == 10.0
+        hundred = [float(i) for i in range(1, 101)]
+        assert serve_mod._percentile(hundred, 99.0) == 99.0
+        assert serve_mod._percentile(hundred, 100.0) == 100.0
+        assert serve_mod._percentile([], 50.0) == 0.0
+        assert serve_mod._percentile([7.0], 99.0) == 7.0
+
+
+class TestChurnTrace:
+    def test_deterministic(self):
+        base = majority_fbas(9)
+        t1 = churn_trace(base, steps=6, seed=3)
+        t2 = churn_trace(base, steps=6, seed=3)
+        assert json.dumps(t1) == json.dumps(t2)
+        t3 = churn_trace(base, steps=6, seed=4)
+        assert json.dumps(t1) != json.dumps(t3)
+
+    def test_bounded_diffs_and_no_aliasing(self):
+        base = majority_fbas(9)
+        trace = churn_trace(base, steps=8, seed=0, max_diff=2)
+        assert len(trace) == 9
+        assert trace[0] == base and trace[0] is not base
+        for prev, cur in zip(trace, trace[1:]):
+            changed = sum(1 for a, b in zip(prev, cur) if a != b)
+            assert changed <= 2
+
+    def test_negative_steps_raises(self):
+        with pytest.raises(ValueError):
+            churn_trace(majority_fbas(5), steps=-1)
+
+    def test_trace_verdicts_solvable(self):
+        # Every churned snapshot stays a valid FBAS the pipeline solves.
+        for snap in churn_trace(majority_fbas(7), steps=3, seed=1):
+            solve(snap, backend="python")

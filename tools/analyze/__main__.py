@@ -73,6 +73,40 @@ def _race_pass(root: Path) -> tuple:
             f"verdicts identical to the sequential chain"
         )
 
+    # Serving-layer schedules (ISSUE 8): the ServeEngine's drain thread +
+    # deadline supervisor orderings, forced through serve._serve_sync the
+    # same way the race orderings go through auto._race_sync.
+    from tools.analyze.schedules import run_serve_schedules
+
+    try:
+        serve_results = run_serve_schedules()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/serve.py",
+            line=1, message=str(exc),
+        ))
+        serve_results = []
+    for r in serve_results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (one-shot pipeline says "
+                f"{r.expected})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/serve.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if serve_results:
+        notes.append(
+            f"serve schedules: {len(serve_results)} forced interleavings, "
+            f"typed errors + verdicts identical to the one-shot pipeline"
+        )
+
     from quorum_intersection_tpu.backends.cpp import build_native_cli
 
     try:

@@ -30,6 +30,7 @@ Used by ``python -m tools.analyze`` (race pass) and
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -292,6 +293,159 @@ _REQUIRED_POINTS: Dict[str, tuple] = {
     "both_finish_simultaneously": ("sweep.verdict", "oracle.returned"),
     "budget_burn_then_sweep_verdict": ("oracle.returned", "sweep.verdict"),
 }
+
+
+# ---- serving-layer schedules (ISSUE 8) --------------------------------------
+#
+# The ServeEngine's drain thread + deadline timers introduce a second
+# concurrency surface with its own nasty orderings; ``serve._serve_sync``
+# is the hook, exactly like ``auto._race_sync`` above.
+
+SERVE_SCHEDULES = (
+    "serve_coalesce_during_solve",
+    "serve_deadline_between_pop_and_solve",
+    "serve_shed_while_drain_parked",
+)
+
+_REQUIRED_SERVE_POINTS: Dict[str, tuple] = {
+    # coalesce: the second submit must have taken the single-flight path
+    # WHILE the entry was popped-but-unsolved (drain parked at the point).
+    "serve_coalesce_during_solve": ("drain.popped", "admit.coalesced"),
+    # deadline: the drain must have popped before the expiry was handled.
+    "serve_deadline_between_pop_and_solve": ("drain.popped",),
+    # shed: a queue at its bound while the drain is parked mid-cycle.
+    "serve_shed_while_drain_parked": ("drain.popped", "admit.queued"),
+}
+
+
+def _run_serve_one(schedule: str, data: object, expected: bool,
+                   topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.serve as serve_mod
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.serve import (
+        DeadlineExceeded,
+        Overloaded,
+        ServeEngine,
+    )
+
+    ctl = SyncController()
+    release = threading.Event()
+    verdict: Optional[bool] = None
+    error: Optional[str] = None
+    old_sync = serve_mod._serve_sync
+    serve_mod._serve_sync = ctl
+    engine: Optional[ServeEngine] = None
+    try:
+        if schedule == "serve_coalesce_during_solve":
+            # The drain pops the entry, then parks BEFORE solving; an
+            # identical submit lands meanwhile and must coalesce onto the
+            # in-flight entry (single-flight), not re-queue a second solve.
+            ctl.hold("drain.popped", ctl.reached_event("admit.coalesced"))
+            engine = ServeEngine(backend="python")
+            engine.start()
+            t1 = engine.submit(data)
+            if not ctl.reached_event("drain.popped").wait(WAIT_S):
+                raise ScheduleError("drain never popped the entry")
+            t2 = engine.submit(data)
+            r1, r2 = t1.result(WAIT_S), t2.result(WAIT_S)
+            verdict = r1.intersects
+            if r2.intersects is not r1.intersects:
+                error = (
+                    f"coalesced waiter verdict {r2.intersects} != "
+                    f"primary {r1.intersects}"
+                )
+        elif schedule == "serve_deadline_between_pop_and_solve":
+            # The request's deadline expires in the gap between queue pop
+            # and solve: the engine must deliver a typed DeadlineExceeded
+            # (never a wedge, never a late verdict pretending to be timely)
+            # and keep serving afterwards.
+            ctl.hold("drain.popped", release)
+            engine = ServeEngine(backend="python")
+            engine.start()
+            t1 = engine.submit(data, deadline_s=0.05)
+            if not ctl.reached_event("drain.popped").wait(WAIT_S):
+                raise ScheduleError("drain never popped the entry")
+            assert t1.deadline_t is not None
+            while time.monotonic() < t1.deadline_t:
+                time.sleep(0.005)
+            release.set()
+            try:
+                t1.result(WAIT_S)
+                error = "expired request was served instead of raising"
+            except DeadlineExceeded:
+                pass
+            t2 = engine.submit(data)  # the engine must not be wedged
+            verdict = t2.result(WAIT_S).intersects
+        elif schedule == "serve_shed_while_drain_parked":
+            # Queue bound 1, drain parked mid-cycle: the second distinct
+            # request fills the queue, the third must shed with a typed
+            # Overloaded — and both admitted requests must still serve.
+            ctl.hold("drain.popped", release)
+            engine = ServeEngine(backend="python", queue_depth=1)
+            engine.start()
+            t_a = engine.submit(data)
+            if not ctl.reached_event("drain.popped").wait(WAIT_S):
+                raise ScheduleError("drain never popped the entry")
+            t_b = engine.submit(majority_fbas(5, prefix="SHED"))
+            try:
+                engine.submit(majority_fbas(7, prefix="SHED"))
+                error = "over-depth request admitted instead of shed"
+            except Overloaded:
+                pass
+            release.set()
+            r_a = t_a.result(WAIT_S)
+            t_b.result(WAIT_S)  # must deliver, verdict checked vs its own solve
+            verdict = r_a.intersects
+        else:
+            raise ValueError(f"unknown serve schedule {schedule!r}")
+    finally:
+        serve_mod._serve_sync = old_sync
+        release.set()
+        if engine is not None:
+            engine.stop(drain=True, timeout=WAIT_S)
+    missing = [
+        p for p in _REQUIRED_SERVE_POINTS[schedule] if p not in ctl.trace
+    ]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=bool(verdict),
+        expected=expected,
+        winner="serve",
+        oracle_outcome="-",
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+def run_serve_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
+    """Every serve schedule × {intersecting, broken} topology; ground truth
+    from the one-shot pipeline (the differential contract the serving layer
+    is held to everywhere).  Leaked drain threads are a failure."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend="python").intersects
+        for schedule in SERVE_SCHEDULES:
+            results.append(_run_serve_one(schedule, data, expected, topology))
+    leaked = [
+        t for t in threading.enumerate() if t.name == "qi-serve-drain"
+    ]
+    for t in leaked:
+        t.join(timeout=join_timeout)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        raise ScheduleError(
+            f"{len(leaked)} serve drain thread(s) still alive after "
+            f"{join_timeout}s — a schedule leaked its engine"
+        )
+    return results
 
 
 def run_all(join_timeout: float = 5.0) -> List[ScheduleResult]:

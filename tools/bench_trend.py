@@ -9,8 +9,10 @@ runs up in round order, compares the newest run's tracked metrics against
 the BEST prior measurement of each, renders the trend as a table
 (``tools/metrics_report.py`` formatting), and exits nonzero when a tracked
 metric regressed past its tolerance — so the r5 carried numbers
-(``pack_fill_pct``, ``sweep_mfu_pct``, ``window_candidates_per_sec``) are
-gated, not just emitted.
+(``pack_fill_pct``, ``sweep_mfu_pct``, ``window_candidates_per_sec``) and
+the serving-layer rows (``serve_verdicts_per_sec``, ``serve_p99_ms``,
+``serve_cache_hit_pct`` from ``benchmarks/serve.py``, ISSUE 8) are gated,
+not just emitted.
 
 Sources, newest-last:
 
@@ -81,6 +83,15 @@ TRACKED: Dict[str, str] = {
     "sweep_windows_enumerated": "lower",
     "sweep_windows_pruned": "higher",
     "sweep_enumeration_ratio": "lower",
+    # serving-layer rows (ISSUE 8): benchmarks/serve.py open-loop driver.
+    # Throughput and cache efficiency regress by dropping; the tail
+    # latency gauge regresses by growing — the pair that catches both a
+    # slowed drain loop and a cache keyed wrong (hit_pct collapsing to 0
+    # under the same churn trace is a fingerprint bug, not a load change).
+    "serve_verdicts_per_sec": "higher",
+    "serve_cache_hit_pct": "higher",
+    "serve_p50_ms": "lower",
+    "serve_p99_ms": "lower",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -102,6 +113,10 @@ TELEMETRY_GAUGES = (
     "sweep.pack_fill_pct",
     "sweep.xla_compile_seconds",
     "cert.enumeration_ratio",
+    "serve.p50_ms",
+    "serve.p99_ms",
+    "serve.queue_depth",
+    "serve.bench_verdicts_per_sec",
 )
 
 

@@ -23,7 +23,12 @@
 #   - a chaos-soak smoke (docs/ROBUSTNESS.md) runs last: a small fixed-seed
 #     window of `tools/soak.py --chaos` — every injected fault schedule
 #     must leave the verdict equal to the fault-free sequential chain or
-#     fail with a typed error.  Any gate failing fails the script.
+#     fail with a typed error.  Any gate failing fails the script;
+#   - a serving-layer smoke (ISSUE 8, README §Serving): a short open-loop
+#     `benchmarks/serve.py --quick` run (every served verdict compared to
+#     the one-shot oracle, any silent drop = exit 1) plus a chaos variant
+#     `tools/soak.py --serve --chaos` covering the serve.* fault points
+#     and one kill-and-replay journal round.
 #
 # Usage: tools/ci_tier1.sh [extra pytest args...]
 set -o pipefail
@@ -108,6 +113,22 @@ for fx in trivial_correct trivial_broken nested_correct nested_broken \
 done
 echo "CERTS=$CERTDIR (exit $certrc)"
 
+# Serving-layer smoke (ISSUE 8): open-loop load through a live ServeEngine
+# — the driver itself is a parity gate (served verdict == one-shot oracle
+# for every request, zero silent drops, exit 1 otherwise) — then the serve
+# chaos soak: seeded faults at every serve.* boundary plus one hard-kill
+# mid-stream with journal replay, asserting the chaos-gate contract
+# (oracle-equal verdict or typed error; zero lost / zero duplicated
+# verdicts across the kill).  The serve.* telemetry rides $METRICS.
+env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick
+src=$?
+echo "SERVE_BENCH=exit $src"
+env JAX_PLATFORMS=cpu python tools/soak.py --serve --chaos \
+    --instances "${TIER1_SERVE_INSTANCES:-4}" \
+    --seed "${TIER1_SERVE_SEED:-0}" --no-ledger
+ssrc=$?
+echo "SERVE_CHAOS=exit $ssrc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -122,4 +143,6 @@ echo "TREND=exit $trc"
 [ "$crc" -ne 0 ] && exit "$crc"
 [ "$prc" -ne 0 ] && exit "$prc"
 [ "$certrc" -ne 0 ] && exit "$certrc"
+[ "$src" -ne 0 ] && exit "$src"
+[ "$ssrc" -ne 0 ] && exit "$ssrc"
 exit "$trc"

@@ -39,12 +39,36 @@ or the run fails LOUDLY with a typed error (``FaultInjected`` family /
 exit 1.  Same ``--seed`` ⇒ same schedules ⇒ same firing sequence, so a
 chaos failure reproduces exactly.
 
+**Serve mode** (ISSUE 8): ``--serve`` soaks the long-lived serving layer
+(``quorum_intersection_tpu/serve.py``) instead of one-shot solves.  Two
+rounds per seed:
+
+1. **In-process chaos** (with ``--chaos``): a churn-trace request stream is
+   driven through a live ``ServeEngine`` under a seeded serving-layer
+   fault schedule (``utils/faults.py sample_serve_plan`` — every
+   ``serve.*`` boundary is drawable) and the chaos contract is asserted
+   per request: the served verdict equals the fault-free ``python``-oracle
+   verdict for its snapshot, or the request fails LOUDLY with a typed
+   error (``ServeError`` family / ``FaultInjected``) — a silent drop (a
+   ticket that never resolves) or a flipped verdict is a mismatch.  A
+   fault-free restart on the same journal then re-replays: replayed
+   verdicts must also match the oracle.
+2. **Kill-and-replay**: a real ``python -m quorum_intersection_tpu serve``
+   subprocess with a request journal is fed the stream, hard-killed
+   (``SIGKILL``) mid-drain (a ``serve.drain=hang`` rule holds the drain so
+   work is genuinely in flight), and restarted with ``--replay-only``.
+   The journal accounting must balance exactly: every journaled request
+   reaches exactly one outcome across the kill (answered before it, or
+   replayed after it) — zero lost, zero duplicated — and every verdict on
+   both sides of the kill equals the oracle's.
+
 Usage::
 
     python tools/soak.py                      # 40 instances from seed 0
     python tools/soak.py --instances 100 --seed 1000
     python tools/soak.py --no-ledger          # dry run, don't record
     python tools/soak.py --chaos --instances 20 --seed 0
+    python tools/soak.py --serve --chaos --instances 6 --seed 0
 """
 
 from __future__ import annotations
@@ -54,6 +78,8 @@ import json
 import os
 import pathlib
 import random
+import signal
+import subprocess
 import sys
 import tempfile
 import time
@@ -314,6 +340,294 @@ def chaos_main(args: argparse.Namespace) -> int:
     return 1 if bad else 0
 
 
+def make_serve_traffic(seed: int, requests: int = 12):
+    """Seed → ``(desc, [(request_id, snapshot), ...], oracle)``: a churn
+    trace walked with temporal locality (the serving layer's realistic
+    traffic shape) plus the fault-free ``python`` verdict per request —
+    the parity bar every served or replayed verdict is held to."""
+    from quorum_intersection_tpu.fbas import synth
+    from quorum_intersection_tpu.pipeline import solve
+
+    rng = random.Random(seed * 7919 + 17)
+    broken = rng.random() < 0.4
+    n = rng.randint(5, 9)
+    base = synth.majority_fbas(n, broken=broken, prefix=f"SOAK{seed}")
+    advance_every = rng.randint(2, 4)
+    trace = synth.churn_trace(
+        base, max(requests // advance_every, 1), seed=seed, max_diff=2,
+    )
+    stream, oracle, memo = [], {}, {}
+    for i in range(requests):
+        step = min(i // advance_every, len(trace) - 1)
+        rid = f"soak-{seed}-{i}"
+        stream.append((rid, trace[step]))
+        if step not in memo:
+            memo[step] = solve(trace[step], backend="python").intersects
+        oracle[rid] = memo[step]
+    return f"majority(n={n},broken={broken},churn)", stream, oracle
+
+
+def run_serve_chaos_instance(seed: int, workdir: pathlib.Path,
+                             chaos: bool) -> dict:
+    """Drive one churn-trace stream through a live ServeEngine under a
+    seeded serving-layer fault schedule; every request must reach exactly
+    one outcome — the oracle verdict or a typed error — and a fault-free
+    restart on the same journal must replay to oracle-identical verdicts."""
+    from quorum_intersection_tpu.serve import ServeEngine, ServeError
+    from quorum_intersection_tpu.utils import faults
+
+    desc, stream, oracle = make_serve_traffic(seed)
+    journal = workdir / f"serve-chaos-{seed}.jsonl"
+    faults.clear_plan()
+    plan = None
+    if chaos:
+        plan = faults.install_plan(faults.sample_serve_plan(seed))
+    schedule_label = plan.label if plan is not None else "fault-free"
+    mismatches: list = []
+    typed_failures: list = []
+    served = 0
+    rng = random.Random(seed * 104729 + 3)
+    engine = ServeEngine(
+        backend="python", journal=journal,
+        batch_max=3, queue_depth=max(len(stream) // 2, 2),
+    )
+    tickets = []
+    try:
+        engine.start()
+        for rid, snap in stream:
+            # A sprinkle of tight deadlines exercises the expiry path; a
+            # fast solve may still beat the budget — both outcomes are
+            # legitimate, and both are checked below.
+            deadline = 0.002 if rng.random() < 0.2 else None
+            try:
+                tickets.append(
+                    (rid, engine.submit(snap, request_id=rid,
+                                        deadline_s=deadline))
+                )
+            except (ServeError, faults.FaultInjected, OSError) as exc:
+                typed_failures.append(f"{rid}: {type(exc).__name__}")
+        engine.stop(drain=True, timeout=60.0)
+    finally:
+        faults.clear_plan()
+    for rid, ticket in tickets:
+        try:
+            resp = ticket.result(timeout=30.0)
+        except TimeoutError:
+            mismatches.append(
+                f"{rid}: SILENT DROP — no outcome 30s after drain "
+                f"under {schedule_label}"
+            )
+            continue
+        except (ServeError, faults.FaultInjected, OSError) as exc:
+            typed_failures.append(f"{rid}: {type(exc).__name__}")
+            continue
+        except Exception as exc:  # noqa: BLE001 — an untyped crash IS a finding
+            mismatches.append(
+                f"{rid}: UNTYPED {type(exc).__name__}: {exc} "
+                f"under {schedule_label}"
+            )
+            continue
+        served += 1
+        if resp.intersects is not oracle[rid]:
+            mismatches.append(
+                f"{rid}: SILENT verdict flip {resp.intersects} != "
+                f"fault-free {oracle[rid]} under {schedule_label}"
+            )
+    # Fault-free restart on the same journal: whatever the chaos round
+    # left un-done replays now, and a replayed verdict must still match
+    # the oracle (journal faults may legitimately have lost entries — a
+    # lost ENTRY is loud and allowed; a wrong VERDICT never is).
+    engine2 = ServeEngine(backend="python", journal=journal, batch_max=3)
+    try:
+        report = engine2.start() or {}
+        for rid, verdict in (report.get("verdicts") or {}).items():
+            if rid in oracle and verdict is not oracle[rid]:
+                mismatches.append(
+                    f"{rid}: REPLAY verdict flip {verdict} != "
+                    f"fault-free {oracle[rid]}"
+                )
+    finally:
+        engine2.stop(drain=True, timeout=30.0)
+    fired = len(plan.fired) if plan is not None else 0
+    return {"seed": seed, "desc": desc, "schedule": schedule_label,
+            "fired": fired, "served": served,
+            "typed_failures": typed_failures, "mismatches": mismatches}
+
+
+def run_serve_kill_replay(seed: int, workdir: pathlib.Path) -> dict:
+    """Hard-kill a real serve subprocess mid-stream; the journal must
+    replay with zero lost and zero duplicated verdicts, all oracle-equal.
+
+    A ``serve.drain=hang`` rule (via ``QI_FAULTS``) holds every drain
+    cycle ~0.3s so the kill provably lands with work in flight — without
+    it the python oracle answers these topologies in microseconds and the
+    kill would only ever hit an idle queue."""
+    desc, stream, oracle = make_serve_traffic(seed)
+    journal = workdir / f"serve-kill-{seed}.jsonl"
+    mismatches: list = []
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "QI_FAULTS": "serve.drain=hang:0.3@1+",
+        # The soak's own stream stays out of the child's telemetry files.
+        "QI_METRICS_JSON": "", "QI_METRICS_PROM": "", "QI_TRACE_OUT": "",
+    })
+    child = subprocess.Popen(
+        [sys.executable, "-m", "quorum_intersection_tpu", "serve",
+         "--journal", str(journal), "--backend", "python",
+         "--batch-max", "2"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=str(_REPO),
+    )
+    try:
+        for rid, snap in stream:
+            child.stdin.write(json.dumps(
+                {"request_id": rid, "nodes": snap}
+            ) + "\n")
+        child.stdin.flush()
+        # Kill only after the journal shows accepted work: a fixed sleep
+        # can land before a slow machine's child even imported — the kill
+        # would hit an empty journal and the round would pass vacuously.
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            try:
+                text = journal.read_text()
+            except OSError:
+                text = ""
+            if text.count('"kind": "req"') >= len(stream):
+                break
+            time.sleep(0.1)
+        child.send_signal(signal.SIGKILL)
+        out, _ = child.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        out, _ = child.communicate()
+    responded = {}
+    for line in out.splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "verdict" in obj:
+            responded[obj["request_id"]] = obj["verdict"]
+    # Journal state at the kill: accepted (req) vs already-marked done.
+    # Parsed directly (not through RequestJournal) so the soak stays an
+    # independent witness of the on-disk format; only a torn FINAL line is
+    # excused — that is the one artifact a hard kill may write.
+    req_ids, done_ids = set(), set()
+    try:
+        lines = [ln for ln in journal.read_text().splitlines() if ln.strip()]
+    except OSError:
+        lines = []
+    for i, line in enumerate(lines):
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            if i != len(lines) - 1:
+                mismatches.append(f"corrupt journal line {i} (not the tail)")
+            continue
+        if obj.get("kind") == "req":
+            req_ids.add(obj.get("request_id"))
+        elif obj.get("kind") == "done":
+            done_ids.add(obj.get("request_id"))
+    # Restart: --replay-only re-solves everything accepted-but-not-done.
+    env_replay = dict(env)
+    env_replay["QI_FAULTS"] = ""
+    replay_proc = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "serve",
+         "--journal", str(journal), "--backend", "python", "--replay-only"],
+        capture_output=True, text=True, env=env_replay, cwd=str(_REPO),
+        timeout=120,
+    )
+    report = {}
+    for line in replay_proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if obj.get("kind") == "replay":
+            report = obj
+    replayed = dict(report.get("verdicts") or {})
+    failed = set(report.get("errors") or {})
+    if replay_proc.returncode != 0:
+        mismatches.append(f"replay exited {replay_proc.returncode}")
+    # Zero lost: every accepted request reached an outcome on one side of
+    # the kill.  Zero duplicated: nothing marked done was re-replayed.
+    lost = req_ids - done_ids - set(replayed) - failed
+    if lost:
+        mismatches.append(f"LOST requests (no outcome across kill): {sorted(lost)}")
+    dup = done_ids & set(replayed)
+    if dup:
+        mismatches.append(f"DUPLICATED verdicts (done yet replayed): {sorted(dup)}")
+    for rid, verdict in responded.items():
+        if rid in oracle and verdict is not oracle[rid]:
+            mismatches.append(
+                f"{rid}: pre-kill verdict {verdict} != oracle {oracle[rid]}")
+    for rid, verdict in replayed.items():
+        if rid in oracle and verdict is not oracle[rid]:
+            mismatches.append(
+                f"{rid}: replayed verdict {verdict} != oracle {oracle[rid]}")
+    return {"seed": seed, "desc": desc, "accepted": len(req_ids),
+            "responded_pre_kill": len(responded), "replayed": len(replayed),
+            "already_done": len(done_ids), "mismatches": mismatches}
+
+
+def serve_soak_main(args: argparse.Namespace) -> int:
+    """--serve driver: serving-layer chaos + kill-and-replay per seed."""
+    t0 = time.time()
+    bad: list = []
+    total_fired = 0
+    total_typed = 0
+    total_served = 0
+    kill_rounds = 0
+    with tempfile.TemporaryDirectory(prefix="qi-serve-soak-") as tmp:
+        workdir = pathlib.Path(tmp)
+        for i, seed in enumerate(range(args.seed, args.seed + args.instances)):
+            rec = run_serve_chaos_instance(seed, workdir, chaos=args.chaos)
+            total_fired += rec["fired"]
+            total_typed += len(rec["typed_failures"])
+            total_served += rec["served"]
+            if rec["mismatches"]:
+                bad.append(rec)
+                print(f"SERVE CHAOS MISMATCH seed={seed} {rec['desc']} "
+                      f"[{rec['schedule']}]: {rec['mismatches']}")
+            # The kill round costs a subprocess pair; every other seed
+            # keeps the soak's wall time linear in --instances.
+            if seed % 2 == 0:
+                kill_rounds += 1
+                krec = run_serve_kill_replay(seed, workdir)
+                if krec["mismatches"]:
+                    bad.append(krec)
+                    print(f"SERVE KILL-REPLAY MISMATCH seed={seed} "
+                          f"{krec['desc']}: {krec['mismatches']}")
+            if (i + 1) % 5 == 0:
+                print(f"  ... {i + 1}/{args.instances} serve instances "
+                      f"({time.time() - t0:.0f}s, {len(bad)} mismatches, "
+                      f"{total_fired} faults fired)", file=sys.stderr)
+    summary = {
+        "serve": True,
+        "chaos": bool(args.chaos),
+        "window": [args.seed, args.seed + args.instances],
+        "instances": args.instances,
+        "kill_rounds": kill_rounds,
+        "n_mismatches": len(bad),
+        "mismatches": bad,
+        "faults_fired": total_fired,
+        "typed_failures": total_typed,
+        "served": total_served,
+        "seconds": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", "ambient"),
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "mismatches"}))
+    if not args.no_ledger:
+        ledger = load_ledger()
+        ledger.setdefault("serve_runs", []).append(summary)
+        LEDGER.parent.mkdir(parents=True, exist_ok=True)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: serve run recorded -> {LEDGER}", file=sys.stderr)
+    return 1 if bad else 0
+
+
 def load_ledger() -> dict:
     if LEDGER.exists():
         return json.loads(LEDGER.read_text())
@@ -341,6 +655,15 @@ def main(argv=None) -> int:
                              "schedule (utils/faults.py) and assert the "
                              "verdict equals the fault-free sequential chain "
                              "or fails loudly with a typed error")
+    parser.add_argument("--serve", action="store_true",
+                        help="soak the serving layer (serve.py) instead of "
+                             "one-shot solves: churn-trace streams through a "
+                             "live ServeEngine (with --chaos: under seeded "
+                             "serve.* fault schedules) plus a SIGKILL "
+                             "mid-stream + journal-replay round per even "
+                             "seed; oracle-equal verdicts or typed errors "
+                             "only, zero lost / zero duplicated across the "
+                             "kill")
     args = parser.parse_args(argv)
 
     # The differential contract is platform-independent, so the harness
@@ -352,6 +675,8 @@ def main(argv=None) -> int:
 
         honor_platform_env()
 
+    if args.serve:
+        return serve_soak_main(args)
     if args.chaos:
         return chaos_main(args)
 
