@@ -70,6 +70,94 @@ def build_traffic(args) -> list:
     return traffic
 
 
+def run_churn_phase(args, record) -> tuple:
+    """The qi-delta churn phase: every request is a NEW consecutive churn
+    step over a multi-SCC stellar-like base, so the snapshot-level verdict
+    cache (PR 8) misses on every structurally changed step and the per-SCC
+    store (delta.py) carries the reuse.  Returns ``(row_fields,
+    mismatches)``; the headline numbers are ``delta_scc_reuse_pct`` (SCC
+    verdict-store hits as a % of lookups — watcher churn should keep the
+    core fragment hot) and ``delta_resolve_ratio`` (backend solves per
+    trace snapshot; 1.0 = no incremental reuse at all)."""
+    from quorum_intersection_tpu.fbas import synth
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.serve import ServeEngine, ServeError
+
+    steps = args.churn_steps or min(args.requests, 60)
+    base = synth.stellar_like_fbas(
+        n_core_orgs=3, per_org=2, n_watchers=max(args.nodes, 12),
+        n_null=2, n_dangling=1, seed=args.seed + 1,
+    )
+    trace = synth.churn_trace(base, steps, seed=args.seed)
+    expected = [solve(s, backend="python").intersects for s in trace]
+    c0, _ = record.snapshot()
+    # Same driver flags as the main-phase engine, so the persisted churn
+    # row describes the configuration that actually ran.  The journal
+    # stays off here: the churn phase measures the per-SCC store, and a
+    # second engine replaying the main phase's journal would double-serve
+    # its requests.
+    engine = ServeEngine(
+        backend=args.backend, cache_max=args.cache_max,
+        queue_depth=args.queue_depth, batch_max=args.batch_max,
+        deadline_s=args.deadline_s,
+    )
+    engine.start()
+    tickets = []
+    shed = 0
+    t0 = time.perf_counter()
+    with record.span("serve.bench_churn", steps=len(trace)):
+        for i, snap in enumerate(trace):
+            target = t0 + i / args.rate
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                tickets.append((i, engine.submit(snap)))
+            except ServeError:
+                shed += 1
+        engine.stop(drain=True, timeout=600.0)
+    wall_s = time.perf_counter() - t0
+
+    served = 0
+    mismatches = []
+    for i, ticket in tickets:
+        try:
+            resp = ticket.result(timeout=60.0)
+        except ServeError as exc:
+            print(f"churn typed error at step {i}: {exc}", file=sys.stderr)
+            continue
+        except TimeoutError:
+            print(f"CHURN SILENT DROP: step {i} reached no outcome",
+                  file=sys.stderr)
+            mismatches.append(f"churn step {i}: no outcome (silent drop)")
+            continue
+        served += 1
+        if resp.intersects is not expected[i]:
+            mismatches.append(
+                f"churn step {i}: served {resp.intersects} != oracle "
+                f"{expected[i]}"
+            )
+    c1, _ = record.snapshot()
+    hits = c1.get("delta.scc_hits", 0) - c0.get("delta.scc_hits", 0)
+    misses = c1.get("delta.scc_misses", 0) - c0.get("delta.scc_misses", 0)
+    solves = c1.get("delta.solves", 0) - c0.get("delta.solves", 0)
+    reuse_pct = 100.0 * hits / (hits + misses) if hits + misses else 0.0
+    row = {
+        "churn_steps": len(trace),
+        "churn_served": served,
+        "churn_shed": shed,
+        "delta_scc_reuse_pct": round(reuse_pct, 2),
+        "delta_resolve_ratio": (
+            round(solves / len(trace), 4) if trace else 0.0
+        ),
+        "churn_verdicts_per_sec": (
+            round(served / wall_s, 2) if wall_s > 0 else 0.0
+        ),
+    }
+    record.gauge("delta.bench_reuse_pct", row["delta_scc_reuse_pct"])
+    return row, mismatches
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--requests", type=int, default=300,
@@ -96,6 +184,18 @@ def main(argv=None) -> int:
     parser.add_argument("--cache-max", type=int, default=None)
     parser.add_argument("--journal", default=None,
                         help="exercise the crash-only journal on this path")
+    parser.add_argument("--churn", action="store_true",
+                        help="append the qi-delta churn phase (ISSUE 9): "
+                             "every request advances the churn trace one "
+                             "step, so snapshot-level caching never hits "
+                             "and the per-SCC store does the work — "
+                             "measures delta_scc_reuse_pct / "
+                             "delta_resolve_ratio (tools/bench_trend.py "
+                             "gates both) with the same per-step oracle "
+                             "parity bar")
+    parser.add_argument("--churn-steps", type=int, default=None,
+                        help="churn-phase trace length (default: "
+                             "min(requests, 60))")
     parser.add_argument("--quick", action="store_true",
                         help="CI smoke preset: 120 requests at 300/s")
     parser.add_argument("--metrics-json", default=None, metavar="PATH")
@@ -215,6 +315,13 @@ def main(argv=None) -> int:
         "verdict_ok": not mismatches,
         "device": os.environ.get("JAX_PLATFORMS", "ambient"),
     }
+    if args.churn:
+        churn_row, churn_mismatches = run_churn_phase(args, record)
+        row.update(churn_row)
+        mismatches.extend(churn_mismatches)
+        # The persisted row must agree with the exit code: a churn-phase
+        # parity failure flips verdict_ok too, not just the return value.
+        row["verdict_ok"] = not mismatches
     for m in mismatches:
         print(f"SERVE PARITY MISMATCH: {m}", file=sys.stderr)
     # Accounting invariant: every admitted request reached exactly one

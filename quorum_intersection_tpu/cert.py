@@ -193,9 +193,18 @@ def build_certificate(
     target_scc_index: Optional[int] = None,
     events: Optional[List[dict]] = None,
     batched: bool = False,
+    delta: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Assemble one ``qi-cert/1`` certificate and emit its telemetry
-    summary (``cert.emitted`` event + ``cert.certificates`` counter)."""
+    summary (``cert.emitted`` event + ``cert.certificates`` counter).
+
+    ``delta`` (qi-delta, ISSUE 9) is the incremental-re-analysis stamp:
+    reused vs re-solved SCC counts for this verdict, recorded under
+    ``provenance.delta`` so a consumer can tell a composed certificate
+    (cached SCC fragments stitched against this snapshot) from a
+    from-scratch solve.  Purely provenance: the witness/ledger claims are
+    rebuilt against THIS graph either way, so ``tools/check_cert.py``
+    validates both identically."""
     rec = get_run_record()
     cert: Dict[str, object] = {
         "schema": CERT_SCHEMA,
@@ -232,6 +241,8 @@ def build_certificate(
             "events_truncated": rec.events_truncated(),
         },
     }
+    if delta is not None:
+        cert["provenance"]["delta"] = dict(delta)  # type: ignore[index]
     summary: Dict[str, object] = {
         "verdict": bool(intersects),
         "backend": stats.get("backend", reason),

@@ -1,0 +1,738 @@
+"""qi-delta/1 — incremental re-analysis (ISSUE 9 tentpole).
+
+The serving layer answers a *stream* of stellarbeat snapshots, and PR 8's
+verdict cache is all-or-nothing per snapshot fingerprint: one threshold
+wobble in one SCC forces a full re-solve of every SCC, even though the
+NP-hard work decomposes per-SCC (arXiv:1902.06493) and "Read-Write Quorum
+Systems Made Practical" (arXiv:2104.04102) treats quorum analysis as a
+continuously re-queried service artifact.  This module closes the gap:
+
+- :class:`SccVerdictStore` — an LRU store (``QI_DELTA_CACHE_MAX``) keyed
+  by the SCC-local fingerprints of ``fbas/diff.py``: per-SCC **scan**
+  results (the polynomial max-quorum fixpoint, re-run for every SCC of
+  every snapshot today) and per-SCC **search verdicts** (the exponential
+  disjointness search plus its qi-cert ledger/witness fragment), both in
+  SCC-local coordinates so they project onto any snapshot whose component
+  is structurally identical.  Concurrent misses on one fingerprint are
+  **single-flight**: one leader solves, followers wait and reuse
+  (``tools/analyze/schedules.py`` forces the orderings).
+- :class:`DeltaEngine` — the delta-aware twin of
+  :func:`pipeline.check_many`: per snapshot it re-runs only the cheap
+  structural prefix (parse → graph → Tarjan), serves every fingerprint-
+  unchanged SCC's scan and the target SCC's verdict from the store, and
+  sends **only dirty/new SCCs** to a backend.  A ``churn_trace`` step that
+  wobbles one watcher SCC therefore re-solves *zero* SCCs; a step that
+  dirties the quorum-bearing core re-solves exactly that one.
+- **Composed certificates**: a store hit stitches the cached SCC
+  ledger/witness fragment into a fresh ``qi-cert/1`` built against the
+  *new* snapshot (guard counts, node ids, and witness evidence recomputed
+  — only the structural verdict and its coverage arithmetic are reused),
+  stamped ``provenance.delta`` with reused vs re-solved SCC counts, and
+  still checkable by the unmodified stdlib ``tools/check_cert.py``.
+
+The diff/fingerprint path is a declared fault point (``delta.diff``,
+docs/ROBUSTNESS.md): an injected or real failure there degrades to the
+full re-solve chain (``pipeline.check_many``) — incremental re-analysis is
+an optimization, never a precondition for a verdict.  Telemetry
+(``qi-telemetry/1``): ``delta.*`` spans/events/counters plus the
+``delta.scc_reuse_pct`` / ``delta.store_size`` gauges ``/healthz`` and
+``/metrics`` expose (docs/OBSERVABILITY.md registry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple, Union
+
+from quorum_intersection_tpu.backends.base import (
+    SearchBackend,
+    SearchCancelled,
+)
+from quorum_intersection_tpu.cert import build_certificate
+from quorum_intersection_tpu.fbas.diff import (
+    diff_snapshots,
+    localize,
+    project,
+    scc_fingerprint,
+)
+from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph
+from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.pipeline import (
+    SolveResult,
+    _classify_sccs,
+    check_many,
+    scan_scc_quorums,
+)
+from quorum_intersection_tpu.utils.env import qi_env_int
+from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.timers import PhaseTimers
+
+log = get_logger("delta")
+
+DELTA_SCHEMA = "qi-delta/1"
+
+# Deterministic-interleaving hook (tools/analyze/schedules.py): a no-op in
+# production; the schedule harness swaps in a SyncController to FORCE the
+# store's single-flight orderings (follower-waits-for-leader,
+# leader-fails-follower-takes-over) the wall clock almost never produces.
+_delta_sync: Callable[[str], None] = lambda point: None
+
+# Bound on one single-flight wait: a follower whose leader died without
+# publishing takes the lease over instead of wedging the drain forever.
+LEASE_WAIT_S = 60.0
+
+# Stats keys that describe the ORIGINAL solve's run, not the verdict: they
+# are dropped from stored fragments so a composed result never claims a
+# stale race outcome as its own (native/bnb counters stay — they ARE the
+# coverage evidence the composed ledger re-serves).
+_VOLATILE_STATS = ("race",)
+
+_StoreKey = Tuple[str, str, str]
+
+
+@dataclass
+class SccScan:
+    """Cached per-SCC quorum-scan result, SCC-local coordinates."""
+
+    quorum_local: Tuple[int, ...]  # () = no quorum inside this SCC
+
+
+@dataclass
+class SccVerdict:
+    """Cached per-SCC search verdict + its certificate fragment."""
+
+    intersects: bool
+    q1_local: Optional[List[int]]
+    q2_local: Optional[List[int]]
+    stats: Dict[str, object] = field(default_factory=dict)
+
+
+class SccVerdictStore:
+    """LRU-bounded, single-flight store of per-SCC scans and verdicts.
+
+    One LRU budget (``QI_DELTA_CACHE_MAX``) covers both entry kinds — scan
+    entries are tiny next to verdict fragments, but a shared bound keeps
+    the occupancy gauge honest.  Thread-safe; telemetry is emitted outside
+    the lock (lock-discipline: never emit while holding one).
+    """
+
+    def __init__(self, max_entries: Optional[int] = None) -> None:
+        self.max_entries = max(
+            max_entries if max_entries is not None
+            else qi_env_int("QI_DELTA_CACHE_MAX", 4096),
+            1,
+        )
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[_StoreKey, object]" = OrderedDict()
+        self._pending: Dict[_StoreKey, threading.Event] = {}
+        self._scc_hits = 0
+        self._scc_misses = 0
+
+    @staticmethod
+    def _vkey(fp: str, scope_to_scc: bool) -> _StoreKey:
+        return ("verdict", fp, str(int(scope_to_scc)))
+
+    # ---- internal ---------------------------------------------------------
+
+    def _put(self, key: _StoreKey, value: object) -> None:
+        evicted = 0
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                evicted += 1
+            size = len(self._entries)
+        rec = get_run_record()
+        if evicted:
+            rec.add("delta.store_evictions", evicted)
+        rec.gauge("delta.store_size", size)
+
+    def _note_verdict_lookup(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self._scc_hits += 1
+            else:
+                self._scc_misses += 1
+            hits, misses = self._scc_hits, self._scc_misses
+        rec = get_run_record()
+        rec.add("delta.scc_hits" if hit else "delta.scc_misses")
+        rec.gauge(
+            "delta.scc_reuse_pct",
+            round(100.0 * hits / (hits + misses), 2) if hits + misses else 0.0,
+        )
+
+    # ---- scans ------------------------------------------------------------
+
+    def get_scan(self, fp: str) -> Optional[SccScan]:
+        key = ("scan", fp, "")
+        with self._lock:
+            scan = self._entries.get(key)
+            if scan is not None:
+                self._entries.move_to_end(key)
+        rec = get_run_record()
+        rec.add("delta.scan_hits" if scan is not None else "delta.scan_misses")
+        return scan  # type: ignore[return-value]
+
+    def put_scan(self, fp: str, scan: SccScan) -> None:
+        self._put(("scan", fp, ""), scan)
+
+    # ---- verdicts (single-flight) -----------------------------------------
+
+    def peek_verdict(
+        self, fp: str, scope_to_scc: bool
+    ) -> Optional[SccVerdict]:
+        """Plain lookup, no lease, no hit/miss accounting — the intra-batch
+        follower probe after its leader's batch solved."""
+        key = self._vkey(fp, scope_to_scc)
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self._entries.move_to_end(key)
+        return cached  # type: ignore[return-value]
+
+    def lease_verdict(
+        self, fp: str, scope_to_scc: bool
+    ) -> Tuple[str, Optional[SccVerdict]]:
+        """``("hit", verdict)`` or ``("leader", None)``.
+
+        A concurrent leader already solving this fingerprint parks the
+        caller until :meth:`publish_verdict` fires, then re-probes: the
+        published verdict is a hit; a leader that failed (published
+        ``None``) hands the lease over — the caller becomes the new
+        leader.  Bounded by :data:`LEASE_WAIT_S` so a dead leader can
+        never wedge a drain.
+        """
+        key = self._vkey(fp, scope_to_scc)
+        while True:
+            wait_ev: Optional[threading.Event] = None
+            cached: Optional[object] = None
+            with self._lock:
+                cached = self._entries.get(key)
+                if cached is not None:
+                    self._entries.move_to_end(key)
+                elif key in self._pending:
+                    wait_ev = self._pending[key]
+                else:
+                    self._pending[key] = threading.Event()
+            if cached is not None:
+                self._note_verdict_lookup(True)
+                return "hit", cached  # type: ignore[return-value]
+            if wait_ev is None:
+                self._note_verdict_lookup(False)
+                _delta_sync("store.leader")
+                return "leader", None
+            _delta_sync("store.wait")
+            if not wait_ev.wait(LEASE_WAIT_S):
+                # Leader died without publishing: exactly ONE timed-out
+                # waiter takes the lease over — it swaps in a fresh event
+                # so later arrivals (and the other timed-out waiters, who
+                # loop) park on the new leader instead of all becoming
+                # leaders and re-solving the same fingerprint N times.
+                # Should the presumed-dead leader publish after all, its
+                # publish pops the fresh event and wakes those waiters to
+                # re-probe — correctness is unaffected either way.
+                with self._lock:
+                    if self._pending.get(key) is not wait_ev:
+                        continue  # published or already taken over: re-probe
+                    self._pending[key] = threading.Event()
+                self._note_verdict_lookup(False)
+                _delta_sync("store.leader")
+                return "leader", None
+
+    def publish_verdict(
+        self, fp: str, scope_to_scc: bool, verdict: Optional[SccVerdict]
+    ) -> None:
+        """Resolve a lease: store ``verdict`` (``None`` = the leader's
+        solve failed or was uncacheable; waiting followers re-contend for
+        the lease) and wake every waiter."""
+        key = self._vkey(fp, scope_to_scc)
+        if verdict is not None:
+            self._put(key, verdict)
+        with self._lock:
+            ev = self._pending.pop(key, None)
+        if ev is not None:
+            ev.set()
+        _delta_sync("store.publish")
+
+    # ---- introspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def reuse_pct(self) -> float:
+        with self._lock:
+            total = self._scc_hits + self._scc_misses
+            return 100.0 * self._scc_hits / total if total else 0.0
+
+
+@dataclass
+class _SourceState:
+    """Per-source bookkeeping across the classify → compose/solve phases."""
+
+    ix: int
+    fbas: Fbas
+    graph: TrustGraph
+    n_sccs: int = 0
+    quorum_scc_ids: List[int] = field(default_factory=list)
+    scc_quorums: Dict[int, List[int]] = field(default_factory=dict)
+    main_scc: List[int] = field(default_factory=list)
+    target_scc: List[int] = field(default_factory=list)
+    target_index: int = 0
+    target_fp: str = ""
+    cacheable: bool = False
+    scan_reused: int = 0
+    scan_fresh: int = 0
+    ev0: int = 0
+    timers: Dict[str, float] = field(default_factory=dict)
+
+
+class DeltaEngine:
+    """Delta-aware batch solver (see module docstring).
+
+    One engine per serving configuration: all snapshots it sees share the
+    front-end options (dangling policy, SCC selection, scoping), which is
+    what makes per-SCC fragments interchangeable across them.  The engine
+    remembers the previous snapshot's graph and emits a
+    ``delta.classified`` event per snapshot with the
+    :func:`fbas.diff.diff_snapshots` summary — the observable that tells
+    "cosmetic churn" from "core restructure" in a live ``/metrics``
+    scrape.
+    """
+
+    def __init__(
+        self,
+        store: Optional[SccVerdictStore] = None,
+        *,
+        dangling: str = "strict",
+        scc_select: str = "quorum-bearing",
+        scope_to_scc: bool = False,
+        track_diff: bool = True,
+    ) -> None:
+        self.store = store if store is not None else SccVerdictStore()
+        self.dangling = dangling
+        self.scc_select = scc_select
+        self.scope_to_scc = scope_to_scc
+        self.track_diff = track_diff
+        # Previous snapshot's (graph, partition, fingerprints) — kept so
+        # the per-snapshot delta.classified diff costs only overlap
+        # bookkeeping, never a second Tarjan/fingerprint pass.
+        self._prev: Optional[
+            Tuple[TrustGraph, List[List[int]], List[Tuple[str, bool]]]
+        ] = None
+
+    # ---- entry point ------------------------------------------------------
+
+    def check_many(
+        self,
+        sources: List[object],
+        *,
+        backend: Union[str, SearchBackend] = "auto",
+        pack: Optional[bool] = None,
+    ) -> List[SolveResult]:
+        """Batch verdicts for ``sources``, reusing per-SCC work.
+
+        Semantics contract: result verdicts, witnesses and certificates
+        are interchangeable with :func:`pipeline.check_many`'s for the
+        same sources (the differential suite in ``tests/test_qi_delta.py``);
+        only *which engine re-derives them* changes.  Degrades to the full
+        re-solve chain on an injected/real ``delta.diff`` failure — and on
+        ANY unexpected error in the incremental body itself (fingerprint,
+        diff, store, compose): incremental re-analysis is an optimization,
+        never a precondition for a verdict.  Only cooperative cancellation
+        (``SearchCancelled``, the serve deadline path) propagates.
+        """
+        rec = get_run_record()
+        try:
+            fault_point("delta.diff")
+        except (FaultInjected, OSError) as exc:
+            rec.add("delta.diff_faults")
+            return self._degrade(sources, backend, pack, exc)
+        try:
+            return self._check_many_incremental(sources, backend, pack)
+        except SearchCancelled:
+            raise
+        except Exception as exc:  # noqa: BLE001 — any differ/store failure
+            # degrades to the full chain (docs/ROBUSTNESS.md contract);
+            # the verdict must never depend on the optimization working.
+            rec.add("delta.errors")
+            return self._degrade(sources, backend, pack, exc)
+
+    def _degrade(
+        self,
+        sources: List[object],
+        backend: Union[str, SearchBackend],
+        pack: Optional[bool],
+        exc: BaseException,
+    ) -> List[SolveResult]:
+        rec = get_run_record()
+        rec.event("delta.degraded", error=str(exc))
+        log.warning(
+            "delta path failed (%s); degrading to full re-solve", exc,
+        )
+        return check_many(
+            sources, backend=backend, dangling=self.dangling,
+            scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+            pack=pack,
+        )
+
+    def _check_many_incremental(
+        self,
+        sources: List[object],
+        backend: Union[str, SearchBackend],
+        pack: Optional[bool],
+    ) -> List[SolveResult]:
+        rec = get_run_record()
+        allow_native = backend_name(backend) != "python"
+        results: List[Optional[SolveResult]] = [None] * len(sources)
+        misses: List[_SourceState] = []
+        followers: List[_SourceState] = []
+        # Fingerprints THIS call holds the lease for: an identical snapshot
+        # later in the same batch must not wait on its own batch's lease
+        # (single-thread deadlock) — it becomes an intra-batch follower and
+        # composes from the store after the leader's batch solve lands.
+        held: Set[str] = set()
+        reused = 0
+        with rec.span("delta.check", sources=len(sources)):
+            try:
+                for ix, source in enumerate(sources):
+                    st = self._classify(ix, source, allow_native)
+                    if len(st.quorum_scc_ids) != 1:
+                        results[ix] = self._guard_result(st)
+                        continue
+                    if not st.cacheable:
+                        get_run_record().add("delta.uncacheable")
+                        misses.append(st)
+                        continue
+                    if st.target_fp in held:
+                        followers.append(st)
+                        continue
+                    outcome, cached = self.store.lease_verdict(
+                        st.target_fp, self.scope_to_scc
+                    )
+                    if outcome == "hit":
+                        assert cached is not None
+                        results[ix] = self._compose(st, cached)
+                        reused += 1
+                    else:
+                        held.add(st.target_fp)
+                        misses.append(st)
+                if misses:
+                    self._solve_misses(misses, results, backend, pack, held)
+                for st in followers:
+                    cached = self.store.peek_verdict(
+                        st.target_fp, self.scope_to_scc
+                    )
+                    # Intra-batch followers count toward the reuse gauge
+                    # too: a composition IS a reuse, whichever flight path
+                    # (lease wait vs same-batch peek) delivered the
+                    # fragment — and a straggler that must re-solve is a
+                    # miss the gauge must not hide.
+                    self.store._note_verdict_lookup(cached is not None)
+                    if cached is not None:
+                        results[st.ix] = self._compose(st, cached)
+                        reused += 1
+                if any(
+                    results[st.ix] is None for st in followers
+                ):
+                    # The leader's fragment never landed (failed solve /
+                    # witness escaped the SCC): solve the stragglers
+                    # directly — correctness over reuse.
+                    strag = [st for st in followers if results[st.ix] is None]
+                    self._solve_misses(strag, results, backend, pack, set())
+            finally:
+                # Any lease still held here (an exception mid-batch, a
+                # deadline cancel inside the backend solve) is released as
+                # a failure so concurrent followers re-contend instead of
+                # wedging until the lease timeout.
+                for fp in held:
+                    self.store.publish_verdict(fp, self.scope_to_scc, None)
+        if reused:
+            rec.add("delta.compositions", reused)
+        return [r for r in results if r is not None]
+
+    # ---- classification ---------------------------------------------------
+
+    def _classify(
+        self, ix: int, source: object, allow_native: bool
+    ) -> _SourceState:
+        """The structural prefix: parse → graph → the SAME
+        ``pipeline._classify_sccs`` guard/selection logic the one-shot
+        entry points share (so incremental guard verdicts cannot drift),
+        with a store-aware scan provider that serves every
+        fingerprint-matched SCC's scan from cache (the polynomial half of
+        incremental re-analysis)."""
+        rec = get_run_record()
+        timers = PhaseTimers()
+        with timers.phase("parse"):
+            fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+        with timers.phase("graph"):
+            graph = build_graph(fbas, dangling=self.dangling)
+        st = _SourceState(ix=ix, fbas=fbas, graph=graph)
+        st.ev0 = rec.event_count()
+
+        fps: List[Tuple[str, bool]] = []
+        parts: List[List[int]] = []
+
+        def store_scan(
+            g: TrustGraph, sccs: List[List[int]], *, allow_native: bool
+        ) -> List[Optional[List[int]]]:
+            parts.extend(sccs)
+            quorums, scc_fps, reused, fresh = self._serve_scans(
+                g, sccs, allow_native
+            )
+            fps.extend(scc_fps)
+            st.scan_reused += reused
+            st.scan_fresh += fresh
+            return quorums
+
+        count, sccs, quorum_scc_ids, scc_quorums, main_scc = _classify_sccs(
+            graph, allow_native=allow_native, scc_select=self.scc_select,
+            timers=timers, scan=store_scan,
+        )
+        st.n_sccs = count
+        st.quorum_scc_ids = quorum_scc_ids
+        st.scc_quorums = scc_quorums
+        st.main_scc = main_scc
+        if self.track_diff:
+            # The diff summary costs only overlap bookkeeping: both
+            # snapshots' partitions and fingerprints are already in hand
+            # (this one's from the scan above, the previous one's kept).
+            if self._prev is not None:
+                prev_graph, prev_parts, prev_fps = self._prev
+                diff = diff_snapshots(
+                    prev_graph, graph,
+                    old_parts=prev_parts, old_fps_list=prev_fps,
+                    new_parts=sccs, new_fps_list=fps,
+                )
+                rec.event("delta.classified", **diff.summary())
+            self._prev = (graph, sccs, fps)
+        if len(st.quorum_scc_ids) == 1:
+            st.target_index = (
+                0 if self.scc_select == "front" else st.quorum_scc_ids[0]
+            )
+            st.target_scc = sccs[st.target_index]
+            st.target_fp, closed = fps[st.target_index]
+            # Soundness gate (fbas/diff.py module docstring): under the
+            # reference's whole-graph availability, a stored verdict is
+            # only reusable when the component cannot see outside itself.
+            st.cacheable = closed or self.scope_to_scc
+        st.timers = dict(timers.totals)
+        return st
+
+    def _serve_scans(
+        self, graph: TrustGraph, sccs: List[List[int]], allow_native: bool
+    ) -> Tuple[
+        List[Optional[List[int]]], List[Tuple[str, bool]], int, int
+    ]:
+        """Per-SCC quorum scans with the store in front: every
+        fingerprint-matched SCC's scan comes from cache, misses run the
+        real :func:`pipeline.scan_scc_quorums` and are banked.  Returns
+        ``(quorums, fingerprints, reused, fresh)``.  Shared by the
+        classification prefix AND the re-solve leg (via ``check_many``'s
+        ``scan`` hook), so a dirty snapshot's unchanged SCCs never re-run
+        their fixpoints either."""
+        fps = [scc_fingerprint(graph, members) for members in sccs]
+        quorums: List[Optional[List[int]]] = [None] * len(sccs)
+        miss_ids: List[int] = []
+        reused = 0
+        for sid, members in enumerate(sccs):
+            scan = self.store.get_scan(fps[sid][0])
+            if scan is None:
+                miss_ids.append(sid)
+            else:
+                quorums[sid] = project(list(scan.quorum_local), members)
+                reused += 1
+        if miss_ids:
+            fresh = scan_scc_quorums(
+                graph, [sccs[sid] for sid in miss_ids],
+                allow_native=allow_native,
+            )
+            for sid, quorum in zip(miss_ids, fresh):
+                quorums[sid] = quorum
+                local = localize(quorum, sccs[sid])
+                if local is not None:
+                    self.store.put_scan(
+                        fps[sid][0], SccScan(quorum_local=tuple(local))
+                    )
+        return quorums, fps, reused, len(miss_ids)
+
+    # ---- composition ------------------------------------------------------
+
+    def _compose(self, st: _SourceState, cached: SccVerdict) -> SolveResult:
+        """Stitch one cached fragment into a full result + certificate
+        against THIS snapshot's graph (guard, node ids and witness
+        evidence all rebuilt fresh — see module docstring)."""
+        rec = get_run_record()
+        t0 = time.perf_counter()
+        q1 = project(cached.q1_local, st.target_scc)
+        q2 = project(cached.q2_local, st.target_scc)
+        stats: Dict[str, object] = dict(cached.stats)
+        stats["delta"] = {
+            "reused": True,
+            "solved_seconds": stats.get("seconds"),
+        }
+        delta_stamp = {
+            "schema": DELTA_SCHEMA,
+            "reused_sccs": 1,
+            "resolved_sccs": 0,
+            "scan_reused": st.scan_reused,
+            "scan_fresh": st.scan_fresh,
+        }
+        timers = dict(st.timers)
+        timers["search"] = time.perf_counter() - t0
+        stats["seconds"] = timers["search"]
+        res = SolveResult(
+            intersects=cached.intersects,
+            n_sccs=st.n_sccs,
+            quorum_scc_ids=list(st.quorum_scc_ids),
+            main_scc=st.main_scc,
+            q1=q1,
+            q2=q2,
+            stats=stats,
+            timers=timers,
+            cert=build_certificate(
+                st.graph, intersects=cached.intersects, reason="search",
+                n_sccs=st.n_sccs, quorum_bearing=len(st.quorum_scc_ids),
+                scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+                stats=stats, q1=q1, q2=q2,
+                target_scc=st.target_scc, target_scc_index=st.target_index,
+                events=rec.events_since(st.ev0), batched=True,
+                delta=delta_stamp,
+            ),
+        )
+        rec.event(
+            "delta.composed", fingerprint=st.target_fp,
+            verdict=cached.intersects, backend=stats.get("backend"),
+        )
+        return res
+
+    def _guard_result(self, st: _SourceState) -> SolveResult:
+        """Guard-decided snapshot (zero or >= 2 quorum-bearing SCCs) —
+        exactly :func:`pipeline.check_many`'s guard path, with the scans
+        possibly served from the store."""
+        rec = get_run_record()
+        q1 = q2 = None
+        if len(st.quorum_scc_ids) >= 2:
+            q1 = st.scc_quorums[st.quorum_scc_ids[0]]
+            q2 = st.scc_quorums[st.quorum_scc_ids[1]]
+        delta_stamp = {
+            "schema": DELTA_SCHEMA,
+            "reused_sccs": 0,
+            "resolved_sccs": 0,
+            "scan_reused": st.scan_reused,
+            "scan_fresh": st.scan_fresh,
+        }
+        return SolveResult(
+            intersects=False, n_sccs=st.n_sccs,
+            quorum_scc_ids=list(st.quorum_scc_ids), main_scc=st.main_scc,
+            q1=q1, q2=q2, stats={"reason": "scc_guard"},
+            timers=dict(st.timers),
+            cert=build_certificate(
+                st.graph, intersects=False, reason="scc_guard",
+                n_sccs=st.n_sccs, quorum_bearing=len(st.quorum_scc_ids),
+                scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+                stats={"reason": "scc_guard"}, q1=q1, q2=q2,
+                events=rec.events_since(st.ev0), batched=True,
+                delta=delta_stamp,
+            ),
+        )
+
+    # ---- backend solves ---------------------------------------------------
+
+    def _solve_misses(
+        self,
+        misses: List[_SourceState],
+        results: List[Optional[SolveResult]],
+        backend: Union[str, SearchBackend],
+        pack: Optional[bool],
+        held: Set[str],
+    ) -> None:
+        """Send the dirty/new target SCCs to the real backend (one batched
+        ``check_many`` call — lane packing and the ladder apply as ever),
+        then bank each solved fragment and release its lease."""
+        rec = get_run_record()
+        rec.add("delta.solves", len(misses))
+        # The classification prefix already scanned every one of these
+        # snapshots; check_many re-derives the same partition from the
+        # same Fbas deterministically, so the re-solve leg re-serves the
+        # prefix's per-SCC quorums verbatim (non-quorum SCCs scanned
+        # empty) — no fixpoint, fingerprint, or store work re-runs, and
+        # the delta.scan_* counters count each SCC exactly once.
+        seq = iter(misses)
+
+        def store_scan(
+            g: TrustGraph, sccs: List[List[int]], *, allow_native: bool
+        ) -> List[Optional[List[int]]]:
+            st = next(seq)
+            return [
+                st.scc_quorums.get(sid, []) for sid in range(len(sccs))
+            ]
+
+        solved = check_many(
+            [st.fbas for st in misses], backend=backend,
+            dangling=self.dangling, scc_select=self.scc_select,
+            scope_to_scc=self.scope_to_scc, pack=pack,
+            delta={
+                "schema": DELTA_SCHEMA,
+                "reused_sccs": 0,
+                "resolved_sccs": 1,
+            },
+            scan=store_scan,
+        )
+        for st, res in zip(misses, solved):
+            results[st.ix] = res
+            self._bank(st, res, held)
+
+    def _bank(
+        self, st: _SourceState, res: SolveResult, held: Set[str]
+    ) -> None:
+        """Store one freshly solved fragment and publish its lease.
+
+        Publishes a failed lease (followers re-contend) whenever the
+        fragment could not faithfully re-serve: an un-closed SCC under
+        whole-graph availability, a guard flip mid-flight, or a witness
+        that escaped the component."""
+        publishable: Optional[SccVerdict] = None
+        if st.cacheable and res.stats.get("reason") != "scc_guard":
+            q1_local = localize(res.q1, st.target_scc)
+            q2_local = localize(res.q2, st.target_scc)
+            witness_ok = res.intersects or (
+                q1_local is not None and q2_local is not None
+            )
+            if witness_ok:
+                stats = {
+                    k: v for k, v in res.stats.items()
+                    if k not in _VOLATILE_STATS
+                }
+                publishable = SccVerdict(
+                    intersects=bool(res.intersects),
+                    q1_local=q1_local, q2_local=q2_local, stats=stats,
+                )
+        if st.target_fp in held:
+            held.discard(st.target_fp)
+            self.store.publish_verdict(
+                st.target_fp, self.scope_to_scc, publishable
+            )
+        elif publishable is not None:
+            # An intra-batch straggler re-solved after its leader's
+            # fragment failed to land: bank the fresh fragment directly
+            # (publishable is only ever built for a cacheable state).
+            self.store.publish_verdict(
+                st.target_fp, self.scope_to_scc, publishable
+            )
+
+
+def backend_name(backend: Union[str, SearchBackend, None]) -> str:
+    """Best-effort backend name for routing decisions (scan path)."""
+    if backend is None:
+        return "auto"
+    if isinstance(backend, str):
+        return backend
+    return getattr(backend, "name", "auto")

@@ -15,7 +15,8 @@ from __future__ import annotations
 import copy
 import json
 import random
-from typing import Dict, List, Optional
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
 
 
 def _node(key: str, name: str, qset: Dict) -> Dict:
@@ -187,12 +188,279 @@ def benchmark_fbas(
     return nodes
 
 
+# The default churn mix (the three bounded mutations a live stellarbeat
+# feed actually produces — see churn_trace_steps); the restructuring kinds
+# scc_split / scc_merge are opt-in via ``kinds`` because they change the
+# SCC partition itself, which most load-shaped consumers don't want.
+CHURN_KINDS = ("threshold", "swap", "rename")
+
+def _scc_partition(snapshot: List[Dict]) -> Tuple[List[int], List[str]]:
+    """``(comp, keys)``: the snapshot's SCC component id per node (JSON
+    order) and each node's publicKey — the ground truth churn annotations
+    are expressed against.  Uses the real front end (parse → build →
+    Tarjan) so annotations agree with what the pipeline will see."""
+    from quorum_intersection_tpu.fbas.graph import build_graph, tarjan_scc
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+
+    fbas = parse_fbas(snapshot)
+    graph = build_graph(fbas)
+    _, comp = tarjan_scc(graph.n, graph.succ)
+    return comp, list(graph.node_ids)
+
+
+def _key_sets(comp: List[int], keys: List[str]) -> List[frozenset]:
+    """One partition as member-publicKey sets (the ground-truth currency
+    of the restructure annotations)."""
+    groups: Dict[int, set] = {}
+    for v, c in enumerate(comp):
+        groups.setdefault(c, set()).add(keys[v])
+    return [frozenset(g) for g in groups.values()]
+
+
+def churn_trace_steps(
+    base: List[Dict],
+    steps: int,
+    seed: int = 0,
+    *,
+    max_diff: int = 2,
+    kinds: Tuple[str, ...] = CHURN_KINDS,
+    annotate: bool = True,
+) -> Tuple[List[List[Dict]], List[Dict]]:
+    """Deterministic snapshot stream with **ground-truth step annotations**
+    (qi-delta, ISSUE 9): ``(trace, metas)`` where ``trace`` has
+    ``steps + 1`` consecutive snapshots starting at ``base`` and
+    ``metas[k]`` describes the mutations that produced ``trace[k + 1]``:
+
+    - ``mutations``: ``[{kind, node, scc_id}, ...]`` — each churned node's
+      publicKey and its SCC id in the **predecessor** snapshot's partition
+      (merge mutations list both touched nodes);
+    - ``affected_scc_ids``: the predecessor-partition SCC ids whose
+      structural fingerprint the step invalidated — empty for a pure
+      cosmetic-rename step, so incremental tests can assert *exactly*
+      which SCCs a delta engine must re-derive;
+    - ``partition_changed`` / ``merges`` / ``splits``: whether the SCC
+      partition itself restructured (computed independently of
+      ``fbas/diff.py`` by comparing member-key sets, so the differ is
+      tested against ground truth, not against itself).
+
+    ``kinds`` selects the mutation mix.  Beyond the bounded trio
+    (**threshold wobble**, **validator swap**, **cosmetic rename** — see
+    :func:`churn_trace`), two restructuring kinds are available:
+
+    - ``scc_merge``: the churned node and a node of another SCC add each
+      other as validators — the 2-cycle merges their components;
+    - ``scc_split``: a node of a multi-node SCC replaces its quorum set
+      with a self-only slice (threshold 1 over itself), splitting off —
+      the classic broken-config shape, so expect guard-decided verdicts.
+
+    Either falls back to a threshold wobble when the partition offers no
+    candidate (a single SCC to merge, no multi-node SCC to split).
+
+    Same ``(base, steps, seed, max_diff, kinds)`` ⇒ byte-identical trace
+    and metas; annotation never consumes randomness, so ``annotate=False``
+    (what :func:`churn_trace` passes — load-shaped consumers pay no
+    parse/Tarjan passes for metas they discard) and the default ``kinds``
+    yield a byte-identical trace with empty metas.  Nodes with null
+    quorum sets are never churned.  Each snapshot is a deep copy:
+    mutating one never aliases another.
+    """
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    for kind in kinds:
+        if kind not in ("threshold", "swap", "rename", "scc_split",
+                        "scc_merge"):
+            raise ValueError(f"unknown churn kind {kind!r}")
+    rng = random.Random(seed)
+    trace = [copy.deepcopy(base)]
+    metas: List[Dict] = []
+    all_keys = [n.get("publicKey") for n in base if n.get("publicKey")]
+    # Predecessor partition: the coordinate system of the annotations and
+    # the candidate pool for merge/split.  Computed once per snapshot and
+    # carried forward — each step's successor partition (needed for the
+    # restructure ground truth anyway) IS the next step's predecessor, so
+    # the front end runs once per snapshot, not twice per step — and not
+    # at all when nothing needs it (annotate=False with the bounded trio,
+    # whose mutations never consult the partition).
+    needs_partition = annotate or bool(
+        {"scc_split", "scc_merge"}.intersection(kinds)
+    )
+    comp, keys = _scc_partition(base) if needs_partition else ([], [])
+    for step in range(steps):
+        prev = trace[-1]
+        snap = copy.deepcopy(prev)
+        mutable = [
+            i for i, n in enumerate(snap)
+            if isinstance(n.get("quorumSet"), dict)
+            and n["quorumSet"].get("validators")
+        ]
+        scc_of = dict(zip(keys, comp))
+        key_of_ix = [n.get("publicKey") for n in snap]
+        mutations: List[Dict] = []
+        affected: set = set()
+        for ix in (
+            rng.sample(mutable, min(max_diff, len(mutable))) if mutable else ()
+        ):
+            node = snap[ix]
+            q = node["quorumSet"]
+            kind = rng.choice(kinds)
+            own_scc = scc_of.get(key_of_ix[ix])
+            structural = False
+            extra: Dict = {}
+            if kind == "scc_merge":
+                partner = _merge_partner(
+                    rng, snap, mutable, scc_of, key_of_ix, own_scc
+                )
+                if partner is None:
+                    kind = "threshold"  # no second SCC to merge with
+                else:
+                    other = snap[partner]
+                    q["validators"].append(other["publicKey"])
+                    other["quorumSet"]["validators"].append(node["publicKey"])
+                    partner_scc = scc_of.get(key_of_ix[partner])
+                    structural = True
+                    extra = {"partner": other["publicKey"],
+                             "partner_scc_id": partner_scc}
+                    if partner_scc is not None:
+                        affected.add(partner_scc)
+            if kind == "scc_split":
+                # The drawn node may sit in a single-node SCC (most
+                # watchers do); redirect to a split-capable node so the
+                # requested kind actually restructures, falling back to a
+                # wobble only when NO multi-node SCC exists at all.
+                if sum(1 for c in comp if c == own_scc) < 2:
+                    sizes = Counter(comp)
+                    # A split only replaces the whole quorum set, so any
+                    # dict-qset member of a multi-node SCC qualifies —
+                    # including org-structured cores whose top-level
+                    # validator list is empty (all-inner-sets).
+                    capable = [
+                        j for j, n in enumerate(snap)
+                        if isinstance(n.get("quorumSet"), dict)
+                        and sizes.get(scc_of.get(key_of_ix[j]), 0) >= 2
+                    ]
+                    if not capable:
+                        kind = "threshold"  # nothing multi-node to split
+                    else:
+                        ix = rng.choice(capable)
+                        node = snap[ix]
+                        q = node["quorumSet"]
+                        own_scc = scc_of.get(key_of_ix[ix])
+                if kind == "scc_split":
+                    node["quorumSet"] = _qset(1, [node["publicKey"]])
+                    structural = True
+            if kind == "threshold":
+                lo, hi = 1, max(1, len(q["validators"]))
+                old_t = q.get("threshold", 1)
+                t = old_t + rng.choice((-1, 1))
+                q["threshold"] = min(max(t, lo), hi)
+                # A wobble clamped back to its old value mutated nothing.
+                structural = q["threshold"] != old_t
+            elif kind == "swap":
+                vix = rng.randrange(len(q["validators"]))
+                old_key = q["validators"][vix]
+                new_key = rng.choice(all_keys)
+                q["validators"][vix] = new_key
+                # SCC-local structure changes only when an endpoint is
+                # inside the owner's component or the dropped ref was
+                # dangling (strict policy folds dangling into n_dangling,
+                # a fingerprinted field); an outside→outside swap leaves
+                # the restricted problem identical — though it can still
+                # restructure the partition, which the key-set comparison
+                # below catches independently.
+                structural = old_key != new_key and (
+                    scc_of.get(old_key) == own_scc
+                    or scc_of.get(new_key) == own_scc
+                    or old_key not in scc_of
+                )
+                extra = {"old_key": old_key, "new_key": new_key}
+            elif kind == "rename":
+                node["name"] = f"{node.get('name', '')}~{rng.randrange(999)}"
+            mutations.append({
+                "kind": kind, "node": node.get("publicKey"),
+                "scc_id": own_scc, "structural": structural, **extra,
+            })
+            if structural and own_scc is not None:
+                affected.add(own_scc)
+        trace.append(snap)
+        if not needs_partition:
+            continue
+        old_parts = _key_sets(comp, keys)
+        comp, keys = _scc_partition(snap)  # becomes the next step's prev
+        if not annotate:
+            continue
+        # Partition restructure ground truth, by member-key sets (never by
+        # fingerprints — see docstring).  A validator swap can restructure
+        # the partition as a side effect (a new edge closing a cycle
+        # between components); every old SCC that gained or lost members
+        # is invalidated even when its own node wasn't churned.
+        new_parts = _key_sets(comp, keys)
+        new_set = set(new_parts)
+        changed = set(old_parts) != new_set
+        merges = sum(
+            1 for np in new_parts
+            if sum(1 for p in old_parts if p & np) >= 2
+        )
+        splits = sum(
+            1 for p in old_parts
+            if sum(1 for np in new_parts if p & np) >= 2
+        )
+        for part in old_parts:
+            if part not in new_set:
+                sid = old_ix_to_scc_id(part, scc_of)
+                if sid is not None:
+                    affected.add(sid)
+        metas.append({
+            "step": step + 1,
+            "mutations": mutations,
+            "affected_scc_ids": sorted(affected),
+            "partition_changed": changed,
+            "merges": merges,
+            "splits": splits,
+        })
+    # Determinism belt-and-braces: the trace must be JSON-serializable as
+    # produced (the serving layer journals exactly these dicts).
+    json.dumps(trace[-1])
+    json.dumps(metas)
+    return trace, metas
+
+
+def _merge_partner(
+    rng: random.Random,
+    snap: List[Dict],
+    mutable: List[int],
+    scc_of: Dict[str, int],
+    key_of_ix: List[Optional[str]],
+    own_scc: Optional[int],
+) -> Optional[int]:
+    """A deterministic merge partner: a mutable node in a different SCC
+    (rng draws among the candidates in snapshot order), or ``None``."""
+    candidates = [
+        j for j in mutable
+        if scc_of.get(key_of_ix[j]) is not None
+        and scc_of.get(key_of_ix[j]) != own_scc
+    ]
+    if not candidates or own_scc is None:
+        return None
+    return rng.choice(candidates)
+
+
+def old_ix_to_scc_id(
+    part: frozenset, scc_of: Dict[str, int]
+) -> Optional[int]:
+    """The predecessor SCC id of one old partition cell (any member's)."""
+    for key in part:
+        if key in scc_of:
+            return scc_of[key]
+    return None
+
+
 def churn_trace(
     base: List[Dict],
     steps: int,
     seed: int = 0,
     *,
     max_diff: int = 2,
+    kinds: Tuple[str, ...] = CHURN_KINDS,
 ) -> List[List[Dict]]:
     """Deterministic snapshot stream: ``steps + 1`` consecutive snapshots
     starting at ``base``, each differing from its predecessor in at most
@@ -211,41 +479,20 @@ def churn_trace(
       sanitized-SCC fingerprint (``serve.snapshot_fingerprint``) must
       ignore, so caches stay hot across it.
 
+    ``kinds`` extends the mix with the restructuring mutations
+    ``scc_split`` / ``scc_merge`` (see :func:`churn_trace_steps`, which
+    also returns per-step ground-truth annotations — this wrapper is the
+    load-shaped view, so it skips the annotation work entirely:
+    ``annotate=False`` costs no parse/Tarjan passes with the default
+    ``kinds``).
+
     Same ``(base, steps, seed)`` ⇒ byte-identical trace.  Nodes with null
     quorum sets are never churned (there is nothing bounded to mutate).
     Each snapshot is a deep copy: mutating one never aliases another.
     """
-    if steps < 0:
-        raise ValueError(f"steps must be >= 0, got {steps}")
-    rng = random.Random(seed)
-    trace = [copy.deepcopy(base)]
-    all_keys = [n.get("publicKey") for n in base if n.get("publicKey")]
-    for _ in range(steps):
-        snap = copy.deepcopy(trace[-1])
-        mutable = [
-            i for i, n in enumerate(snap)
-            if isinstance(n.get("quorumSet"), dict)
-            and n["quorumSet"].get("validators")
-        ]
-        for ix in (
-            rng.sample(mutable, min(max_diff, len(mutable))) if mutable else ()
-        ):
-            node = snap[ix]
-            q = node["quorumSet"]
-            kind = rng.choice(("threshold", "swap", "rename"))
-            if kind == "threshold":
-                lo, hi = 1, max(1, len(q["validators"]))
-                t = q.get("threshold", 1) + rng.choice((-1, 1))
-                q["threshold"] = min(max(t, lo), hi)
-            elif kind == "swap":
-                vix = rng.randrange(len(q["validators"]))
-                q["validators"][vix] = rng.choice(all_keys)
-            else:
-                node["name"] = f"{node.get('name', '')}~{rng.randrange(999)}"
-        trace.append(snap)
-    # Determinism belt-and-braces: the trace must be JSON-serializable as
-    # produced (the serving layer journals exactly these dicts).
-    json.dumps(trace[-1])
+    trace, _ = churn_trace_steps(
+        base, steps, seed, max_diff=max_diff, kinds=kinds, annotate=False,
+    )
     return trace
 
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, TextIO, Tuple, Union
+from typing import Callable, Dict, List, Optional, TextIO, Tuple, Union
 
 from quorum_intersection_tpu.backends.base import SearchBackend, get_backend
 from quorum_intersection_tpu.cert import build_certificate
@@ -89,12 +89,18 @@ def _classify_sccs(
     allow_native: bool,
     scc_select: str,
     timers: PhaseTimers,
+    scan: Optional[
+        Callable[..., List[Optional[List[int]]]]
+    ] = None,
 ) -> Tuple[int, List[List[int]], List[int], Dict[int, List[int]], List[int]]:
-    """The SCC-classification prefix shared by :func:`solve_graph` and
-    :func:`check_many`: Tarjan + per-SCC quorum scan + main-SCC selection
-    (Q5/Q8 semantics), under the same ``scc``/``scc_scan`` timer phases —
-    one implementation, so the two entry points' guard verdicts cannot
-    drift.  Returns ``(count, sccs, quorum_scc_ids, scc_quorums,
+    """The SCC-classification prefix shared by :func:`solve_graph`,
+    :func:`check_many` and the incremental engine (``delta.py``): Tarjan +
+    per-SCC quorum scan + main-SCC selection (Q5/Q8 semantics), under the
+    same ``scc``/``scc_scan`` timer phases — one implementation, so the
+    entry points' guard verdicts cannot drift.  ``scan`` substitutes the
+    scan provider (same signature as :func:`scan_scc_quorums`) — qi-delta
+    passes a verdict-store-aware one that serves fingerprint-matched SCCs
+    from cache.  Returns ``(count, sccs, quorum_scc_ids, scc_quorums,
     main_scc)``."""
     with timers.phase("scc"):
         count, comp = tarjan_scc(graph.n, graph.succ)
@@ -103,7 +109,7 @@ def _classify_sccs(
     scc_quorums: Dict[int, List[int]] = {}
     with timers.phase("scc_scan"):
         for sid, quorum in enumerate(
-            scan_scc_quorums(graph, sccs, allow_native=allow_native)
+            (scan or scan_scc_quorums)(graph, sccs, allow_native=allow_native)
         ):
             if quorum:
                 quorum_scc_ids.append(sid)
@@ -302,6 +308,8 @@ def check_many(
     scc_select: str = "quorum-bearing",
     scope_to_scc: bool = False,
     pack: Optional[bool] = None,
+    delta: Optional[Dict[str, object]] = None,
+    scan: Optional[Callable[..., List[Optional[List[int]]]]] = None,
 ) -> List[SolveResult]:
     """Batch entry point (ISSUE 5): decide quorum intersection for MANY
     FBAS sources in one call — the shape heavy multi-snapshot traffic
@@ -320,6 +328,15 @@ def check_many(
     ``pack`` forwards to the auto router: None (default) engages packing
     only behind a measured calibration win, True forces it, False never
     packs.
+
+    ``delta`` (qi-delta, ISSUE 9) is an optional provenance stamp the
+    incremental re-analysis engine (``delta.py``) attaches when this batch
+    is the *re-solve* leg of an incremental step: it rides every produced
+    certificate as ``provenance.delta`` (cert.py) so composed and
+    fresh-solved certificates are distinguishable downstream.  ``scan``
+    substitutes the per-SCC scan provider (see :func:`_classify_sccs`) —
+    the same engine passes its verdict-store-aware one so the re-solve leg
+    still reuses every fingerprint-matched SCC's cached scan.
     """
     caller_backend = not isinstance(backend, str)
     if isinstance(backend, str):
@@ -342,7 +359,7 @@ def check_many(
             graph = build_graph(fbas, dangling=dangling)
         count, sccs, quorum_scc_ids, scc_quorums, main_scc = _classify_sccs(
             graph, allow_native=allow_native_scan, scc_select=scc_select,
-            timers=timers,
+            timers=timers, scan=scan,
         )
         if len(quorum_scc_ids) != 1:
             # Guard-decided, exactly as solve_graph: >= 2 quorum-bearing
@@ -362,6 +379,7 @@ def check_many(
                     scc_select=scc_select, scope_to_scc=scope_to_scc,
                     stats={"reason": "scc_guard"}, q1=q1, q2=q2,
                     events=rec.events_since(cert_ev0), batched=True,
+                    delta=delta,
                 ),
             )
             continue
@@ -440,7 +458,7 @@ def check_many(
                             0 if scc_select == "front"
                             else quorum_scc_ids[0]
                         ),
-                        events=batch_events, batched=True,
+                        events=batch_events, batched=True, delta=delta,
                     ),
                 )
     finally:

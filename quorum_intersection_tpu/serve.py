@@ -75,6 +75,7 @@ from quorum_intersection_tpu.backends.base import (
     get_backend,
 )
 from quorum_intersection_tpu.cert import CERT_SCHEMA
+from quorum_intersection_tpu.delta import DeltaEngine, SccVerdictStore
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
 from quorum_intersection_tpu.pipeline import SolveResult, check_many
@@ -514,6 +515,7 @@ class ServeEngine:
         scc_select: str = "quorum-bearing",
         scope_to_scc: bool = False,
         pack: Optional[bool] = None,
+        delta: Optional[bool] = None,
     ) -> None:
         self.backend = backend
         self.queue_depth = (
@@ -542,6 +544,22 @@ class ServeEngine:
         self.scc_select = scc_select
         self.scope_to_scc = scope_to_scc
         self.pack = pack
+        # Incremental re-analysis (qi-delta, ISSUE 9): the drain consults
+        # the per-SCC verdict store BEFORE check_many, so a churn step that
+        # leaves the quorum-bearing SCC structurally unchanged composes its
+        # verdict from cached fragments and never reaches a backend.  On by
+        # default; delta=False (CLI --no-delta) or QI_DELTA_CACHE_MAX=0
+        # restores the all-or-nothing pre-delta behavior.
+        delta_cache = qi_env_int("QI_DELTA_CACHE_MAX", 4096)
+        delta_on = delta if delta is not None else delta_cache > 0
+        self._delta: Optional[DeltaEngine] = (
+            DeltaEngine(
+                SccVerdictStore(delta_cache if delta_cache > 0 else None),
+                dangling=dangling, scc_select=scc_select,
+                scope_to_scc=scope_to_scc,
+            )
+            if delta_on else None
+        )
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: Deque[_Entry] = deque()
@@ -826,6 +844,23 @@ class ServeEngine:
             options["pack"] = self.pack
         return get_backend(self.backend, **options)
 
+    def _check_many(
+        self, sources: List[Fbas], backend: SearchBackend
+    ) -> List[SolveResult]:
+        """One batched solve, delta-aware when qi-delta is enabled: the
+        incremental engine serves structurally unchanged SCCs from its
+        per-SCC store and sends only dirty/new ones to ``backend`` (its
+        ``delta.diff`` fault point degrades back to the full chain)."""
+        if self._delta is not None:
+            return self._delta.check_many(
+                sources, backend=backend, pack=self.pack,
+            )
+        return check_many(
+            sources, backend=backend, dangling=self.dangling,
+            scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+            pack=self.pack,
+        )
+
     def _split_expired(
         self, entry: _Entry, now: float
     ) -> Tuple[List[Ticket], List[Ticket]]:
@@ -918,11 +953,7 @@ class ServeEngine:
     ) -> None:
         backend = self._make_backend(cancel)
         try:
-            results = check_many(
-                [e.fbas for e in live], backend=backend,
-                dangling=self.dangling, scc_select=self.scc_select,
-                scope_to_scc=self.scope_to_scc, pack=self.pack,
-            )
+            results = self._check_many([e.fbas for e in live], backend)
         except SearchCancelled:
             self._after_deadline_cancel(live, counters0)
             return
@@ -949,11 +980,7 @@ class ServeEngine:
                 return
             backend = self._make_backend(cancel)
             try:
-                results = check_many(
-                    [entry.fbas], backend=backend, dangling=self.dangling,
-                    scc_select=self.scc_select,
-                    scope_to_scc=self.scope_to_scc, pack=self.pack,
-                )
+                results = self._check_many([entry.fbas], backend)
             except SearchCancelled:
                 self._after_deadline_cancel(live[ix:], counters0)
                 return
@@ -1277,11 +1304,9 @@ class ServeEngine:
             for i in range(0, len(pending), self.batch_max):
                 chunk = pending[i:i + self.batch_max]
                 try:
-                    results = check_many(
+                    results = self._check_many(
                         [p["fbas"] for p in chunk],
-                        backend=self._make_backend(None),
-                        dangling=self.dangling, scc_select=self.scc_select,
-                        scope_to_scc=self.scope_to_scc, pack=self.pack,
+                        self._make_backend(None),
                     )
                 except Exception as exc:  # noqa: BLE001 — replay must not block startup
                     for p in chunk:
@@ -1415,6 +1440,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="which SCC to search (default quorum-bearing)")
     p.add_argument("--scope-scc", action="store_true",
                    help="scope availability to the searched SCC")
+    p.add_argument("--no-delta", action="store_true",
+                   help="disable incremental re-analysis (qi-delta): every "
+                        "snapshot re-solves from scratch instead of reusing "
+                        "per-SCC verdict fragments (env twin: "
+                        "QI_DELTA_CACHE_MAX=0)")
     p.add_argument("--replay-only", action="store_true",
                    help="replay the journal, print the report, exit "
                         "(restart-recovery probe; no requests accepted)")
@@ -1446,6 +1476,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         dangling=args.dangling_policy,
         scc_select=args.scc_select,
         scope_to_scc=args.scope_scc,
+        delta=False if args.no_delta else None,
     )
     out_lock = threading.Lock()
 

@@ -192,6 +192,14 @@ QI_SERVE_CACHE_MAX = _declare(
     "snapshot fingerprints retained before LRU eviction "
     "(serve.cache_evictions counter).",
 )
+QI_DELTA_CACHE_MAX = _declare(
+    "QI_DELTA_CACHE_MAX", "4096",
+    "Per-SCC verdict-store capacity of the incremental re-analysis "
+    "subsystem (delta.py): SCC-local scan and verdict fragments retained "
+    "before LRU eviction (delta.store_evictions counter).  0 disables "
+    "qi-delta entirely — the serving layer then re-solves every snapshot "
+    "from scratch, exactly the pre-delta behavior.",
+)
 QI_SERVE_JOURNAL = _declare(
     "QI_SERVE_JOURNAL", "",
     "Path of the serving layer's crash-only request journal (serve.py): "

@@ -221,6 +221,14 @@ SERVE_RESPOND = _declare(
     "a silent drop) while the verdict itself is already cached and "
     "journal-marked done, so a retry is a cache hit.",
 )
+DELTA_DIFF = _declare(
+    "delta.diff",
+    "Snapshot diff / SCC-fingerprint path of the incremental re-analysis "
+    "engine (delta.py DeltaEngine.check_many): error simulates a broken "
+    "differ — the engine degrades to the full re-solve chain "
+    "(pipeline.check_many), verdicts unchanged; incremental re-analysis "
+    "is an optimization, never a precondition for a verdict.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "
@@ -464,6 +472,7 @@ _SERVE_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
     (SERVE_DRAIN, "error", 0.0),
     (SERVE_DRAIN, "hang", 0.2),
     (SERVE_RESPOND, "error", 0.0),
+    (DELTA_DIFF, "error", 0.0),
     (NATIVE_CALL, "error", 0.0),
     (SWEEP_DISPATCH, "oom", 0.0),
 )

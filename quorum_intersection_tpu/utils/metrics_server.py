@@ -71,6 +71,12 @@ def healthz_payload() -> dict:
         "retries": counters.get("ladder.retries", 0),
         "faults_injected": counters.get("faults.injected", 0),
         "flight_dumps": counters.get("telemetry.dumps", 0),
+        # qi-delta (ISSUE 9): per-SCC reuse efficiency + store occupancy —
+        # a reuse_pct collapsing to 0 under steady churn is a fingerprint
+        # bug (or a store sized below the working set), visible from any
+        # fleet scrape without attaching a debugger.
+        "delta_scc_reuse_pct": gauges.get("delta.scc_reuse_pct", 0.0),
+        "delta_store_size": gauges.get("delta.store_size", 0),
     }
 
 

@@ -1,0 +1,554 @@
+"""qi-delta differential suite (ISSUE 9): incremental re-analysis must be
+invisible in the verdicts — DeltaEngine vs from-scratch pipeline across a
+long churn trace on all four backend rungs with checker-validated composed
+certificates, solver-invocation counts pinning that a one-SCC diff
+re-solves exactly one SCC, the SCC merge/split invalidation matrix, the
+SCC-local fingerprint's identity-invariance, the closedness soundness
+gate, the store's LRU bound, and the ``delta.diff`` fault degrading to the
+full re-solve chain."""
+
+import copy
+import threading
+
+import pytest
+
+from quorum_intersection_tpu.backends.python_oracle import PythonOracleBackend
+from quorum_intersection_tpu.backends.tpu.frontier import TpuFrontierBackend
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.delta import DeltaEngine, SccScan, SccVerdictStore
+from quorum_intersection_tpu.fbas.diff import (
+    diff_snapshots,
+    localize,
+    project,
+    scc_fingerprint,
+)
+from quorum_intersection_tpu.fbas.graph import (
+    build_graph,
+    group_sccs,
+    tarjan_scc,
+)
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import (
+    churn_trace,
+    churn_trace_steps,
+    majority_fbas,
+    stellar_like_fbas,
+)
+from quorum_intersection_tpu.pipeline import check_many, solve
+from quorum_intersection_tpu.utils import faults, telemetry
+from tools.check_cert import check_certificate
+
+BACKENDS = ("python", "cpp", "tpu-sweep", "tpu-frontier")
+
+
+def make_backend(name):
+    if name == "tpu-sweep":
+        return TpuSweepBackend(batch=512)
+    if name == "tpu-frontier":
+        return TpuFrontierBackend(arena=4096, pop=128)
+    return name
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+def multi_scc_base(seed=7, n_watchers=12):
+    """A stellar-like snapshot: one 6-node quorum-bearing core + many
+    single-node watcher SCCs — the K-SCC shape the invalidation tests
+    churn one component of."""
+    return stellar_like_fbas(
+        n_core_orgs=3, per_org=2, n_watchers=n_watchers, seed=seed,
+    )
+
+
+def partition(nodes):
+    graph = build_graph(parse_fbas(nodes))
+    count, comp = tarjan_scc(graph.n, graph.succ)
+    return graph, group_sccs(graph.n, comp, count)
+
+
+def core_scc(nodes):
+    """(graph, members) of the quorum-bearing core (the largest SCC in
+    every multi_scc_base topology)."""
+    graph, sccs = partition(nodes)
+    return graph, max(sccs, key=len)
+
+
+def wobble(nodes, key, delta=-1):
+    """Deterministic threshold wobble on one node, by publicKey."""
+    out = copy.deepcopy(nodes)
+    for n in out:
+        if n.get("publicKey") == key:
+            q = n["quorumSet"]
+            q["threshold"] = max(1, min(q["threshold"] + delta,
+                                        len(q["validators"]) or 1))
+            return out
+    raise KeyError(key)
+
+
+def core_key(nodes):
+    graph, members = core_scc(nodes)
+    return graph.node_ids[members[0]]
+
+
+def watcher_key(nodes):
+    """A churnable (non-null-qset) node OUTSIDE the core SCC."""
+    graph, members = core_scc(nodes)
+    core_keys = {graph.node_ids[v] for v in members}
+    for n in nodes:
+        q = n.get("quorumSet")
+        if (n.get("publicKey") not in core_keys
+                and isinstance(q, dict) and q.get("validators")):
+            return n["publicKey"]
+    raise AssertionError("no churnable watcher in base")
+
+
+class CountingOracle:
+    """Python-oracle delegate counting check_scc calls — the observable
+    that pins 'a one-SCC diff re-solves exactly one SCC'."""
+
+    name = "python"
+    needs_circuit = False
+
+    def __init__(self):
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+        with self._lock:
+            self.calls += 1
+        return PythonOracleBackend().check_scc(
+            graph, circuit, scc, scope_to_scc=scope_to_scc
+        )
+
+
+class TestSccFingerprint:
+    """The SCC-local fingerprint: structural, never identity-sensitive."""
+
+    def test_rename_invariant(self):
+        base = multi_scc_base()
+        g0, m0 = core_scc(base)
+        renamed = copy.deepcopy(base)
+        for n in renamed:
+            n["name"] = (n.get("name") or "") + "~renamed"
+        g1, m1 = core_scc(renamed)
+        assert scc_fingerprint(g0, m0) == scc_fingerprint(g1, m1)
+
+    def test_index_shift_invariant(self):
+        """Prepending nodes shifts every global vertex index; the SCC-local
+        fingerprint must not notice."""
+        base = multi_scc_base()
+        g0, m0 = core_scc(base)
+        shifted = [
+            {"publicKey": f"ZZPREF{i}", "name": f"pad{i}", "quorumSet": None}
+            for i in range(3)
+        ] + copy.deepcopy(base)
+        g1, m1 = core_scc(shifted)
+        assert m0 != m1  # the indices really did move
+        assert scc_fingerprint(g0, m0)[0] == scc_fingerprint(g1, m1)[0]
+
+    def test_threshold_sensitive(self):
+        base = multi_scc_base()
+        g0, m0 = core_scc(base)
+        key = core_key(base)
+        g1, m1 = core_scc(wobble(base, key))
+        assert scc_fingerprint(g0, m0)[0] != scc_fingerprint(g1, m1)[0]
+
+    def test_closedness_reported(self):
+        open_core = [
+            {"publicKey": k, "name": k,
+             "quorumSet": {"threshold": 2,
+                           "validators": ["A", "B", "C", "W"]}}
+            for k in ("A", "B", "C")
+        ] + [{"publicKey": "W", "name": "W", "quorumSet": None}]
+        graph, members = core_scc(open_core)
+        fp, closed = scc_fingerprint(graph, members)
+        assert closed is False
+        closed_core = majority_fbas(5)
+        g2, m2 = core_scc(closed_core)
+        assert scc_fingerprint(g2, m2)[1] is True
+
+    def test_localize_project_round_trip(self):
+        members = [3, 7, 11, 20]
+        local = localize([11, 3], members)
+        assert local == [2, 0]
+        assert project(local, members) == [11, 3]
+        assert localize([11, 4], members) is None  # escapes the SCC
+        assert localize(None, members) is None
+        assert project(None, members) is None
+
+
+class TestDiffSnapshots:
+    """old→new SCC mapping: unchanged | dirty | new, merges and splits."""
+
+    def test_rename_is_all_unchanged(self):
+        base = multi_scc_base()
+        renamed = copy.deepcopy(base)
+        for n in renamed:
+            n["name"] = (n.get("name") or "") + "~r"
+        diff = diff_snapshots(build_graph(parse_fbas(base)),
+                              build_graph(parse_fbas(renamed)))
+        assert diff.dirty == 0 and diff.new == 0
+        assert diff.unchanged == diff.new_n_sccs
+
+    def test_one_wobble_dirties_one(self):
+        base = multi_scc_base()
+        nxt = wobble(base, core_key(base))
+        diff = diff_snapshots(build_graph(parse_fbas(base)),
+                              build_graph(parse_fbas(nxt)))
+        assert diff.dirty == 1 and diff.new == 0
+        assert diff.unchanged == diff.new_n_sccs - 1
+        (dirty,) = [d for d in diff.deltas if d.kind == "dirty"]
+        assert dirty.size == 6  # the core
+
+    def test_added_node_is_new(self):
+        base = multi_scc_base()
+        nxt = copy.deepcopy(base) + [{
+            "publicKey": "FRESH1", "name": "fresh",
+            "quorumSet": {"threshold": 1, "validators": ["FRESH1"]},
+        }]
+        diff = diff_snapshots(build_graph(parse_fbas(base)),
+                              build_graph(parse_fbas(nxt)))
+        assert diff.new == 1
+        (new,) = [d for d in diff.deltas if d.kind == "new"]
+        assert new.old_indices == []
+
+    def test_merge_and_split_counted(self):
+        """The invalidation matrix's structural half, against the
+        ground-truth annotations of churn_trace_steps (computed by member
+        key sets, independently of the differ)."""
+        base = multi_scc_base(seed=11)
+        trace, metas = churn_trace_steps(
+            base, 10, seed=5, max_diff=1,
+            kinds=("scc_merge", "scc_split", "threshold"),
+        )
+        restructured = 0
+        for prev, nxt, meta in zip(trace, trace[1:], metas):
+            diff = diff_snapshots(build_graph(parse_fbas(prev)),
+                                  build_graph(parse_fbas(nxt)))
+            assert diff.merges == meta["merges"]
+            assert diff.splits == meta["splits"]
+            if meta["partition_changed"]:
+                restructured += 1
+                assert diff.dirty + diff.new >= 1
+            if not meta["affected_scc_ids"]:
+                assert diff.dirty == 0
+        assert restructured >= 2  # the kinds mix really restructured
+
+
+class TestChurnTraceSteps:
+    """Ground-truth step annotations (satellite 1)."""
+
+    def test_deterministic_and_wrapper_identical(self):
+        base = multi_scc_base()
+        t1, m1 = churn_trace_steps(base, 6, seed=3)
+        t2, m2 = churn_trace_steps(base, 6, seed=3)
+        assert t1 == t2 and m1 == m2
+        assert churn_trace(base, 6, seed=3) == t1
+
+    def test_affected_ids_match_structural_mutations(self):
+        base = multi_scc_base()
+        _, metas = churn_trace_steps(base, 20, seed=9)
+        saw_structural = saw_cosmetic = False
+        for meta in metas:
+            structural_sccs = {
+                m["scc_id"] for m in meta["mutations"]
+                if m["structural"] and m["scc_id"] is not None
+            }
+            assert structural_sccs <= set(meta["affected_scc_ids"])
+            if not meta["partition_changed"]:
+                assert set(meta["affected_scc_ids"]) == structural_sccs
+            if structural_sccs:
+                saw_structural = True
+            if any(m["kind"] == "rename" for m in meta["mutations"]):
+                saw_cosmetic = True
+        assert saw_structural and saw_cosmetic
+
+    def test_split_marks_guardward_restructure(self):
+        base = multi_scc_base(seed=11)
+        _, metas = churn_trace_steps(
+            base, 8, seed=2, max_diff=1, kinds=("scc_split",),
+        )
+        assert any(m["splits"] >= 1 for m in metas)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            churn_trace_steps(multi_scc_base(), 1, kinds=("bogus",))
+
+
+class TestDifferentialChurn:
+    """Incremental verdicts + composed certs == from-scratch, every rung."""
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_incremental_equals_scratch(self, rec, backend):
+        steps = 10 if backend in ("python", "cpp") else 5
+        base = multi_scc_base(seed=7, n_watchers=8)
+        trace = churn_trace(base, steps, seed=2)
+        engine = DeltaEngine(SccVerdictStore(256))
+        inc = [
+            engine.check_many([snap], backend=make_backend(backend))[0]
+            for snap in trace
+        ]
+        scratch = check_many(trace, backend=make_backend(backend))
+        assert len(inc) == len(scratch) == len(trace)
+        composed = 0
+        for snap, a, b in zip(trace, inc, scratch):
+            assert a.intersects is b.intersects
+            if not a.intersects:
+                assert a.q1 is not None and a.q2 is not None
+                assert {frozenset(a.q1), frozenset(a.q2)} == \
+                    {frozenset(b.q1), frozenset(b.q2)}
+            # Composed and fresh certs both pass the stdlib checker
+            # against the RAW snapshot — the adversarial bar.
+            check_certificate(a.cert, snap)
+            stamp = a.cert["provenance"]["delta"]
+            assert stamp["schema"] == "qi-delta/1"
+            composed += stamp["reused_sccs"]
+        assert composed >= 1  # churn really exercised reuse
+        assert engine.store.reuse_pct() > 0.0
+
+    def test_restructuring_churn_parity(self, rec):
+        """Merge/split steps flow through the same differential bar
+        (guard flips included) — python rung, the semantics oracle."""
+        base = multi_scc_base(seed=11)
+        trace = churn_trace(
+            base, 8, seed=4,
+            kinds=("threshold", "swap", "rename", "scc_merge", "scc_split"),
+        )
+        engine = DeltaEngine(SccVerdictStore(256))
+        inc = [engine.check_many([s], backend="python")[0] for s in trace]
+        scratch = check_many(trace, backend="python")
+        for snap, a, b in zip(trace, inc, scratch):
+            assert a.intersects is b.intersects
+            check_certificate(a.cert, snap)
+
+    def test_intra_batch_followers_compose(self, rec):
+        """Identical snapshots inside ONE batch: a single leader solve,
+        the rest compose from the just-banked fragment."""
+        nodes = multi_scc_base()
+        counting = CountingOracle()
+        engine = DeltaEngine(SccVerdictStore(64), track_diff=False)
+        results = engine.check_many([nodes] * 4, backend=counting)
+        assert counting.calls == 1
+        assert len(results) == 4
+        assert len({r.intersects for r in results}) == 1
+        assert results[1].cert["provenance"]["delta"]["reused_sccs"] == 1
+
+
+class TestInvocationPinning:
+    """Exactly one SCC reaches a backend on a one-SCC diff."""
+
+    def test_watcher_wobble_resolves_zero(self, rec):
+        base = multi_scc_base()
+        counting = CountingOracle()
+        engine = DeltaEngine(SccVerdictStore(256))
+        engine.check_many([base], backend=counting)
+        assert counting.calls == 1  # the cold solve
+        wobbled = wobble(base, watcher_key(base))
+        res = engine.check_many([wobbled], backend=counting)[0]
+        assert counting.calls == 1  # nothing new reached a backend
+        assert res.cert["provenance"]["delta"]["reused_sccs"] == 1
+        counters, _ = rec.snapshot()
+        # exactly one SCC's scan re-derived: the wobbled watcher's
+        assert counters.get("delta.scan_misses", 0) == \
+            res.n_sccs + 1
+
+    def test_core_wobble_resolves_exactly_one(self, rec):
+        base = multi_scc_base()
+        counting = CountingOracle()
+        engine = DeltaEngine(SccVerdictStore(256))
+        engine.check_many([base], backend=counting)
+        dirtied = wobble(base, core_key(base))
+        res = engine.check_many([dirtied], backend=counting)[0]
+        assert counting.calls == 2  # cold solve + exactly the dirty core
+        assert res.cert["provenance"]["delta"]["resolved_sccs"] == 1
+        assert res.intersects is solve(
+            dirtied, backend="python").intersects
+
+    def test_merge_invalidates_core_fragment(self, rec):
+        """SCC merge/split invalidation matrix, solver-counter half: a
+        core merged with a watcher is a NEW structural problem — the old
+        fragment must not answer it."""
+        base = multi_scc_base()
+        counting = CountingOracle()
+        engine = DeltaEngine(SccVerdictStore(256))
+        engine.check_many([base], backend=counting)
+        assert counting.calls == 1
+        graph, members = core_scc(base)
+        ckey = graph.node_ids[members[0]]
+        wkey = watcher_key(base)
+        merged = copy.deepcopy(base)
+        for n in merged:
+            if n["publicKey"] == ckey:
+                n["quorumSet"]["validators"].append(wkey)
+            elif n["publicKey"] == wkey:
+                n["quorumSet"]["validators"].append(ckey)
+        g2, m2 = core_scc(merged)
+        assert len(m2) == len(members) + 1  # the merge really happened
+        res = engine.check_many([merged], backend=counting)[0]
+        assert counting.calls == 2  # re-solved, not served stale
+        assert res.intersects is solve(merged, backend="python").intersects
+        # ... and the merged fragment now serves its own repeats.
+        engine.check_many([copy.deepcopy(merged)], backend=counting)
+        assert counting.calls == 2
+
+    def test_split_flips_to_guard_not_stale(self, rec):
+        """Splitting a self-sufficient slice off the core yields >= 2
+        quorum-bearing SCCs: the guard decides, no stale fragment may."""
+        base = multi_scc_base()
+        graph, members = core_scc(base)
+        ckey = graph.node_ids[members[0]]
+        split = copy.deepcopy(base)
+        for n in split:
+            if n["publicKey"] == ckey:
+                n["quorumSet"] = {"threshold": 1, "validators": [ckey]}
+        engine = DeltaEngine(SccVerdictStore(256))
+        engine.check_many([base], backend="python")
+        res = engine.check_many([split], backend="python")[0]
+        oracle = solve(split, backend="python")
+        assert res.intersects is oracle.intersects is False
+        assert res.stats.get("reason") == "scc_guard"
+        check_certificate(res.cert, split)
+
+
+class TestSoundnessGate:
+    """A non-closed SCC's verdict is only reusable under scope_to_scc."""
+
+    OPEN = [
+        {"publicKey": k, "name": k,
+         "quorumSet": {"threshold": 2, "validators": ["A", "B", "C", "W"]}}
+        for k in ("A", "B", "C")
+    ] + [{"publicKey": "W", "name": "W", "quorumSet": None}]
+
+    def test_open_scc_never_cached_whole_graph(self, rec):
+        counting = CountingOracle()
+        engine = DeltaEngine(SccVerdictStore(64), track_diff=False)
+        for _ in range(3):
+            engine.check_many([copy.deepcopy(self.OPEN)], backend=counting)
+        assert counting.calls == 3  # every repeat re-solved
+        counters, _ = rec.snapshot()
+        assert counters.get("delta.uncacheable", 0) == 3
+
+    def test_open_scc_cached_when_scoped(self, rec):
+        counting = CountingOracle()
+        engine = DeltaEngine(
+            SccVerdictStore(64), scope_to_scc=True, track_diff=False,
+        )
+        for _ in range(3):
+            engine.check_many([copy.deepcopy(self.OPEN)], backend=counting)
+        assert counting.calls == 1
+
+
+class TestFaultDegrade:
+    """delta.diff failure degrades to the full chain, verdicts unchanged."""
+
+    def test_diff_fault_full_resolve_parity(self, rec):
+        faults.install_plan(faults.parse_faults("delta.diff=error@1+"))
+        base = multi_scc_base()
+        trace = churn_trace(base, 3, seed=1)
+        engine = DeltaEngine(SccVerdictStore(64))
+        inc = [engine.check_many([s], backend="python")[0] for s in trace]
+        faults.clear_plan()
+        scratch = check_many(trace, backend="python")
+        for a, b in zip(inc, scratch):
+            assert a.intersects is b.intersects
+        counters, _ = rec.snapshot()
+        assert counters.get("delta.diff_faults", 0) == len(trace)
+        assert len(engine.store) == 0  # degraded runs never touch the store
+
+    def test_fault_then_recovery_reuses(self, rec):
+        faults.install_plan(faults.parse_faults("delta.diff=error@1"))
+        base = multi_scc_base()
+        engine = DeltaEngine(SccVerdictStore(64))
+        counting = CountingOracle()
+        engine.check_many([base], backend=counting)  # degraded (fault @1)
+        engine.check_many([base], backend=counting)  # delta path, cold
+        engine.check_many([base], backend=counting)  # delta path, reuse
+        assert counting.calls == 2
+
+
+class TestStore:
+    """LRU bound, occupancy gauge, lease cycle."""
+
+    def test_lru_bound_and_evictions(self, rec):
+        store = SccVerdictStore(2)
+        for i in range(4):
+            store.put_scan(f"fp{i}", SccScan(quorum_local=(0,)))
+        assert len(store) == 2
+        assert store.get_scan("fp0") is None  # the oldest fell out
+        assert store.get_scan("fp3") is not None
+        counters, gauges = rec.snapshot()
+        assert counters.get("delta.store_evictions", 0) == 2
+        assert gauges.get("delta.store_size") == 2
+
+    def test_env_knob_bounds_store(self, rec, monkeypatch):
+        monkeypatch.setenv("QI_DELTA_CACHE_MAX", "3")
+        assert SccVerdictStore().max_entries == 3
+
+    def test_lease_cycle(self, rec):
+        store = SccVerdictStore(8)
+        outcome, cached = store.lease_verdict("fpX", False)
+        assert outcome == "leader" and cached is None
+        from quorum_intersection_tpu.delta import SccVerdict
+
+        store.publish_verdict("fpX", False, SccVerdict(
+            intersects=True, q1_local=None, q2_local=None,
+        ))
+        outcome, cached = store.lease_verdict("fpX", False)
+        assert outcome == "hit" and cached.intersects is True
+        # scope_to_scc is part of the key: same fp, different scoping.
+        outcome, _ = store.lease_verdict("fpX", True)
+        assert outcome == "leader"
+        store.publish_verdict("fpX", True, None)  # failed lease: no entry
+        outcome, _ = store.lease_verdict("fpX", True)
+        assert outcome == "leader"
+
+
+class TestServeIntegration:
+    """The serve drain consults qi-delta; the gauges reach /healthz."""
+
+    def test_serve_churn_reuses_and_matches(self, rec):
+        from quorum_intersection_tpu.serve import ServeEngine
+
+        base = multi_scc_base()
+        trace = churn_trace(base, 6, seed=3)
+        oracle = [solve(s, backend="python").intersects for s in trace]
+        engine = ServeEngine(backend="python")
+        assert engine._delta is not None  # on by default
+        try:
+            engine.start()
+            for snap, expected in zip(trace, oracle):
+                resp = engine.submit(snap).result(timeout=60.0)
+                assert resp.intersects is expected
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        assert engine._delta.store.reuse_pct() > 0.0
+        counters, gauges = rec.snapshot()
+        assert counters.get("delta.compositions", 0) >= 1
+        assert gauges.get("delta.scc_reuse_pct", 0.0) > 0.0
+
+    def test_serve_delta_off_switch(self, rec, monkeypatch):
+        from quorum_intersection_tpu.serve import ServeEngine
+
+        assert ServeEngine(backend="python", delta=False)._delta is None
+        monkeypatch.setenv("QI_DELTA_CACHE_MAX", "0")
+        assert ServeEngine(backend="python")._delta is None
+
+    def test_healthz_exposes_delta_gauges(self, rec):
+        from quorum_intersection_tpu.utils.metrics_server import (
+            healthz_payload,
+        )
+
+        engine = DeltaEngine(SccVerdictStore(64), track_diff=False)
+        nodes = multi_scc_base()
+        engine.check_many([nodes], backend="python")
+        engine.check_many([copy.deepcopy(nodes)], backend="python")
+        payload = healthz_payload()
+        assert payload["delta_scc_reuse_pct"] == 50.0
+        assert payload["delta_store_size"] >= 1

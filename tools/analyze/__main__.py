@@ -107,6 +107,40 @@ def _race_pass(root: Path) -> tuple:
             f"typed errors + verdicts identical to the one-shot pipeline"
         )
 
+    # qi-delta store schedules (ISSUE 9): the per-SCC verdict store's
+    # single-flight lease orderings, forced through delta._delta_sync the
+    # same way the serve orderings go through serve._serve_sync.
+    from tools.analyze.schedules import run_delta_schedules
+
+    try:
+        delta_results = run_delta_schedules()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/delta.py",
+            line=1, message=str(exc),
+        ))
+        delta_results = []
+    for r in delta_results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (one-shot pipeline says "
+                f"{r.expected})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/delta.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if delta_results:
+        notes.append(
+            f"delta schedules: {len(delta_results)} forced single-flight "
+            f"interleavings, verdicts identical to the one-shot pipeline"
+        )
+
     from quorum_intersection_tpu.backends.cpp import build_native_cli
 
     try:

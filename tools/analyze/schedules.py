@@ -448,6 +448,166 @@ def run_serve_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
     return results
 
 
+# ---- qi-delta store schedules (ISSUE 9) -------------------------------------
+#
+# The per-SCC verdict store's single-flight lease (delta.py
+# SccVerdictStore.lease_verdict) has two orderings worth forcing: a
+# follower must actually WAIT while a leader solves (one backend call for
+# two concurrent identical snapshots), and a follower whose leader FAILS
+# must take the lease over and still produce the verdict.
+# ``delta._delta_sync`` is the hook, exactly like ``serve._serve_sync``.
+
+DELTA_SCHEDULES = (
+    "delta_follower_waits_for_leader",
+    "delta_leader_fails_follower_takes_over",
+)
+
+_REQUIRED_DELTA_POINTS: Dict[str, tuple] = {
+    # the follower must have parked on the leader's lease (store.wait)
+    # before the leader published — single-flight actually happened.
+    "delta_follower_waits_for_leader": (
+        "store.leader", "store.wait", "store.publish",
+    ),
+    # the failed leader must have published its failed lease (waking the
+    # follower to re-take it — a second store.leader after wait) BEFORE
+    # degrading to its own full re-solve.
+    "delta_leader_fails_follower_takes_over": (
+        "store.wait", "store.publish", "store.leader",
+    ),
+}
+
+
+class _CountingOracle:
+    """Python-oracle delegate that counts (and optionally fails) solves —
+    the observable the single-flight schedules pin."""
+
+    name = "python"
+    needs_circuit = False
+
+    def __init__(self, fail_first: bool = False) -> None:
+        self.calls = 0
+        self.fail_first = fail_first
+        self._count_lock = threading.Lock()
+
+    def check_scc(self, graph, circuit, scc, *, scope_to_scc=False):
+        from quorum_intersection_tpu.backends.python_oracle import (
+            PythonOracleBackend,
+        )
+
+        with self._count_lock:
+            self.calls += 1
+            n = self.calls
+        if self.fail_first and n == 1:
+            raise RuntimeError("scheduled leader failure")
+        return PythonOracleBackend().check_scc(
+            graph, circuit, scc, scope_to_scc=scope_to_scc
+        )
+
+
+def _run_delta_one(schedule: str, data: object, expected: bool,
+                   topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.delta as delta_mod
+    from quorum_intersection_tpu.delta import DeltaEngine, SccVerdictStore
+
+    ctl = SyncController()
+    # Park the leader between taking its lease and solving, until the
+    # follower is provably waiting on that lease.
+    ctl.hold("store.leader", ctl.reached_event("store.wait"))
+    backend = _CountingOracle(
+        fail_first=(schedule == "delta_leader_fails_follower_takes_over")
+    )
+    engine = DeltaEngine(SccVerdictStore(64), track_diff=False)
+    outcomes: Dict[str, object] = {}
+
+    def run(tag: str) -> None:
+        try:
+            res = engine.check_many([data], backend=backend)
+            outcomes[tag] = res[0].intersects
+        except Exception as exc:  # noqa: BLE001 — the failure IS the observable
+            outcomes[tag] = exc
+
+    old_sync = delta_mod._delta_sync
+    delta_mod._delta_sync = ctl
+    try:
+        # Bounded schedule threads around the pure-python oracle; joined
+        # below with a leak check, nothing in-flight to cancel.
+        # qi-lint: allow(cancel-token-plumbed) — bounded, joined below
+        t1 = threading.Thread(target=run, args=("leader",), daemon=True)
+        t1.start()
+        if not ctl.reached_event("store.leader").wait(WAIT_S):
+            raise ScheduleError("leader never took the lease")
+        # qi-lint: allow(cancel-token-plumbed) — bounded, joined below
+        t2 = threading.Thread(target=run, args=("follower",), daemon=True)
+        t2.start()
+        t1.join(WAIT_S)
+        t2.join(WAIT_S)
+        if t1.is_alive() or t2.is_alive():
+            raise ScheduleError(f"schedule {schedule!r} leaked a thread")
+    finally:
+        delta_mod._delta_sync = old_sync
+
+    error: Optional[str] = None
+    verdict = outcomes.get("follower")
+    if schedule == "delta_follower_waits_for_leader":
+        if backend.calls != 1:
+            error = (
+                f"single-flight broken: {backend.calls} backend solves for "
+                f"two concurrent identical snapshots (want 1)"
+            )
+        elif outcomes.get("leader") != expected:
+            error = f"leader verdict {outcomes.get('leader')} != {expected}"
+    else:  # delta_leader_fails_follower_takes_over
+        # The failed leader releases its lease (follower re-takes it) and
+        # then DEGRADES to the full re-solve chain — it still answers
+        # (incremental re-analysis is never a precondition for a verdict).
+        if outcomes.get("leader") != expected:
+            error = (
+                f"failed leader was expected to degrade to the verdict "
+                f"{expected}, got {outcomes.get('leader')!r}"
+            )
+        elif backend.calls != 3:
+            error = (
+                f"takeover broken: {backend.calls} backend solves (want "
+                f"3: failed leader + leader's degraded full re-solve + "
+                f"follower retake)"
+            )
+    if not isinstance(verdict, bool):
+        error = error or f"follower reached no verdict: {verdict!r}"
+        verdict = not expected
+    missing = [
+        p for p in _REQUIRED_DELTA_POINTS[schedule] if p not in ctl.trace
+    ]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=bool(verdict),
+        expected=expected,
+        winner="delta",
+        oracle_outcome="-",
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+def run_delta_schedules() -> List[ScheduleResult]:
+    """Every delta schedule × {intersecting, broken} topology; ground truth
+    from the one-shot pipeline, the differential contract the incremental
+    engine is held to everywhere."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend="python").intersects
+        for schedule in DELTA_SCHEDULES:
+            results.append(_run_delta_one(schedule, data, expected, topology))
+    return results
+
+
 def run_all(join_timeout: float = 5.0) -> List[ScheduleResult]:
     """Every schedule × {intersecting, broken} topology.  The expected
     verdict is computed by the sequential (race=False) chain with the real

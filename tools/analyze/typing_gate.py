@@ -53,6 +53,11 @@ TYPING_TARGETS = (
     # the evidence/ledger assembly is exactly the kind of silent
     # unsoundness the independent checker exists to catch downstream.
     "quorum_intersection_tpu/cert.py",
+    # ISSUE 9: the incremental re-analysis engine joins the spine — a
+    # type confusion between SCC-local and global coordinates is exactly
+    # the transplant unsoundness the fingerprint discipline exists to
+    # prevent (fbas/diff.py rides the fbas directory target above).
+    "quorum_intersection_tpu/delta.py",
 )
 
 

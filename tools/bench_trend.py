@@ -92,6 +92,16 @@ TRACKED: Dict[str, str] = {
     "serve_cache_hit_pct": "higher",
     "serve_p50_ms": "lower",
     "serve_p99_ms": "lower",
+    # qi-delta incremental re-analysis (ISSUE 9): benchmarks/serve.py
+    # --churn rows.  `delta_scc_reuse_pct` is per-SCC verdict-store hits
+    # as a % of lookups over the churn trace — a collapse to 0 under the
+    # same trace means the SCC-local fingerprint went identity-sensitive
+    # (cosmetic churn now misses).  `delta_resolve_ratio` is backend
+    # solves per trace snapshot — 1.0 means incremental reuse stopped
+    # entirely and every step pays the full NP-hard re-solve.
+    "delta_scc_reuse_pct": "higher",
+    "delta_resolve_ratio": "lower",
+    "churn_verdicts_per_sec": "higher",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -117,6 +127,9 @@ TELEMETRY_GAUGES = (
     "serve.p99_ms",
     "serve.queue_depth",
     "serve.bench_verdicts_per_sec",
+    "delta.scc_reuse_pct",
+    "delta.store_size",
+    "delta.bench_reuse_pct",
 )
 
 

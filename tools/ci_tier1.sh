@@ -115,12 +115,16 @@ echo "CERTS=$CERTDIR (exit $certrc)"
 
 # Serving-layer smoke (ISSUE 8): open-loop load through a live ServeEngine
 # — the driver itself is a parity gate (served verdict == one-shot oracle
-# for every request, zero silent drops, exit 1 otherwise) — then the serve
-# chaos soak: seeded faults at every serve.* boundary plus one hard-kill
-# mid-stream with journal replay, asserting the chaos-gate contract
-# (oracle-equal verdict or typed error; zero lost / zero duplicated
-# verdicts across the kill).  The serve.* telemetry rides $METRICS.
-env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick
+# for every request, zero silent drops, exit 1 otherwise).  --churn
+# (ISSUE 9) appends the qi-delta churn-parity phase: every request
+# advances a churn trace one step, incremental verdicts re-checked
+# against the from-scratch oracle per step.  Then the serve chaos soak:
+# seeded faults at every serve.* boundary (incl. a forced delta.diff
+# mid-churn round on odd seeds) plus one hard-kill mid-stream with
+# journal replay, asserting the chaos-gate contract (oracle-equal verdict
+# or typed error; zero lost / zero duplicated verdicts across the kill).
+# The serve.* / delta.* telemetry rides $METRICS.
+env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick --churn
 src=$?
 echo "SERVE_BENCH=exit $src"
 env JAX_PLATFORMS=cpu python tools/soak.py --serve --chaos \

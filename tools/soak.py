@@ -61,6 +61,12 @@ rounds per seed:
    reaches exactly one outcome across the kill (answered before it, or
    replayed after it) — zero lost, zero duplicated — and every verdict on
    both sides of the kill equals the oracle's.
+3. **Forced qi-delta degradation** (ISSUE 9, odd ``--chaos`` seeds): the
+   same stream re-runs under an explicit ``delta.diff=error@2+`` plan, so
+   the incremental differ fails *mid-churn* — the first drain batch runs
+   incrementally, every later one must degrade to the full re-solve chain
+   with verdicts still oracle-identical, and the round fails if the forced
+   plan never fired (the differ path silently bypassed).
 
 Usage::
 
@@ -368,19 +374,25 @@ def make_serve_traffic(seed: int, requests: int = 12):
 
 
 def run_serve_chaos_instance(seed: int, workdir: pathlib.Path,
-                             chaos: bool) -> dict:
+                             chaos: bool, plan_spec: str = "") -> dict:
     """Drive one churn-trace stream through a live ServeEngine under a
     seeded serving-layer fault schedule; every request must reach exactly
     one outcome — the oracle verdict or a typed error — and a fault-free
-    restart on the same journal must replay to oracle-identical verdicts."""
+    restart on the same journal must replay to oracle-identical verdicts.
+
+    ``plan_spec`` replaces the sampled schedule with an explicit one
+    (``QI_FAULTS`` syntax) — the guaranteed ``delta.diff`` mid-churn round
+    uses it, since a sampled window may never draw a given point."""
     from quorum_intersection_tpu.serve import ServeEngine, ServeError
     from quorum_intersection_tpu.utils import faults
 
     desc, stream, oracle = make_serve_traffic(seed)
-    journal = workdir / f"serve-chaos-{seed}.jsonl"
+    journal = workdir / f"serve-chaos-{seed}{'-forced' if plan_spec else ''}.jsonl"
     faults.clear_plan()
     plan = None
-    if chaos:
+    if plan_spec:
+        plan = faults.install_plan(faults.parse_faults(plan_spec))
+    elif chaos:
         plan = faults.install_plan(faults.sample_serve_plan(seed))
     schedule_label = plan.label if plan is not None else "fault-free"
     mismatches: list = []
@@ -591,6 +603,27 @@ def serve_soak_main(args: argparse.Namespace) -> int:
                 bad.append(rec)
                 print(f"SERVE CHAOS MISMATCH seed={seed} {rec['desc']} "
                       f"[{rec['schedule']}]: {rec['mismatches']}")
+            # Guaranteed qi-delta degradation round (ISSUE 9): the sampled
+            # window may never draw delta.diff, so every odd chaos seed
+            # re-runs its stream with the differ failing from the second
+            # drain batch on — degraded mid-churn, the engine must fall
+            # back to full re-solves with verdicts still oracle-identical.
+            if args.chaos and seed % 2 == 1:
+                drec = run_serve_chaos_instance(
+                    seed, workdir, chaos=True,
+                    plan_spec="delta.diff=error@2+",
+                )
+                total_fired += drec["fired"]
+                total_served += drec["served"]
+                if not drec["fired"]:
+                    drec["mismatches"].append(
+                        "forced delta.diff plan never fired "
+                        "(differ path not reached mid-churn)"
+                    )
+                if drec["mismatches"]:
+                    bad.append(drec)
+                    print(f"SERVE DELTA-FAULT MISMATCH seed={seed} "
+                          f"{drec['desc']}: {drec['mismatches']}")
             # The kill round costs a subprocess pair; every other seed
             # keeps the soak's wall time linear in --instances.
             if seed % 2 == 0:
