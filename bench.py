@@ -254,6 +254,16 @@ def phase_sweep(n_nodes: int) -> dict:
         out["sweep_enumeration_ratio"] = round(
             ledger["windows_enumerated"] / ledger["window_space"], 6
         )
+    # Pruned-sweep row (ISSUE 10): the tracked pruning gates go live with
+    # REAL baselines measured on the adversarial near-disjoint-cores preset
+    # — a symmetric majority's maximal candidates almost always contain a
+    # quorum, so its ratio sits at ~1.0 by construction and would keep the
+    # gates inert.  The preset's ledger OVERRIDES the three tracked keys
+    # above (lower enumerated/ratio = better, higher pruned = better).
+    try:
+        out.update(_pruned_sweep_row(n_nodes))
+    except Exception as exc:  # noqa: BLE001 — diagnostics row, never fatal
+        out["sweep_pruned_error"] = f"{type(exc).__name__}: {exc}"
     import jax
 
     out["sweep_device"] = jax.devices()[0].device_kind
@@ -262,6 +272,37 @@ def phase_sweep(n_nodes: int) -> dict:
     except Exception as exc:  # noqa: BLE001 — roofline is diagnostics, never fatal
         out["sweep_mfu_error"] = f"{type(exc).__name__}: {exc}"
     return out
+
+
+def _pruned_sweep_row(n_nodes: int) -> dict:
+    """Rank-ordered + block-guard-pruned exhaustive sweep on the
+    ``near_disjoint_cores`` preset (fbas/synth.py): two dense cores joined
+    by a thin bridge, where most window blocks' maximal candidates hold no
+    quorum and the guard prunes them into the certificate's
+    ``windows_pruned_guard`` term.  The emitted keys are the
+    tools/bench_trend.py pruning gates."""
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import near_disjoint_cores
+    from quorum_intersection_tpu.pipeline import solve
+
+    core = max(6, min(10, (n_nodes - 1) // 2))
+    data = near_disjoint_cores(core, 1)
+    t0 = time.perf_counter()
+    res = solve(data, backend=TpuSweepBackend(order="rank", prune=True))
+    seconds = time.perf_counter() - t0
+    assert res.intersects is True
+    ledger = res.stats.get("cert") or {}
+    if not ledger.get("window_space"):
+        return {"sweep_pruned_error": "no sweep ledger on the pruned row"}
+    return {
+        "sweep_pruned_nodes": 2 * core + 1,
+        "sweep_pruned_seconds": round(seconds, 2),
+        "sweep_windows_enumerated": ledger["windows_enumerated"],
+        "sweep_windows_pruned": ledger["windows_pruned_guard"],
+        "sweep_enumeration_ratio": round(
+            ledger["windows_enumerated"] / ledger["window_space"], 6
+        ),
+    }
 
 
 def _sweep_roofline(n_nodes: int, steady_rate) -> dict:

@@ -211,6 +211,76 @@ def packed_row(scc: int, device: str) -> dict:
     return row
 
 
+def pruned_row(core: int, device: str) -> dict:
+    """One qi-prune measurement (ISSUE 10) on the ``near_disjoint_cores``
+    pair (2*core+1 nodes, one SCC):
+
+    - correct twin: rank-ordered + block-guard-pruned sweep vs the
+      natural/unpruned baseline — ``sweep_enumeration_ratio`` and
+      ``sweep_windows_pruned`` are the ledger numbers the
+      tools/bench_trend.py gates track, wall-clock rides along;
+    - broken twin: first-hit window index ordered vs natural — the
+      rank-order permutation's win on false verdicts;
+    - native column: the oracle's B&B node count for the same SCC vs the
+      windows the pruned sweep actually enumerated.
+
+    Verdict parity (both twins, pruned and unpruned, vs the oracle) gates
+    the row: any mismatch marks it INVALID and the driver exits 1.
+    """
+    from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+    from quorum_intersection_tpu.fbas.synth import near_disjoint_cores
+
+    correct = near_disjoint_cores(core, 1)
+    broken = near_disjoint_cores(core, 1, broken=True)
+    n = 2 * core + 1
+
+    base_s, base = time_solve(correct, TpuSweepBackend())
+    pruned_s, pruned = time_solve(
+        correct, TpuSweepBackend(order="rank", prune=True)
+    )
+    led = pruned.stats.get("cert") or {}
+    space = led.get("window_space") or (1 << (n - 1))
+
+    _, nat_broken = time_solve(broken, TpuSweepBackend())
+    _, ord_broken = time_solve(
+        broken, TpuSweepBackend(order="rank", prune=True)
+    )
+
+    from quorum_intersection_tpu.pipeline import solve
+
+    try:
+        from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+
+        oracle = solve(correct, backend=CppOracleBackend())
+        oracle_engine = "cpp"
+    except Exception:  # noqa: BLE001 — no g++: the python oracle still counts
+        oracle = solve(correct, backend="python")
+        oracle_engine = "python"
+
+    verdict_ok = (
+        base.intersects is True
+        and pruned.intersects is True
+        and oracle.intersects is True
+        and nat_broken.intersects is False
+        and ord_broken.intersects is False
+    )
+    return {
+        "scc": n, "device": device,
+        "unpruned_seconds": round(base_s, 3),
+        "pruned_seconds": round(pruned_s, 3),
+        "sweep_windows_enumerated": led.get("windows_enumerated"),
+        "sweep_windows_pruned": led.get("windows_pruned_guard"),
+        "sweep_enumeration_ratio": round(
+            (led.get("windows_enumerated") or 0) / space, 6
+        ),
+        "first_hit_natural": nat_broken.stats.get("hit_index"),
+        "first_hit_ordered": ord_broken.stats.get("hit_index"),
+        "native_bnb_calls": oracle.stats.get("bnb_calls"),
+        "native_engine": oracle_engine,
+        "verdict_ok": verdict_ok,
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -228,7 +298,17 @@ def main() -> int:
                              "(<= 31: the packable window)")
     parser.add_argument("--metrics-json", default=None, metavar="PATH",
                         help="append the run's qi-telemetry/1 stream "
-                             "(sweep.pack_* counters included) to PATH")
+                             "(sweep.pack_* / sweep.prune_* counters "
+                             "included) to PATH")
+    parser.add_argument("--pruned", action="store_true",
+                        help="add rank-ordered + block-guard-pruned sweep "
+                             "rows on the near_disjoint_cores pair "
+                             "(enumeration ratio, pruned window mass, "
+                             "first-hit ordered-vs-natural, native B&B "
+                             "node counts for the same SCC)")
+    parser.add_argument("--pruned-core", type=int, nargs="*", default=None,
+                        help="core sizes for the --pruned rows "
+                             "(|scc| = 2*core + 1)")
     args = parser.parse_args()
 
     if args.metrics_json:
@@ -324,6 +404,28 @@ def main() -> int:
                 f"{row['packed_speedup_vs_unpacked']}x{flag} | "
                 f"{row['packed_macs_ratio']} | {row['pack_fill_pct']} | "
                 f"{mfu if mfu is not None else '—'} |"
+            )
+            print(json.dumps(row), flush=True)
+        if not ok:
+            return 1
+
+    if args.pruned:
+        pruned_cores = args.pruned_core or ([6] if args.quick else [8, 10])
+        print("\n| scc | unpruned (s) | pruned (s) | enum ratio | pruned "
+              "windows | first-hit nat→ord | native B&B |")
+        print("|---|---|---|---|---|---|---|")
+        ok = True
+        for core in pruned_cores:
+            row = pruned_row(core, device)
+            ok = ok and row["verdict_ok"]
+            flag = "" if row["verdict_ok"] else " **INVALID: verdict mismatch**"
+            print(
+                f"| {row['scc']} | {row['unpruned_seconds']:.2f} | "
+                f"{row['pruned_seconds']:.2f} | "
+                f"{row['sweep_enumeration_ratio']}{flag} | "
+                f"{row['sweep_windows_pruned']} | "
+                f"{row['first_hit_natural']}→{row['first_hit_ordered']} | "
+                f"{row['native_bnb_calls']} |"
             )
             print(json.dumps(row), flush=True)
         if not ok:

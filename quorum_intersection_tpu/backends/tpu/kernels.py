@@ -356,6 +356,44 @@ def sweep_program_factory(
     return factory
 
 
+def guard_program_factory(
+    circuit: Circuit, batch: int
+) -> Callable[[np.ndarray], np.ndarray]:
+    """Block-guard fixpoint program (ISSUE 10 device-side pruning).
+
+    Returns ``run(masks)``: (B, n) 0/1 maximal-candidate rows — one per
+    window block, built by the sweep driver's prune planner — evaluated
+    through the Q-side greatest fixpoint to (B,) int32 survivor counts.
+    A zero count proves the block's maximal candidate contains NO quorum,
+    so (by monotonicity of the greatest fixpoint in its candidate set) no
+    window of the block can hit and the whole block is skippable.  Rows
+    are chunked to a fixed ``batch`` shape (zero-padded tail) so the
+    whole guard pass compiles exactly one program.
+    """
+    arrays = CircuitArrays(circuit)
+    batch = max(int(batch), 1)
+
+    @jax.jit
+    def step(masks: jnp.ndarray) -> jnp.ndarray:
+        return fixpoint(arrays, masks).sum(axis=-1, dtype=jnp.int32)
+
+    def run(masks: np.ndarray) -> np.ndarray:
+        rows = masks.shape[0]
+        out = np.empty((rows,), dtype=np.int32)
+        for lo in range(0, rows, batch):
+            chunk = masks[lo : lo + batch]
+            if chunk.shape[0] < batch:
+                pad = np.zeros((batch, masks.shape[1]), dtype=masks.dtype)
+                pad[: chunk.shape[0]] = chunk
+                chunk = pad
+            out[lo : lo + batch] = np.asarray(step(arrays.cast(chunk)))[
+                : rows - lo
+            ]
+        return out
+
+    return run
+
+
 def decode_masks_packed(
     starts_lane: jnp.ndarray, batch: int, pos: jnp.ndarray, dtype
 ) -> jnp.ndarray:

@@ -254,6 +254,92 @@ def pallas_sweep_program_factory(
     return factory
 
 
+def pallas_guard_factory(
+    circuit: Circuit,
+    block: int = 256,
+    interpret: Optional[bool] = None,
+) -> Callable[[np.ndarray], np.ndarray]:
+    """Pallas twin of ``kernels.guard_program_factory`` (ISSUE 10): the
+    block-guard Q-side fixpoint as a fused kernel — (B, n) 0/1
+    maximal-candidate rows in, (B,) int32 survivor counts out (zero ⇒ the
+    block's maximal candidate contains no quorum ⇒ the block prunes).
+    Same padded layout and int8 regime as the sweep kernels; rows pad to
+    the grid block and columns to the lane tile, both inert.
+    """
+    if not pallas_supported(circuit):
+        raise ValueError("circuit vote counts exceed int8; use the XLA guard path")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block = _round_up(max(block, 1), 32)
+    members_np, child_np, thr_np, np_, up = pad_circuit(circuit)
+    depth = circuit.depth if child_np is not None else 0
+
+    members_j = jnp.asarray(members_np)
+    thr_j = jnp.asarray(thr_np)
+    child_j = jnp.asarray(child_np) if child_np is not None else None
+
+    def kernel(avail_ref, members_ref, thr_ref, *rest):
+        child_ref, out_ref = (
+            (rest[0], rest[1]) if child_j is not None else (None, rest[0])
+        )
+        thr = thr_ref[:]
+
+        def node_sat(total):
+            base = jnp.dot(total, members_ref[:], preferred_element_type=jnp.int32)
+            sat = (base >= thr).astype(jnp.int8)
+            for _ in range(depth):
+                sat = (
+                    (base + jnp.dot(sat, child_ref[:], preferred_element_type=jnp.int32))
+                    >= thr
+                ).astype(jnp.int8)
+            return jnp.bitwise_and(sat[:, :np_], total)
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            a, _ = c
+            nxt = jnp.bitwise_and(node_sat(a), a)
+            # Same arithmetic change detection as the sweep kernels.
+            changed = jnp.sum(a.astype(jnp.int32) - nxt.astype(jnp.int32)) > 0
+            return nxt, changed
+
+        q, _ = lax.while_loop(cond, body, (avail_ref[...], jnp.bool_(True)))
+        out_ref[...] = jnp.sum(q, axis=1, keepdims=True, dtype=jnp.int32)
+
+    const_spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((block, np_), lambda i: (i, 0)),  # guard rows
+        const_spec(),  # members
+        const_spec(),  # thresholds
+    ]
+    operands = [members_j, thr_j]
+    if child_j is not None:
+        in_specs.append(const_spec())
+        operands.append(child_j)
+
+    def run(masks: np.ndarray) -> np.ndarray:
+        rows = masks.shape[0]
+        rows_pad = _round_up(max(rows, 1), block)
+        padded = np.zeros((rows_pad, np_), dtype=np.int8)
+        padded[:rows, : masks.shape[1]] = masks.astype(np.int8)
+        call = pl.pallas_call(
+            kernel,
+            grid=(rows_pad // block,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows_pad, 1), jnp.int32),
+            interpret=interpret,
+        )
+        return np.asarray(call(jnp.asarray(padded), *operands))[:rows, 0]
+
+    return run
+
+
 def pallas_packed_program_factory(
     circuit: Circuit,
     circuit_d: Optional[Circuit],

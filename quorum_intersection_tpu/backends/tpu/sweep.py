@@ -27,10 +27,11 @@ but are never filtered — so verdicts match the oracle under either scoping.
 
 from __future__ import annotations
 
+import bisect
 import logging
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,10 +47,12 @@ from quorum_intersection_tpu.encode.circuit import (
     ladder_up,
     pack_circuits,
     plan_packs,
+    rank_order_nodes,
     restrict_circuit_pair,
 )
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
+from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.faults import fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
@@ -109,6 +112,111 @@ RAMP_INFLIGHT = 4
 
 class SccTooLargeError(ValueError):
     """Raised when the SCC exceeds the sweep's enumeration width."""
+
+
+# ---------------------------------------------------------------------------
+# Block-guard pruning (ISSUE 10): partition the enumeration into blocks of
+# 2^k consecutive windows sharing a fixed high-bit prefix, and run ONE cheap
+# greatest-fixpoint test on each block's MAXIMAL candidate (the prefix's
+# fixed-one nodes plus every free low-bit node).  Soundness: every window S
+# of the block satisfies S ⊆ S_max, the greatest fixpoint is monotone in its
+# candidate set, and a window hits only when maxQuorum(S) ≠ ∅ — so an EMPTY
+# fixpoint on S_max proves no window of the block can hit, and the whole
+# block skips into the certificate's `windows_pruned_guard` term as a
+# checkable `(prefix, k, rule)` claim that tools/check_cert.py re-verifies
+# with its own stdlib fixpoint evaluator.  The guard runs on device through
+# the same fixpoint kernels as the sweep itself (kernels.
+# guard_program_factory / pallas_sweep.pallas_guard_factory).
+
+# The single guard rule this engine emits; the checker rejects unknown ids.
+PRUNE_RULE_ID = "empty-max-quorum"
+# Below this enumeration width the space is trivial and guard setup costs
+# more than sweeping; skip pruning.
+PRUNE_MIN_BITS = 6
+# Prefix granularity cap: at most 2^14 = 16384 guard rows per enumeration —
+# one fixpoint row per block, ~windows/2^k of extra work.
+PRUNE_MAX_PREFIX_BITS = 14
+# Never shrink blocks below 2^2 windows (guard row per 4 windows is the
+# break-even floor: each guard row costs about one window's Q fixpoint).
+PRUNE_MIN_BLOCK_BITS = 2
+# Guard rows per compiled guard program (kernels.guard_program_factory
+# chunk shape).
+GUARD_BATCH = 4096
+
+
+@dataclass
+class _PrunePlan:
+    """One enumeration's block-guard prune plan: the pruned blocks (as
+    cert-ledger prefixes AND merged window runs for O(log) overlap
+    queries) plus the surviving ranges the drive loop actually sweeps."""
+
+    block_bits: int                 # k: windows per block = 2^k
+    prefixes: List[int]             # pruned block ids (>= the resume cut)
+    windows: int                    # pruned window count = len(prefixes) << k
+    ranges: List[Tuple[int, int]]   # surviving [lo, hi) over [start0, total)
+    runs: List[Tuple[int, int]]     # merged pruned [lo, hi) window runs
+    cum: List[int]                  # pruned windows before runs[i]
+    run_los: List[int] = field(default_factory=list)
+    guard_rows: int = 0
+
+    @classmethod
+    def build(
+        cls,
+        block_bits: int,
+        prefixes: List[int],
+        total: int,
+        start0: int,
+        guard_rows: int,
+    ) -> "_PrunePlan":
+        runs: List[Tuple[int, int]] = []
+        for p in prefixes:  # ascending
+            lo, hi = p << block_bits, (p + 1) << block_bits
+            if runs and runs[-1][1] == lo:
+                runs[-1] = (runs[-1][0], hi)
+            else:
+                runs.append((lo, hi))
+        cum = [0]
+        for lo, hi in runs:
+            cum.append(cum[-1] + (hi - lo))
+        ranges: List[Tuple[int, int]] = []
+        pos = start0
+        for lo, hi in runs:
+            if lo > pos:
+                ranges.append((pos, lo))
+            pos = max(pos, hi)
+        if pos < total:
+            ranges.append((pos, total))
+        return cls(
+            block_bits=block_bits,
+            prefixes=list(prefixes),
+            windows=len(prefixes) << block_bits,
+            ranges=ranges,
+            runs=runs,
+            cum=cum,
+            run_los=[lo for lo, _ in runs],
+            guard_rows=guard_rows,
+        )
+
+    def pruned_before(self, x: int) -> int:
+        """Pruned windows with index < ``x``."""
+        ix = bisect.bisect_right(self.run_los, x) - 1
+        if ix < 0:
+            return 0
+        lo, hi = self.runs[ix]
+        return self.cum[ix] + min(max(x - lo, 0), hi - lo)
+
+    def overlap(self, lo: int, hi: int) -> int:
+        """Pruned windows inside ``[lo, hi)``."""
+        if hi <= lo:
+            return 0
+        return self.pruned_before(hi) - self.pruned_before(lo)
+
+    def skip(self, pos: int) -> int:
+        """Smallest surviving window index >= ``pos``."""
+        ix = bisect.bisect_right(self.run_los, pos) - 1
+        if ix >= 0 and pos < self.runs[ix][1]:
+            return self.runs[ix][1]
+        return pos
 
 
 # A jump level only reaches full throughput when enough programs of it fit
@@ -258,6 +366,9 @@ class _SweepJob:
     resolved: bool = False
     intersects: Optional[bool] = None
     result: Optional[SccCheckResult] = None
+    # Rank-order provenance (ISSUE 10): stamped into the job's stats/cert
+    # when the enumeration order was permuted.
+    order_meta: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -321,6 +432,8 @@ class TpuSweepBackend:
         lo_bits: int = LO_BITS,
         cancel=None,
         pad_shapes: bool = True,
+        order: Optional[str] = None,
+        prune: Optional[bool] = None,
     ) -> None:
         self.batch = batch  # None ⇒ _auto_batch(circuit.n) at check time
         self.max_bits = max_bits
@@ -348,6 +461,147 @@ class TpuSweepBackend:
         # silently flip the verdict.
         if lo_bits > LO_BITS:
             raise ValueError(f"lo_bits={lo_bits} exceeds the int32 decode ceiling {LO_BITS}")
+        # ISSUE 10 search-space reductions.  order: None reads QI_SWEEP_ORDER
+        # ("rank" applies the rank-order permutation, anything else keeps the
+        # natural SCC order); prune: None reads QI_SWEEP_PRUNE (block-guard
+        # pruning).  Both default OFF — verdicts are identical either way
+        # (tests/test_qi_prune.py), these are perf knobs.
+        if order not in (None, "natural", "rank"):
+            raise ValueError(f"unknown sweep order {order!r}")
+        self.order = order
+        self.prune = prune
+
+    def _order_mode(self) -> str:
+        if self.order is not None:
+            return self.order
+        return (
+            "rank"
+            if qi_env("QI_SWEEP_ORDER").strip().lower() == "rank"
+            else "natural"
+        )
+
+    def _prune_enabled(self) -> bool:
+        if self.prune is not None:
+            return self.prune
+        return qi_env("QI_SWEEP_PRUNE").strip() not in ("", "0")
+
+    # ---- block-guard prune planning (ISSUE 10) ---------------------------
+
+    def _plan_pruning(
+        self,
+        circuit: Circuit,
+        bit_nodes: np.ndarray,
+        bits: int,
+        total: int,
+        start0: int,
+        engine: str,
+    ) -> Optional[_PrunePlan]:
+        """Evaluate the block guards for one enumeration; None ⇒ unpruned.
+
+        ``bit_nodes``: enumeration bit j → circuit node ``bit_nodes[j]``
+        (device lane space — post-restriction local indices, or graph
+        indices for an unrestricted whole-graph SCC).  ``start0`` is the
+        checkpoint-resume cut: blocks not entirely at or above it stay
+        unpruned, so the resumed prefix and the pruned mass never overlap
+        in the certificate's ledger arithmetic.
+        """
+        fault_point("sweep.prune")
+        if bits < PRUNE_MIN_BITS:
+            return None
+        prefix_bits = min(PRUNE_MAX_PREFIX_BITS, bits - PRUNE_MIN_BLOCK_BITS)
+        if prefix_bits <= 0:
+            return None
+        k = bits - prefix_bits
+        n_blocks = 1 << prefix_bits
+        cols = np.asarray(bit_nodes, dtype=np.int64)
+        # Block b's maximal candidate: every free low-bit node plus the
+        # prefix's fixed-one nodes (bit j of b toggles bit_nodes[k + j]).
+        masks = np.zeros((n_blocks, circuit.n), dtype=np.int8)
+        masks[:, cols[:k]] = 1
+        pref = np.arange(n_blocks, dtype=np.int64)
+        hi_bits = (
+            (pref[:, None] >> np.arange(prefix_bits, dtype=np.int64)[None, :]) & 1
+        ).astype(np.int8)
+        masks[:, cols[k:]] = hi_bits
+        if engine == "pallas":
+            from quorum_intersection_tpu.backends.tpu import pallas_sweep
+
+            guard = pallas_sweep.pallas_guard_factory(circuit)
+        else:
+            from quorum_intersection_tpu.backends.tpu.kernels import (
+                guard_program_factory,
+            )
+
+            guard = guard_program_factory(circuit, min(GUARD_BATCH, n_blocks))
+        prunable = guard(masks) == 0
+        # Resume cut: the first block fully at or above start0 — earlier
+        # blocks ride in windows_resumed_prefix, not the pruned ledger.
+        cut = (start0 + (1 << k) - 1) >> k
+        prunable[:cut] = False
+        prefixes = [int(p) for p in np.nonzero(prunable)[0]]
+        return _PrunePlan.build(k, prefixes, total, start0, n_blocks)
+
+    def _try_plan_pruning(
+        self,
+        circuit: Circuit,
+        bit_nodes: np.ndarray,
+        bits: int,
+        total: int,
+        start0: int,
+        engine: str,
+    ) -> Optional[_PrunePlan]:
+        """Guard planning with in-place degrade: any failure — the injected
+        ``sweep.prune`` fault included — falls back to the unpruned
+        enumeration (``sweep.prune_degraded`` event + ``sweep.prune_errors``
+        counter).  Pruning is an optimization, never a precondition for a
+        verdict, so the engine rung itself never fails here."""
+        if not self._prune_enabled() or self.mesh is not None:
+            return None
+        try:
+            return self._plan_pruning(
+                circuit, bit_nodes, bits, total, start0, engine
+            )
+        except SearchCancelled:
+            raise
+        # Pruning degrades IN PLACE to the unpruned sweep (ROBUSTNESS
+        # sweep.prune row); the tpu-sweep rung keeps running untouched.
+        # qi-lint: allow(degrade-via-ladder) — in-place optimization degrade
+        except Exception as exc:  # noqa: BLE001
+            rec = get_run_record()
+            rec.add("sweep.prune_errors")
+            rec.event("sweep.prune_degraded", cause=str(exc))
+            log.warning(
+                "sweep pruning degraded to unpruned enumeration (%s)", exc
+            )
+            return None
+
+    @staticmethod
+    def _emit_prune_telemetry(
+        plans: Sequence[Optional[_PrunePlan]],
+        totals: Sequence[int],
+        packed: bool = False,
+    ) -> None:
+        """One ``sweep.pruned`` event + the counters/gauge per drive/pack."""
+        live = [p for p in plans if p is not None]
+        if not live:
+            return
+        rec = get_run_record()
+        blocks = sum(len(p.prefixes) for p in live)
+        windows = sum(p.windows for p in live)
+        space = sum(totals)
+        rec.add("sweep.blocks_pruned", blocks)
+        rec.add("cert.windows_pruned_guard", windows)
+        rec.gauge(
+            "sweep.prune_ratio",
+            round(windows / space, 6) if space else 0.0,
+        )
+        rec.event(
+            "sweep.pruned",
+            blocks=blocks, windows=windows, total=space,
+            block_bits=live[0].block_bits,
+            guard_rows=sum(p.guard_rows for p in live),
+            packed=packed,
+        )
 
     # ---- host-side witness reconstruction -------------------------------
 
@@ -387,6 +641,15 @@ class TpuSweepBackend:
     ) -> SccCheckResult:
         if circuit is None:
             raise ValueError("sweep backend requires the encoded circuit")
+        scc = list(scc)
+        # Rank-ordered windows (ISSUE 10): the permutation is applied to the
+        # SCC order itself, BEFORE restriction — every downstream structure
+        # (restricted circuit lanes, bit_nodes, checkpoint fingerprint,
+        # witness decode through `nodes`) inherits it, and the graph-space
+        # id list keeps hit decode order-transparent.
+        order_meta: Optional[Dict[str, object]] = None
+        if self._order_mode() == "rank" and len(scc) > 2:
+            scc, order_meta = rank_order_nodes(graph, scc)
         s = len(scc)
         bits = s - 1
         if bits > self.max_bits:
@@ -520,8 +783,47 @@ class TpuSweepBackend:
                     )
                 n = circuit.n
 
+        # Block-guard pruning (ISSUE 10): narrow (single-level) unsharded
+        # enumerations only — the wide two-level decode's hi mask and the
+        # mesh's contiguous sub-blocks don't speak non-contiguous work yet
+        # (the plan itself is None-safe everywhere below).  The guard runs
+        # on the SAME device kernels as the sweep; failures degrade in
+        # place to the unpruned enumeration (sweep.prune fault point).
+        # Planned BEFORE batch selection: when blocks pruned, the base
+        # program shrinks toward the block granularity so a surviving
+        # fragment never burns a full-size program (the ramp regains large
+        # programs on contiguous surviving runs).
+        plan: Optional[_PrunePlan] = None
+        if not hi_nodes:
+            plan = self._try_plan_pruning(
+                circuit, bit_nodes, bits, total, start0, engine
+            )
+        self._emit_prune_telemetry((plan,), (total,))
+        pruned_windows = plan.windows if plan is not None else 0
+        if plan is not None:
+            ranges = plan.ranges
+        elif start0 < total:
+            ranges = [(start0, total)]
+        else:
+            ranges = []
+        # Suffix sums: surviving work at/after each range, so the ramp-jump
+        # heuristics read "remaining REAL work", not the raw index distance
+        # (which would count pruned gaps as work and over-jump).
+        range_suffix = [0] * (len(ranges) + 1)
+        for _rix in range(len(ranges) - 1, -1, -1):
+            range_suffix[_rix] = (
+                range_suffix[_rix + 1] + ranges[_rix][1] - ranges[_rix][0]
+            )
+
         batch = self.batch if self.batch is not None else _auto_batch(circuit.n)
         batch = clamp_batch_to_index_ceiling(batch, lo_total)
+        if plan is not None and plan.windows:
+            # Align the base program with the prune granularity (floor 512
+            # rows keeps shapes sane at tiny block sizes): fragmented
+            # surviving ranges cost at most one base-size program each,
+            # while STEPS_RAMP still fuses up to 1024 base blocks per
+            # program across contiguous surviving runs.
+            batch = min(batch, max(1 << plan.block_bits, 512))
         if hi_nodes:
             # Power-of-two blocks make chunk tails exact (no aliased
             # overshoot work); correctness does not depend on it — the
@@ -722,7 +1024,12 @@ class TpuSweepBackend:
                 rec.add("sweep.windows_cancelled", len(inflight))
                 # qi-cert: everything not yet drained is CANCELLED coverage
                 # — a later certificate must never claim these windows.
-                rec.add("cert.windows_cancelled", max(total - candidates, 0))
+                # (The resumed prefix and the guard-pruned mass are already
+                # claimed by their own ledger terms, never by this one.)
+                rec.add(
+                    "cert.windows_cancelled",
+                    max(total - start0 - pruned_windows - candidates, 0),
+                )
                 rec.event(
                     "sweep.cancelled", start=start, total=total,
                     windows_dropped=len(inflight), drained=steps,
@@ -732,11 +1039,21 @@ class TpuSweepBackend:
                     f"({steps} programs dispatched)"
                 )
 
-        start = start0
+        seg_ix = 0
+        start = ranges[0][0] if ranges else total
         ramp_ix = 0
         since_ramp = 0  # dispatches since the last ramp change: the first
         # (small) program must run before the jump, so an early hit or crash
         # right at the start never has to sync/lose a maximum-size program.
+
+        def remaining_work() -> int:
+            """Surviving (un-pruned) windows not yet dispatched — the
+            "remaining work" every ramp decision reads.  With pruning the
+            space is no longer contiguous, so the raw index distance
+            ``total - start`` would count pruned gaps as work."""
+            if seg_ix >= len(ranges):
+                return 0
+            return range_suffix[seg_ix] - (start - ranges[seg_ix][0])
 
         def jump_worthwhile() -> bool:
             """Can the remaining work still fill the next ramp level?  The
@@ -746,7 +1063,7 @@ class TpuSweepBackend:
             desynchronize from the actual jump decision."""
             return (
                 ramp_ix + 1 < len(STEPS_RAMP)
-                and total - start >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
+                and remaining_work() >= STEPS_RAMP[ramp_ix + 1] * base_block * 2
             )
 
         if jump_worthwhile():
@@ -755,16 +1072,25 @@ class TpuSweepBackend:
             # only after it (the first dispatch blocks on level-1's compile;
             # serializing the two wastes the bigger compile's full latency).
             start_async_compile(STEPS_RAMP[
-                _jump_target_ix(STEPS_RAMP, ramp_ix, base_block, total - start)
+                _jump_target_ix(STEPS_RAMP, ramp_ix, base_block, remaining_work())
             ])
         # One span over the whole dispatch/drain drive (qi-trace): every
         # per-window sweep.window progress event lands inside it, so the
         # exported timeline shows the enumeration as one block with its
         # windows as instant marks on the same thread track.
         with rec.span(
-            "sweep.drive", scc=s, total=total, resumed_from=start0
+            "sweep.drive", scc=s, total=total, resumed_from=start0,
+            pruned=pruned_windows,
         ) as drive_span:
-            while start < total:
+            while seg_ix < len(ranges):
+                cur_hi = ranges[seg_ix][1]
+                if start >= cur_hi:
+                    # Range exhausted: hop over the pruned gap to the next
+                    # surviving range (remaining work is non-contiguous now).
+                    seg_ix += 1
+                    if seg_ix < len(ranges):
+                        start = ranges[seg_ix][0]
+                    continue
                 check_cancel()
                 # Injectable window boundary: `preempt` simulates the scheduler
                 # revoking the chip mid-enumeration (any recorded checkpoint
@@ -784,14 +1110,14 @@ class TpuSweepBackend:
                     if (
                         ct is not None
                         and ct in dispatchers
-                        and total - start >= ct * base_block
+                        and remaining_work() >= ct * base_block
                     ):
                         # The in-flight compile landed and still fits: jump.
                         ramp_ix, since_ramp = STEPS_RAMP.index(ct), 0
                         async_compile["target"] = None
                     elif thread is None or not thread.is_alive():
                         target_ix = _jump_target_ix(
-                            STEPS_RAMP, ramp_ix, base_block, total - start
+                            STEPS_RAMP, ramp_ix, base_block, remaining_work()
                         )
                         if target_ix == ramp_ix:
                             # No level above is worth compiling for the work
@@ -817,16 +1143,21 @@ class TpuSweepBackend:
                 hi, lo = start >> lo_bits, start & (lo_total - 1)
                 coverage = STEPS_RAMP[ramp_ix] * base_block
                 spc = STEPS_RAMP[ramp_ix]
-                if lo + coverage > lo_total:
-                    # Chunk tail: dispatch the smallest program that covers the
-                    # remainder, but ADVANCE/RECORD only to the chunk boundary.
-                    # The overshot indices decode as aliases of this same
-                    # chunk's prefix (bit lo_bits+ shifts hit pos 31) — already
-                    # evaluated, so harmless duplicates — while the recorded
-                    # position never claims the NEXT chunk's candidates (whose
-                    # hi mask differs).  This also makes checkpoint positions
-                    # independent of batch/lo_bits choices across resumes.
-                    rem = lo_total - lo
+                boundary = min(lo_total - lo, cur_hi - start)
+                if coverage > boundary:
+                    # Segment tail — the decode chunk (two-level lo space) or
+                    # the current surviving range, whichever ends first:
+                    # dispatch the smallest program that covers the remainder,
+                    # but ADVANCE/RECORD only to the boundary.  Chunk-tail
+                    # overshoot decodes as aliases of this same chunk's prefix
+                    # (harmless duplicates); range-tail overshoot sweeps into
+                    # a guard-pruned gap, which by guard soundness holds no
+                    # hit — either way the recorded position never claims
+                    # windows beyond the boundary, so the enumerated count
+                    # and any pruned ledger term stay disjoint.  This also
+                    # makes checkpoint positions independent of batch/lo_bits
+                    # choices across resumes.
+                    rem = boundary
                     # Prefer the smallest ALREADY-COMPILED shape that covers the
                     # remainder (overshoot aliases are free duplicates): the
                     # jump skips intermediate levels, so a fresh `next(...)`
@@ -889,9 +1220,10 @@ class TpuSweepBackend:
             # qi-cert coverage ledger (cert.py ledger_entry): the window
             # categories whose sum the independent checker pins to the
             # window space on every `true` certificate.  Pruned-by-guard
-            # is reserved for the ROADMAP device-side pruning item — when
-            # pruning lands, its wins become auditable here instead of
-            # silently shrinking `windows_enumerated`.  A checkpoint-
+            # carries the block-guard wins (ISSUE 10) as auditable mass —
+            # each pruned block is a checkable (prefix, k, rule) claim the
+            # checker re-verifies with its own fixpoint evaluator, never a
+            # silent shrink of `windows_enumerated`.  A checkpoint-
             # resumed run did not re-drain the fingerprint-matched prefix,
             # so the prefix rides as its own term (the checker counts it
             # into the sum) rather than inflating `windows_enumerated`,
@@ -899,12 +1231,29 @@ class TpuSweepBackend:
             "cert": {
                 "window_space": total,
                 "windows_enumerated": candidates,
-                "windows_pruned_guard": 0,
+                "windows_pruned_guard": pruned_windows,
                 "windows_skipped_pack_fill": 0,
                 "windows_cancelled": 0,
                 "windows_resumed_prefix": start0,
             },
         }
+        if plan is not None and plan.windows:
+            # The checkable pruned-block ledger: enough for the stdlib
+            # checker to rebuild every block's maximal candidate in graph
+            # space and re-run its own greatest fixpoint on it.
+            stats["cert"]["pruned_blocks"] = {
+                "k": plan.block_bits,
+                "rule": PRUNE_RULE_ID,
+                "prefixes": list(plan.prefixes),
+            }
+            stats["cert"]["enumeration"] = {
+                "fixed": graph.node_ids[nodes[0]],
+                "bit_nodes": [graph.node_ids[v] for v in nodes[1:]],
+            }
+        if order_meta is not None:
+            # Rank-order provenance: cert.py lifts this into
+            # provenance.order on every certificate of this solve.
+            stats["order"] = dict(order_meta)
         rec.gauge("sweep.candidates_per_sec", round(throughput.per_second, 1))
         # Registry definition (docs/OBSERVABILITY.md): windows_enumerated /
         # window_space of a FULL sweep — 1.0 under pure brute force, driven
@@ -984,6 +1333,12 @@ class TpuSweepBackend:
         needs no frozen row."""
         if circuit is None:
             raise ValueError("sweep backend requires the encoded circuit")
+        scc = list(scc)
+        order_meta: Optional[Dict[str, object]] = None
+        if self._order_mode() == "rank" and len(scc) > 2:
+            # Same rank-order permutation as the unpacked driver, applied
+            # before restriction so the packed lanes inherit it.
+            scc, order_meta = rank_order_nodes(graph, scc)
         s = len(scc)
         bits = s - 1
         if bits > self.max_bits:
@@ -999,6 +1354,7 @@ class TpuSweepBackend:
             circuit_d=None if scope_to_scc else q6_c,
             bits=bits,
             total=1 << bits if bits > 0 else 1,
+            order_meta=order_meta,
         )
 
     def check_sccs(
@@ -1076,6 +1432,34 @@ class TpuSweepBackend:
         slot = ladder_up(max(j.circuit.n for j in jobs))
         capacity = max(1, LANE_TILE // slot)
 
+        # Block-guard pruning per packed job (ISSUE 10): each member's guard
+        # runs against its OWN restricted circuit (the packed block shares
+        # no windows across groups), and any failure degrades the whole
+        # pack to unpruned enumeration in place — same contract as the
+        # unpacked driver's sweep.prune fault point.
+        prune_plans: List[Optional[_PrunePlan]] = [None] * n_jobs
+        if self._prune_enabled():
+            try:
+                for jix, job in enumerate(jobs):
+                    prune_plans[jix] = self._plan_pruning(
+                        job.circuit,
+                        np.arange(1, job.circuit.n, dtype=np.int64),
+                        job.bits, job.total, 0, "xla",
+                    )
+            except SearchCancelled:
+                raise
+            # qi-lint: allow(degrade-via-ladder) — in-place optimization degrade
+            except Exception as exc:  # noqa: BLE001
+                prune_plans = [None] * n_jobs
+                rec.add("sweep.prune_errors")
+                rec.event("sweep.prune_degraded", cause=str(exc), packed=True)
+                log.warning(
+                    "packed sweep pruning degraded to unpruned (%s)", exc
+                )
+        self._emit_prune_telemetry(
+            prune_plans, [j.total for j in jobs], packed=True
+        )
+
         # Spare lanes become extra windows of the jobs with the largest
         # per-window enumerations (pack source (a): multiple in-flight
         # windows of the current SCC) — never split below ~two blocks per
@@ -1113,6 +1497,14 @@ class TpuSweepBackend:
             max(g.hi - g.lo for g in groups),
         ))
         batch = clamp_batch_to_index_ceiling(batch, max(j.total for j in jobs))
+        live_plans = [p for p in prune_plans if p is not None and p.windows]
+        if live_plans:
+            # Same base-program/prune-granularity alignment as the unpacked
+            # drive: a surviving fragment must not burn a full-size program.
+            batch = min(
+                batch,
+                max(1 << min(p.block_bits for p in live_plans), 512),
+            )
         resolution = resolve_engine(
             self.engine, mesh=False, wide=False, restricted=False,
             circuit=packed.circuit,
@@ -1162,10 +1554,23 @@ class TpuSweepBackend:
 
         unresolved = set(range(n_jobs))
         nxt = [g.lo for g in groups]
-        # Per-group enumerated coverage (qi-cert): lets the skip accounting
-        # below compute exactly how much of a window was never swept when a
-        # lower window's hit retires it.
-        covered = [0] * len(groups)
+        # Per-group drained high-water position (qi-cert): lets the skip
+        # and cancel accounting compute exactly how much of a window was
+        # never swept — minus any guard-pruned windows inside it, which the
+        # pruned ledger term claims instead.
+        pos = [g.lo for g in groups]
+
+        def pruned_in(job_ix: int, lo: int, hi: int) -> int:
+            p = prune_plans[job_ix]
+            return p.overlap(lo, hi) if p is not None else 0
+
+        for gix, g in enumerate(groups):
+            p = prune_plans[g.job]
+            if p is not None:
+                nxt[gix] = p.skip(nxt[gix])
+                if nxt[gix] >= g.hi:
+                    # The whole window is guard-pruned: nothing to sweep.
+                    g.done = True
         inflight: "deque" = deque()
         pack_rows = 0
         ramp = (1, 8, 64)
@@ -1178,7 +1583,7 @@ class TpuSweepBackend:
                 # qi-cert: the unswept remainder of every live window is
                 # CANCELLED coverage, exactly as in the unpacked drive.
                 rec.add("cert.windows_cancelled", sum(
-                    max(g.hi - g.lo - covered[i], 0)
+                    max(g.hi - pos[i], 0) - pruned_in(g.job, pos[i], g.hi)
                     for i, g in enumerate(groups) if not g.done
                 ))
                 rec.event(
@@ -1231,9 +1636,10 @@ class TpuSweepBackend:
                 if s0 >= g.hi:
                     continue  # frozen lane: nothing new covered
                 top = min(s0 + coverage, g.hi)
-                jobs[g.job].candidates += top - s0
-                covered[gix] += top - s0
-                drained += top - s0
+                swept = (top - s0) - pruned_in(g.job, s0, top)
+                jobs[g.job].candidates += swept
+                pos[gix] = max(pos[gix], top)
+                drained += swept
                 h = int(hits[gix])
                 if h < g.hi:
                     # In-range hit.  Overshoot rows (>= hi, aliased decode
@@ -1247,17 +1653,31 @@ class TpuSweepBackend:
                     # remainder is SKIPPED-BY-PACK-FILL coverage (qi-cert):
                     # windows that only existed because spare pack lanes
                     # split the enumeration, retired by a lower window's
-                    # hit — counted exactly, per job.
+                    # hit — counted exactly, per job, with any guard-pruned
+                    # windows inside it staying on the pruned ledger term.
                     for g2ix, g2 in enumerate(groups):
                         if g2.job == g.job and g2.lo > g.lo and not g2.done:
-                            skip = max(g2.hi - g2.lo - covered[g2ix], 0)
-                            jobs[g.job].skipped += skip
-                            rec.add("cert.windows_skipped_pack_fill", skip)
+                            skip = max(g2.hi - pos[g2ix], 0) - pruned_in(
+                                g.job, pos[g2ix], g2.hi
+                            )
+                            jobs[g.job].skipped += max(skip, 0)
+                            rec.add(
+                                "cert.windows_skipped_pack_fill",
+                                max(skip, 0),
+                            )
                             g2.done = True
-                elif top >= g.hi:
+                elif top >= g.hi or pruned_in(g.job, top, g.hi) == g.hi - top:
+                    # Fully drained — or everything left of this window is
+                    # guard-pruned tail no program will ever be dispatched
+                    # for (nxt skipped past it).
                     g.done = True
             rec.add("cert.windows_enumerated", drained)
             resolve_jobs()
+
+        # A job whose every window was guard-pruned resolves before any
+        # dispatch (its groups were marked done at init; pruned blocks hold
+        # no hits, so "nothing left to sweep" IS the clean verdict).
+        resolve_jobs()
 
         # The whole pack drive is one span (qi-trace), and the live
         # endpoint's /healthz reads the in-flight count from the gauge
@@ -1297,6 +1717,12 @@ class TpuSweepBackend:
                         for i, g in enumerate(groups):
                             if not g.done and nxt[i] < g.hi:
                                 nxt[i] += coverage
+                                p = prune_plans[g.job]
+                                if p is not None and nxt[i] < g.hi:
+                                    # Hop the next dispatch over any guard-
+                                    # pruned run ("remaining work" is no
+                                    # longer contiguous under pruning).
+                                    nxt[i] = p.skip(nxt[i])
                         if len(inflight) >= depth_cap:
                             drain_one()
                     elif inflight:
@@ -1344,7 +1770,8 @@ class TpuSweepBackend:
             rec.gauge(
                 "cert.enumeration_ratio", round(enum_all / total_all, 6)
             )
-        for job in jobs:
+        for jix, job in enumerate(jobs):
+            job_plan = prune_plans[jix]
             stats = {
                 "backend": self.name,
                 "candidates_checked": job.candidates,
@@ -1352,17 +1779,33 @@ class TpuSweepBackend:
                 "seconds": seconds,
                 # qi-cert ledger, per packed job: a clean (true-verdict)
                 # job's windows partition its enumeration exactly, so
-                # enumerated sums to the window space; a hit job's skipped
-                # count is the pack-fill windows its hit retired.
+                # enumerated + pruned sums to the window space; a hit job's
+                # skipped count is the pack-fill windows its hit retired.
                 "cert": {
                     "window_space": job.total,
                     "windows_enumerated": job.candidates,
-                    "windows_pruned_guard": 0,
+                    "windows_pruned_guard": (
+                        job_plan.windows if job_plan is not None else 0
+                    ),
                     "windows_skipped_pack_fill": job.skipped,
                     "windows_cancelled": 0,
                 },
                 **pack_stats,
             }
+            if job_plan is not None and job_plan.windows:
+                stats["cert"]["pruned_blocks"] = {
+                    "k": job_plan.block_bits,
+                    "rule": PRUNE_RULE_ID,
+                    "prefixes": list(job_plan.prefixes),
+                }
+                stats["cert"]["enumeration"] = {
+                    "fixed": job.graph.node_ids[job.nodes[0]],
+                    "bit_nodes": [
+                        job.graph.node_ids[v] for v in job.nodes[1:]
+                    ],
+                }
+            if job.order_meta is not None:
+                stats["order"] = dict(job.order_meta)
             if job.first_hit is None:
                 job.result = SccCheckResult(intersects=True, stats=stats)
                 continue

@@ -243,6 +243,14 @@ def build_certificate(
     }
     if delta is not None:
         cert["provenance"]["delta"] = dict(delta)  # type: ignore[index]
+    order = stats.get("order")
+    if isinstance(order, dict):
+        # Rank-ordered windows (ISSUE 10): which enumeration permutation the
+        # sweep ran under (mode/score source/fixed-out node) — provenance
+        # only; the witness and every ledger claim are already expressed in
+        # graph-space node ids, so the checker needs no decode help here
+        # (a pruned ledger carries its own explicit `enumeration` block).
+        cert["provenance"]["order"] = dict(order)  # type: ignore[index]
     summary: Dict[str, object] = {
         "verdict": bool(intersects),
         "backend": stats.get("backend", reason),

@@ -88,11 +88,70 @@ LEASE_WAIT_S = 60.0
 
 # Stats keys that describe the ORIGINAL solve's run, not the verdict: they
 # are dropped from stored fragments so a composed result never claims a
-# stale race outcome as its own (native/bnb counters stay — they ARE the
-# coverage evidence the composed ledger re-serves).
-_VOLATILE_STATS = ("race",)
+# stale race outcome (or the original run's rank-order provenance — the
+# composed result never ran a sweep) as its own (native/bnb counters stay
+# — they ARE the coverage evidence the composed ledger re-serves).
+_VOLATILE_STATS = ("race", "order")
 
 _StoreKey = Tuple[str, str, str]
+
+
+def _localize_pruned_evidence(
+    stats: Dict[str, object], graph: TrustGraph, members: List[int]
+) -> Optional[Dict[str, object]]:
+    """Rewrite a fragment's pruned-block evidence (ISSUE 10) into SCC-local
+    coordinates before banking: the cert ledger's ``enumeration`` block
+    names graph-space publicKeys, and a fingerprint-matched SCC in a LATER
+    snapshot may carry different keys (the same rank map the witness
+    localization rides).  The ``pruned_blocks`` claims are pure block
+    arithmetic over the bit order, so only the bit→node map needs the
+    coordinate change.  ``None`` when an enumeration id fails to localize
+    (a claim that escaped the SCC — the same unsoundness the witness
+    localization refuses to cache): the caller must not bank the
+    fragment, because a composed certificate could never re-verify it."""
+    cert = stats.get("cert")
+    if not isinstance(cert, dict) or "enumeration" not in cert:
+        return stats
+    enum = cert.get("enumeration") or {}
+    rank: Dict[str, int] = {
+        graph.node_ids[v]: i for i, v in enumerate(members)
+    }
+    try:
+        local = {
+            "fixed": rank[enum["fixed"]],
+            "bit_nodes": [rank[pk] for pk in enum["bit_nodes"]],
+        }
+    except (KeyError, TypeError):
+        return None
+    stats = dict(stats)
+    cert = dict(cert)
+    del cert["enumeration"]
+    cert["enumeration_local"] = local
+    stats["cert"] = cert
+    return stats
+
+
+def _project_pruned_evidence(
+    stats: Dict[str, object], graph: TrustGraph, members: List[int]
+) -> Dict[str, object]:
+    """Inverse of :func:`_localize_pruned_evidence` at compose time: rebuild
+    the ``enumeration`` bit→node map against THIS snapshot's graph, so the
+    composed certificate's pruned blocks re-verify under the new ids."""
+    cert = stats.get("cert")
+    if not isinstance(cert, dict) or "enumeration_local" not in cert:
+        return stats
+    local = cert["enumeration_local"]
+    stats = dict(stats)
+    cert = dict(cert)
+    del cert["enumeration_local"]
+    cert["enumeration"] = {
+        "fixed": graph.node_ids[members[local["fixed"]]],
+        "bit_nodes": [
+            graph.node_ids[members[r]] for r in local["bit_nodes"]
+        ],
+    }
+    stats["cert"] = cert
+    return stats
 
 
 @dataclass
@@ -573,7 +632,9 @@ class DeltaEngine:
         t0 = time.perf_counter()
         q1 = project(cached.q1_local, st.target_scc)
         q2 = project(cached.q2_local, st.target_scc)
-        stats: Dict[str, object] = dict(cached.stats)
+        stats: Dict[str, object] = _project_pruned_evidence(
+            dict(cached.stats), st.graph, st.target_scc
+        )
         stats["delta"] = {
             "reused": True,
             "solved_seconds": stats.get("seconds"),
@@ -707,14 +768,18 @@ class DeltaEngine:
                 q1_local is not None and q2_local is not None
             )
             if witness_ok:
-                stats = {
-                    k: v for k, v in res.stats.items()
-                    if k not in _VOLATILE_STATS
-                }
-                publishable = SccVerdict(
-                    intersects=bool(res.intersects),
-                    q1_local=q1_local, q2_local=q2_local, stats=stats,
+                stats = _localize_pruned_evidence(
+                    {
+                        k: v for k, v in res.stats.items()
+                        if k not in _VOLATILE_STATS
+                    },
+                    st.graph, st.target_scc,
                 )
+                if stats is not None:
+                    publishable = SccVerdict(
+                        intersects=bool(res.intersects),
+                        q1_local=q1_local, q2_local=q2_local, stats=stats,
+                    )
         if st.target_fp in held:
             held.discard(st.target_fp)
             self.store.publish_verdict(

@@ -188,6 +188,71 @@ def benchmark_fbas(
     return nodes
 
 
+def near_disjoint_cores(
+    core: int = 10,
+    bridge: int = 1,
+    *,
+    broken: bool = False,
+    seed: int = 0,
+    prefix: str = "NDC",
+) -> List[Dict]:
+    """Adversarial preset (ISSUE 10, ROADMAP scenario diversity): two dense
+    cores A and B joined by a THIN bridge — one SCC whose disjointness
+    search has deep first-hit windows, exactly where rank-ordered windows
+    and block-guard pruning shine.
+
+    Topology (``2*core + bridge`` nodes, a single SCC):
+
+    - ``a ∈ A``: 2-of-[majority-of-A, all-of-bridge] — a quorum touching A
+      needs a majority of A AND every bridge node;
+    - ``b ∈ B``: same with B (correct twin);
+    - ``m ∈ bridge``: 2-of-[majority-of-A, majority-of-B] — the bridge
+      pulls in majorities of BOTH cores, so in the correct twin every
+      quorum contains the bridge and any two quorums intersect there.
+
+    ``broken=True`` turns one knob on the B side: B's slice relaxes to
+    1-of-[sub-majority-of-B, all-of-bridge] (``core // 2``-of-B suffices
+    alone), so two disjoint sub-majority halves of B are both quorums —
+    while the trust EDGES (and with them the single SCC) are unchanged, so
+    the witness must be found by the search INSIDE the full SCC and hides
+    deep in the enumeration (B's members are shuffled across the window
+    bits; snapshot order is arbitrary).  Guard pruning shines on the
+    correct twin: any block whose maximal candidate misses the bridge or
+    either core's majority holds no quorum at all.  Same ``(core, bridge,
+    seed)`` ⇒ byte-identical snapshot.
+    """
+    if core < 3 or bridge < 1:
+        raise ValueError(
+            f"need core >= 3 and bridge >= 1, got core={core}, bridge={bridge}"
+        )
+    rng = random.Random(seed)
+    a_keys = keys(core, f"{prefix}A")
+    b_keys = keys(core, f"{prefix}B")
+    m_keys = keys(bridge, f"{prefix}M")
+    maj = core // 2 + 1
+    inner_a = _qset(maj, list(a_keys))
+    inner_b = _qset(maj, list(b_keys))
+    inner_m = _qset(bridge, list(m_keys))
+    nodes: List[Dict] = []
+    for key in a_keys:
+        nodes.append(_node(key, f"a-{key}", _qset(2, [], [dict(inner_a), dict(inner_m)])))
+    for key in b_keys:
+        if broken:
+            # One knob (the fixture-pair methodology): a sub-majority of B
+            # ALONE satisfies the slice — two disjoint halves of B qualify
+            # — but the bridge inner set (and its trust edges) stays, so
+            # the SCC partition is identical to the correct twin's.
+            nodes.append(_node(key, f"b-{key}", _qset(
+                1, [], [_qset(max(core // 2, 1), list(b_keys)), dict(inner_m)]
+            )))
+        else:
+            nodes.append(_node(key, f"b-{key}", _qset(2, [], [dict(inner_b), dict(inner_m)])))
+    for key in m_keys:
+        nodes.append(_node(key, f"m-{key}", _qset(2, [], [dict(inner_a), dict(inner_b)])))
+    rng.shuffle(nodes)  # snapshot order is arbitrary; the witness bits spread
+    return nodes
+
+
 # The default churn mix (the three bounded mutations a live stellarbeat
 # feed actually produces — see churn_trace_steps); the restructuring kinds
 # scc_split / scc_merge are opt-in via ``kinds`` because they change the

@@ -200,6 +200,27 @@ QI_DELTA_CACHE_MAX = _declare(
     "qi-delta entirely — the serving layer then re-solves every snapshot "
     "from scratch, exactly the pre-delta behavior.",
 )
+QI_SWEEP_ORDER = _declare(
+    "QI_SWEEP_ORDER", "",
+    "Enumeration-order mode of the exhaustive sweep "
+    "(backends/tpu/sweep.py): 'rank' applies the rank-order permutation "
+    "(PageRank + top-tier scores, deterministic tie-break) so low-rank "
+    "nodes occupy high window bits and the expected first-hit window of a "
+    "false verdict shrinks; empty/'natural' (default) keeps the SCC's "
+    "natural order.  Verdicts are order-independent (pinned by "
+    "tests/test_qi_prune.py); the permutation is stamped into cert "
+    "provenance.",
+)
+QI_SWEEP_PRUNE = _declare(
+    "QI_SWEEP_PRUNE", "",
+    "Device-side block-guard pruning of the exhaustive sweep "
+    "(backends/tpu/sweep.py): any value other than empty or '0' skips "
+    "window blocks whose maximal candidate contains no quorum (one "
+    "greatest-fixpoint guard per 2^k-window block), booking them as "
+    "checkable (prefix, k, rule) entries under the certificate's "
+    "windows_pruned_guard ledger term (tools/check_cert.py re-verifies "
+    "every block).  Empty/'0' (default): unpruned brute force.",
+)
 QI_SERVE_JOURNAL = _declare(
     "QI_SERVE_JOURNAL", "",
     "Path of the serving layer's crash-only request journal (serve.py): "

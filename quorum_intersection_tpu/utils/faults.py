@@ -167,6 +167,16 @@ SWEEP_PACK = _declare(
     "simulates a packing failure — the auto router's DegradationLadder "
     "degrades to the unpacked per-problem sweep, verdicts unchanged.",
 )
+SWEEP_PRUNE = _declare(
+    "sweep.prune",
+    "Block-guard prune planning of the exhaustive sweep "
+    "(backends/tpu/sweep.py _plan_pruning, fired once per drive/pack "
+    "before any guard is evaluated): error simulates a broken guard path "
+    "— the sweep degrades IN PLACE to the unpruned enumeration "
+    "(sweep.prune_degraded event + sweep.prune_errors counter), verdicts "
+    "unchanged; pruning is an optimization, never a precondition for a "
+    "verdict.",
+)
 FRONTIER_CHUNK = _declare(
     "frontier.chunk",
     "Frontier device-chunk dispatch (backends/tpu/frontier.py): oom/error "

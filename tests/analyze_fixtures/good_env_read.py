@@ -10,5 +10,11 @@ def documented_knob():
     return qi_env("QI_LOG_LEVEL")
 
 
+def sweep_reduction_knobs():
+    # ISSUE 10: the two pruned-sweep knobs are registry-declared — a read
+    # through qi_env is the documented (and lint-clean) access path.
+    return qi_env("QI_SWEEP_ORDER"), qi_env("QI_SWEEP_PRUNE")
+
+
 def foreign_knob():
     return os.environ.get("JAX_PLATFORMS")  # not QI_*: out of scope

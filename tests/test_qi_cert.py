@@ -281,17 +281,20 @@ class TestChecker:
         with pytest.raises(CheckFailure, match="cancelled"):
             check_certificate(bad, nodes)
 
-    def test_pruned_guard_is_reserved_until_pruning_exists(self):
-        # No engine prunes yet, so a ledger booking unswept windows as
-        # "pruned" sums to the space but claims coverage nothing verified
-        # — the checker must reject the whole reserved term as unsound.
+    def test_unverifiable_pruned_mass_is_unsound(self):
+        # Since ISSUE 10 pruning exists, but every pruned window must be
+        # backed by a re-checkable `pruned_blocks` ledger — a ledger
+        # booking unswept windows as "pruned" with no block claims sums
+        # to the space yet asserts coverage nothing verified, and the
+        # checker rejects it (tests/test_qi_prune.py pins the accept
+        # side and the forged-block rejection).
         nodes = fixture_nodes("nested_correct")
         res = solve(json.dumps(nodes), backend=TpuSweepBackend(batch=512))
         bad = copy.deepcopy(res.cert)
         entry = bad["coverage"]["sccs"][0]
         entry["windows_enumerated"] -= 7
         entry["windows_pruned_guard"] += 7  # sums, but nothing pruned it
-        with pytest.raises(CheckFailure, match="reserved"):
+        with pytest.raises(CheckFailure, match="unverifiable"):
             check_certificate(bad, nodes)
 
     def test_wrong_guard_count_is_unsound(self):

@@ -1,0 +1,402 @@
+"""qi-prune differential suite (ISSUE 10): rank-ordered windows +
+device-side block-guard pruning.
+
+Pins: ordered/pruned vs natural/unpruned vs the python oracle on the
+correct/broken ``near_disjoint_cores`` pair (verdicts identical
+everywhere; pruning byte-identical ON TOP of a fixed ordering — same
+witness pair, same hit index), packed parity, witness-pair validity
+through the permutation (independent checker), mid-sweep cancel and
+checkpoint-resume accounting with pruned blocks, the ``sweep.prune``
+fault degrading to the unpruned enumeration with the verdict unchanged,
+and the checker's accept/reject pinning for pruned-block ledgers
+(including a forged pruned block).
+"""
+
+import copy
+import json
+from functools import lru_cache
+
+import pytest
+
+from quorum_intersection_tpu.backends.base import SearchCancelled
+from quorum_intersection_tpu.backends.tpu.sweep import (
+    PRUNE_RULE_ID,
+    TpuSweepBackend,
+)
+from quorum_intersection_tpu.encode.circuit import (
+    encode_circuit,
+    rank_order_nodes,
+)
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import near_disjoint_cores
+from quorum_intersection_tpu.pipeline import quorum_bearing_sccs, solve
+from quorum_intersection_tpu.utils import telemetry
+from tools.check_cert import CheckFailure, check_certificate
+
+CORRECT = near_disjoint_cores(6, 1)
+BROKEN = near_disjoint_cores(6, 1, broken=True)
+FIXTURES = {"correct": (CORRECT, True), "broken": (BROKEN, False)}
+
+
+def sweep(order, prune, **kw):
+    kw.setdefault("batch", 256)
+    return TpuSweepBackend(order=order, prune=prune, **kw)
+
+
+@lru_cache(maxsize=None)
+def sweep_solve(fixture, order, prune, engine="xla"):
+    data, _ = FIXTURES[fixture]
+    return solve(
+        json.dumps(data),
+        backend=sweep(order, prune, engine=engine),
+    )
+
+
+@lru_cache(maxsize=None)
+def oracle_solve(fixture):
+    data, _ = FIXTURES[fixture]
+    return solve(json.dumps(data), backend="python")
+
+
+def make_job(data):
+    graph = build_graph(parse_fbas(data))
+    circuit = encode_circuit(graph)
+    [(_sid, scc)] = quorum_bearing_sccs(graph, allow_native=False)
+    return graph, circuit, scc
+
+
+@pytest.fixture
+def fresh_record():
+    rec = telemetry.reset_run_record()
+    yield rec
+    telemetry.reset_run_record()
+
+
+class TestPreset:
+    """near_disjoint_cores is a one-knob pair with ONE SCC both ways."""
+
+    def test_pair_verdicts_and_structure(self):
+        for fixture, (data, verdict) in FIXTURES.items():
+            res = oracle_solve(fixture)
+            assert res.intersects is verdict, fixture
+            assert res.n_sccs == 1  # the knob never changes the partition
+            assert len(res.main_scc) == len(data)
+
+    def test_deterministic_bytes(self):
+        assert near_disjoint_cores(6, 1, seed=3) == near_disjoint_cores(
+            6, 1, seed=3
+        )
+        assert near_disjoint_cores(6, 1, seed=3) != near_disjoint_cores(
+            6, 1, seed=4
+        )
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("order", ["natural", "rank"])
+    @pytest.mark.parametrize("fixture", ["correct", "broken"])
+    def test_pruned_matches_unpruned_and_oracle(self, order, fixture):
+        _, verdict = FIXTURES[fixture]
+        unpruned = sweep_solve(fixture, order, False)
+        pruned = sweep_solve(fixture, order, True)
+        assert oracle_solve(fixture).intersects is verdict
+        assert unpruned.intersects is verdict
+        assert pruned.intersects is verdict
+        # Pruning is byte-identical ON TOP of the ordering: pruned blocks
+        # hold no hits, so the first-hit window and witness pair are the
+        # unpruned sweep's exactly.
+        assert (pruned.q1, pruned.q2) == (unpruned.q1, unpruned.q2)
+        assert pruned.stats.get("hit_index") == unpruned.stats.get("hit_index")
+
+    @pytest.mark.parametrize("order", ["natural", "rank"])
+    def test_true_cert_has_verifiable_pruned_mass(self, order):
+        data, _ = FIXTURES["correct"]
+        res = sweep_solve("correct", order, True)
+        led = res.stats["cert"]
+        assert led["windows_pruned_guard"] > 0
+        # sweep_enumeration_ratio < 1.0: real pruning, exact arithmetic.
+        assert led["windows_enumerated"] < led["window_space"]
+        assert (
+            led["windows_enumerated"] + led["windows_pruned_guard"]
+            == led["window_space"]
+        )
+        assert led["pruned_blocks"]["rule"] == PRUNE_RULE_ID
+        notes = check_certificate(res.cert, data)
+        assert any("guard-pruned" in n for n in notes)
+
+    def test_witness_valid_through_the_permutation(self):
+        # The ordered witness decodes through the permuted graph-space id
+        # list; the independent checker confirms it is a disjoint quorum
+        # pair of the RAW snapshot.
+        data, _ = FIXTURES["broken"]
+        res = sweep_solve("broken", "rank", True)
+        check_certificate(res.cert, data)
+
+    def test_pallas_engine_parity(self):
+        data, _ = FIXTURES["correct"]
+        res = sweep_solve("correct", "rank", True, engine="pallas")
+        assert res.intersects is True
+        assert res.stats["cert"]["windows_pruned_guard"] > 0
+        check_certificate(res.cert, data)
+
+    def test_rank_order_is_a_permutation_with_provenance(self):
+        graph = build_graph(parse_fbas(BROKEN))
+        [(_sid, scc)] = quorum_bearing_sccs(graph, allow_native=False)
+        ordered, meta = rank_order_nodes(graph, scc)
+        assert sorted(ordered) == sorted(scc)
+        assert meta["mode"] == "rank"
+        assert meta["fixed"] == graph.node_ids[ordered[0]]
+        # The FULL permutation rides the meta, so any ordered cert lets a
+        # consumer reconstruct the enumeration (bit j = bit_nodes[j]).
+        assert meta["bit_nodes"] == [graph.node_ids[v] for v in ordered[1:]]
+        rank_cert = sweep_solve("correct", "rank", True).cert
+        prov = rank_cert["provenance"]["order"]
+        assert prov["mode"] == "rank"
+        assert len(prov["bit_nodes"]) == len(CORRECT) - 1
+        assert prov["fixed"] not in prov["bit_nodes"]
+        natural_cert = sweep_solve("correct", "natural", True).cert
+        assert "order" not in natural_cert["provenance"]
+
+
+class TestPacked:
+    def test_packed_pruned_matches_unpacked(self):
+        datas = [CORRECT, near_disjoint_cores(6, 1, seed=1), BROKEN]
+        jobs = [make_job(d) for d in datas]
+        unpacked = [
+            sweep("rank", True).check_scc(g, c, s) for g, c, s in jobs
+        ]
+        packed = sweep("rank", True).check_sccs(jobs)
+        for u, p in zip(unpacked, packed):
+            assert u.intersects == p.intersects
+            assert (u.q1, u.q2) == (p.q1, p.q2)
+        for p in packed:
+            if not p.intersects:
+                continue
+            led = p.stats["cert"]
+            assert led["windows_pruned_guard"] > 0
+            assert (
+                led["windows_enumerated"]
+                + led["windows_pruned_guard"]
+                + led["windows_skipped_pack_fill"]
+                == led["window_space"]
+            )
+
+
+class _TrippingCancel:
+    def __init__(self, after):
+        self.after = after
+        self.polls = 0
+
+    @property
+    def cancelled(self):
+        self.polls += 1
+        return self.polls > self.after
+
+
+class TestCancelAndResume:
+    def test_cancel_accounting_with_pruned_blocks(self, fresh_record):
+        data = near_disjoint_cores(7, 1)  # 2^14 windows, heavy pruning
+        graph, circuit, scc = make_job(data)
+        backend = sweep(
+            "natural", True, max_inflight=2, cancel=_TrippingCancel(6)
+        )
+        with pytest.raises(SearchCancelled):
+            backend.check_scc(graph, circuit, scc)
+        counters, _ = fresh_record.snapshot()
+        space = 1 << (len(scc) - 1)
+        pruned = counters.get("cert.windows_pruned_guard", 0)
+        enumerated = counters.get("cert.windows_enumerated", 0)
+        cancelled = counters.get("cert.windows_cancelled", 0)
+        assert pruned > 0 and cancelled > 0
+        # Exact partition: every window is enumerated, pruned, or
+        # cancelled — never two of those at once.
+        assert enumerated + pruned + cancelled == space
+        assert enumerated < space
+
+    def test_checkpoint_resume_accounting_with_pruned_blocks(
+        self, tmp_path, fresh_record
+    ):
+        from quorum_intersection_tpu.utils.checkpoint import SweepCheckpoint
+
+        data = near_disjoint_cores(7, 1)
+        ck = SweepCheckpoint(tmp_path / "sweep.ckpt")
+        first = sweep(
+            "natural", True, max_inflight=2, checkpoint=ck,
+            cancel=_TrippingCancel(10),
+        )
+        with pytest.raises(SearchCancelled):
+            solve(json.dumps(data), backend=first)
+        res = solve(
+            json.dumps(data),
+            backend=sweep("natural", True, checkpoint=ck),
+        )
+        assert res.intersects is True
+        entry = res.cert["coverage"]["sccs"][0]
+        assert entry["windows_resumed_prefix"] > 0
+        assert entry["windows_pruned_guard"] > 0
+        # The resumed prefix and the pruned mass never overlap: the plan
+        # only prunes blocks fully at or above the resume cut.
+        assert (
+            entry["windows_enumerated"]
+            + entry["windows_pruned_guard"]
+            + entry["windows_resumed_prefix"]
+            == entry["window_space"]
+        )
+        notes = check_certificate(res.cert, data)
+        assert any("checkpoint-resumed" in n for n in notes)
+
+
+class TestFaultDegrade:
+    def test_prune_fault_degrades_to_unpruned_same_verdict(
+        self, monkeypatch, fresh_record
+    ):
+        monkeypatch.setenv("QI_FAULTS", "sweep.prune=error")
+        res = solve(json.dumps(CORRECT), backend=sweep("natural", True))
+        assert res.intersects is True
+        led = res.stats["cert"]
+        assert led["windows_pruned_guard"] == 0
+        assert led["windows_enumerated"] == led["window_space"]
+        counters, _ = fresh_record.snapshot()
+        assert counters.get("sweep.prune_errors", 0) >= 1
+        assert counters.get("faults.injected", 0) >= 1
+        assert any(
+            e.get("name") == "sweep.prune_degraded"
+            for e in fresh_record.events
+        )
+        check_certificate(res.cert, CORRECT)
+
+    def test_prune_fault_degrades_packed_pack(self, monkeypatch):
+        monkeypatch.setenv("QI_FAULTS", "sweep.prune=error")
+        jobs = [make_job(CORRECT), make_job(BROKEN)]
+        results = sweep("natural", True).check_sccs(jobs)
+        assert [r.intersects for r in results] == [True, False]
+        assert results[0].stats["cert"]["windows_pruned_guard"] == 0
+
+
+class TestChecker:
+    def _pruned_cert(self):
+        return copy.deepcopy(sweep_solve("correct", "natural", True).cert)
+
+    def test_forged_pruned_block_rejected(self):
+        bad = self._pruned_cert()
+        blocks = bad["coverage"]["sccs"][0]["pruned_blocks"]
+        size = bad["coverage"]["sccs"][0]["size"]
+        block_space = 1 << (size - 1 - blocks["k"])
+        # The all-ones prefix's maximal candidate is (nearly) the whole
+        # SCC, which certainly contains a quorum — booking it as pruned
+        # claims coverage nothing verified.  Replacing (not adding) keeps
+        # the ledger arithmetic intact, so only the re-verification can
+        # catch it.
+        forged = block_space - 1
+        assert forged not in blocks["prefixes"]
+        blocks["prefixes"][-1] = forged
+        with pytest.raises(CheckFailure, match="maximal candidate contains"):
+            check_certificate(bad, CORRECT)
+
+    def test_pruned_mass_without_block_ledger_rejected(self):
+        bad = self._pruned_cert()
+        del bad["coverage"]["sccs"][0]["pruned_blocks"]
+        with pytest.raises(CheckFailure, match="unverifiable"):
+            check_certificate(bad, CORRECT)
+
+    def test_block_count_mismatch_rejected(self):
+        bad = self._pruned_cert()
+        bad["coverage"]["sccs"][0]["pruned_blocks"]["prefixes"].pop()
+        with pytest.raises(CheckFailure, match="blocks \\* 2"):
+            check_certificate(bad, CORRECT)
+
+    def test_unknown_rule_rejected(self):
+        bad = self._pruned_cert()
+        bad["coverage"]["sccs"][0]["pruned_blocks"]["rule"] = "trust-me"
+        with pytest.raises(CheckFailure, match="unknown prune rule"):
+            check_certificate(bad, CORRECT)
+
+    def test_enumeration_must_be_a_permutation(self):
+        bad = self._pruned_cert()
+        enum = bad["coverage"]["sccs"][0]["enumeration"]
+        enum["bit_nodes"][0] = enum["bit_nodes"][1]
+        with pytest.raises(CheckFailure, match="not a permutation"):
+            check_certificate(bad, CORRECT)
+
+    def test_pruned_block_overlapping_resumed_prefix_rejected(self):
+        # A forged cert could recast enumerated windows as a resumed
+        # prefix while keeping pruned blocks BELOW the cut: every block
+        # still re-verifies and the sum still covers the space, but the
+        # overlapped windows are claimed by two ledger terms at once.
+        bad = self._pruned_cert()
+        entry = bad["coverage"]["sccs"][0]
+        blocks = entry["pruned_blocks"]
+        lowest = min(blocks["prefixes"]) << blocks["k"]
+        resumed = lowest + (1 << blocks["k"])  # covers the lowest block
+        entry["windows_resumed_prefix"] = resumed
+        entry["windows_enumerated"] -= resumed
+        with pytest.raises(CheckFailure, match="resumed prefix"):
+            check_certificate(bad, CORRECT)
+
+    def test_sampled_verification_accepts(self):
+        cert = self._pruned_cert()
+        notes = check_certificate(cert, CORRECT, sample=3)
+        assert any("sampled" in n for n in notes)
+
+
+class TestDeltaComposition:
+    def test_composed_cert_projects_pruned_evidence_across_keys(self):
+        # qi-delta fragments transplant across fingerprint-matched SCCs
+        # even when every publicKey differs (fbas/diff.py hashes structure,
+        # not identity).  A pruned fragment's enumeration map is banked in
+        # SCC-local ranks and rebuilt against the NEW snapshot's ids at
+        # compose time, so the composed certificate's pruned blocks still
+        # re-verify under the stdlib checker.
+        from quorum_intersection_tpu.delta import DeltaEngine, SccVerdictStore
+
+        twin = near_disjoint_cores(6, 1, prefix="XYZ")  # same structure,
+        # disjoint key space
+        engine = DeltaEngine(SccVerdictStore())
+        [first] = engine.check_many(
+            [CORRECT], backend=sweep("rank", True)
+        )
+        assert first.intersects is True
+        assert first.cert["coverage"]["sccs"][0]["windows_pruned_guard"] > 0
+        [composed] = engine.check_many(
+            [twin], backend=sweep("rank", True)
+        )
+        assert composed.intersects is True
+        assert composed.cert["provenance"]["delta"]["reused_sccs"] == 1
+        entry = composed.cert["coverage"]["sccs"][0]
+        assert entry["windows_pruned_guard"] > 0
+        # Every enumeration id is a NEW-snapshot key, and the checker
+        # re-proves every transplanted pruned block against the raw twin.
+        assert all(
+            pk.startswith("XYZ") for pk in entry["enumeration"]["bit_nodes"]
+        )
+        notes = check_certificate(composed.cert, twin)
+        assert any("pruned blocks re-verified" in n for n in notes)
+
+
+class TestKnobs:
+    def test_env_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("QI_SWEEP_ORDER", raising=False)
+        monkeypatch.delenv("QI_SWEEP_PRUNE", raising=False)
+        backend = TpuSweepBackend()
+        assert backend._order_mode() == "natural"
+        assert backend._prune_enabled() is False
+
+    def test_env_knobs_engage(self, monkeypatch):
+        monkeypatch.setenv("QI_SWEEP_ORDER", "rank")
+        monkeypatch.setenv("QI_SWEEP_PRUNE", "1")
+        backend = TpuSweepBackend()
+        assert backend._order_mode() == "rank"
+        assert backend._prune_enabled() is True
+        monkeypatch.setenv("QI_SWEEP_PRUNE", "0")
+        assert backend._prune_enabled() is False
+
+    def test_constructor_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("QI_SWEEP_ORDER", "rank")
+        monkeypatch.setenv("QI_SWEEP_PRUNE", "1")
+        backend = TpuSweepBackend(order="natural", prune=False)
+        assert backend._order_mode() == "natural"
+        assert backend._prune_enabled() is False
+
+    def test_unknown_order_rejected(self):
+        with pytest.raises(ValueError, match="unknown sweep order"):
+            TpuSweepBackend(order="chaotic")

@@ -33,14 +33,27 @@ What is checked:
   support an exhaustive-coverage claim; a checkpoint-resumed run's
   fingerprint-matched prefix counts without inflating the run's own
   enumerated windows); B&B entries (native/python oracle) must
-  carry `bnb_calls >= 1`, frontier entries `frontier_chunks_drained >= 1`.
+  carry `bnb_calls >= 1`, frontier entries `frontier_chunks_drained >= 1`;
+- **pruned mass** (ISSUE 10): nonzero `windows_pruned_guard` must be
+  backed by a `pruned_blocks` ledger `{k, rule, prefixes}` plus an
+  `enumeration` block naming the fixed-out node and the bit→node map —
+  a permutation of the entry's SCC.  For every pruned block this checker
+  rebuilds the block's MAXIMAL candidate (all free low-bit nodes plus
+  the prefix's fixed-one nodes) and re-runs its own greatest fixpoint on
+  it: the `empty-max-quorum` rule is sound iff that fixpoint is empty
+  (the fixpoint is monotone in its candidate set, so no window of the
+  block can contain a quorum, hence none can hit).  All blocks are
+  re-verified by default; `--sample N` checks a deterministic stride of
+  N blocks for huge ledgers.  Pruned mass without a verifiable block
+  ledger, an unknown rule, a count mismatch, or a block whose maximal
+  candidate DOES contain a quorum is unsound.
 
 Exit codes: 0 — certificate sound; 1 — any unsound witness, ledger
 arithmetic failure, or guard mismatch; 2 — unreadable/ill-formed inputs.
 
 Usage::
 
-    python tools/check_cert.py CERT.json FBAS.json [-q]
+    python tools/check_cert.py CERT.json FBAS.json [-q] [--sample N]
 """
 
 from __future__ import annotations
@@ -286,7 +299,130 @@ def _check_witness_quorum(
     return set(indices)
 
 
-def _check_ledger_entry(entry: dict, qb_ids: Set[str], scc_select: str) -> str:
+# Prune rules this checker knows how to re-verify; any other id is unsound
+# by definition (a claim nothing independent can re-check).
+PRUNE_RULES = ("empty-max-quorum",)
+
+
+def _check_pruned_blocks(
+    ev: Evaluator, entry: dict, sample: Optional[int]
+) -> str:
+    """Re-verify a sweep entry's pruned mass; returns a note ('' if none).
+
+    Every pruned block is a standalone claim: "the maximal candidate of
+    the 2^k windows sharing this high-bit prefix contains no quorum".
+    This checker rebuilds that candidate from the entry's `enumeration`
+    bit map and re-runs its OWN greatest fixpoint on it — sharing no code
+    with the engine that pruned."""
+    pruned = entry.get("windows_pruned_guard", 0)
+    blocks = entry.get("pruned_blocks")
+    if not pruned:
+        _require(
+            blocks is None,
+            "pruned_blocks ledger present with zero pruned windows",
+        )
+        return ""
+    _require(
+        isinstance(blocks, dict),
+        "nonzero windows_pruned_guard without a pruned_blocks ledger is "
+        "unverifiable and therefore unsound",
+    )
+    size = entry["size"]
+    bits = size - 1
+    k = blocks.get("k")
+    rule = blocks.get("rule")
+    prefixes = blocks.get("prefixes")
+    _require(
+        rule in PRUNE_RULES,
+        f"unknown prune rule {rule!r}: nothing independent can re-verify it",
+    )
+    _require(
+        isinstance(k, int) and 0 <= k <= bits,
+        f"pruned_blocks k={k!r} outside [0, {bits}]",
+    )
+    _require(
+        isinstance(prefixes, list)
+        and all(isinstance(p, int) and not isinstance(p, bool) for p in prefixes),
+        "pruned_blocks prefixes must be a list of integers",
+    )
+    block_space = 1 << (bits - k)
+    _require(
+        all(0 <= p < block_space for p in prefixes),
+        f"pruned block prefix outside [0, {block_space})",
+    )
+    _require(
+        len(set(prefixes)) == len(prefixes),
+        "pruned_blocks repeats a prefix (double-counted windows)",
+    )
+    _require(
+        len(prefixes) * (1 << k) == pruned,
+        f"windows_pruned_guard {pruned} != {len(prefixes)} blocks * 2^{k}",
+    )
+    # Disjointness with the checkpoint-resumed prefix: the sum invariant
+    # only means "every window claimed exactly once" if no pruned block
+    # dips below the resumed cut (the engine clips there; a forged cert
+    # could otherwise double-claim resumed windows as pruned and shrink
+    # windows_enumerated by the same amount with every block still
+    # re-verifying).
+    resumed = entry.get("windows_resumed_prefix", 0)
+    if isinstance(resumed, int) and resumed > 0:
+        _require(
+            all((p << k) >= resumed for p in prefixes),
+            "a pruned block overlaps the checkpoint-resumed prefix "
+            "(windows claimed by two ledger terms at once)",
+        )
+    enum = entry.get("enumeration") or {}
+    fixed = enum.get("fixed")
+    bit_ids = enum.get("bit_nodes") or []
+    _require(
+        isinstance(fixed, str)
+        and isinstance(bit_ids, list)
+        and all(isinstance(b, str) for b in bit_ids),
+        "pruned mass without a usable enumeration (fixed + bit_nodes) block",
+    )
+    _require(
+        len(bit_ids) == bits,
+        f"enumeration names {len(bit_ids)} bit nodes; expected {bits}",
+    )
+    scc_ids = set(entry.get("nodes") or [])
+    _require(
+        len(set(bit_ids)) == bits
+        and fixed not in bit_ids
+        and {fixed, *bit_ids} == scc_ids,
+        "enumeration is not a permutation of the ledger SCC",
+    )
+    bit_ix: List[int] = []
+    for pk in bit_ids:
+        v = ev.index.get(pk)
+        _require(v is not None, f"enumeration names unknown node {pk!r}")
+        bit_ix.append(v)  # type: ignore[arg-type]
+    checked = list(prefixes)
+    if sample and 0 < sample < len(checked):
+        stride = max(len(checked) // sample, 1)
+        checked = checked[::stride][:sample]
+    free = bit_ix[:k]
+    for p in checked:
+        members = free + [
+            bit_ix[k + j] for j in range(bits - k) if (p >> j) & 1
+        ]
+        _require(
+            not ev.max_quorum(members),
+            f"pruned block {p} is unsound: its maximal candidate contains "
+            f"a quorum under this checker's evaluator",
+        )
+    note = f"pruned blocks re-verified: {len(checked)}/{len(prefixes)}"
+    if len(checked) < len(prefixes):
+        note += " (sampled)"
+    return note
+
+
+def _check_ledger_entry(
+    entry: dict,
+    qb_ids: Set[str],
+    scc_select: str,
+    ev: Optional[Evaluator] = None,
+    sample: Optional[int] = None,
+) -> str:
     _require(isinstance(entry, dict), "coverage ledger entry is not an object")
     size = entry.get("size")
     nodes = entry.get("nodes") or []
@@ -340,19 +476,19 @@ def _check_ledger_entry(entry: dict, qb_ids: Set[str], scc_select: str) -> str:
             parts["windows_skipped_pack_fill"] == 0,
             "a true verdict cannot rest on pack-skipped windows",
         )
-        # Reserved term: no engine implements guard pruning yet (the
-        # ROADMAP "prune the search space" item), so ANY nonzero value is
-        # by definition unsound — a mis-binned counter or a forged ledger
-        # claiming coverage it never verified.  Relax this only when
-        # pruning lands together with a rule this checker can re-verify.
-        _require(
-            parts["windows_pruned_guard"] == 0,
-            "windows_pruned_guard is reserved (no engine prunes yet); "
-            "nonzero pruned mass is unverifiable and therefore unsound",
-        )
+        # Pruned mass (ISSUE 10): formerly a reserved always-zero term, now
+        # verifiable — every pruned block must be re-provable from the raw
+        # JSON by this checker's own fixpoint evaluator (module docs).
+        prune_note = ""
+        if ev is not None:
+            prune_note = _check_pruned_blocks(ev, entry, sample)
         note = f"sweep ledger: {parts['windows_enumerated']}/{space} windows"
+        if parts["windows_pruned_guard"]:
+            note += f" (+{parts['windows_pruned_guard']} guard-pruned)"
         if resumed:
             note += f" (+{resumed} checkpoint-resumed)"
+        if prune_note:
+            note += f"; {prune_note}"
         return note
     if backend in ("cpp", "python"):
         _require(
@@ -370,9 +506,13 @@ def _check_ledger_entry(entry: dict, qb_ids: Set[str], scc_select: str) -> str:
     raise CheckFailure(f"ledger entry with unknown backend {backend!r}")
 
 
-def check_certificate(cert: dict, nodes: Sequence[dict]) -> List[str]:
+def check_certificate(
+    cert: dict, nodes: Sequence[dict], sample: Optional[int] = None
+) -> List[str]:
     """Validate ``cert`` against the raw node list; returns human-readable
-    notes, raises :class:`CheckFailure` on the first unsound claim."""
+    notes, raises :class:`CheckFailure` on the first unsound claim.
+    ``sample``: re-verify at most that many pruned blocks per ledger entry
+    (deterministic stride); None/0 re-verifies every block."""
     notes: List[str] = []
     _require(cert.get("schema") == "qi-cert/1",
              f"unknown certificate schema {cert.get('schema')!r}")
@@ -401,7 +541,10 @@ def check_certificate(cert: dict, nodes: Sequence[dict]) -> List[str]:
         _require(bool(entries), "true verdict without a coverage ledger")
         qb_ids = {ev.ids[v] for v in qb[0]}
         for entry in entries:
-            notes.append(_check_ledger_entry(entry, qb_ids, scc_select))
+            notes.append(
+                _check_ledger_entry(entry, qb_ids, scc_select, ev=ev,
+                                    sample=sample)
+            )
         return notes
 
     witness = cert.get("witness")
@@ -451,6 +594,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("fbas", help="raw stellarbeat JSON the verdict ran on")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the per-check notes")
+    parser.add_argument("--sample", type=int, default=None, metavar="N",
+                        help="re-verify at most N pruned blocks per sweep "
+                             "ledger entry (deterministic stride) instead "
+                             "of all of them — for huge pruned ledgers")
     args = parser.parse_args(argv)
     try:
         try:
@@ -460,7 +607,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise InputError(f"cannot read certificate {args.cert}: {exc}")
         if not isinstance(cert, dict):
             raise InputError(f"{args.cert}: certificate is not a JSON object")
-        notes = check_certificate(cert, _load_nodes(args.fbas))
+        notes = check_certificate(cert, _load_nodes(args.fbas),
+                                  sample=args.sample)
     except CheckFailure as exc:
         print(f"UNSOUND: {exc}", file=sys.stderr)
         return 1

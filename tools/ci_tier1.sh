@@ -113,6 +113,38 @@ for fx in trivial_correct trivial_broken nested_correct nested_broken \
 done
 echo "CERTS=$CERTDIR (exit $certrc)"
 
+# qi-prune gate (ISSUE 10): the same six fixture certs with rank-ordered
+# windows + block-guard pruning forced through the sweep backend, each
+# re-validated by the independent checker — which now re-verifies every
+# pruned block with its own stdlib fixpoint evaluator — plus an
+# enumeration-ratio assertion on the snapshot pair's correct twin:
+# pruning must actually remove windows (ratio < 1.0) while the cert
+# stays sound and the verdict stays the manifest's.
+PRUNEDIR="${TIER1_PRUNED:-/tmp/_t1_pruned}"
+rm -rf "$PRUNEDIR"
+mkdir -p "$PRUNEDIR"
+prrc=0
+for fx in trivial_correct trivial_broken nested_correct nested_broken \
+          snapshot_correct snapshot_broken; do
+    env JAX_PLATFORMS=cpu QI_SWEEP_ORDER=rank QI_SWEEP_PRUNE=1 \
+        python -m quorum_intersection_tpu --backend tpu-sweep \
+        --cert-out "$PRUNEDIR/$fx.cert.json" \
+        < "fixtures/$fx.json" > /dev/null
+    vrc=$?
+    [ "$vrc" -gt 1 ] && { echo "PRUNED: solve crashed on $fx (rc=$vrc)"; prrc=1; }
+    env JAX_PLATFORMS=cpu python tools/check_cert.py \
+        "$PRUNEDIR/$fx.cert.json" "fixtures/$fx.json" || prrc=1
+done
+env JAX_PLATFORMS=cpu python - "$PRUNEDIR/snapshot_correct.cert.json" <<'PYEOF' || prrc=1
+import json, sys
+entry = json.load(open(sys.argv[1]))["coverage"]["sccs"][0]
+ratio = entry["windows_enumerated"] / entry["window_space"]
+assert entry["windows_pruned_guard"] > 0 and ratio < 1.0, entry
+print(f"PRUNED: snapshot_correct enumeration ratio {ratio:.4f} "
+      f"({entry['windows_pruned_guard']} windows guard-pruned)")
+PYEOF
+echo "PRUNED_CERTS=$PRUNEDIR (exit $prrc)"
+
 # Serving-layer smoke (ISSUE 8): open-loop load through a live ServeEngine
 # — the driver itself is a parity gate (served verdict == one-shot oracle
 # for every request, zero silent drops, exit 1 otherwise).  --churn
@@ -147,6 +179,7 @@ echo "TREND=exit $trc"
 [ "$crc" -ne 0 ] && exit "$crc"
 [ "$prc" -ne 0 ] && exit "$prc"
 [ "$certrc" -ne 0 ] && exit "$certrc"
+[ "$prrc" -ne 0 ] && exit "$prrc"
 [ "$src" -ne 0 ] && exit "$src"
 [ "$ssrc" -ne 0 ] && exit "$ssrc"
 exit "$trc"
