@@ -24,10 +24,13 @@ orchestrator), ``--checkpoint`` (sweep resume), ``--profile-dir`` (jax
 profiler trace), ``--metrics-json``/``--metrics-prom`` (run-record telemetry
 sinks — docs/OBSERVABILITY.md).
 
-Subcommand (this framework only): ``serve`` — the long-lived
+Subcommands (this framework only): ``serve`` — the long-lived
 snapshot-stream serving layer (``serve.py``, README §Serving): one JSON
 request per stdin line, one JSON response per stdout line, with admission
-control, deadlines, load shedding and a crash-only request journal.
+control, deadlines, load shedding and a crash-only request journal; and
+``fleet`` — the replicated serve tier (``fleet.py``, README §Fleet): the
+same JSONL contract fanned across N serve workers behind a
+consistent-hash front door with journal-backed failover.
 """
 
 from __future__ import annotations
@@ -166,6 +169,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         from quorum_intersection_tpu.serve import serve_main
 
         return serve_main(arglist[1:])
+    if arglist and arglist[0] == "fleet":
+        # The replicated serve tier (ISSUE 11): the same JSONL stream
+        # contract as `serve`, fanned across N worker engines behind a
+        # consistent-hash front door (fleet.py owns flags and exit
+        # semantics, like serve above).
+        from quorum_intersection_tpu.fleet import fleet_main
+
+        return fleet_main(arglist[1:])
     parser = build_parser()
     args = parser.parse_args(arglist)
 

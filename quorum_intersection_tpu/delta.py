@@ -15,7 +15,13 @@ continuously re-queried service artifact.  This module closes the gap:
   SCC-local coordinates so they project onto any snapshot whose component
   is structurally identical.  Concurrent misses on one fingerprint are
   **single-flight**: one leader solves, followers wait and reuse
-  (``tools/analyze/schedules.py`` forces the orderings).
+  (``tools/analyze/schedules.py`` forces the orderings).  Since ISSUE 11
+  the store is a **two-level tier**: an attached :class:`SharedSccStore`
+  (fingerprint-keyed files, atomic writes — the fleet workers' shared
+  directory) is read through on every local miss and written through on
+  every bank, so identical SCC fragments are solved once per *fleet*, not
+  once per process; a dead shared tier degrades to local-LRU-only through
+  the ``fleet.store`` fault point, loudly, never to a wrong verdict.
 - :class:`DeltaEngine` — the delta-aware twin of
   :func:`pipeline.check_many`: per snapshot it re-runs only the cheap
   structural prefix (parse → graph → Tarjan), serves every fingerprint-
@@ -41,10 +47,13 @@ an optimization, never a precondition for a verdict.  Telemetry
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Callable, Dict, List, Optional, Set, Tuple, Union
 
 from quorum_intersection_tpu.backends.base import (
@@ -171,6 +180,135 @@ class SccVerdict:
     stats: Dict[str, object] = field(default_factory=dict)
 
 
+class SharedSccStore:
+    """Fingerprint-keyed shared fragment tier (qi-fleet, ISSUE 11).
+
+    The second level under :class:`SccVerdictStore`: one file per fragment
+    under ``root``, named by entry kind + SCC-local fingerprint + scoping
+    bit, written atomically (tmp + rename) so concurrent fleet workers
+    never read a torn fragment.  Fragments are stored in SCC-local
+    coordinates — deliberately coordinate-free (PR 10 proved transplant
+    across key spaces), which is exactly what makes a fragment solved by
+    worker A composable into worker B's certificate, with the composed
+    cert still passing the unmodified ``tools/check_cert.py``.
+
+    Every operation sits behind the ``fleet.store`` fault point
+    (docs/ROBUSTNESS.md) and **degrades to local-LRU-only**: a read error,
+    a full disk, an unparseable fragment, or an injected fault costs
+    fleet-wide reuse (``fleet.store_errors`` counter, loud), never a
+    verdict and never a wrong fragment — a fragment that fails shape
+    validation is treated as a miss, not trusted.
+    """
+
+    def __init__(self, root: Union[str, Path]) -> None:
+        self.root = Path(root)
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    def _path(self, kind: str, fp: str, scope: str) -> Path:
+        return self.root / f"{kind}-{scope or 'g'}-{fp}.json"
+
+    def _note(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+            hits, misses = self._hits, self._misses
+        rec = get_run_record()
+        rec.add("fleet.store_hits" if hit else "fleet.store_misses")
+        rec.gauge(
+            "fleet.store_hit_pct",
+            round(100.0 * hits / (hits + misses), 2) if hits + misses else 0.0,
+        )
+
+    def get(self, kind: str, fp: str, scope: str = "") -> Optional[Dict[str, object]]:
+        """One fragment payload, or ``None`` (miss or degraded)."""
+        rec = get_run_record()
+        try:
+            fault_point("fleet.store")
+            raw = self._path(kind, fp, scope).read_text(encoding="utf-8")
+            payload = json.loads(raw)
+            if not isinstance(payload, dict):
+                raise ValueError("shared fragment is not a JSON object")
+        except FileNotFoundError:
+            self._note(False)
+            return None
+        except (OSError, ValueError, FaultInjected) as exc:
+            rec.add("fleet.store_errors")
+            rec.event("fleet.store_error", op="get", kind=kind, error=str(exc))
+            log.warning(
+                "shared store read failed (%s); degrading to local LRU only "
+                "for this lookup", exc,
+            )
+            return None
+        self._note(True)
+        return payload
+
+    def put(self, kind: str, fp: str, payload: Dict[str, object],
+            scope: str = "") -> bool:
+        """Bank one fragment; ``False`` (never an exception) on failure."""
+        rec = get_run_record()
+        path = self._path(kind, fp, scope)
+        tmp = path.with_name(f"{path.name}.tmp-{os.getpid()}")
+        try:
+            fault_point("fleet.store")
+            self.root.mkdir(parents=True, exist_ok=True)
+            tmp.write_text(
+                json.dumps(payload, separators=(",", ":")), encoding="utf-8",
+            )
+            os.replace(tmp, path)
+        except (OSError, TypeError, ValueError, FaultInjected) as exc:
+            rec.add("fleet.store_errors")
+            rec.event("fleet.store_error", op="put", kind=kind, error=str(exc))
+            log.warning(
+                "shared store write failed (%s); fragment stays local-only",
+                exc,
+            )
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            return False
+        return True
+
+
+def _encode_verdict(verdict: SccVerdict) -> Dict[str, object]:
+    return {
+        "intersects": bool(verdict.intersects),
+        "q1_local": verdict.q1_local,
+        "q2_local": verdict.q2_local,
+        "stats": verdict.stats,
+    }
+
+
+def _decode_verdict(payload: Dict[str, object]) -> Optional[SccVerdict]:
+    """Strict shape validation: a forged/torn shared fragment becomes a
+    miss, never a trusted verdict."""
+    intersects = payload.get("intersects")
+    q1 = payload.get("q1_local")
+    q2 = payload.get("q2_local")
+    stats = payload.get("stats")
+    if not isinstance(intersects, bool) or not isinstance(stats, dict):
+        return None
+    for q in (q1, q2):
+        if q is not None and not (
+            isinstance(q, list) and all(isinstance(v, int) for v in q)
+        ):
+            return None
+    return SccVerdict(intersects=intersects, q1_local=q1, q2_local=q2,
+                      stats=stats)
+
+
+def _decode_scan(payload: Dict[str, object]) -> Optional[SccScan]:
+    quorum = payload.get("quorum_local")
+    if not (isinstance(quorum, list)
+            and all(isinstance(v, int) for v in quorum)):
+        return None
+    return SccScan(quorum_local=tuple(quorum))
+
+
 class SccVerdictStore:
     """LRU-bounded, single-flight store of per-SCC scans and verdicts.
 
@@ -178,14 +316,25 @@ class SccVerdictStore:
     entries are tiny next to verdict fragments, but a shared bound keeps
     the occupancy gauge honest.  Thread-safe; telemetry is emitted outside
     the lock (lock-discipline: never emit while holding one).
+
+    **Two-level tier** (qi-fleet, ISSUE 11): with ``shared`` attached the
+    local LRU reads through to a fingerprint-keyed
+    :class:`SharedSccStore` — a local scan/verdict miss probes the shared
+    tier before solving (a shared hit is banked locally and counted as a
+    reuse), and every banked fragment is written through, so N fleet
+    workers solve each structurally distinct SCC once fleet-wide instead
+    of once per process.  ``shared=None`` (the default) is byte-for-byte
+    the PR 9 per-process behavior.
     """
 
-    def __init__(self, max_entries: Optional[int] = None) -> None:
+    def __init__(self, max_entries: Optional[int] = None,
+                 shared: Optional[SharedSccStore] = None) -> None:
         self.max_entries = max(
             max_entries if max_entries is not None
             else qi_env_int("QI_DELTA_CACHE_MAX", 4096),
             1,
         )
+        self.shared = shared
         self._lock = threading.Lock()
         self._entries: "OrderedDict[_StoreKey, object]" = OrderedDict()
         self._pending: Dict[_StoreKey, threading.Event] = {}
@@ -234,14 +383,36 @@ class SccVerdictStore:
             scan = self._entries.get(key)
             if scan is not None:
                 self._entries.move_to_end(key)
+        if scan is None and self.shared is not None:
+            payload = self.shared.get("scan", fp)
+            if payload is not None:
+                scan = _decode_scan(payload)
+                if scan is not None:
+                    self._put(key, scan)
         rec = get_run_record()
         rec.add("delta.scan_hits" if scan is not None else "delta.scan_misses")
         return scan  # type: ignore[return-value]
 
     def put_scan(self, fp: str, scan: SccScan) -> None:
         self._put(("scan", fp, ""), scan)
+        if self.shared is not None:
+            self.shared.put("scan", fp, {"quorum_local": list(scan.quorum_local)})
 
     # ---- verdicts (single-flight) -----------------------------------------
+
+    def _shared_verdict(
+        self, fp: str, scope_to_scc: bool
+    ) -> Optional[SccVerdict]:
+        """Shared-tier verdict probe: a validated hit is banked locally."""
+        if self.shared is None:
+            return None
+        payload = self.shared.get("verdict", fp, scope=f"s{int(scope_to_scc)}")
+        if payload is None:
+            return None
+        verdict = _decode_verdict(payload)
+        if verdict is not None:
+            self._put(self._vkey(fp, scope_to_scc), verdict)
+        return verdict
 
     def peek_verdict(
         self, fp: str, scope_to_scc: bool
@@ -253,6 +424,8 @@ class SccVerdictStore:
             cached = self._entries.get(key)
             if cached is not None:
                 self._entries.move_to_end(key)
+        if cached is None:
+            cached = self._shared_verdict(fp, scope_to_scc)
         return cached  # type: ignore[return-value]
 
     def lease_verdict(
@@ -283,6 +456,18 @@ class SccVerdictStore:
                 self._note_verdict_lookup(True)
                 return "hit", cached  # type: ignore[return-value]
             if wait_ev is None:
+                # Read-through before solving (qi-fleet): another worker
+                # may already have banked this fragment in the shared
+                # tier.  A hit releases the just-taken lease — followers
+                # re-probe and find the banked local entry.
+                shared_hit = self._shared_verdict(fp, scope_to_scc)
+                if shared_hit is not None:
+                    with self._lock:
+                        ev = self._pending.pop(key, None)
+                    if ev is not None:
+                        ev.set()
+                    self._note_verdict_lookup(True)
+                    return "hit", shared_hit
                 self._note_verdict_lookup(False)
                 _delta_sync("store.leader")
                 return "leader", None
@@ -313,6 +498,13 @@ class SccVerdictStore:
         key = self._vkey(fp, scope_to_scc)
         if verdict is not None:
             self._put(key, verdict)
+            if self.shared is not None:
+                # Write-through: the fragment is SCC-local (coordinate-
+                # free), so any fleet worker can compose it.
+                self.shared.put(
+                    "verdict", fp, _encode_verdict(verdict),
+                    scope=f"s{int(scope_to_scc)}",
+                )
         with self._lock:
             ev = self._pending.pop(key, None)
         if ev is not None:

@@ -282,6 +282,20 @@ def _key_sets(comp: List[int], keys: List[str]) -> List[frozenset]:
     return [frozenset(g) for g in groups.values()]
 
 
+def _zipf_pick(rng: random.Random, n: int, skew: float) -> int:
+    """One draw from a truncated Zipf over ranks ``0..n-1``:
+    ``P(r) ∝ 1/(r+1)^skew``.  Rank 0 is the hottest."""
+    weights = [(r + 1) ** -skew for r in range(n)]
+    total = sum(weights)
+    x = rng.random() * total
+    acc = 0.0
+    for r, w in enumerate(weights):
+        acc += w
+        if x <= acc:
+            return r
+    return n - 1
+
+
 def churn_trace_steps(
     base: List[Dict],
     steps: int,
@@ -290,6 +304,7 @@ def churn_trace_steps(
     max_diff: int = 2,
     kinds: Tuple[str, ...] = CHURN_KINDS,
     annotate: bool = True,
+    skew: float = 0.0,
 ) -> Tuple[List[List[Dict]], List[Dict]]:
     """Deterministic snapshot stream with **ground-truth step annotations**
     (qi-delta, ISSUE 9): ``(trace, metas)`` where ``trace`` has
@@ -321,23 +336,49 @@ def churn_trace_steps(
     Either falls back to a threshold wobble when the partition offers no
     candidate (a single SCC to merge, no multi-node SCC to split).
 
-    Same ``(base, steps, seed, max_diff, kinds)`` ⇒ byte-identical trace
-    and metas; annotation never consumes randomness, so ``annotate=False``
-    (what :func:`churn_trace` passes — load-shaped consumers pay no
-    parse/Tarjan passes for metas they discard) and the default ``kinds``
-    yield a byte-identical trace with empty metas.  Nodes with null
-    quorum sets are never churned.  Each snapshot is a deep copy:
-    mutating one never aliases another.
+    ``skew`` (qi-fleet, ISSUE 11) adds **zipfian temporal skew**: with
+    ``skew > 0`` each emitted step either *advances* the underlying
+    bounded-diff mutation chain (rank 0) or *re-emits* a recent chain
+    snapshot byte-identically, with rank ``r`` (the r-th most recent)
+    drawn ``P(r) ∝ 1/(r+1)^skew`` — the hot-key request distribution the
+    fleet bench routes (``benchmarks/serve.py --fleet``: identical
+    re-emissions are fleet-wide cache/coalesce hits, the advancing tail
+    spreads across workers).  The skew draws come from a separate
+    string-seeded RNG, so the mutation chain consumes exactly the same
+    ``seed`` stream with or without revisits — ``skew=0.0`` (default) is
+    **byte-identical** to the pre-skew generator.  Revisit metas are
+    ``{"revisit_of": <trace index>, "mutations": []}`` with empty
+    ``affected_scc_ids``: a revisit is a re-emission, not a bounded diff,
+    so the per-mutation ground-truth fields do not apply to it.
+
+    Same ``(base, steps, seed, max_diff, kinds, skew)`` ⇒ byte-identical
+    trace and metas; annotation never consumes randomness, so
+    ``annotate=False`` (what :func:`churn_trace` passes — load-shaped
+    consumers pay no parse/Tarjan passes for metas they discard) and the
+    default ``kinds`` yield a byte-identical trace with empty metas.
+    Nodes with null quorum sets are never churned.  Each snapshot is a
+    deep copy: mutating one never aliases another.
     """
     if steps < 0:
         raise ValueError(f"steps must be >= 0, got {steps}")
+    if skew < 0:
+        raise ValueError(f"skew must be >= 0, got {skew}")
     for kind in kinds:
         if kind not in ("threshold", "swap", "rename", "scc_split",
                         "scc_merge"):
             raise ValueError(f"unknown churn kind {kind!r}")
     rng = random.Random(seed)
+    # Separate, string-seeded RNG for the skew draws (sha-based seeding,
+    # deterministic across processes): the mutation chain consumes exactly
+    # the same `rng` stream whether or not revisits interleave, so a
+    # skew>0 trace shares its underlying chain with the skew=0 one.
+    rng_skew = random.Random(f"qi-churn-skew:{seed}")
     trace = [copy.deepcopy(base)]
     metas: List[Dict] = []
+    # The distinct mutation chain (head = next mutation's base) and each
+    # chain snapshot's first trace index — revisit steps re-emit from here.
+    chain: List[List[Dict]] = [trace[0]]
+    chain_emit_ix: List[int] = [0]
     all_keys = [n.get("publicKey") for n in base if n.get("publicKey")]
     # Predecessor partition: the coordinate system of the annotations and
     # the candidate pool for merge/split.  Computed once per snapshot and
@@ -351,7 +392,24 @@ def churn_trace_steps(
     )
     comp, keys = _scc_partition(base) if needs_partition else ([], [])
     for step in range(steps):
-        prev = trace[-1]
+        if skew > 0:
+            r = _zipf_pick(rng_skew, len(chain) + 1, skew)
+            if r > 0:
+                # Zipfian revisit: re-emit the r-th most recent distinct
+                # snapshot byte-identically (a fleet-wide hot key).
+                trace.append(copy.deepcopy(chain[-r]))
+                if annotate:
+                    metas.append({
+                        "step": step + 1,
+                        "revisit_of": chain_emit_ix[-r],
+                        "mutations": [],
+                        "affected_scc_ids": [],
+                        "partition_changed": False,
+                        "merges": 0,
+                        "splits": 0,
+                    })
+                continue
+        prev = chain[-1]
         snap = copy.deepcopy(prev)
         mutable = [
             i for i, n in enumerate(snap)
@@ -447,6 +505,8 @@ def churn_trace_steps(
             if structural and own_scc is not None:
                 affected.add(own_scc)
         trace.append(snap)
+        chain.append(snap)
+        chain_emit_ix.append(len(trace) - 1)
         if not needs_partition:
             continue
         old_parts = _key_sets(comp, keys)
@@ -526,6 +586,7 @@ def churn_trace(
     *,
     max_diff: int = 2,
     kinds: Tuple[str, ...] = CHURN_KINDS,
+    skew: float = 0.0,
 ) -> List[List[Dict]]:
     """Deterministic snapshot stream: ``steps + 1`` consecutive snapshots
     starting at ``base``, each differing from its predecessor in at most
@@ -549,14 +610,20 @@ def churn_trace(
     also returns per-step ground-truth annotations — this wrapper is the
     load-shaped view, so it skips the annotation work entirely:
     ``annotate=False`` costs no parse/Tarjan passes with the default
-    ``kinds``).
+    ``kinds``).  ``skew > 0`` adds zipfian temporal skew — steps
+    re-emitting recent snapshots byte-identically with rank probability
+    ``∝ 1/(r+1)^skew`` — the hot-key traffic shape the fleet bench needs
+    (``benchmarks/serve.py --fleet``); the default ``skew=0.0`` keeps the
+    trace byte-identical to the pre-skew generator.
 
-    Same ``(base, steps, seed)`` ⇒ byte-identical trace.  Nodes with null
-    quorum sets are never churned (there is nothing bounded to mutate).
-    Each snapshot is a deep copy: mutating one never aliases another.
+    Same ``(base, steps, seed, skew)`` ⇒ byte-identical trace.  Nodes
+    with null quorum sets are never churned (there is nothing bounded to
+    mutate).  Each snapshot is a deep copy: mutating one never aliases
+    another.
     """
     trace, _ = churn_trace_steps(
         base, steps, seed, max_diff=max_diff, kinds=kinds, annotate=False,
+        skew=skew,
     )
     return trace
 

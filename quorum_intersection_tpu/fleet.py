@@ -1,0 +1,1179 @@
+"""qi-fleet/1 — a replicated serve tier (ISSUE 11 tentpole).
+
+qi-serve (PR 8) made the verdict pipeline a long-lived service, but ONE
+process on ONE stream; the ROADMAP's millions-of-users north star needs N
+of them behaving like one.  This module is that tier, following
+"Read-Write Quorum Systems Made Practical" (PAPERS.md, arXiv:2104.04102 —
+quorum analysis operated as a continuously-queried, load-balanced service)
+and quorum-keyed work distribution à la "Scaling Distributed All-Pairs
+Algorithms" (arXiv:1608.05174):
+
+- **Workers**: N :class:`~quorum_intersection_tpu.serve.ServeEngine`\\ s —
+  subprocesses speaking the existing JSONL protocol over pipes
+  (:class:`ProcWorker`, the production shape: ``python -m
+  quorum_intersection_tpu serve --journal ... --emit-certs``) or
+  in-process engines behind the identical duck-type (:class:`LocalWorker`
+  — the schedule harness / test / bench-smoke shape).  Both answer in the
+  exact wire shape ``serve_transport.ticket_response`` emits, so the
+  front door cannot tell them apart.
+- **Consistent-hash routing** (:class:`HashRing`): the front door keys on
+  the *sanitized snapshot fingerprint* (``serve.snapshot_fingerprint``),
+  so identical snapshots from any client coalesce fleet-wide through one
+  worker's existing single-flight path, and join/leave moves only ~1/N of
+  the key space (virtual nodes smooth the split).
+- **Shared per-SCC verdict store**: every worker's ``SccVerdictStore``
+  reads through to one :class:`~quorum_intersection_tpu.delta.SharedSccStore`
+  directory (``QI_FLEET_STORE_DIR``, exported to each worker), so an SCC
+  fragment solved on worker A composes into worker B's certificate — the
+  fragments are SCC-local and coordinate-free (PR 10 proved transplant
+  across key spaces), and the composed cert still passes the unmodified
+  ``tools/check_cert.py``.
+- **Journal-backed failover**: each worker keeps its own crash-only
+  ``RequestJournal``; when health probes (or a broken pipe) declare a
+  worker dead, the front door evicts it from the ring and replays its
+  unfinished journal — every request re-routes to the peer inheriting its
+  hash range, deduplicated against the front door's own in-flight tickets
+  and the journal's ``done`` marks: **zero lost, zero duplicated**, the
+  PR 8 ``kill -9`` guarantee extended to kill-one-of-N.
+- **Degradation, not death** — four declared fault points
+  (``fleet.route`` / ``fleet.probe`` / ``fleet.replay`` / ``fleet.store``,
+  docs/ROBUSTNESS.md): a broken ring lookup falls back to the first live
+  worker, an injected probe failure is inconclusive (never a spurious
+  eviction), an unreadable dead journal degrades to re-routing the front
+  door's own tickets, and a dead shared store tier degrades each worker
+  to local-LRU-only — all loud, none a wrong verdict.
+
+Telemetry: ``fleet.*`` spans/counters/gauges (docs/OBSERVABILITY.md §Fleet
+registry); per-worker health rides the JSONL ``ping``/``pong`` probe and
+aggregates into the front door's ``fleet.workers_live`` /
+``fleet.ring_size`` / ``fleet.store_hit_pct`` gauges, which ``/healthz``
+(utils/metrics_server.py) exposes; ``/readyz`` answers 503 until every
+live worker finished journal replay (``fleet.replay_complete``).
+
+CLI: ``python -m quorum_intersection_tpu fleet -n 4`` — same JSONL
+stdin/stdout contract as ``serve``, requests fanned across the ring.
+``benchmarks/serve.py --fleet`` is the closed-loop driver (aggregate
+verdicts/sec, p99, fleet-wide cache hit %, ``delta_scc_reuse_pct`` under
+zipfian churn at N ∈ {1, 2, 4}, with a kill-one-worker bench phase).
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple, Union
+
+from quorum_intersection_tpu.delta import SharedSccStore
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.serve import (
+    RequestJournal,
+    ServeEngine,
+    ServeError,
+    ServeResponse,
+    Ticket,
+    _percentile,
+    _raw_nodes,
+    snapshot_fingerprint,
+)
+from quorum_intersection_tpu.serve_transport import (
+    JsonlSession,
+    pong_payload,
+    run_jsonl_loop,
+    ticket_response,
+)
+from quorum_intersection_tpu.utils.env import (
+    qi_env,
+    qi_env_float,
+    qi_env_int,
+)
+from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+log = get_logger("fleet")
+
+FLEET_SCHEMA = "qi-fleet/1"
+
+# Deterministic-interleaving hook (tools/analyze/schedules.py): a no-op in
+# production; the schedule harness swaps in a SyncController to FORCE the
+# routing/eviction/replay orderings the wall clock almost never produces —
+# route-during-eviction, replay-races-new-request.
+_fleet_sync: Callable[[str], None] = lambda point: None
+
+# Latency window for the fleet p50/p99 gauges (same rationale as
+# serve.LATENCY_WINDOW: track the CURRENT load shape).
+LATENCY_WINDOW = 512
+
+
+# ---- consistent-hash ring ---------------------------------------------------
+
+
+class HashRing:
+    """Deterministic consistent-hash ring with virtual nodes.
+
+    Each worker owns ``vnodes`` points (``sha256(worker_id + '#' + i)``,
+    first 8 bytes) on a 64-bit circle; a key routes to the first point at
+    or after its own hash.  Determinism is the routing contract: the same
+    worker set and vnode count produce the identical key→worker map in
+    every process and on every run, and adding/removing one worker moves
+    only the keys whose arcs that worker's points own — **bounded
+    rebalance**, ~1/N of the key space (``tests/test_qi_fleet.py`` pins
+    both properties).
+    """
+
+    def __init__(self, vnodes: Optional[int] = None) -> None:
+        self.vnodes = max(
+            vnodes if vnodes is not None
+            else qi_env_int("QI_FLEET_VNODES", 32),
+            1,
+        )
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, worker_id)
+        self._workers: Set[str] = set()
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(key.encode("utf-8")).digest()[:8], "big",
+        )
+
+    def add(self, worker_id: str) -> None:
+        if worker_id in self._workers:
+            return
+        self._workers.add(worker_id)
+        for v in range(self.vnodes):
+            bisect.insort(
+                self._points, (self._hash(f"{worker_id}#{v}"), worker_id),
+            )
+
+    def remove(self, worker_id: str) -> None:
+        if worker_id not in self._workers:
+            return
+        self._workers.discard(worker_id)
+        self._points = [p for p in self._points if p[1] != worker_id]
+
+    def route(self, key: str) -> str:
+        """The worker owning ``key``'s arc; ``LookupError`` on an empty
+        ring (the caller turns it into a typed no-live-workers error)."""
+        if not self._points:
+            raise LookupError("consistent-hash ring is empty")
+        h = self._hash(key)
+        ix = bisect.bisect_left(self._points, (h, ""))
+        if ix == len(self._points):
+            ix = 0
+        return self._points[ix][1]
+
+    def workers(self) -> List[str]:
+        return sorted(self._workers)
+
+    def __len__(self) -> int:
+        return len(self._workers)
+
+    def __contains__(self, worker_id: str) -> bool:
+        return worker_id in self._workers
+
+
+# ---- worker handles ---------------------------------------------------------
+
+# A worker handle's response callback: (worker_id, response object).
+_OnResponse = Callable[[str, Dict[str, object]], None]
+
+
+class ProcWorker:
+    """One serve worker subprocess speaking JSONL over pipes.
+
+    The production worker shape: ``python -m quorum_intersection_tpu serve
+    --journal <own journal> --emit-certs`` with ``QI_FLEET_STORE_DIR``
+    exported, so its verdict responses carry certificates (the front door
+    relays them verbatim) and its per-SCC store shares the fleet tier.  A
+    reader thread demultiplexes the pipe: replay reports resolve
+    readiness, pongs resolve pending pings, everything else is a response
+    handed to the front door.
+    """
+
+    kind = "proc"
+
+    def __init__(
+        self,
+        worker_id: str,
+        journal_path: Union[str, Path],
+        on_response: _OnResponse,
+        *,
+        backend: str = "auto",
+        store_dir: Optional[Union[str, Path]] = None,
+        deadline_s: Optional[float] = None,
+        batch_max: Optional[int] = None,
+        cache_max: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        dangling: str = "strict",
+        scc_select: str = "quorum-bearing",
+        scope_to_scc: bool = False,
+        on_exit: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.worker_id = worker_id
+        self.journal_path = Path(journal_path)
+        self._on_response = on_response
+        self._on_exit = on_exit
+        self._closing = False
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pings: Dict[str, Tuple[threading.Event, List[Dict[str, object]]]] = {}
+        self._ready = threading.Event()
+        self.replay_report: Optional[Dict[str, object]] = None
+        cmd = [
+            sys.executable, "-m", "quorum_intersection_tpu", "serve",
+            "--journal", str(self.journal_path),
+            "--backend", backend,
+            "--emit-certs",
+            "--dangling-policy", dangling,
+            "--scc-select", scc_select,
+        ]
+        if scope_to_scc:
+            cmd.append("--scope-scc")
+        if deadline_s is not None:
+            cmd += ["--deadline-s", str(deadline_s)]
+        if batch_max is not None:
+            cmd += ["--batch-max", str(batch_max)]
+        if cache_max is not None:
+            cmd += ["--cache-max", str(cache_max)]
+        if queue_depth is not None:
+            cmd += ["--queue-depth", str(queue_depth)]
+        env = dict(os.environ)
+        if store_dir is not None:
+            env["QI_FLEET_STORE_DIR"] = str(store_dir)
+        # One scrape port cannot be shared by N workers; their health rides
+        # the ping/pong protocol instead.
+        env["QI_METRICS_PORT"] = "0"
+        self._proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+        )
+        # qi-lint: allow(cancel-token-plumbed) — pipe demultiplexer; close()/kill() end it via EOF
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"qi-fleet-read-{worker_id}",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        assert self._proc.stdout is not None
+        for line in self._proc.stdout:
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if obj.get("kind") == "replay":
+                self.replay_report = obj
+                self._ready.set()
+                continue
+            if obj.get("kind") == "listening":
+                continue
+            if "pong" in obj:
+                token = str(obj.get("pong"))
+                with self._plock:
+                    waiter = self._pings.pop(token, None)
+                if waiter is not None:
+                    waiter[1].append(obj)
+                    waiter[0].set()
+                continue
+            self._on_response(self.worker_id, obj)
+        if not self._closing and self._on_exit is not None:
+            self._on_exit(self.worker_id)
+
+    def _write(self, obj: Dict[str, object]) -> bool:
+        try:
+            assert self._proc.stdin is not None
+            with self._wlock:
+                self._proc.stdin.write(json.dumps(obj, default=str) + "\n")
+                self._proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            # Broken pipe / closed stdin: the worker is gone — the caller
+            # turns this into eviction + failover.
+            return False
+
+    def wait_ready(self, timeout: float) -> bool:
+        return self._ready.wait(timeout)
+
+    def submit(self, request_id: str, nodes: List[Dict[str, object]],
+               deadline_s: Optional[float]) -> bool:
+        line: Dict[str, object] = {"request_id": request_id, "nodes": nodes}
+        if deadline_s is not None:
+            line["deadline_s"] = deadline_s
+        return self._write(line)
+
+    def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
+        token = f"{self.worker_id}-{time.monotonic_ns():x}"
+        ev: threading.Event = threading.Event()
+        box: List[Dict[str, object]] = []
+        with self._plock:
+            self._pings[token] = (ev, box)
+        if not self._write({"ping": token}) or not ev.wait(timeout):
+            with self._plock:
+                self._pings.pop(token, None)
+            return None
+        return box[0]
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — the bench's kill-one-of-N hook (a real hard kill: the
+        journal's torn tail and unfinished entries are genuine)."""
+        self._proc.kill()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: stdin EOF drains the worker (every accepted
+        request answers before exit, the serve CLI contract)."""
+        self._closing = True
+        try:
+            assert self._proc.stdin is not None
+            with self._wlock:
+                self._proc.stdin.close()
+        except (OSError, ValueError):
+            pass
+        try:
+            self._proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            log.warning("fleet worker %s did not drain in %gs; killed",
+                        self.worker_id, timeout)
+            self._proc.kill()
+        self._reader.join(timeout=5.0)
+
+
+class LocalWorker:
+    """In-process worker: the same ``ServeEngine`` behind the same handle
+    duck-type, answering in the exact shape the JSONL transport emits
+    (``serve_transport.ticket_response``) — the front door cannot tell a
+    LocalWorker from a ProcWorker.  Used by the deterministic schedule
+    harness, the test matrix, and bench smokes where N subprocess
+    spin-ups would dominate the measurement.
+
+    ``kill()`` simulates a hard kill at the fidelity an in-process worker
+    allows: responses stop immediately (suppressed, as a dead process's
+    would be) and the engine is torn down without draining — the real
+    SIGKILL matrix (torn journal tails) is covered by :class:`ProcWorker`
+    rounds and the journal-construction tests.
+    """
+
+    kind = "local"
+
+    def __init__(
+        self,
+        worker_id: str,
+        journal_path: Union[str, Path],
+        on_response: _OnResponse,
+        *,
+        backend: str = "auto",
+        store_dir: Optional[Union[str, Path]] = None,
+        deadline_s: Optional[float] = None,
+        batch_max: Optional[int] = None,
+        cache_max: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        dangling: str = "strict",
+        scc_select: str = "quorum-bearing",
+        scope_to_scc: bool = False,
+        on_exit: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.worker_id = worker_id
+        self.journal_path = Path(journal_path)
+        self._on_response = on_response
+        self._dead = False
+        self.replay_report: Optional[Dict[str, object]] = None
+        self.engine = ServeEngine(
+            backend=backend,
+            journal=self.journal_path,
+            deadline_s=deadline_s,
+            batch_max=batch_max,
+            cache_max=cache_max,
+            queue_depth=queue_depth,
+            dangling=dangling,
+            scc_select=scc_select,
+            scope_to_scc=scope_to_scc,
+            shared_store=(
+                SharedSccStore(store_dir) if store_dir is not None else None
+            ),
+        )
+        self.replay_report = self.engine.start()
+
+    def wait_ready(self, timeout: float) -> bool:
+        return True  # start() above already replayed synchronously
+
+    def _respond(self, obj: Dict[str, object]) -> None:
+        if self._dead:
+            return  # a killed worker answers nobody
+        self._on_response(self.worker_id, obj)
+
+    def _on_ticket_done(self, ticket: Ticket) -> None:
+        self._respond(ticket_response(ticket, emit_certs=True))
+
+    def submit(self, request_id: str, nodes: List[Dict[str, object]],
+               deadline_s: Optional[float]) -> bool:
+        if self._dead:
+            return False
+        try:
+            ticket = self.engine.submit(
+                nodes, request_id=request_id, deadline_s=deadline_s,
+            )
+        except ServeError as exc:
+            self._respond({"request_id": request_id,
+                           "error": {"code": exc.code, "message": str(exc)}})
+            return True
+        except (ValueError, TypeError, FaultInjected) as exc:
+            self._respond({"request_id": request_id,
+                           "error": {"code": "invalid", "message": str(exc)}})
+            return True
+        ticket.add_done_callback(self._on_ticket_done)
+        return True
+
+    def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
+        if self._dead:
+            return None
+        return pong_payload(f"local-{self.worker_id}")
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def kill(self) -> None:
+        self._dead = True
+        self.engine.stop(drain=False, timeout=2.0)
+
+    def close(self, timeout: float = 30.0) -> None:
+        if not self._dead:
+            self.engine.stop(drain=True, timeout=timeout)
+
+
+# ---- the front door ---------------------------------------------------------
+
+
+@dataclass
+class _Pending:
+    """One in-flight fleet request: the client ticket plus everything a
+    failover needs to re-route it (journal-grade payload, routing key).
+
+    ``wire_id`` is the id on the worker protocol — normally the client's
+    ``request_id``, made unique when a client reuses an id while the
+    first request is still in flight (the serve contract answers every
+    submission, so a duplicate must not orphan the earlier ticket)."""
+
+    ticket: Ticket
+    wire_id: str
+    fingerprint: str
+    nodes: List[Dict[str, object]]
+    deadline_s: Optional[float]
+    worker_id: str = ""
+    internal: bool = False  # journal-inherited work with no client ticket
+    replaying: bool = False  # dispatched by a failover; gates /readyz
+
+
+class FleetEngine:
+    """The replicated serve tier's front door (see module docstring).
+
+    ``submit`` has the same signature and Ticket semantics as
+    ``ServeEngine.submit``, so the JSONL transports drive either — the
+    ``fleet`` CLI subcommand IS ``serve_transport.JsonlSession`` over this
+    class.
+    """
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        *,
+        backend: str = "auto",
+        worker_mode: str = "subprocess",
+        journal_dir: Optional[Union[str, Path]] = None,
+        store_dir: Optional[Union[str, Path]] = None,
+        deadline_s: Optional[float] = None,
+        batch_max: Optional[int] = None,
+        cache_max: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        dangling: str = "strict",
+        scc_select: str = "quorum-bearing",
+        scope_to_scc: bool = False,
+        vnodes: Optional[int] = None,
+        probe_interval_s: Optional[float] = None,
+        probe_fails: Optional[int] = None,
+    ) -> None:
+        if worker_mode not in ("subprocess", "local"):
+            raise ValueError(f"unknown worker_mode {worker_mode!r}")
+        self.n_workers = max(
+            workers if workers is not None
+            else qi_env_int("QI_FLEET_WORKERS", 2),
+            1,
+        )
+        self.backend = backend
+        self.worker_mode = worker_mode
+        self.deadline_s = deadline_s
+        self.batch_max = batch_max
+        self.cache_max = cache_max
+        self.queue_depth = queue_depth
+        self.dangling = dangling
+        self.scc_select = scc_select
+        self.scope_to_scc = scope_to_scc
+        self.probe_interval_s = (
+            probe_interval_s if probe_interval_s is not None
+            else max(qi_env_float("QI_FLEET_PROBE_INTERVAL_S", 0.5), 0.05)
+        )
+        self.probe_fails = max(
+            probe_fails if probe_fails is not None
+            else qi_env_int("QI_FLEET_PROBE_FAILS", 2),
+            1,
+        )
+        self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
+        if journal_dir is None:
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="qi-fleet-")
+            journal_dir = self._tmpdir.name
+        self.journal_dir = Path(journal_dir)
+        env_store = qi_env("QI_FLEET_STORE_DIR")
+        self.store_dir = Path(
+            store_dir if store_dir is not None
+            else (env_store or self.journal_dir / "store")
+        )
+        self._lock = threading.Lock()
+        self._ring = HashRing(vnodes=vnodes)
+        self._workers: Dict[str, Union[ProcWorker, LocalWorker]] = {}
+        self._live: Set[str] = set()
+        self._pending: Dict[str, _Pending] = {}  # wire_id → pending
+        self._dead_handled: Set[str] = set()
+        self._failovers_active = 0
+        self._replays_outstanding = 0
+        self._latencies: Deque[float] = deque(maxlen=LATENCY_WINDOW)
+        self._pongs: Dict[str, Dict[str, object]] = {}
+        self._closed = False
+        self._started = False
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def worker_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._live)
+
+    def start(self) -> Dict[str, object]:
+        """Spawn the workers, replay their journals, build the ring.
+
+        ``fleet.replay_complete`` stays 0 (``/readyz`` answers 503) until
+        EVERY live worker finished its own journal replay — a restarted
+        fleet must not take traffic while any predecessor's work is
+        outstanding.  Returns a start report (per-worker replay reports).
+        """
+        if self._started:
+            return {"schema": FLEET_SCHEMA, "workers": self.worker_ids()}
+        self._started = True
+        rec = get_run_record()
+        rec.gauge("fleet.replay_complete", 0)
+        self.journal_dir.mkdir(parents=True, exist_ok=True)
+        self.store_dir.mkdir(parents=True, exist_ok=True)
+        make = ProcWorker if self.worker_mode == "subprocess" else LocalWorker
+        with rec.span("fleet.start", workers=self.n_workers,
+                      mode=self.worker_mode):
+            for i in range(self.n_workers):
+                wid = f"w{i}"
+                worker = make(
+                    wid, self.journal_dir / f"{wid}.journal",
+                    self._on_response,
+                    backend=self.backend, store_dir=self.store_dir,
+                    deadline_s=self.deadline_s, batch_max=self.batch_max,
+                    cache_max=self.cache_max, queue_depth=self.queue_depth,
+                    dangling=self.dangling,
+                    scc_select=self.scc_select,
+                    scope_to_scc=self.scope_to_scc,
+                    on_exit=self._on_worker_exit,
+                )
+                self._workers[wid] = worker
+            reports: Dict[str, object] = {}
+            for wid, worker in self._workers.items():
+                if not worker.wait_ready(timeout=120.0):
+                    log.warning(
+                        "fleet worker %s never reported replay-complete; "
+                        "left out of the ring", wid,
+                    )
+                    continue
+                reports[wid] = worker.replay_report
+                with self._lock:
+                    self._live.add(wid)
+                    self._ring.add(wid)
+        with self._lock:
+            live, ring_size = len(self._live), len(self._ring)
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        rec.gauge("fleet.replay_complete", 1)
+        # qi-lint: allow(cancel-token-plumbed) — health-probe loop, no solve work; stop() ends it via the event
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="qi-fleet-probe", daemon=True,
+        )
+        self._probe_thread.start()
+        log.info(
+            "fleet started: %d/%d workers live (mode=%s, store=%s)",
+            live, self.n_workers, self.worker_mode, self.store_dir,
+        )
+        return {
+            "schema": FLEET_SCHEMA,
+            "workers": self.worker_ids(),
+            "mode": self.worker_mode,
+            "replay": reports,
+        }
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Close admission, drain (or kill) every worker, resolve whatever
+        is left with a typed error — a fleet stop is never a silent drop."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=self.probe_interval_s + 5.0)
+        for worker in list(self._workers.values()):
+            if drain:
+                worker.close(timeout=timeout)
+            else:
+                worker.kill()
+        with self._lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        rec = get_run_record()
+        for pending in leftovers:
+            if not pending.internal:
+                rec.add("fleet.errors")
+            pending.ticket._resolve(("err", ServeError(
+                "fleet stopped before this request resolved"
+            )))
+            self._note_replay_resolved(pending)
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+            self._tmpdir = None
+
+    # ---- admission / routing ---------------------------------------------
+
+    def submit(
+        self,
+        source: Union[str, bytes, List[Dict[str, object]], Fbas],
+        *,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit one request: fingerprint, route, dispatch.  Same contract
+        as ``ServeEngine.submit`` (typed errors, Ticket immediately)."""
+        rec = get_run_record()
+        with self._lock:
+            closed = self._closed
+        if closed:
+            rec.add("fleet.errors")
+            raise ServeError("fleet is closed to new requests")
+        request_id = (
+            request_id
+            or f"flt-{os.getpid()}-{time.monotonic_ns():x}"
+        )
+        fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+        nodes = _raw_nodes(source, fbas)
+        graph = build_graph(fbas, dangling=self.dangling)
+        fp = snapshot_fingerprint(
+            graph, scc_select=self.scc_select,
+            scope_to_scc=self.scope_to_scc,
+        )
+        ticket = Ticket(request_id, time.monotonic(), deadline_t=None)
+        pending = _Pending(
+            ticket=ticket, wire_id=request_id, fingerprint=fp, nodes=nodes,
+            deadline_s=deadline_s if deadline_s is not None
+            else self.deadline_s,
+        )
+        with self._lock:
+            # A client may reuse a request_id while the first request is
+            # still in flight (the serve contract answers every
+            # submission): give the duplicate a unique wire id so the
+            # earlier pending entry is never orphaned — both tickets
+            # resolve, the client-facing request_id stays its own.
+            n = 0
+            while pending.wire_id in self._pending:
+                n += 1
+                pending.wire_id = f"{request_id}~dup{n}"
+            self._pending[pending.wire_id] = pending
+        rec.add("fleet.requests")
+        self._dispatch(pending)
+        return ticket
+
+    def _route(self, fingerprint: str) -> str:
+        """One ring lookup behind the ``fleet.route`` fault point: an
+        injected/real failure degrades to the first live worker — only
+        fleet-wide coalescing locality is lost, loudly."""
+        rec = get_run_record()
+        try:
+            fault_point("fleet.route")
+            with self._lock:
+                return self._ring.route(fingerprint)
+        except (FaultInjected, OSError) as exc:
+            rec.add("fleet.route_errors")
+            rec.event("fleet.route_degraded", error=str(exc))
+            log.warning(
+                "ring routing failed (%s); degrading to first live worker",
+                exc,
+            )
+            with self._lock:
+                live = sorted(self._live)
+            if not live:
+                raise LookupError("no live fleet workers") from exc
+            return live[0]
+
+    def _dispatch(self, pending: _Pending) -> None:
+        """Route-and-send with bounded retry: a dead worker discovered at
+        dispatch time is evicted (its journal replays) and the request
+        re-routes through the shrunken ring."""
+        rec = get_run_record()
+        rid = pending.wire_id
+        for _ in range(len(self._workers) + 1):
+            try:
+                wid = self._route(pending.fingerprint)
+            except LookupError:
+                break
+            with self._lock:
+                if self._pending.get(rid) is not pending:
+                    return  # already resolved or superseded
+                pending.worker_id = wid
+            _fleet_sync("route.resolved")
+            with self._lock:
+                if pending.worker_id != wid:
+                    return  # a concurrent failover re-routed it already
+                worker = self._workers.get(wid) if wid in self._live else None
+            if worker is not None and worker.submit(
+                rid, pending.nodes, pending.deadline_s,
+            ):
+                rec.add("fleet.routed")
+                return
+            self._handle_worker_death(wid, "dispatch failed")
+            with self._lock:
+                if (self._pending.get(rid) is not pending
+                        or pending.worker_id != wid):
+                    return  # the failover replay re-dispatched it
+        with self._lock:
+            still_mine = self._pending.pop(rid, None) is pending
+        if still_mine:
+            if not pending.internal:
+                rec.add("fleet.errors")
+            pending.ticket._resolve(("err", ServeError(
+                "no live fleet workers to route this request to"
+            )))
+            self._note_replay_resolved(pending)
+
+    # ---- responses -------------------------------------------------------
+
+    def _on_response(self, worker_id: str, obj: Dict[str, object]) -> None:
+        rec = get_run_record()
+        rid = obj.get("request_id")
+        with self._lock:
+            pending = (
+                self._pending.pop(rid, None) if isinstance(rid, str) else None
+            )
+        if pending is None:
+            # A late answer for a request that already failed over (both
+            # the dead worker and its inheritor solved it): the first
+            # resolution won, the client never sees two outcomes.
+            rec.add("fleet.duplicate_responses")
+            return
+        err = obj.get("error")
+        if isinstance(err, dict):
+            exc = ServeError(str(err.get("message") or "upstream serve error"))
+            exc.code = str(err.get("code") or ServeError.code)  # type: ignore[assignment]
+            if not pending.internal:
+                rec.add("fleet.errors")
+            pending.ticket._resolve(("err", exc))
+            self._note_replay_resolved(pending)
+            return
+        seconds = time.monotonic() - pending.ticket.submitted_t
+        cert = obj.get("cert")
+        stats = obj.get("stats")
+        response = ServeResponse(
+            # The CLIENT's id, not the wire id (a deduplicated duplicate
+            # answers under the id its client actually sent).
+            request_id=pending.ticket.request_id,
+            intersects=bool(obj.get("verdict")),
+            cert=cert if isinstance(cert, dict) else None,
+            stats=dict(stats) if isinstance(stats, dict) else {},
+            cached=bool(obj.get("cached")),
+            seconds=seconds,
+        )
+        if not pending.internal:
+            rec.add("fleet.verdicts")
+            self._note_latency(seconds)
+        else:
+            rec.add("fleet.replayed_verdicts")
+        pending.ticket._resolve(("ok", response))
+        self._note_replay_resolved(pending)
+
+    def _note_replay_resolved(self, pending: _Pending) -> None:
+        """One failover-dispatched request reached its outcome; flip
+        ``fleet.replay_complete`` back to 1 only when NO failover is mid-
+        replay and every inherited request has resolved — the /readyz 503
+        window covers the re-SOLVE of inherited work, not just its
+        re-dispatch (docs/ROBUSTNESS.md §Fleet tier)."""
+        with self._lock:
+            if not pending.replaying:
+                return
+            pending.replaying = False
+            self._replays_outstanding -= 1
+            done = (
+                self._replays_outstanding == 0
+                and self._failovers_active == 0
+            )
+        if done:
+            get_run_record().gauge("fleet.replay_complete", 1)
+
+    def _note_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds * 1000.0)
+            samples = list(self._latencies)
+        samples.sort()
+        rec = get_run_record()
+        rec.gauge("fleet.p50_ms", round(_percentile(samples, 50.0), 3))
+        rec.gauge("fleet.p99_ms", round(_percentile(samples, 99.0), 3))
+
+    # ---- health probing / eviction ---------------------------------------
+
+    def _on_worker_exit(self, worker_id: str) -> None:
+        self._handle_worker_death(worker_id, "stdout EOF")
+
+    def _probe_loop(self) -> None:
+        rec = get_run_record()
+        fails: Dict[str, int] = {}
+        while not self._stop.wait(self.probe_interval_s):
+            _fleet_sync("probe.tick")
+            with self._lock:
+                targets = [
+                    (wid, self._workers[wid]) for wid in sorted(self._live)
+                ]
+            pongs: Dict[str, Dict[str, object]] = {}
+            for wid, worker in targets:
+                # The liveness check runs BEFORE the fault point: a dead
+                # process must evict even while the probe path is broken
+                # (the FLEET_PROBE contract — only the ping half degrades).
+                if not worker.alive():
+                    self._handle_worker_death(wid, "process exited")
+                    continue
+                try:
+                    fault_point("fleet.probe")
+                except (FaultInjected, OSError) as exc:
+                    # Inconclusive, not dead: an injected probe failure
+                    # must never cost a healthy worker its ring arc.
+                    rec.add("fleet.probe_errors")
+                    rec.event("fleet.probe_degraded", worker=wid,
+                              error=str(exc))
+                    continue
+                pong = worker.ping(timeout=2.0)
+                if pong is None:
+                    fails[wid] = fails.get(wid, 0) + 1
+                    rec.add("fleet.probe_timeouts")
+                    if fails[wid] >= self.probe_fails:
+                        self._handle_worker_death(
+                            wid, f"{fails[wid]} consecutive failed probes",
+                        )
+                else:
+                    fails[wid] = 0
+                    pongs[wid] = pong
+            self._aggregate_health(pongs)
+
+    def _aggregate_health(self, pongs: Dict[str, Dict[str, object]]) -> None:
+        """Fold the workers' pong snapshots into the fleet gauges the
+        front door's ``/healthz`` exposes (fleet_workers_live /
+        fleet_ring_size / fleet_store_hit_pct)."""
+        rec = get_run_record()
+        with self._lock:
+            self._pongs = dict(pongs)
+            live, ring_size = len(self._live), len(self._ring)
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        hits = misses = 0
+        d_hits = d_misses = 0
+        for pong in pongs.values():
+            counters = pong.get("counters")
+            if not isinstance(counters, dict):
+                continue
+            hits += int(counters.get("fleet.store_hits", 0) or 0)
+            misses += int(counters.get("fleet.store_misses", 0) or 0)
+            d_hits += int(counters.get("delta.scc_hits", 0) or 0)
+            d_misses += int(counters.get("delta.scc_misses", 0) or 0)
+        if hits + misses:
+            rec.gauge(
+                "fleet.store_hit_pct",
+                round(100.0 * hits / (hits + misses), 2),
+            )
+        if d_hits + d_misses:
+            rec.gauge(
+                "fleet.delta_scc_reuse_pct",
+                round(100.0 * d_hits / (d_hits + d_misses), 2),
+            )
+
+    def healthz(self) -> Dict[str, object]:
+        """The aggregated fleet health picture (per-worker last pongs +
+        ring state) — the bench and tests read it; the qi-health/1
+        endpoint exposes the gauge subset."""
+        with self._lock:
+            return {
+                "schema": FLEET_SCHEMA,
+                "workers_live": len(self._live),
+                "ring_size": len(self._ring),
+                "pending": len(self._pending),
+                "workers": dict(self._pongs),
+            }
+
+    def kill_worker(self, worker_id: str, *, evict: bool = False) -> None:
+        """Hard-kill one worker (the bench's kill-one-of-N hook).  With
+        ``evict=False`` (default) the health probes discover the death —
+        the production path; ``evict=True`` runs eviction + journal
+        failover immediately (the deterministic schedule/test path)."""
+        worker = self._workers.get(worker_id)
+        if worker is None:
+            raise KeyError(f"unknown fleet worker {worker_id!r}")
+        worker.kill()
+        if evict:
+            self._handle_worker_death(worker_id, "killed (explicit)")
+
+    # ---- failover --------------------------------------------------------
+
+    def _handle_worker_death(self, worker_id: str, reason: str) -> None:
+        rec = get_run_record()
+        with self._lock:
+            if worker_id in self._dead_handled or worker_id not in self._live:
+                return
+            self._dead_handled.add(worker_id)
+            self._live.discard(worker_id)
+            self._ring.remove(worker_id)
+            live, ring_size = len(self._live), len(self._ring)
+        rec.add("fleet.evictions")
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        rec.event("fleet.evicted", worker=worker_id, reason=reason)
+        log.warning(
+            "fleet worker %s evicted (%s); its hash range and unfinished "
+            "journal move to the surviving peers", worker_id, reason,
+        )
+        _fleet_sync("evict.removed")
+        worker = self._workers.get(worker_id)
+        self._failover(
+            worker_id,
+            worker.journal_path if worker is not None else None,
+        )
+
+    def adopt_journal(self, journal_path: Union[str, Path],
+                      worker_id: str = "adopted") -> int:
+        """Inherit a crashed predecessor's request journal: every
+        journaled-but-unfinished request re-solves on the worker its hash
+        range now belongs to.  Returns the number of requests replayed
+        (the front-door-restart recovery path; also the schedule
+        harness's deterministic failover entry)."""
+        return self._failover(worker_id, Path(journal_path))
+
+    def _failover(self, worker_id: str,
+                  journal_path: Optional[Path]) -> int:
+        """Replay a dead worker's unfinished work on the peers inheriting
+        its hash range: the front door's own in-flight tickets first
+        (they re-route with their clients still attached), then the
+        journal's pending entries (zero lost), deduplicated against both
+        the in-flight set and the journal's done marks (zero duplicated).
+        """
+        rec = get_run_record()
+        with self._lock:
+            self._failovers_active += 1
+        rec.gauge("fleet.replay_complete", 0)
+        _fleet_sync("replay.begin")
+        entries: List[Dict[str, object]] = []
+        if journal_path is not None:
+            try:
+                fault_point("fleet.replay")
+                journal = RequestJournal(journal_path)
+                scanned, corrupt, torn = journal.scan()
+                done_ids = {
+                    e.get("request_id") for e in scanned
+                    if e.get("kind") == "done"
+                }
+                entries = [
+                    e for e in scanned
+                    if e.get("kind") == "req"
+                    and e.get("request_id") not in done_ids
+                ]
+                if torn:
+                    rec.add("fleet.replay_torn_tails")
+                if corrupt:
+                    journal.quarantine(
+                        corrupt, "corrupt line in a dead worker's journal",
+                    )
+            except (FaultInjected, OSError) as exc:
+                rec.add("fleet.replay_errors")
+                rec.event("fleet.replay_degraded", worker=worker_id,
+                          error=str(exc))
+                log.warning(
+                    "dead worker %s journal unreadable (%s); failover "
+                    "degrades to re-routing the front door's own in-flight "
+                    "tickets only", worker_id, exc,
+                )
+                entries = []
+        with self._lock:
+            local = [
+                p for p in self._pending.values()
+                if p.worker_id == worker_id
+            ]
+        replayed = 0
+        seen: Set[str] = set()
+        with rec.span("fleet.replay", worker=worker_id,
+                      inflight=len(local), journaled=len(entries)):
+            for pending in local:
+                seen.add(pending.wire_id)
+                with self._lock:
+                    # Flag + counter move together under the lock, and
+                    # only while the entry is still unresolved — a ticket
+                    # resolving concurrently must not leave the
+                    # outstanding count stuck above zero.
+                    if (self._pending.get(pending.wire_id) is pending
+                            and not pending.replaying):
+                        pending.replaying = True
+                        self._replays_outstanding += 1
+                self._dispatch(pending)
+                replayed += 1
+            for entry in entries:
+                rid = entry.get("request_id")
+                nodes = entry.get("nodes")
+                if (not isinstance(rid, str) or rid in seen
+                        or not isinstance(nodes, list)):
+                    continue
+                seen.add(rid)
+                with self._lock:
+                    known = rid in self._pending
+                if known:
+                    continue  # already re-routed under a different owner
+                pending = _Pending(
+                    ticket=Ticket(rid, time.monotonic(), None),
+                    wire_id=rid,
+                    fingerprint=str(entry.get("fingerprint") or rid),
+                    nodes=nodes,
+                    deadline_s=None,  # its original budget is long since moot
+                    internal=True,
+                    replaying=True,
+                )
+                with self._lock:
+                    self._pending[rid] = pending
+                    self._replays_outstanding += 1
+                _fleet_sync("replay.dispatch")
+                self._dispatch(pending)
+                replayed += 1
+        if replayed:
+            rec.add("fleet.replays", replayed)
+        rec.event("fleet.replayed", worker=worker_id, requests=replayed)
+        _fleet_sync("replay.done")
+        with self._lock:
+            self._failovers_active -= 1
+            done = (
+                self._replays_outstanding == 0
+                and self._failovers_active == 0
+            )
+        if done:
+            rec.gauge("fleet.replay_complete", 1)
+        return replayed
+
+
+# ---- CLI subcommand ---------------------------------------------------------
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m quorum_intersection_tpu fleet",
+        description=(
+            "Replicated snapshot-verdict service: N serve workers behind "
+            "a consistent-hash front door.  Same JSONL contract as the "
+            "serve subcommand — one JSON request per stdin line, one JSON "
+            "response per stdout line in completion order; EOF drains "
+            "every worker and exits 0."
+        ),
+    )
+    p.add_argument("-n", "--workers", type=int, default=None, metavar="N",
+                   help="worker count (env twin: QI_FLEET_WORKERS)")
+    p.add_argument("--journal-dir", metavar="DIR", default=None,
+                   help="directory of the per-worker crash-only request "
+                        "journals (default: a temporary directory); a "
+                        "dead worker's unfinished journal replays on the "
+                        "peer inheriting its hash range")
+    p.add_argument("--store-dir", metavar="DIR", default=None,
+                   help="shared SCC-fragment store directory exported to "
+                        "every worker (env twin: QI_FLEET_STORE_DIR; "
+                        "default: <journal-dir>/store)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep",
+                            "tpu-frontier"],
+                   help="search backend inside each worker (default auto)")
+    p.add_argument("--local-workers", action="store_true",
+                   help="run the workers in-process instead of as "
+                        "subprocesses (debug/smoke mode)")
+    p.add_argument("--deadline-s", type=float, default=None, metavar="F",
+                   help="per-request deadline budget forwarded to the "
+                        "workers (env twin: QI_SERVE_DEADLINE_S)")
+    p.add_argument("--batch-max", type=int, default=None, metavar="N",
+                   help="per-worker drain batch bound (QI_SERVE_BATCH_MAX)")
+    p.add_argument("--cache-max", type=int, default=None, metavar="N",
+                   help="per-worker verdict-cache capacity "
+                        "(QI_SERVE_CACHE_MAX)")
+    p.add_argument("--dangling-policy", default="strict",
+                   choices=["strict", "alias0"],
+                   help="unknown validator refs (default strict)")
+    p.add_argument("--scc-select", default="quorum-bearing",
+                   choices=["quorum-bearing", "front"],
+                   help="which SCC to search (default quorum-bearing)")
+    p.add_argument("--scope-scc", action="store_true",
+                   help="scope availability to the searched SCC")
+    p.add_argument("--emit-certs", action="store_true",
+                   help="verdict responses carry their qi-cert/1 "
+                        "certificate and solve stats")
+    p.add_argument("--metrics-json", metavar="PATH", default=None,
+                   help="stream qi-telemetry/1 JSONL to PATH")
+    p.add_argument("--metrics-prom", metavar="PATH", default=None,
+                   help="write final counters/gauges to PATH "
+                        "(Prometheus textfile)")
+    return p
+
+
+def fleet_main(argv: Optional[List[str]] = None) -> int:
+    """The ``fleet`` subcommand body (dispatched from cli.py)."""
+    from quorum_intersection_tpu.utils import telemetry
+
+    args = build_fleet_parser().parse_args(argv)
+    record = telemetry.get_run_record()
+    if args.metrics_json:
+        record.add_sink(telemetry.JsonlSink(args.metrics_json))
+    if args.metrics_prom:
+        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+    engine = FleetEngine(
+        args.workers,
+        backend=args.backend,
+        worker_mode="local" if args.local_workers else "subprocess",
+        journal_dir=args.journal_dir,
+        store_dir=args.store_dir,
+        deadline_s=args.deadline_s,
+        batch_max=args.batch_max,
+        cache_max=args.cache_max,
+        dangling=args.dangling_policy,
+        scc_select=args.scc_select,
+        scope_to_scc=args.scope_scc,
+    )
+    session = JsonlSession(
+        engine,  # type: ignore[arg-type] — same submit/Ticket contract
+        sys.stdout, emit_certs=args.emit_certs,
+    )
+    try:
+        report = engine.start()
+        session.emit({"kind": "fleet", **report})
+        run_jsonl_loop(session, sys.stdin)
+        engine.stop(drain=True)
+        session.wait_drained(timeout=None)
+        return 0
+    finally:
+        engine.stop(drain=False)
+        record.finish()

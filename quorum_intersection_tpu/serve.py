@@ -50,7 +50,11 @@ shed-state and p50/p99 latency gauges; served certificates carry a
 
 CLI: ``python -m quorum_intersection_tpu serve`` (one JSON request per
 stdin line, one JSON response per stdout line — :func:`serve_main`);
-``benchmarks/serve.py`` is the open-loop load driver.
+``benchmarks/serve.py`` is the open-loop load driver.  Since the ISSUE 11
+engine/transport split the engine here is transport-agnostic: the stdio
+loop, the socket transport and the fleet supervisor's per-worker sessions
+all live in ``serve_transport.py``, and ``fleet.py`` runs N of these
+engines behind a consistent-hash front door.
 """
 
 from __future__ import annotations
@@ -60,7 +64,6 @@ import hashlib
 import json
 import math
 import os
-import sys
 import threading
 import time
 from collections import OrderedDict, deque
@@ -75,7 +78,11 @@ from quorum_intersection_tpu.backends.base import (
     get_backend,
 )
 from quorum_intersection_tpu.cert import CERT_SCHEMA
-from quorum_intersection_tpu.delta import DeltaEngine, SccVerdictStore
+from quorum_intersection_tpu.delta import (
+    DeltaEngine,
+    SccVerdictStore,
+    SharedSccStore,
+)
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
 from quorum_intersection_tpu.pipeline import SolveResult, check_many
@@ -516,6 +523,7 @@ class ServeEngine:
         scope_to_scc: bool = False,
         pack: Optional[bool] = None,
         delta: Optional[bool] = None,
+        shared_store: Optional[SharedSccStore] = None,
     ) -> None:
         self.backend = backend
         self.queue_depth = (
@@ -552,9 +560,21 @@ class ServeEngine:
         # restores the all-or-nothing pre-delta behavior.
         delta_cache = qi_env_int("QI_DELTA_CACHE_MAX", 4096)
         delta_on = delta if delta is not None else delta_cache > 0
+        # Two-level store tier (qi-fleet, ISSUE 11): with a shared fragment
+        # store attached — explicitly, or via QI_FLEET_STORE_DIR in a fleet
+        # worker's environment — the per-process LRU reads through to the
+        # fingerprint-keyed shared tier, so an SCC fragment solved by any
+        # worker composes into every worker's certs.  A dead shared tier
+        # degrades to local-LRU-only (fleet.store fault point), loudly.
+        if shared_store is None:
+            store_dir = qi_env("QI_FLEET_STORE_DIR")
+            shared_store = SharedSccStore(store_dir) if store_dir else None
         self._delta: Optional[DeltaEngine] = (
             DeltaEngine(
-                SccVerdictStore(delta_cache if delta_cache > 0 else None),
+                SccVerdictStore(
+                    delta_cache if delta_cache > 0 else None,
+                    shared=shared_store,
+                ),
                 dangling=dangling, scc_select=scc_select,
                 scope_to_scc=scope_to_scc,
             )
@@ -1397,144 +1417,25 @@ def _raw_nodes(
 
 
 # ---- CLI subcommand ---------------------------------------------------------
+#
+# The transport half of the serving layer moved to serve_transport.py in
+# the ISSUE 11 engine/transport split (the ROADMAP-named seam): the same
+# ServeEngine now runs under the stdio loop, a socket transport, and the
+# fleet supervisor (fleet.py).  These wrappers keep the public import
+# surface (`from quorum_intersection_tpu.serve import serve_main`) and the
+# cli.py dispatch stable.
 
 
 def build_serve_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="python -m quorum_intersection_tpu serve",
-        description=(
-            "Long-lived snapshot-verdict service: one JSON request per "
-            "stdin line (a raw stellarbeat node array, or "
-            '{"request_id": ..., "nodes": [...]}), one JSON response per '
-            "stdout line in completion order.  EOF drains the queue and "
-            "exits 0."
-        ),
+    from quorum_intersection_tpu.serve_transport import (
+        build_serve_parser as _build,
     )
-    p.add_argument("--journal", metavar="PATH", default=None,
-                   help="crash-only request journal (env twin: "
-                        "QI_SERVE_JOURNAL): accepted requests are "
-                        "journaled before solving; a hard kill + restart "
-                        "replays unfinished work")
-    p.add_argument("--deadline-s", type=float, default=None, metavar="F",
-                   help="per-request deadline budget in seconds (env twin: "
-                        "QI_SERVE_DEADLINE_S; 0 = none)")
-    p.add_argument("--queue-depth", type=int, default=None, metavar="N",
-                   help="admission-queue bound; over-depth requests are "
-                        "shed with a typed 'overloaded' error (env twin: "
-                        "QI_SERVE_QUEUE_DEPTH)")
-    p.add_argument("--batch-max", type=int, default=None, metavar="N",
-                   help="most requests one drain cycle batches into "
-                        "pipeline.check_many (env twin: QI_SERVE_BATCH_MAX)")
-    p.add_argument("--cache-max", type=int, default=None, metavar="N",
-                   help="verdict-cache capacity (env twin: "
-                        "QI_SERVE_CACHE_MAX)")
-    p.add_argument("--backend", default="auto",
-                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep",
-                            "tpu-frontier"],
-                   help="search backend for served solves (default auto)")
-    p.add_argument("--dangling-policy", default="strict",
-                   choices=["strict", "alias0"],
-                   help="unknown validator refs (default strict)")
-    p.add_argument("--scc-select", default="quorum-bearing",
-                   choices=["quorum-bearing", "front"],
-                   help="which SCC to search (default quorum-bearing)")
-    p.add_argument("--scope-scc", action="store_true",
-                   help="scope availability to the searched SCC")
-    p.add_argument("--no-delta", action="store_true",
-                   help="disable incremental re-analysis (qi-delta): every "
-                        "snapshot re-solves from scratch instead of reusing "
-                        "per-SCC verdict fragments (env twin: "
-                        "QI_DELTA_CACHE_MAX=0)")
-    p.add_argument("--replay-only", action="store_true",
-                   help="replay the journal, print the report, exit "
-                        "(restart-recovery probe; no requests accepted)")
-    p.add_argument("--metrics-json", metavar="PATH", default=None,
-                   help="stream qi-telemetry/1 JSONL to PATH")
-    p.add_argument("--metrics-prom", metavar="PATH", default=None,
-                   help="write final counters/gauges to PATH "
-                        "(Prometheus textfile)")
-    return p
+
+    return _build()
 
 
 def serve_main(argv: Optional[List[str]] = None) -> int:
     """The ``serve`` subcommand body (dispatched from cli.py)."""
-    from quorum_intersection_tpu.utils import telemetry
+    from quorum_intersection_tpu.serve_transport import serve_main as _main
 
-    args = build_serve_parser().parse_args(argv)
-    record = telemetry.get_run_record()
-    if args.metrics_json:
-        record.add_sink(telemetry.JsonlSink(args.metrics_json))
-    if args.metrics_prom:
-        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
-    engine = ServeEngine(
-        backend=args.backend,
-        queue_depth=args.queue_depth,
-        batch_max=args.batch_max,
-        deadline_s=args.deadline_s,
-        cache_max=args.cache_max,
-        journal=args.journal,
-        dangling=args.dangling_policy,
-        scc_select=args.scc_select,
-        scope_to_scc=args.scope_scc,
-        delta=False if args.no_delta else None,
-    )
-    out_lock = threading.Lock()
-
-    def emit(obj: Dict[str, object]) -> None:
-        with out_lock:
-            sys.stdout.write(json.dumps(obj, default=str) + "\n")
-            sys.stdout.flush()
-
-    def on_done(ticket: Ticket) -> None:
-        try:
-            resp = ticket.result(timeout=0)
-        except ServeError as exc:
-            emit({"request_id": ticket.request_id,
-                  "error": {"code": exc.code, "message": str(exc)}})
-            return
-        except Exception as exc:  # noqa: BLE001 — an untyped failure still gets a response line
-            emit({"request_id": ticket.request_id,
-                  "error": {"code": "internal", "message": str(exc)}})
-            return
-        emit({"request_id": resp.request_id,
-              "verdict": resp.intersects, "cached": resp.cached,
-              "seconds": round(resp.seconds, 6)})
-
-    try:
-        report = engine.start()
-        if report is not None:
-            emit({"kind": "replay", **report})
-        if args.replay_only:
-            return 0
-        for n, line in enumerate(sys.stdin):
-            line = line.strip()
-            if not line:
-                continue
-            request_id: Optional[str] = None
-            try:
-                obj = json.loads(line)
-                nodes = obj
-                if isinstance(obj, dict):
-                    request_id = obj.get("request_id")
-                    nodes = obj.get("nodes")
-                if not isinstance(nodes, list):
-                    raise ValueError("expected a node array or "
-                                     '{"request_id", "nodes"}')
-                ticket = engine.submit(nodes, request_id=request_id)
-            except ServeError as exc:
-                emit({"request_id": request_id or f"line-{n + 1}",
-                      "error": {"code": exc.code, "message": str(exc)}})
-                continue
-            except (ValueError, FaultInjected) as exc:
-                emit({"request_id": request_id or f"line-{n + 1}",
-                      "error": {"code": "invalid", "message": str(exc)}})
-                continue
-            ticket.add_done_callback(on_done)
-        # No drain bound at EOF: every accepted request gets its response
-        # line before exit, however long its solve runs (deadlines, not
-        # timeouts, are the latency control here).
-        engine.stop(drain=True, timeout=None)
-        return 0
-    finally:
-        engine.stop(drain=False, timeout=5.0)
-        record.finish()
+    return _main(argv)

@@ -1,0 +1,396 @@
+"""qi-serve transports — the engine/transport seam (ISSUE 11).
+
+PR 8 built :class:`quorum_intersection_tpu.serve.ServeEngine` but fused it
+to one transport: a stdio loop inside ``serve_main``.  The ROADMAP's fleet
+item names the engine/transport split as the seam — this module is that
+split.  The engine stays transport-agnostic (submit → Ticket → callback);
+everything that turns bytes into requests and outcomes into bytes lives
+here, once, shared by every way an engine can be driven:
+
+- **stdio** (:func:`serve_main`): the existing CLI contract, byte-for-byte
+  — one JSON request per stdin line, one JSON response per stdout line in
+  completion order, EOF drains and exits 0 (``tests/test_serve.py`` pins
+  it; the split must not churn a single expectation);
+- **sockets** (:class:`SocketServeServer`): the same JSONL conversation
+  over TCP (127.0.0.1), many concurrent connections sharing ONE engine —
+  each connection gets its own :class:`JsonlSession`, so its responses
+  never interleave with another client's;
+- **the fleet supervisor** (``fleet.py``): worker subprocesses run this
+  module's stdio loop over pipes, and the front door's
+  :class:`~quorum_intersection_tpu.fleet.LocalWorker` reuses
+  :func:`ticket_response` directly — both worker kinds answer in exactly
+  the shape this module emits, so the front door cannot tell them apart.
+
+Protocol (one JSON value per line, ``qi-serve/1``):
+
+- request: a raw stellarbeat node array, or ``{"request_id", "nodes"}``
+  optionally with ``"deadline_s"`` (per-request budget — the fleet front
+  door forwards its clients' budgets this way);
+- response: ``{"request_id", "verdict", "cached", "seconds"}`` or
+  ``{"request_id", "error": {"code", "message"}}``; with certificates
+  enabled (``--emit-certs``, the fleet workers' mode) the verdict line
+  additionally carries ``"cert"`` and ``"stats"`` — off by default so the
+  pre-split byte contract holds;
+- probe: ``{"ping": token}`` → ``{"pong": token, ...}`` with the worker's
+  readiness and a small counter/gauge snapshot (:func:`pong_payload`) —
+  the fleet's health probes and its fleet-wide ``/healthz`` aggregation
+  ride this instead of N scrape ports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socketserver
+import sys
+import threading
+from typing import Dict, Iterator, List, Optional, TextIO
+
+from quorum_intersection_tpu.serve import (
+    ServeEngine,
+    ServeError,
+    ServeResponse,
+    Ticket,
+)
+from quorum_intersection_tpu.utils.faults import FaultInjected
+from quorum_intersection_tpu.utils.logging import get_logger
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+log = get_logger("serve.transport")
+
+PROTOCOL_SCHEMA = "qi-serve/1"
+
+# The counter/gauge slice one pong carries: enough for the fleet front
+# door to aggregate health (store hit %, delta reuse, queue depth) without
+# opening N scrape ports — docs/OBSERVABILITY.md §Fleet.
+PONG_COUNTERS = (
+    "serve.requests",
+    "serve.verdicts",
+    "serve.errors",
+    "serve.cache_hits",
+    "fleet.store_hits",
+    "fleet.store_misses",
+    "fleet.store_errors",
+    "delta.scc_hits",
+    "delta.scc_misses",
+)
+PONG_GAUGES = (
+    "serve.queue_depth",
+    "delta.scc_reuse_pct",
+    "delta.store_size",
+)
+
+
+def pong_payload(token: object) -> Dict[str, object]:
+    """The ``{"ping": token}`` reply: readiness + a health snapshot."""
+    rec = get_run_record()
+    counters, gauges = rec.snapshot()
+    replay = gauges.get("serve.replay_complete")
+    return {
+        "pong": token,
+        "schema": PROTOCOL_SCHEMA,
+        "pid": os.getpid(),
+        "ready": bool(replay) if replay is not None else True,
+        "counters": {k: counters.get(k, 0) for k in PONG_COUNTERS},
+        "gauges": {k: gauges.get(k, 0) for k in PONG_GAUGES},
+    }
+
+
+def ticket_response(
+    ticket: Ticket, *, emit_certs: bool = False
+) -> Dict[str, object]:
+    """One RESOLVED ticket → its JSONL response object (the single place
+    the outcome→wire shape lives; LocalWorker and both loop transports
+    share it so a fleet front door sees one shape from every worker)."""
+    try:
+        resp: ServeResponse = ticket.result(timeout=0)
+    except ServeError as exc:
+        return {"request_id": ticket.request_id,
+                "error": {"code": exc.code, "message": str(exc)}}
+    except Exception as exc:  # noqa: BLE001 — an untyped failure still gets a response line
+        return {"request_id": ticket.request_id,
+                "error": {"code": "internal", "message": str(exc)}}
+    line: Dict[str, object] = {
+        "request_id": resp.request_id,
+        "verdict": resp.intersects,
+        "cached": resp.cached,
+        "seconds": round(resp.seconds, 6),
+    }
+    if emit_certs:
+        line["cert"] = resp.cert
+        line["stats"] = resp.stats
+    return line
+
+
+class JsonlSession:
+    """One JSONL conversation against one engine.
+
+    Owns the write lock (responses from concurrent ticket callbacks never
+    interleave bytes) and the outstanding-ticket count, so a transport can
+    drain a single connection without stopping the shared engine.
+    """
+
+    def __init__(self, engine: ServeEngine, writer: TextIO,
+                 *, emit_certs: bool = False) -> None:
+        self._engine = engine
+        self._writer = writer
+        self._emit_certs = emit_certs
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._drained = threading.Condition(self._lock)
+
+    def emit(self, obj: Dict[str, object]) -> None:
+        """Write one response line; a vanished client (closed socket) is
+        logged and dropped — its verdict is already cached and journaled,
+        so a reconnect-and-retry is a cache hit, never lost work."""
+        try:
+            with self._lock:
+                self._writer.write(json.dumps(obj, default=str) + "\n")
+                self._writer.flush()
+        except (OSError, ValueError) as exc:
+            log.warning("response write failed (client gone?): %s", exc)
+
+    def _on_done(self, ticket: Ticket) -> None:
+        self.emit(ticket_response(ticket, emit_certs=self._emit_certs))
+        with self._drained:
+            self._outstanding -= 1
+            self._drained.notify_all()
+
+    def handle_line(self, n: int, line: str) -> None:
+        """One request line → submit (or ping/typed rejection), non-blocking."""
+        line = line.strip()
+        if not line:
+            return
+        request_id: Optional[str] = None
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "ping" in obj:
+                self.emit(pong_payload(obj["ping"]))
+                return
+            nodes = obj
+            deadline_s: Optional[float] = None
+            if isinstance(obj, dict):
+                request_id = obj.get("request_id")
+                nodes = obj.get("nodes")
+                raw_deadline = obj.get("deadline_s")
+                if raw_deadline is not None:
+                    deadline_s = float(raw_deadline)
+            if not isinstance(nodes, list):
+                raise ValueError("expected a node array or "
+                                 '{"request_id", "nodes"}')
+            ticket = self._engine.submit(
+                nodes, request_id=request_id, deadline_s=deadline_s,
+            )
+        except ServeError as exc:
+            self.emit({"request_id": request_id or f"line-{n + 1}",
+                       "error": {"code": exc.code, "message": str(exc)}})
+            return
+        except (ValueError, TypeError, FaultInjected) as exc:
+            self.emit({"request_id": request_id or f"line-{n + 1}",
+                       "error": {"code": "invalid", "message": str(exc)}})
+            return
+        with self._drained:
+            self._outstanding += 1
+        ticket.add_done_callback(self._on_done)
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted ticket of THIS session delivered."""
+        with self._drained:
+            return self._drained.wait_for(
+                lambda: self._outstanding == 0, timeout=timeout,
+            )
+
+
+def run_jsonl_loop(session: JsonlSession, reader: TextIO) -> None:
+    """Feed ``reader``'s lines through ``session`` until EOF (the caller
+    decides whether EOF drains the engine or just this conversation) —
+    the one request-loop shared by the stdio CLI, the socket handler and
+    the fleet CLI."""
+    for n, line in enumerate(reader):
+        session.handle_line(n, line)
+
+
+class SocketServeServer:
+    """JSONL-over-TCP twin of the stdio loop: one shared engine, many
+    concurrent connections (one :class:`JsonlSession` each), bound to
+    127.0.0.1 like the metrics endpoint — the serve protocol is not an
+    internet-facing surface.  ``port=0`` binds ephemeral; read ``.port``.
+    """
+
+    def __init__(self, engine: ServeEngine, *, host: str = "127.0.0.1",
+                 port: int = 0, emit_certs: bool = False) -> None:
+        outer = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                reader = _utf8_lines(self.rfile)
+                writer = _Utf8Writer(self.wfile)
+                session = JsonlSession(
+                    outer.engine, writer, emit_certs=outer.emit_certs,
+                )
+                run_jsonl_loop(session, reader)  # type: ignore[arg-type]
+                # Connection EOF drains the CONNECTION, not the engine:
+                # every response this client is owed goes out before the
+                # socket closes; other clients' work is untouched.
+                session.wait_drained(timeout=None)
+
+        self.engine = engine
+        self.emit_certs = emit_certs
+        self._httpd = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True,
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        # qi-lint: allow(cancel-token-plumbed) — daemon accept loop, no solve work; stop() shuts it down
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="qi-serve-socket",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("serve socket transport on %s:%d", host, self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _utf8_lines(raw: object) -> Iterator[str]:
+    """Decode a binary line reader lazily — tiny shim so the socket handler
+    can share ``JsonlSession`` with the text-mode stdio loop."""
+    for line in raw:  # type: ignore[attr-defined]
+        yield line.decode("utf-8", errors="replace")
+
+
+class _Utf8Writer:
+    """Text façade over a binary socket file (write + flush only)."""
+
+    def __init__(self, raw: object) -> None:
+        self._raw = raw
+
+    def write(self, text: str) -> int:
+        self._raw.write(text.encode("utf-8"))  # type: ignore[attr-defined]
+        return len(text)
+
+    def flush(self) -> None:
+        self._raw.flush()  # type: ignore[attr-defined]
+
+
+# ---- CLI subcommand ---------------------------------------------------------
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m quorum_intersection_tpu serve",
+        description=(
+            "Long-lived snapshot-verdict service: one JSON request per "
+            "stdin line (a raw stellarbeat node array, or "
+            '{"request_id": ..., "nodes": [...]}), one JSON response per '
+            "stdout line in completion order.  EOF drains the queue and "
+            "exits 0."
+        ),
+    )
+    p.add_argument("--journal", metavar="PATH", default=None,
+                   help="crash-only request journal (env twin: "
+                        "QI_SERVE_JOURNAL): accepted requests are "
+                        "journaled before solving; a hard kill + restart "
+                        "replays unfinished work")
+    p.add_argument("--deadline-s", type=float, default=None, metavar="F",
+                   help="per-request deadline budget in seconds (env twin: "
+                        "QI_SERVE_DEADLINE_S; 0 = none)")
+    p.add_argument("--queue-depth", type=int, default=None, metavar="N",
+                   help="admission-queue bound; over-depth requests are "
+                        "shed with a typed 'overloaded' error (env twin: "
+                        "QI_SERVE_QUEUE_DEPTH)")
+    p.add_argument("--batch-max", type=int, default=None, metavar="N",
+                   help="most requests one drain cycle batches into "
+                        "pipeline.check_many (env twin: QI_SERVE_BATCH_MAX)")
+    p.add_argument("--cache-max", type=int, default=None, metavar="N",
+                   help="verdict-cache capacity (env twin: "
+                        "QI_SERVE_CACHE_MAX)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "python", "cpp", "tpu", "tpu-sweep",
+                            "tpu-frontier"],
+                   help="search backend for served solves (default auto)")
+    p.add_argument("--dangling-policy", default="strict",
+                   choices=["strict", "alias0"],
+                   help="unknown validator refs (default strict)")
+    p.add_argument("--scc-select", default="quorum-bearing",
+                   choices=["quorum-bearing", "front"],
+                   help="which SCC to search (default quorum-bearing)")
+    p.add_argument("--scope-scc", action="store_true",
+                   help="scope availability to the searched SCC")
+    p.add_argument("--no-delta", action="store_true",
+                   help="disable incremental re-analysis (qi-delta): every "
+                        "snapshot re-solves from scratch instead of reusing "
+                        "per-SCC verdict fragments (env twin: "
+                        "QI_DELTA_CACHE_MAX=0)")
+    p.add_argument("--replay-only", action="store_true",
+                   help="replay the journal, print the report, exit "
+                        "(restart-recovery probe; no requests accepted)")
+    p.add_argument("--emit-certs", action="store_true",
+                   help="verdict responses carry their qi-cert/1 "
+                        "certificate and solve stats (the fleet workers' "
+                        "mode; off by default for wire compatibility)")
+    p.add_argument("--socket", type=int, default=None, metavar="PORT",
+                   help="ALSO serve the same JSONL protocol over TCP on "
+                        "127.0.0.1:PORT (0 = ephemeral; the bound port is "
+                        "announced as a {\"kind\": \"listening\"} line); "
+                        "stdin EOF still drains and exits")
+    p.add_argument("--metrics-json", metavar="PATH", default=None,
+                   help="stream qi-telemetry/1 JSONL to PATH")
+    p.add_argument("--metrics-prom", metavar="PATH", default=None,
+                   help="write final counters/gauges to PATH "
+                        "(Prometheus textfile)")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    """The ``serve`` subcommand body (dispatched from cli.py)."""
+    from quorum_intersection_tpu.utils import telemetry
+
+    args = build_serve_parser().parse_args(argv)
+    record = telemetry.get_run_record()
+    if args.metrics_json:
+        record.add_sink(telemetry.JsonlSink(args.metrics_json))
+    if args.metrics_prom:
+        record.add_sink(telemetry.PromFileSink(args.metrics_prom))
+    engine = ServeEngine(
+        backend=args.backend,
+        queue_depth=args.queue_depth,
+        batch_max=args.batch_max,
+        deadline_s=args.deadline_s,
+        cache_max=args.cache_max,
+        journal=args.journal,
+        dangling=args.dangling_policy,
+        scc_select=args.scc_select,
+        scope_to_scc=args.scope_scc,
+        delta=False if args.no_delta else None,
+    )
+    session = JsonlSession(engine, sys.stdout, emit_certs=args.emit_certs)
+    server: Optional[SocketServeServer] = None
+    try:
+        report = engine.start()
+        if report is not None:
+            session.emit({"kind": "replay", **report})
+        if args.replay_only:
+            return 0
+        if args.socket is not None:
+            server = SocketServeServer(
+                engine, port=args.socket, emit_certs=args.emit_certs,
+            )
+            session.emit({"kind": "listening", "host": server.host,
+                          "port": server.port})
+        run_jsonl_loop(session, sys.stdin)
+        # No drain bound at EOF: every accepted request gets its response
+        # line before exit, however long its solve runs (deadlines, not
+        # timeouts, are the latency control here).
+        engine.stop(drain=True, timeout=None)
+        session.wait_drained(timeout=None)
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+        engine.stop(drain=False, timeout=5.0)
+        record.finish()

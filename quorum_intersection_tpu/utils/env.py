@@ -221,6 +221,40 @@ QI_SWEEP_PRUNE = _declare(
     "windows_pruned_guard ledger term (tools/check_cert.py re-verifies "
     "every block).  Empty/'0' (default): unpruned brute force.",
 )
+QI_FLEET_WORKERS = _declare(
+    "QI_FLEET_WORKERS", "2",
+    "Worker count of the replicated serve tier (fleet.py; CLI twin: "
+    "python -m quorum_intersection_tpu fleet -n N): N ServeEngine "
+    "workers behind the consistent-hash front door.",
+)
+QI_FLEET_STORE_DIR = _declare(
+    "QI_FLEET_STORE_DIR", "",
+    "Directory of the shared SCC-fragment store tier (delta.py "
+    "SharedSccStore): set in a serve worker's environment (the fleet "
+    "supervisor exports it to every worker it spawns), the per-process "
+    "SccVerdictStore reads through to it on every miss and writes every "
+    "banked fragment back, so one worker's solve composes into every "
+    "worker's certs.  Empty (default): local LRU only.",
+)
+QI_FLEET_VNODES = _declare(
+    "QI_FLEET_VNODES", "32",
+    "Virtual nodes per worker on the fleet's consistent-hash ring "
+    "(fleet.py HashRing): more vnodes smooth the key distribution; "
+    "join/leave still moves only ~1/N of the fingerprint space.",
+)
+QI_FLEET_PROBE_INTERVAL_S = _declare(
+    "QI_FLEET_PROBE_INTERVAL_S", "0.5",
+    "Seconds between fleet health-probe cycles (fleet.py probe loop): "
+    "each cycle pings every live worker over its own JSONL pipe and "
+    "aggregates the pong snapshots into the fleet /healthz gauges.",
+)
+QI_FLEET_PROBE_FAILS = _declare(
+    "QI_FLEET_PROBE_FAILS", "2",
+    "Consecutive failed health probes before the fleet front door evicts "
+    "a worker from the ring and replays its unfinished journal on the "
+    "peers inheriting its hash range (fleet.py); a dead process is "
+    "evicted immediately regardless.",
+)
 QI_SERVE_JOURNAL = _declare(
     "QI_SERVE_JOURNAL", "",
     "Path of the serving layer's crash-only request journal (serve.py): "

@@ -239,6 +239,41 @@ DELTA_DIFF = _declare(
     "(pipeline.check_many), verdicts unchanged; incremental re-analysis "
     "is an optimization, never a precondition for a verdict.",
 )
+FLEET_ROUTE = _declare(
+    "fleet.route",
+    "Consistent-hash routing decision of the fleet front door (fleet.py "
+    "FleetEngine.submit): error simulates a broken ring lookup — the "
+    "request degrades to the first live worker (fleet.route_errors "
+    "counter, loud; only fleet-wide coalescing locality is lost), never "
+    "a dropped request.",
+)
+FLEET_PROBE = _declare(
+    "fleet.probe",
+    "Worker health probe of the fleet supervisor (fleet.py probe loop): "
+    "error simulates a broken probe path — the cycle is recorded "
+    "inconclusive (fleet.probe_errors counter) and NO eviction happens "
+    "on an injected failure; eviction requires a dead process or "
+    "consecutive real probe timeouts, so a probe fault can cost health "
+    "freshness, never a spurious failover.",
+)
+FLEET_REPLAY = _declare(
+    "fleet.replay",
+    "Dead-worker journal inheritance (fleet.py FleetEngine failover): "
+    "error/oserror simulate an unreadable journal — failover degrades to "
+    "re-routing the front door's own in-flight tickets only "
+    "(fleet.replay_errors counter, loud: journal-only orphans of a "
+    "crashed front door are not recovered this round), never a wrong or "
+    "duplicated verdict.",
+)
+FLEET_STORE = _declare(
+    "fleet.store",
+    "Shared SCC-fragment store tier (delta.py SharedSccStore get/put, "
+    "the fleet workers' read-through second level): error/oserror "
+    "simulate a dead shared tier — the store degrades to local-LRU-only "
+    "(fleet.store_errors counter, loud; fleet-wide reuse is lost, the "
+    "verdict is not), and an unparseable/forged fragment is a miss, "
+    "never trusted.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "
@@ -486,6 +521,42 @@ _SERVE_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
     (NATIVE_CALL, "error", 0.0),
     (SWEEP_DISPATCH, "oom", 0.0),
 )
+
+
+# What the fleet chaos soak can draw (tools/soak.py --fleet --chaos): the
+# four fleet.* boundaries plus the serve.*/delta.* points a routed request
+# crosses inside its worker — one seeded window exercises routing, probing,
+# failover replay and the shared store tier alongside the per-worker
+# degradations.
+_FLEET_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
+    (FLEET_ROUTE, "error", 0.0),
+    (FLEET_PROBE, "error", 0.0),
+    (FLEET_REPLAY, "error", 0.0),
+    (FLEET_STORE, "error", 0.0),
+    (FLEET_STORE, "oserror", 0.0),
+    (SERVE_CACHE, "error", 0.0),
+    (SERVE_JOURNAL, "oserror", 0.0),
+    (DELTA_DIFF, "error", 0.0),
+)
+
+
+def sample_fleet_plan(seed: int) -> FaultPlan:
+    """Draw a deterministic fleet-tier fault schedule from ``seed`` — the
+    fleet twin of :func:`sample_serve_plan`, drawing from the fleet.*
+    boundaries (same seed ⇒ same rules ⇒ same firing sequence)."""
+    rng = random.Random(seed * 31 + 7)
+    n_rules = 1 if rng.random() < 0.5 else 2
+    picks = rng.sample(range(len(_FLEET_CHAOS_CHOICES)), n_rules)
+    rules = []
+    for ix in picks:
+        point, mode, seconds = _FLEET_CHAOS_CHOICES[ix]
+        first = 1 if rng.random() < 0.6 else rng.randint(2, 3)
+        every = rng.random() < 0.6
+        rules.append(FaultRule(
+            point=point, mode=mode, first=first, every=every,
+            seconds=seconds,
+        ))
+    return FaultPlan(rules, label=f"fleet-chaos(seed={seed})")
 
 
 def sample_serve_plan(seed: int) -> FaultPlan:

@@ -17,7 +17,12 @@ utils/env.py):
   route traffic at it yet), 200 once replay completes or when no serving
   engine runs in this process (liveness and readiness then coincide).
   ``/healthz`` deliberately stays pure liveness: a replaying process is
-  alive (don't restart it — that would loop the replay) but not ready;
+  alive (don't restart it — that would loop the replay) but not ready.
+  Since ISSUE 11 the same 503 discipline covers the fleet front door:
+  not ready while any dead worker's journal is still replaying on its
+  inheriting peers (``fleet.replay_complete``), and ``/healthz`` exposes
+  the aggregated ``fleet_workers_live`` / ``fleet_ring_size`` /
+  ``fleet_store_hit_pct`` gauges;
 - ``GET /metrics`` → the Prometheus text encoding of the same record,
   produced by the ONE encoder the textfile sink uses
   (:func:`quorum_intersection_tpu.utils.telemetry.prom_lines`) — scrape it
@@ -77,6 +82,14 @@ def healthz_payload() -> dict:
         # fleet scrape without attaching a debugger.
         "delta_scc_reuse_pct": gauges.get("delta.scc_reuse_pct", 0.0),
         "delta_store_size": gauges.get("delta.store_size", 0),
+        # qi-fleet (ISSUE 11): the front door's aggregated fleet picture —
+        # workers on the ring vs workers answering probes, and the shared
+        # SCC-fragment tier's hit rate (a collapse to 0 under steady
+        # traffic means the shared store died and every worker degraded to
+        # local-LRU-only — loud in the fleet.store_errors counter too).
+        "fleet_workers_live": gauges.get("fleet.workers_live", 0),
+        "fleet_ring_size": gauges.get("fleet.ring_size", 0),
+        "fleet_store_hit_pct": gauges.get("fleet.store_hit_pct", 0.0),
     }
 
 
@@ -95,20 +108,32 @@ def readyz_payload() -> tuple:
     counters, gauges = rec.snapshot()
     replay = gauges.get("serve.replay_complete")
     replaying = replay is not None and not replay
+    # qi-fleet (ISSUE 11): the front door is not ready until EVERY live
+    # worker finished its journal replay (fleet.replay_complete is 0 from
+    # fleet start / failover begin until the inherited work re-solved) —
+    # a scheduler must not route traffic at a fleet still recovering a
+    # dead worker's unfinished requests.
+    fleet_replay = gauges.get("fleet.replay_complete")
+    fleet_replaying = fleet_replay is not None and not fleet_replay
+    not_ready = replaying or fleet_replaying
     payload = {
         "schema": READY_SCHEMA,
-        "status": "replaying" if replaying else "ready",
+        "status": "replaying" if not_ready else "ready",
         "pid": rec.pid,
         "trace_id": rec.trace_id,
         "serving": "serve.queue_depth" in gauges,
         "replay_complete": None if replay is None else bool(replay),
+        "fleet_replay_complete": (
+            None if fleet_replay is None else bool(fleet_replay)
+        ),
+        "fleet_workers_live": gauges.get("fleet.workers_live", 0),
         "queue_depth": gauges.get("serve.queue_depth", 0),
         "shed_state": gauges.get("serve.shed_state", 0),
         "shed_total": counters.get("serve.shed", 0),
         "requests": counters.get("serve.requests", 0),
         "verdicts": counters.get("serve.verdicts", 0),
     }
-    return payload, (503 if replaying else 200)
+    return payload, (503 if not_ready else 200)
 
 
 class _Handler(BaseHTTPRequestHandler):

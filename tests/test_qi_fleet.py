@@ -1,0 +1,608 @@
+"""qi-fleet suite (ISSUE 11): consistent-hash ring determinism + bounded
+rebalance, the two-level SCC verdict store (cross-store reuse through the
+shared tier, degraded-tier behavior, forged-fragment rejection), the
+fleet-vs-single-worker differential on the vendored fixture pairs with
+checker-validated certs including a cross-worker composed fragment, the
+kill-one-of-N journal-inheritance matrix (pending/done/corrupt/torn
+inherited by a peer), every ``fleet.*`` fault point typed-or-oracle-equal,
+the forced routing/failover interleavings, the socket transport of the
+serve split, the zipfian churn skew, and the fleet-aware /healthz +
+/readyz."""
+
+import json
+import socket
+
+import pytest
+
+from quorum_intersection_tpu.delta import (
+    SccScan,
+    SccVerdict,
+    SccVerdictStore,
+    SharedSccStore,
+)
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import (
+    churn_trace,
+    churn_trace_steps,
+    majority_fbas,
+)
+from quorum_intersection_tpu.fleet import FleetEngine, HashRing
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.serve import (
+    RequestJournal,
+    ServeEngine,
+    ServeError,
+    snapshot_fingerprint,
+)
+from quorum_intersection_tpu.serve_transport import SocketServeServer
+from quorum_intersection_tpu.utils import faults, telemetry
+from quorum_intersection_tpu.utils.metrics_server import (
+    healthz_payload,
+    readyz_payload,
+)
+from tools.check_cert import check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+def fingerprint_of(nodes):
+    return snapshot_fingerprint(build_graph(parse_fbas(nodes)))
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+class _Fleet:
+    """Context-managed local-worker fleet with test-friendly defaults."""
+
+    def __init__(self, tmp_path, n=2, **kwargs):
+        kwargs.setdefault("backend", "python")
+        kwargs.setdefault("worker_mode", "local")
+        kwargs.setdefault("journal_dir", tmp_path / "fleet")
+        kwargs.setdefault("probe_interval_s", 30.0)  # probes only on demand
+        self.engine = FleetEngine(n, **kwargs)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=60.0)
+        return False
+
+
+def _wait_counter(record, name, want, timeout=20.0):
+    """Poll the run record until counter ``name`` reaches ``want``."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        counters, _ = record.snapshot()
+        if counters.get(name, 0) >= want:
+            return counters.get(name, 0)
+        time.sleep(0.02)
+    counters, _ = record.snapshot()
+    return counters.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        a, b = HashRing(vnodes=32), HashRing(vnodes=32)
+        for ring in (a, b):
+            for w in ("w0", "w1", "w2", "w3"):
+                ring.add(w)
+        keys = [f"fp-{i:04d}" for i in range(200)]
+        assert [a.route(k) for k in keys] == [b.route(k) for k in keys]
+
+    def test_every_worker_owns_keys(self):
+        ring = HashRing(vnodes=32)
+        for w in ("w0", "w1", "w2", "w3"):
+            ring.add(w)
+        owners = {ring.route(f"fp-{i:04d}") for i in range(400)}
+        assert owners == {"w0", "w1", "w2", "w3"}
+
+    def test_bounded_rebalance_on_leave(self):
+        ring = HashRing(vnodes=32)
+        for w in ("w0", "w1", "w2", "w3"):
+            ring.add(w)
+        keys = [f"fp-{i:04d}" for i in range(400)]
+        before = {k: ring.route(k) for k in keys}
+        ring.remove("w1")
+        moved = [k for k in keys if ring.route(k) != before[k]]
+        # ONLY the departed worker's keys move — everything else is pinned.
+        assert moved and all(before[k] == "w1" for k in moved)
+        assert len(moved) == sum(1 for v in before.values() if v == "w1")
+
+    def test_bounded_rebalance_on_join(self):
+        ring = HashRing(vnodes=32)
+        for w in ("w0", "w1", "w2", "w3"):
+            ring.add(w)
+        keys = [f"fp-{i:04d}" for i in range(400)]
+        before = {k: ring.route(k) for k in keys}
+        ring.add("w4")
+        moved = [k for k in keys if ring.route(k) != before[k]]
+        # Every moved key moves TO the joiner, and only ~1/N of the space
+        # moves (vnode variance bounded well under half).
+        assert moved and all(ring.route(k) == "w4" for k in moved)
+        assert len(moved) < len(keys) / 2
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(LookupError):
+            HashRing().route("fp")
+
+
+# ---------------------------------------------------------------------------
+# two-level SCC verdict store
+
+
+class TestSharedStore:
+    def test_cross_store_verdict_reuse(self, rec, tmp_path):
+        shared = SharedSccStore(tmp_path / "store")
+        a = SccVerdictStore(64, shared=shared)
+        outcome, _ = a.lease_verdict("fp-1", False)
+        assert outcome == "leader"
+        a.publish_verdict("fp-1", False, SccVerdict(
+            intersects=True, q1_local=None, q2_local=None,
+            stats={"backend": "python"},
+        ))
+        # A DIFFERENT store (another worker) reads the banked fragment
+        # through the shared tier instead of solving.
+        b = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "store"))
+        outcome, verdict = b.lease_verdict("fp-1", False)
+        assert outcome == "hit"
+        assert verdict.intersects is True
+        assert verdict.stats["backend"] == "python"
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.store_hits", 0) >= 1
+
+    def test_cross_store_scan_reuse(self, rec, tmp_path):
+        a = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "s"))
+        a.put_scan("scan-fp", SccScan(quorum_local=(0, 2, 3)))
+        b = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "s"))
+        scan = b.get_scan("scan-fp")
+        assert scan is not None and scan.quorum_local == (0, 2, 3)
+
+    def test_scope_bit_partitions_fragments(self, tmp_path):
+        shared = SharedSccStore(tmp_path / "store")
+        a = SccVerdictStore(64, shared=shared)
+        a.lease_verdict("fp-s", True)
+        a.publish_verdict("fp-s", True, SccVerdict(
+            intersects=False, q1_local=[0], q2_local=[1], stats={},
+        ))
+        b = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "store"))
+        outcome, _ = b.lease_verdict("fp-s", False)  # other scoping: miss
+        assert outcome == "leader"
+        b.publish_verdict("fp-s", False, None)
+
+    def test_store_fault_degrades_to_local(self, rec, tmp_path):
+        faults.install_plan(faults.parse_faults("fleet.store=error@1+"))
+        store = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "s"))
+        store.put_scan("fp-x", SccScan(quorum_local=(1,)))  # shared write fails
+        scan = store.get_scan("fp-x")  # local LRU still serves it
+        assert scan is not None and scan.quorum_local == (1,)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.store_errors", 0) >= 1
+        faults.clear_plan()
+        # The shared file was never written while the tier was down.
+        fresh = SccVerdictStore(64, shared=SharedSccStore(tmp_path / "s"))
+        assert fresh.get_scan("fp-x") is None
+
+    def test_forged_fragment_is_a_miss_never_trusted(self, rec, tmp_path):
+        root = tmp_path / "store"
+        shared = SharedSccStore(root)
+        root.mkdir(parents=True)
+        (root / "verdict-s0-forged.json").write_text("{not json", "utf-8")
+        (root / "verdict-s0-shape.json").write_text(
+            json.dumps({"intersects": "yes", "stats": {}}), "utf-8",
+        )
+        store = SccVerdictStore(64, shared=shared)
+        for fp in ("forged", "shape"):
+            outcome, verdict = store.lease_verdict(fp, False)
+            assert outcome == "leader" and verdict is None
+            store.publish_verdict(fp, False, None)
+
+
+# ---------------------------------------------------------------------------
+# fleet-vs-single differential
+
+
+class TestFleetDifferential:
+    @pytest.mark.parametrize("fixture,verdict", FIXTURE_PAIRS)
+    def test_fleet_equals_single_engine(self, rec, tmp_path, fixture,
+                                        verdict):
+        nodes = fixture_nodes(fixture)
+        single = ServeEngine(backend="python")
+        single.start()
+        try:
+            ref = single.submit(nodes).result(timeout=60.0)
+        finally:
+            single.stop(drain=True, timeout=30.0)
+        with _Fleet(tmp_path, n=2) as fleet:
+            resp = fleet.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is verdict is ref.intersects
+        assert resp.cert is not None
+        assert resp.cert["verdict"] is verdict
+        if not verdict:
+            assert (resp.cert["witness"]["q1"], resp.cert["witness"]["q2"]) \
+                == (ref.cert["witness"]["q1"], ref.cert["witness"]["q2"])
+        check_certificate(resp.cert, nodes)
+
+    def test_fleet_n4_differential(self, rec, tmp_path):
+        nodes = fixture_nodes("nested_broken")
+        with _Fleet(tmp_path, n=4) as fleet:
+            resp = fleet.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is False
+        check_certificate(resp.cert, nodes)
+
+    def test_cross_worker_composed_fragment(self, rec, tmp_path):
+        """A fragment solved on one worker composes into a cert answered
+        by the OTHER worker: the SCC-local fingerprint ignores publicKeys
+        (PR 10 transplant), so two key-renamed twins share a fragment
+        while their snapshot fingerprints route to different workers —
+        and the composed cert still passes the unmodified checker."""
+        with _Fleet(tmp_path, n=2, store_dir=tmp_path / "store") as fleet:
+            base_nodes = majority_fbas(7, prefix="CWAAA")
+            base_w = fleet._ring.route(fingerprint_of(base_nodes))
+            other_nodes = None
+            for tag in ("CWBBB", "CWCCC", "CWDDD", "CWEEE", "CWFFF"):
+                cand = majority_fbas(7, prefix=tag)
+                if fleet._ring.route(fingerprint_of(cand)) != base_w:
+                    other_nodes = cand
+                    break
+            assert other_nodes is not None, "no prefix routed differently"
+            first = fleet.submit(base_nodes).result(timeout=60.0)
+            assert first.intersects is True
+            second = fleet.submit(other_nodes).result(timeout=60.0)
+        assert second.intersects is True
+        delta_stamp = second.cert["provenance"]["delta"]
+        # Composed from the shared tier: the other worker never re-solved.
+        assert delta_stamp["reused_sccs"] == 1
+        assert delta_stamp["resolved_sccs"] == 0
+        check_certificate(second.cert, other_nodes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.store_hits", 0) >= 1
+
+    def test_duplicate_request_id_resolves_both(self, rec, tmp_path):
+        """A client reusing a request_id must not orphan the earlier
+        ticket (the serve contract answers every submission): both
+        tickets resolve, each under the client's own id."""
+        nodes = majority_fbas(5, prefix="DUP")
+        with _Fleet(tmp_path, n=2) as fleet:
+            t1 = fleet.submit(nodes, request_id="same-id")
+            t2 = fleet.submit(nodes, request_id="same-id")
+            r1 = t1.result(timeout=60.0)
+            r2 = t2.result(timeout=60.0)
+        assert r1.intersects is True and r2.intersects is True
+        assert r1.request_id == r2.request_id == "same-id"
+        counters, _ = rec.snapshot()
+        assert (counters.get("fleet.verdicts", 0)
+                + counters.get("fleet.errors", 0)) == 2
+
+    def test_zipfian_stream_parity(self, rec, tmp_path):
+        trace = churn_trace(majority_fbas(7, prefix="ZPF"), 14, seed=2,
+                            skew=1.1)
+        expected = {}
+        for snap in trace:
+            key = json.dumps(snap, sort_keys=True)
+            if key not in expected:
+                expected[key] = solve(snap, backend="python").intersects
+        with _Fleet(tmp_path, n=2) as fleet:
+            tickets = [(snap, fleet.submit(snap)) for snap in trace]
+            for snap, ticket in tickets:
+                got = ticket.result(timeout=60.0).intersects
+                assert got is expected[json.dumps(snap, sort_keys=True)]
+
+
+# ---------------------------------------------------------------------------
+# failover
+
+
+class TestFailover:
+    def _journal_with_matrix(self, tmp_path, pending_nodes, done_nodes):
+        """A dead worker's journal: two pending reqs, one done pair, one
+        mid-file corrupt line, one torn tail."""
+        path = tmp_path / "dead.journal"
+        journal = RequestJournal(path)
+        journal.append_request(
+            "pend-a", fingerprint_of(pending_nodes[0]), pending_nodes[0],
+            None,
+        )
+        journal.append_request(
+            "done-b", fingerprint_of(done_nodes), done_nodes, None,
+        )
+        journal.append_done("done-b", fingerprint_of(done_nodes),
+                            "verdict", True)
+        journal.append_request(
+            "pend-c", fingerprint_of(pending_nodes[1]), pending_nodes[1],
+            None,
+        )
+        journal.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"kind": "req", "request_id": "torn-tail", "nod\n')
+        return path
+
+    def test_journal_inheritance_matrix(self, rec, tmp_path):
+        """Pending entries re-solve on a peer exactly once; done entries
+        never replay (zero duplicated); the torn tail is tolerated."""
+        pend = [majority_fbas(5, prefix="INH0"),
+                majority_fbas(5, broken=True, prefix="INH1")]
+        done = majority_fbas(5, prefix="INH2")
+        path = self._journal_with_matrix(tmp_path, pend, done)
+        with _Fleet(tmp_path, n=2) as fleet:
+            replayed = fleet.adopt_journal(path)
+            assert replayed == 2  # pend-a + pend-c; done-b skipped
+            got = _wait_counter(rec, "fleet.replayed_verdicts", 2)
+            assert got == 2
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.replays", 0) == 2
+
+    def test_kill_one_local_rerouted(self, rec, tmp_path):
+        """In-flight requests of a killed worker re-route to the survivor
+        and every ticket still resolves with the oracle verdict."""
+        snaps = [majority_fbas(n, broken=b, prefix="KLL")
+                 for n in (5, 7, 9) for b in (False, True)]
+        expected = [solve(s, backend="python").intersects for s in snaps]
+        with _Fleet(tmp_path, n=2, batch_max=2) as fleet:
+            tickets = [fleet.submit(s) for s in snaps]
+            fleet.kill_worker(fleet.worker_ids()[0], evict=True)
+            got = [t.result(timeout=60.0).intersects for t in tickets]
+        assert got == expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.evictions", 0) == 1
+
+    @pytest.mark.slow
+    def test_kill_one_subprocess_sigkill(self, rec, tmp_path):
+        """The real thing: subprocess workers, a mid-stream SIGKILL, the
+        dead worker's journal inherited by its peer — zero lost, every
+        verdict oracle-equal."""
+        trace = churn_trace(majority_fbas(9, prefix="SGK"), 9, seed=4)
+        expected = [solve(s, backend="python").intersects for s in trace]
+        fleet = FleetEngine(
+            2, backend="python", worker_mode="subprocess",
+            journal_dir=tmp_path / "proc", probe_interval_s=0.2,
+        )
+        fleet.start()
+        try:
+            tickets = [fleet.submit(s) for s in trace[:6]]
+            fleet.kill_worker(fleet.worker_ids()[0])  # real SIGKILL
+            tickets += [fleet.submit(s) for s in trace[6:]]
+            got = [t.result(timeout=120.0).intersects for t in tickets]
+        finally:
+            fleet.stop(drain=True, timeout=60.0)
+        assert got == expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.evictions", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# fault points: typed or oracle-equal
+
+
+class TestFleetFaultPoints:
+    def _stream_parity(self, fleet, snaps, expected):
+        outcomes = []
+        for snap in snaps:
+            try:
+                ticket = fleet.submit(snap)
+            except (ServeError, faults.FaultInjected) as exc:
+                outcomes.append(("typed", type(exc).__name__))
+                continue
+            try:
+                outcomes.append(("ok", ticket.result(timeout=60.0).intersects))
+            except (ServeError, faults.FaultInjected) as exc:
+                outcomes.append(("typed", type(exc).__name__))
+        for (kind, value), want in zip(outcomes, expected):
+            if kind == "ok":
+                assert value is want
+        return outcomes
+
+    def _snaps(self):
+        snaps = [majority_fbas(n, broken=b, prefix="FLT")
+                 for n in (5, 7) for b in (False, True)]
+        return snaps, [solve(s, backend="python").intersects for s in snaps]
+
+    def test_route_fault_degrades_to_first_live(self, rec, tmp_path):
+        snaps, expected = self._snaps()
+        faults.install_plan(faults.parse_faults("fleet.route=error@1+"))
+        with _Fleet(tmp_path, n=2) as fleet:
+            outcomes = self._stream_parity(fleet, snaps, expected)
+        assert all(kind == "ok" for kind, _ in outcomes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.route_errors", 0) >= len(snaps)
+
+    def test_store_fault_degrades_to_local_lru(self, rec, tmp_path):
+        snaps, expected = self._snaps()
+        faults.install_plan(faults.parse_faults("fleet.store=error@1+"))
+        with _Fleet(tmp_path, n=2, store_dir=tmp_path / "store") as fleet:
+            outcomes = self._stream_parity(fleet, snaps, expected)
+        assert all(kind == "ok" for kind, _ in outcomes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.store_errors", 0) >= 1
+
+    def test_probe_fault_never_evicts(self, rec, tmp_path):
+        snaps, expected = self._snaps()
+        faults.install_plan(faults.parse_faults("fleet.probe=error@1+"))
+        with _Fleet(tmp_path, n=2, probe_interval_s=0.05) as fleet:
+            _wait_counter(rec, "fleet.probe_errors", 2, timeout=5.0)
+            outcomes = self._stream_parity(fleet, snaps, expected)
+            assert len(fleet.worker_ids()) == 2  # nobody spuriously evicted
+        assert all(kind == "ok" for kind, _ in outcomes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.probe_errors", 0) >= 2
+        assert counters.get("fleet.evictions", 0) == 0
+
+    def test_replay_fault_degrades_to_inflight_reroute(self, rec, tmp_path):
+        """An unreadable dead journal costs the journal-only orphans, not
+        the in-flight tickets: clients still resolve oracle-equal."""
+        pend = majority_fbas(5, prefix="RPL")
+        journal = RequestJournal(tmp_path / "dead.journal")
+        journal.append_request("orphan", fingerprint_of(pend), pend, None)
+        journal.close()
+        snaps, expected = self._snaps()
+        faults.install_plan(faults.parse_faults("fleet.replay=error@1"))
+        with _Fleet(tmp_path, n=2) as fleet:
+            replayed = fleet.adopt_journal(journal.path)
+            assert replayed == 0  # degraded: journal skipped, loudly
+            outcomes = self._stream_parity(fleet, snaps, expected)
+        assert all(kind == "ok" for kind, _ in outcomes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.replay_errors", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# forced interleavings
+
+
+class TestFleetSchedules:
+    def test_forced_interleavings_clean(self, rec):
+        from tools.analyze.schedules import run_fleet_schedules
+
+        results = run_fleet_schedules()
+        assert len(results) == 4
+        for r in results:
+            assert r.ok, f"{r.schedule} on {r.topology}: {r.error}"
+
+
+# ---------------------------------------------------------------------------
+# transport split
+
+
+class TestTransports:
+    def test_socket_roundtrip_and_ping(self, rec):
+        nodes = majority_fbas(5, prefix="SCK")
+        engine = ServeEngine(backend="python")
+        engine.start()
+        server = SocketServeServer(engine, port=0)
+        try:
+            with socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=10.0) as conn:
+                fh = conn.makefile("rw", encoding="utf-8")
+                fh.write(json.dumps({"ping": "t1"}) + "\n")
+                fh.flush()
+                pong = json.loads(fh.readline())
+                assert pong["pong"] == "t1" and pong["ready"] is True
+                fh.write(json.dumps(
+                    {"request_id": "sock-1", "nodes": nodes}
+                ) + "\n")
+                fh.flush()
+                resp = json.loads(fh.readline())
+                assert resp["request_id"] == "sock-1"
+                assert resp["verdict"] is True
+                assert "cert" not in resp  # emit_certs off by default
+        finally:
+            server.stop()
+            engine.stop(drain=True, timeout=30.0)
+
+    def test_fleet_cli_smoke_local_workers(self, rec, tmp_path):
+        """The `fleet` subcommand over in-process workers: same JSONL
+        contract as serve (module-level, no subprocess spawn)."""
+        import io
+        import sys
+
+        from quorum_intersection_tpu.fleet import fleet_main
+
+        lines = [json.dumps({"request_id": f"r{i}",
+                             "nodes": majority_fbas(5, prefix="CLI")})
+                 for i in range(3)]
+        old_in, old_out = sys.stdin, sys.stdout
+        sys.stdin = io.StringIO("\n".join(lines) + "\n")
+        sys.stdout = io.StringIO()
+        try:
+            rc = fleet_main([
+                "-n", "2", "--backend", "python", "--local-workers",
+                "--journal-dir", str(tmp_path / "cli"),
+            ])
+            out = sys.stdout.getvalue()
+        finally:
+            sys.stdin, sys.stdout = old_in, old_out
+        assert rc == 0
+        responses = [json.loads(ln) for ln in out.splitlines()]
+        assert responses[0]["kind"] == "fleet"
+        verdicts = {r["request_id"]: r["verdict"]
+                    for r in responses if "verdict" in r}
+        assert verdicts == {"r0": True, "r1": True, "r2": True}
+
+
+# ---------------------------------------------------------------------------
+# zipfian churn skew (fbas/synth.py satellite)
+
+
+class TestChurnSkew:
+    def test_default_skew_is_byte_identical(self):
+        base = majority_fbas(7, prefix="SKW")
+        a = churn_trace(base, 10, seed=3)
+        b = churn_trace(base, 10, seed=3, skew=0.0)
+        assert json.dumps(a) == json.dumps(b)
+
+    def test_skew_deterministic_with_revisits(self):
+        base = majority_fbas(7, prefix="SKW")
+        a = churn_trace(base, 30, seed=3, skew=1.1)
+        b = churn_trace(base, 30, seed=3, skew=1.1)
+        assert json.dumps(a) == json.dumps(b)
+        assert len(a) == 31
+        dumps = [json.dumps(s) for s in a]
+        assert len(set(dumps)) < len(dumps)  # hot keys actually repeat
+
+    def test_revisit_metas_point_at_identical_snapshots(self):
+        base = majority_fbas(7, prefix="SKW")
+        trace, metas = churn_trace_steps(base, 20, seed=5, skew=1.2)
+        revisits = [m for m in metas if "revisit_of" in m]
+        assert revisits, "skew=1.2 over 20 steps produced no revisit"
+        for meta in revisits:
+            assert meta["mutations"] == []
+            assert meta["affected_scc_ids"] == []
+            assert json.dumps(trace[meta["step"]]) \
+                == json.dumps(trace[meta["revisit_of"]])
+
+    def test_negative_skew_rejected(self):
+        with pytest.raises(ValueError):
+            churn_trace(majority_fbas(5), 2, skew=-0.5)
+
+
+# ---------------------------------------------------------------------------
+# fleet-aware health endpoints
+
+
+class TestFleetHealth:
+    def test_healthz_carries_fleet_gauges(self, rec, tmp_path):
+        with _Fleet(tmp_path, n=2, probe_interval_s=0.05,
+                    store_dir=tmp_path / "store") as fleet:
+            fleet.submit(majority_fbas(5, prefix="HLZ")).result(timeout=60.0)
+            _wait_counter(rec, "fleet.routed", 1)
+            import time
+
+            time.sleep(0.2)  # a probe cycle refreshes the aggregates
+            payload = healthz_payload()
+            assert payload["fleet_workers_live"] == 2
+            assert payload["fleet_ring_size"] == 2
+
+    def test_readyz_503_while_fleet_replays(self, rec):
+        rec.gauge("fleet.replay_complete", 0)
+        payload, status = readyz_payload()
+        assert status == 503 and payload["status"] == "replaying"
+        assert payload["fleet_replay_complete"] is False
+        rec.gauge("fleet.replay_complete", 1)
+        payload, status = readyz_payload()
+        assert status == 200 and payload["fleet_replay_complete"] is True

@@ -141,6 +141,41 @@ def _race_pass(root: Path) -> tuple:
             f"interleavings, verdicts identical to the one-shot pipeline"
         )
 
+    # qi-fleet schedules (ISSUE 11): the front door's routing/eviction/
+    # replay orderings, forced through fleet._fleet_sync the same way the
+    # delta orderings go through delta._delta_sync.
+    from tools.analyze.schedules import run_fleet_schedules
+
+    try:
+        fleet_results = run_fleet_schedules()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/fleet.py",
+            line=1, message=str(exc),
+        ))
+        fleet_results = []
+    for r in fleet_results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (one-shot pipeline says "
+                f"{r.expected})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/fleet.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if fleet_results:
+        notes.append(
+            f"fleet schedules: {len(fleet_results)} forced routing/failover "
+            f"interleavings, exactly-once outcomes identical to the "
+            f"one-shot pipeline"
+        )
+
     from quorum_intersection_tpu.backends.cpp import build_native_cli
 
     try:

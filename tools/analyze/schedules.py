@@ -29,9 +29,11 @@ Used by ``python -m tools.analyze`` (race pass) and
 
 from __future__ import annotations
 
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Callable, Dict, List, Optional
 
 # Bounded waits everywhere: a schedule that deadlocks fails loudly with the
@@ -605,6 +607,177 @@ def run_delta_schedules() -> List[ScheduleResult]:
         expected = solve(data, backend="python").intersects
         for schedule in DELTA_SCHEDULES:
             results.append(_run_delta_one(schedule, data, expected, topology))
+    return results
+
+
+# ---- qi-fleet schedules (ISSUE 11) ------------------------------------------
+#
+# The fleet front door adds a third concurrency surface: routing decisions
+# racing ring eviction, and dead-worker journal replay racing new client
+# requests for the inherited hash range.  ``fleet._fleet_sync`` is the
+# hook, exactly like ``serve._serve_sync``; workers run in-process
+# (LocalWorker) so the orderings are forced in milliseconds.
+
+FLEET_SCHEDULES = (
+    "fleet_route_during_eviction",
+    "fleet_replay_races_new_request",
+)
+
+_REQUIRED_FLEET_POINTS: Dict[str, tuple] = {
+    # the submit must have resolved its route BEFORE the eviction finished
+    # removing that worker from the ring (the dispatch then lands on a
+    # dead worker and must re-route, or the failover re-dispatches it).
+    "fleet_route_during_eviction": ("route.resolved", "evict.removed"),
+    # the failover replay must have started before the new request routed,
+    # and both must complete (replay.done) with exactly one outcome each.
+    "fleet_replay_races_new_request": (
+        "replay.begin", "route.resolved", "replay.done",
+    ),
+}
+
+
+def _run_fleet_one(schedule: str, data: object, expected: bool,
+                   topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.fleet as fleet_mod
+    from quorum_intersection_tpu.fleet import FleetEngine
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.serve import (
+        RequestJournal,
+        snapshot_fingerprint,
+    )
+
+    ctl = SyncController()
+    verdict: Optional[bool] = None
+    error: Optional[str] = None
+    old_sync = fleet_mod._fleet_sync
+    fleet_mod._fleet_sync = ctl
+    engine: Optional[FleetEngine] = None
+    tmp = tempfile.TemporaryDirectory(prefix="qi-fleet-sched-")
+    try:
+        engine = FleetEngine(
+            2, backend="python", worker_mode="local",
+            journal_dir=tmp.name, probe_interval_s=60.0,
+        )
+        engine.start()
+        fp = snapshot_fingerprint(build_graph(parse_fbas(data)))
+        target = engine._ring.route(fp)
+        if schedule == "fleet_route_during_eviction":
+            # The submit resolves its route to `target`, then parks; the
+            # eviction completes meanwhile (ring shrinks, pending requests
+            # fail over).  The parked dispatch must NOT deliver to the
+            # dead worker — the request still resolves exactly once with
+            # the correct verdict, via the failover or the re-route loop.
+            ctl.hold("route.resolved", ctl.reached_event("evict.removed"))
+            box: Dict[str, object] = {}
+
+            def _submit() -> None:
+                try:
+                    box["ticket"] = engine.submit(data)
+                except Exception as exc:  # noqa: BLE001 — the failure IS the observable
+                    box["error"] = exc
+
+            # qi-lint: allow(cancel-token-plumbed) — bounded schedule thread, joined below
+            t = threading.Thread(target=_submit, daemon=True)
+            t.start()
+            if not ctl.reached_event("route.resolved").wait(WAIT_S):
+                raise ScheduleError("submit never resolved a route")
+            engine.kill_worker(target, evict=True)
+            t.join(WAIT_S)
+            if t.is_alive():
+                raise ScheduleError("submit thread never returned")
+            if "error" in box:
+                error = f"submit raised {box['error']!r}"
+            else:
+                res = box["ticket"].result(WAIT_S)  # type: ignore[union-attr]
+                verdict = res.intersects
+        elif schedule == "fleet_replay_races_new_request":
+            # A crashed predecessor's journal holds a pending request for
+            # fingerprint X; while its failover replay is parked, a NEW
+            # client request for the same X arrives and routes.  Released,
+            # the replay re-solves the journaled request on the inheriting
+            # peer — the new request must resolve exactly once with the
+            # correct verdict and the replayed one must be counted, never
+            # duplicated onto the client.
+            journal = RequestJournal(Path(tmp.name) / "crashed.journal")
+            journal.append_request("ghost-1", fp, data, None)
+            journal.close()
+            ctl.hold("replay.begin", ctl.reached_event("route.resolved"))
+            box2: Dict[str, object] = {}
+
+            def _adopt() -> None:
+                try:
+                    box2["replayed"] = engine.adopt_journal(journal.path)
+                except Exception as exc:  # noqa: BLE001 — the failure IS the observable
+                    box2["error"] = exc
+
+            # qi-lint: allow(cancel-token-plumbed) — bounded schedule thread, joined below
+            t = threading.Thread(target=_adopt, daemon=True)
+            t.start()
+            if not ctl.reached_event("replay.begin").wait(WAIT_S):
+                raise ScheduleError("adopt_journal never began replaying")
+            ticket = engine.submit(data)
+            res = ticket.result(WAIT_S)
+            verdict = res.intersects
+            t.join(WAIT_S)
+            if t.is_alive():
+                raise ScheduleError("replay thread never returned")
+            if "error" in box2:
+                error = f"adopt_journal raised {box2['error']!r}"
+            elif box2.get("replayed") != 1:
+                error = (
+                    f"journal replay count {box2.get('replayed')!r} != 1 "
+                    f"(pending ghost entry not inherited exactly once)"
+                )
+        else:
+            raise ValueError(f"unknown fleet schedule {schedule!r}")
+    finally:
+        fleet_mod._fleet_sync = old_sync
+        if engine is not None:
+            engine.stop(drain=True, timeout=WAIT_S)
+        tmp.cleanup()
+    missing = [
+        p for p in _REQUIRED_FLEET_POINTS[schedule] if p not in ctl.trace
+    ]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=bool(verdict),
+        expected=expected,
+        winner="fleet",
+        oracle_outcome="-",
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+def run_fleet_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
+    """Every fleet schedule × {intersecting, broken} topology; ground truth
+    from the one-shot pipeline, the differential contract the fleet front
+    door is held to everywhere.  Leaked drain threads are a failure."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend="python").intersects
+        for schedule in FLEET_SCHEDULES:
+            results.append(_run_fleet_one(schedule, data, expected, topology))
+    leaked = [
+        t for t in threading.enumerate() if t.name == "qi-serve-drain"
+    ]
+    for t in leaked:
+        t.join(timeout=join_timeout)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        raise ScheduleError(
+            f"{len(leaked)} serve drain thread(s) still alive after "
+            f"{join_timeout}s — a fleet schedule leaked a worker engine"
+        )
     return results
 
 

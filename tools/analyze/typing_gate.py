@@ -58,6 +58,12 @@ TYPING_TARGETS = (
     # the transplant unsoundness the fingerprint discipline exists to
     # prevent (fbas/diff.py rides the fbas directory target above).
     "quorum_intersection_tpu/delta.py",
+    # ISSUE 11: the fleet front door and the serve transport seam join
+    # the spine — a type error in routing/failover bookkeeping loses or
+    # duplicates a request, and one in the wire shape breaks every
+    # worker at once.
+    "quorum_intersection_tpu/fleet.py",
+    "quorum_intersection_tpu/serve_transport.py",
 )
 
 

@@ -102,6 +102,16 @@ TRACKED: Dict[str, str] = {
     "delta_scc_reuse_pct": "higher",
     "delta_resolve_ratio": "lower",
     "churn_verdicts_per_sec": "higher",
+    # qi-fleet replicated serve tier (ISSUE 11): benchmarks/serve.py
+    # --fleet rows.  Aggregate throughput and tail latency at the largest
+    # fleet size regress like their serve twins; `fleet_store_hit_pct`
+    # is the shared SCC-fragment tier's fleet-wide hit rate — a collapse
+    # to 0 under the same churn trace means the read-through tier died
+    # (or the fragment keying broke) and every worker silently re-solves
+    # alone.
+    "fleet_verdicts_per_sec": "higher",
+    "fleet_p99_ms": "lower",
+    "fleet_store_hit_pct": "higher",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -130,6 +140,10 @@ TELEMETRY_GAUGES = (
     "delta.scc_reuse_pct",
     "delta.store_size",
     "delta.bench_reuse_pct",
+    "fleet.workers_live",
+    "fleet.store_hit_pct",
+    "fleet.p99_ms",
+    "fleet.bench_verdicts_per_sec",
 )
 
 

@@ -165,6 +165,27 @@ env JAX_PLATFORMS=cpu python tools/soak.py --serve --chaos \
 ssrc=$?
 echo "SERVE_CHAOS=exit $ssrc"
 
+# qi-fleet smoke (ISSUE 11): the replicated serve tier — an N=2 fleet
+# parity gate over the zipfian churn stream (every routed verdict equals
+# the one-shot oracle, zero silent drops) including the dedicated
+# kill-one-of-N round (the dead worker's unfinished work must re-route to
+# its peer with zero lost / zero duplicated verdicts), then the fleet
+# chaos soak: seeded fleet.* fault schedules (routing, probing, failover
+# replay, shared store) with a kill-one round per even seed.  In-process
+# workers (--fleet-local) keep the smoke cheap inside the tier-1 wall
+# budget — the routing/failover paths are identical, and the
+# subprocess + real-SIGKILL + N=4 scaling coverage runs in the dedicated
+# tier1.yml `fleet` job (and the slow-marked test).
+env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick --fleet \
+    --fleet-n 1,2 --fleet-local
+frc=$?
+echo "FLEET_BENCH=exit $frc"
+env JAX_PLATFORMS=cpu python tools/soak.py --fleet --chaos \
+    --instances "${TIER1_FLEET_INSTANCES:-3}" \
+    --seed "${TIER1_FLEET_SEED:-0}" --no-ledger
+fsrc=$?
+echo "FLEET_CHAOS=exit $fsrc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -182,4 +203,6 @@ echo "TREND=exit $trc"
 [ "$prrc" -ne 0 ] && exit "$prrc"
 [ "$src" -ne 0 ] && exit "$src"
 [ "$ssrc" -ne 0 ] && exit "$ssrc"
+[ "$frc" -ne 0 ] && exit "$frc"
+[ "$fsrc" -ne 0 ] && exit "$fsrc"
 exit "$trc"

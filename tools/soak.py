@@ -68,6 +68,16 @@ rounds per seed:
    with verdicts still oracle-identical, and the round fails if the forced
    plan never fired (the differ path silently bypassed).
 
+**Fleet mode** (ISSUE 11): ``--fleet`` soaks the replicated serve tier
+(``quorum_intersection_tpu/fleet.py``): each seed drives a churn-trace
+stream through a live 2-worker fleet — with ``--chaos``, under a seeded
+fleet-tier fault schedule (``utils/faults.py sample_fleet_plan``: routing,
+probing, failover replay and the shared store tier are all drawable) —
+and even seeds additionally hard-kill one worker mid-stream so the ring
+eviction + journal failover path runs under the same contract: every
+request reaches exactly one outcome, the oracle verdict or a typed error,
+with zero lost and zero duplicated verdicts across the kill.
+
 Usage::
 
     python tools/soak.py                      # 40 instances from seed 0
@@ -75,6 +85,7 @@ Usage::
     python tools/soak.py --no-ledger          # dry run, don't record
     python tools/soak.py --chaos --instances 20 --seed 0
     python tools/soak.py --serve --chaos --instances 6 --seed 0
+    python tools/soak.py --fleet --chaos --instances 4 --seed 0
 """
 
 from __future__ import annotations
@@ -661,6 +672,127 @@ def serve_soak_main(args: argparse.Namespace) -> int:
     return 1 if bad else 0
 
 
+def run_fleet_chaos_instance(seed: int, workdir: pathlib.Path,
+                             chaos: bool) -> dict:
+    """Drive one churn-trace stream through a live 2-worker fleet under a
+    seeded fleet-tier fault schedule (``utils/faults.py
+    sample_fleet_plan`` — routing, probing, failover replay and the
+    shared store tier are all drawable), with a kill-one-of-N round on
+    even seeds; every request must reach exactly one outcome — the
+    oracle verdict or a typed error — across routing degrades, a dead
+    worker's journal failover, and a dead shared store tier."""
+    from quorum_intersection_tpu.fleet import FleetEngine
+    from quorum_intersection_tpu.serve import ServeError
+    from quorum_intersection_tpu.utils import faults
+
+    desc, stream, oracle = make_serve_traffic(seed)
+    faults.clear_plan()
+    plan = (
+        faults.install_plan(faults.sample_fleet_plan(seed)) if chaos else None
+    )
+    schedule_label = plan.label if plan is not None else "fault-free"
+    mismatches: list = []
+    typed_failures: list = []
+    served = 0
+    killed = False
+    engine = FleetEngine(
+        2, backend="python", worker_mode="local",
+        journal_dir=workdir / f"fleet-{seed}", probe_interval_s=0.2,
+        batch_max=3,
+    )
+    tickets = []
+    try:
+        engine.start()
+        kill_at = len(stream) // 2 if seed % 2 == 0 else None
+        for i, (rid, snap) in enumerate(stream):
+            if kill_at is not None and i == kill_at and engine.worker_ids():
+                engine.kill_worker(engine.worker_ids()[0], evict=True)
+                killed = True
+            try:
+                tickets.append((rid, engine.submit(snap, request_id=rid)))
+            except (ServeError, faults.FaultInjected, OSError) as exc:
+                typed_failures.append(f"{rid}: {type(exc).__name__}")
+        for rid, ticket in tickets:
+            try:
+                resp = ticket.result(timeout=60.0)
+            except TimeoutError:
+                mismatches.append(
+                    f"{rid}: SILENT DROP — no outcome 60s after submit "
+                    f"under {schedule_label}"
+                )
+                continue
+            except (ServeError, faults.FaultInjected, OSError) as exc:
+                typed_failures.append(f"{rid}: {type(exc).__name__}")
+                continue
+            except Exception as exc:  # noqa: BLE001 — an untyped crash IS a finding
+                mismatches.append(
+                    f"{rid}: UNTYPED {type(exc).__name__}: {exc} "
+                    f"under {schedule_label}"
+                )
+                continue
+            served += 1
+            if resp.intersects is not oracle[rid]:
+                mismatches.append(
+                    f"{rid}: SILENT verdict flip {resp.intersects} != "
+                    f"fault-free {oracle[rid]} under {schedule_label}"
+                )
+    finally:
+        engine.stop(drain=True, timeout=60.0)
+        faults.clear_plan()
+    fired = len(plan.fired) if plan is not None else 0
+    return {"seed": seed, "desc": desc, "schedule": schedule_label,
+            "fired": fired, "served": served, "killed_one": killed,
+            "typed_failures": typed_failures, "mismatches": mismatches}
+
+
+def fleet_soak_main(args: argparse.Namespace) -> int:
+    """--fleet driver: fleet-tier chaos (+ kill-one-of-N) per seed."""
+    t0 = time.time()
+    bad: list = []
+    total_fired = 0
+    total_typed = 0
+    total_served = 0
+    kill_rounds = 0
+    with tempfile.TemporaryDirectory(prefix="qi-fleet-soak-") as tmp:
+        workdir = pathlib.Path(tmp)
+        for i, seed in enumerate(range(args.seed, args.seed + args.instances)):
+            rec = run_fleet_chaos_instance(seed, workdir, chaos=args.chaos)
+            total_fired += rec["fired"]
+            total_typed += len(rec["typed_failures"])
+            total_served += rec["served"]
+            kill_rounds += int(rec["killed_one"])
+            if rec["mismatches"]:
+                bad.append(rec)
+                print(f"FLEET CHAOS MISMATCH seed={seed} {rec['desc']} "
+                      f"[{rec['schedule']}]: {rec['mismatches']}")
+            if (i + 1) % 5 == 0:
+                print(f"  ... {i + 1}/{args.instances} fleet instances "
+                      f"({time.time() - t0:.0f}s, {len(bad)} mismatches, "
+                      f"{total_fired} faults fired)", file=sys.stderr)
+    summary = {
+        "fleet": True,
+        "chaos": bool(args.chaos),
+        "window": [args.seed, args.seed + args.instances],
+        "instances": args.instances,
+        "kill_rounds": kill_rounds,
+        "n_mismatches": len(bad),
+        "mismatches": bad,
+        "faults_fired": total_fired,
+        "typed_failures": total_typed,
+        "served": total_served,
+        "seconds": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", "ambient"),
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "mismatches"}))
+    if not args.no_ledger:
+        ledger = load_ledger()
+        ledger.setdefault("fleet_runs", []).append(summary)
+        LEDGER.parent.mkdir(parents=True, exist_ok=True)
+        LEDGER.write_text(json.dumps(ledger, indent=1))
+        print(f"ledger: fleet run recorded -> {LEDGER}", file=sys.stderr)
+    return 1 if bad else 0
+
+
 def load_ledger() -> dict:
     if LEDGER.exists():
         return json.loads(LEDGER.read_text())
@@ -688,6 +820,15 @@ def main(argv=None) -> int:
                              "schedule (utils/faults.py) and assert the "
                              "verdict equals the fault-free sequential chain "
                              "or fails loudly with a typed error")
+    parser.add_argument("--fleet", action="store_true",
+                        help="soak the replicated fleet tier (fleet.py): "
+                             "churn-trace streams through a live 2-worker "
+                             "fleet (with --chaos: under seeded fleet.* "
+                             "fault schedules — routing, probing, failover "
+                             "replay, shared store) plus a kill-one-of-N "
+                             "round per even seed; oracle-equal verdicts "
+                             "or typed errors only, zero lost / zero "
+                             "duplicated across the kill")
     parser.add_argument("--serve", action="store_true",
                         help="soak the serving layer (serve.py) instead of "
                              "one-shot solves: churn-trace streams through a "
@@ -708,6 +849,8 @@ def main(argv=None) -> int:
 
         honor_platform_env()
 
+    if args.fleet:
+        return fleet_soak_main(args)
     if args.serve:
         return serve_soak_main(args)
     if args.chaos:
