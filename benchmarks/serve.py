@@ -159,6 +159,140 @@ def run_churn_phase(args, record) -> tuple:
     return row, mismatches
 
 
+def run_queries_phase(args, record) -> tuple:
+    """The qi-query mixed-workload phase (ISSUE 12): a stream mixing all
+    four typed query kinds through one live ServeEngine — the traffic
+    shape the query subsystem exists for — with every served verdict
+    parity-checked against a direct QueryEngine oracle resolution.
+    Returns ``(row_fields, mismatches)``; headline numbers are
+    ``query_verdicts_per_sec`` plus a per-kind breakdown
+    (``tools/bench_trend.py`` gates them)."""
+    from quorum_intersection_tpu.fbas import synth
+    from quorum_intersection_tpu.query import Query, QueryEngine
+    from quorum_intersection_tpu.serve import ServeEngine, ServeError
+
+    n_each = 5 if args.quick else 15
+    base = synth.majority_fbas(max(args.nodes, 7), prefix="QRY")
+    fa_ok, fb_ok = synth.two_family_preset(
+        core=8, watchers=3, seed=args.seed,
+    )
+    fa_bad, fb_bad = synth.two_family_preset(
+        core=8, watchers=3, broken=True, seed=args.seed,
+    )
+    metrics = ("top_tier", "pagerank", "blocking_set", "splitting_set")
+    workload = []  # (kind, nodes, raw_query)
+    for i in range(n_each):
+        workload.append(("intersection", base, None))
+        if i % 2:
+            workload.append(
+                ("relaxed", fa_ok,
+                 {"kind": "relaxed", "family_b": fb_ok}))
+        else:
+            workload.append(
+                ("relaxed", fa_bad,
+                 {"kind": "relaxed", "family_b": fb_bad}))
+        workload.append(("whatif", base, {"kind": "whatif", "max_k": 1}))
+        workload.append(
+            ("analytics", base,
+             {"kind": "analytics", "metric": metrics[i % len(metrics)]}))
+
+    # Oracle verdicts per DISTINCT (snapshot, query): direct QueryEngine
+    # resolution on the python rung — the parity bar.
+    oracle = QueryEngine(backend="python")
+    expected = {}
+    for kind, nodes, raw in workload:
+        key = json.dumps([nodes, raw], sort_keys=True, default=str)
+        if key not in expected:
+            expected[key] = oracle.resolve(
+                nodes, Query.parse(raw)
+            ).verdict
+
+    engine = ServeEngine(
+        backend=args.backend, cache_max=args.cache_max,
+        queue_depth=len(workload) + 8, batch_max=args.batch_max,
+    )
+    engine.start()
+    tickets = []
+    typed_errors = 0
+    t0 = time.perf_counter()
+    with record.span("serve.bench_queries", requests=len(workload)):
+        for i, (kind, nodes, raw) in enumerate(workload):
+            target = t0 + i / args.rate
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                tickets.append(
+                    (i, kind, engine.submit(nodes, query=raw))
+                )
+            except (ServeError, ValueError) as exc:
+                typed_errors += 1
+                print(f"query typed admission error at {i} ({kind}): "
+                      f"{exc}", file=sys.stderr)
+        engine.stop(drain=True, timeout=600.0)
+    wall_s = time.perf_counter() - t0
+
+    served = {k: 0 for k in ("intersection", "relaxed", "whatif",
+                             "analytics")}
+    mismatches = []
+    for i, kind, ticket in tickets:
+        knd, nodes, raw = workload[i]
+        try:
+            resp = ticket.result(timeout=120.0)
+        except ServeError as exc:
+            typed_errors += 1
+            print(f"query typed error at {i} ({kind}): {exc}",
+                  file=sys.stderr)
+            continue
+        except TimeoutError:
+            mismatches.append(f"query step {i} ({kind}): no outcome "
+                              f"(silent drop)")
+            continue
+        served[kind] += 1
+        key = json.dumps([nodes, raw], sort_keys=True, default=str)
+        if resp.intersects is not expected[key]:
+            mismatches.append(
+                f"query step {i} ({kind}): served {resp.intersects} != "
+                f"oracle {expected[key]}"
+            )
+        if kind != "intersection" and resp.result is None:
+            mismatches.append(
+                f"query step {i} ({kind}): typed query answered without "
+                f"a result payload"
+            )
+    # No silent caps: this phase injects no faults, so EVERY submitted
+    # query of every kind must actually serve — a typed error here means
+    # part of the workload escaped the parity check, which must fail the
+    # gate rather than shrink its coverage.
+    for kind, count in served.items():
+        if count != n_each:
+            mismatches.append(
+                f"query phase: only {count}/{n_each} {kind} queries "
+                f"served — the rest were never parity-checked"
+            )
+    if typed_errors:
+        mismatches.append(
+            f"query phase: {typed_errors} typed error(s) in a fault-free "
+            f"run"
+        )
+    total = sum(served.values())
+    row = {
+        "query_requests": len(workload),
+        "query_served": total,
+        "query_typed_errors": typed_errors,
+        "query_verdicts_per_sec": (
+            round(total / wall_s, 2) if wall_s > 0 else 0.0
+        ),
+    }
+    for kind, count in served.items():
+        row[f"query_{kind}_per_sec"] = (
+            round(count / wall_s, 2) if wall_s > 0 else 0.0
+        )
+    record.gauge("query.bench_verdicts_per_sec",
+                 row["query_verdicts_per_sec"])
+    return row, mismatches
+
+
 def run_fleet_phase(args, record) -> tuple:
     """The qi-fleet phase (ISSUE 11): the same zipfian churn stream driven
     through replicated fleets at N ∈ ``--fleet-n``, measuring aggregate
@@ -385,6 +519,15 @@ def main(argv=None) -> int:
     parser.add_argument("--churn-steps", type=int, default=None,
                         help="churn-phase trace length (default: "
                              "min(requests, 60))")
+    parser.add_argument("--queries", action="store_true",
+                        help="append the qi-query mixed-workload phase "
+                             "(ISSUE 12): a stream mixing intersection / "
+                             "relaxed two-family / whatif / analytics "
+                             "queries through one engine, every served "
+                             "verdict parity-checked against a direct "
+                             "QueryEngine oracle — measures "
+                             "query_verdicts_per_sec per kind "
+                             "(tools/bench_trend.py gates them)")
     parser.add_argument("--fleet", action="store_true",
                         help="append the qi-fleet phase (ISSUE 11): the "
                              "same zipfian churn stream through replicated "
@@ -537,6 +680,11 @@ def main(argv=None) -> int:
         mismatches.extend(churn_mismatches)
         # The persisted row must agree with the exit code: a churn-phase
         # parity failure flips verdict_ok too, not just the return value.
+        row["verdict_ok"] = not mismatches
+    if args.queries:
+        query_row, query_mismatches = run_queries_phase(args, record)
+        row.update(query_row)
+        mismatches.extend(query_mismatches)
         row["verdict_ok"] = not mismatches
     if args.fleet:
         fleet_row, fleet_mismatches = run_fleet_phase(args, record)

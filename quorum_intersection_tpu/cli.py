@@ -27,10 +27,14 @@ sinks — docs/OBSERVABILITY.md).
 Subcommands (this framework only): ``serve`` — the long-lived
 snapshot-stream serving layer (``serve.py``, README §Serving): one JSON
 request per stdin line, one JSON response per stdout line, with admission
-control, deadlines, load shedding and a crash-only request journal; and
+control, deadlines, load shedding and a crash-only request journal;
 ``fleet`` — the replicated serve tier (``fleet.py``, README §Fleet): the
 same JSONL contract fanned across N serve workers behind a
-consistent-hash front door with journal-backed failover.
+consistent-hash front door with journal-backed failover; and ``query`` —
+a one-shot typed query (``query.py``, README §Queries): relaxed
+two-family intersection, what-if removal sweeps, or analytics over a
+snapshot on stdin (the same kinds the serve/fleet protocols accept via
+the ``"query"`` request field).
 """
 
 from __future__ import annotations
@@ -177,6 +181,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from quorum_intersection_tpu.fleet import fleet_main
 
         return fleet_main(arglist[1:])
+    if arglist and arglist[0] == "query":
+        # One-shot typed query (ISSUE 12): relaxed two-family
+        # intersection / what-if removal sweep / analytics over the
+        # snapshot on stdin — the stream twin is the serve/fleet
+        # protocols' "query" request field (query.py owns flags and exit
+        # semantics, like serve above).
+        from quorum_intersection_tpu.query import query_main
+
+        return query_main(arglist[1:])
     parser = build_parser()
     args = parser.parse_args(arglist)
 

@@ -75,7 +75,7 @@ from quorum_intersection_tpu.pipeline import (
     check_many,
     scan_scc_quorums,
 )
-from quorum_intersection_tpu.utils.env import qi_env_int
+from quorum_intersection_tpu.utils.env import qi_env_float, qi_env_int
 from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
@@ -200,8 +200,15 @@ class SharedSccStore:
     validation is treated as a miss, not trusted.
     """
 
-    def __init__(self, root: Union[str, Path]) -> None:
+    def __init__(self, root: Union[str, Path],
+                 max_mb: Optional[float] = None) -> None:
         self.root = Path(root)
+        # Compaction budget (ROADMAP follow-up: the fragment directory
+        # grows without bound).  <= 0 keeps the pre-GC unbounded behavior.
+        self.max_bytes = int(
+            (max_mb if max_mb is not None
+             else qi_env_float("QI_FLEET_STORE_MAX_MB", 0.0)) * 1024 * 1024
+        )
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
@@ -271,7 +278,52 @@ class SharedSccStore:
             except OSError:
                 pass
             return False
+        self._maybe_gc()
         return True
+
+    def _maybe_gc(self) -> None:
+        """LRU-by-mtime sweep on publish (``QI_FLEET_STORE_MAX_MB``):
+        while the fragment directory exceeds its size budget the stalest
+        fragments are deleted — LOUD (``delta.store_evictions`` counter +
+        ``delta.store_gc`` event), and an evicted fragment costs a future
+        re-solve on a miss, never a verdict: a concurrent reader of a
+        just-deleted file sees FileNotFoundError, which is already a
+        plain miss."""
+        if self.max_bytes <= 0:
+            return
+        try:
+            files = sorted(
+                (p.stat().st_mtime, p.stat().st_size, str(p), p)
+                for p in self.root.glob("*.json")
+            )
+        except OSError:
+            return
+        total = sum(size for _, size, _, _ in files)
+        if total <= self.max_bytes:
+            return
+        evicted = 0
+        for _, size, _, path in files:
+            try:
+                path.unlink()
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            if total <= self.max_bytes:
+                break
+        if evicted:
+            rec = get_run_record()
+            rec.add("delta.store_evictions", evicted)
+            rec.event(
+                "delta.store_gc", evicted=evicted,
+                remaining_bytes=max(total, 0),
+                budget_bytes=self.max_bytes,
+            )
+            log.warning(
+                "shared store over its %d-byte budget; %d stalest "
+                "fragment(s) evicted (they re-solve on next miss)",
+                self.max_bytes, evicted,
+            )
 
 
 def _encode_verdict(verdict: SccVerdict) -> Dict[str, object]:

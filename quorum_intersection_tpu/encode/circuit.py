@@ -465,6 +465,42 @@ def restrict_circuit_pair(circuit: Circuit, scc: List[int]) -> tuple:
     return build(False), build(True)
 
 
+def restrict_two_family(
+    circuit_a: Circuit, circuit_b: Circuit, scc: List[int]
+) -> tuple:
+    """Two-circuit restriction for the relaxed two-family query (qi-query,
+    Fast Flexible Paxos arXiv:2008.02671): project BOTH families' circuits
+    onto the same SCC columns in the same member order —
+    ``(a_scoped, b_scoped, b_q6)``.
+
+    Both circuits must be encoded over the identical node set (the
+    two-family contract: one vertex order, two quorum-set families), so
+    one ``scc`` index list projects both.  ``a_scoped`` is family A's
+    candidate-scoped restriction — the enumeration side: the greatest
+    A-quorum inside a window mask is one :func:`max_quorum_np` fixpoint,
+    vectorizable over whole window batches.  ``b_scoped`` is family B's
+    scoped twin — the FAST overlap guard: a B-quorum found inside
+    ``scc ∖ qa`` under scoped availability is a real B-quorum (scoped
+    availability only under-approximates), so a nonempty scoped fixpoint
+    is an immediate disjointness witness without leaving the restricted
+    coordinates.  ``b_q6`` is B's whole-graph-availability fold — the
+    sound SLOW guard's device twin for B-quorums that lean on nodes
+    outside the SCC (the host ``cross_family_disjoint_quorum`` remains
+    the reference the kernels are differentially tested against).
+
+    Same equivalence contract as :func:`restrict_circuit_pair` (which
+    this composes), pinned per family by ``tests/test_qi_query.py``.
+    """
+    if circuit_a.n != circuit_b.n:
+        raise ValueError(
+            f"two-family circuits must share one node set; got "
+            f"{circuit_a.n} != {circuit_b.n} nodes"
+        )
+    a_scoped, _a_q6 = restrict_circuit_pair(circuit_a, scc)
+    b_scoped, b_q6 = restrict_circuit_pair(circuit_b, scc)
+    return a_scoped, b_scoped, b_q6
+
+
 def node_sat_np(circuit: Circuit, avail: np.ndarray) -> np.ndarray:
     """NumPy reference evaluator: which nodes have a satisfied slice?
 

@@ -24,7 +24,7 @@ these functions.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph
 
@@ -110,6 +110,76 @@ def max_quorum(
     for v in removed:
         avail[v] = True
     return nodes
+
+
+def cross_family_disjoint_quorum(
+    graph_b: TrustGraph, exclude: Sequence[int]
+) -> List[int]:
+    """Greatest family-B quorum avoiding ``exclude`` — the cross-family
+    overlap guard of the relaxed two-family intersection query (qi-query,
+    Fast Flexible Paxos arXiv:2008.02671: fast-vs-classic quorum safety
+    reduces to "no A-quorum is disjoint from every B-quorum").
+
+    One polynomial fixpoint over family B's graph with the candidate
+    A-quorum's members unavailable: nonempty means the pair ``(exclude ∩
+    A-quorum, result)`` is a disjoint cross-family witness.  Both graphs
+    must index the same node set (same vertex order — the two-family
+    contract ``query.py`` enforces at parse time).
+    """
+    banned = set(exclude)
+    candidates = [v for v in range(graph_b.n) if v not in banned]
+    avail = [v not in banned for v in range(graph_b.n)]
+    return max_quorum(graph_b, candidates, avail)
+
+
+def relaxed_disjoint_witness(
+    graph_a: TrustGraph,
+    graph_b: TrustGraph,
+    members: Sequence[int],
+) -> Tuple[Optional[List[int]], Optional[List[int]], int]:
+    """Cross-family disjointness search (host oracle): find an A-quorum
+    and a B-quorum over the same node set that do NOT intersect, or prove
+    none exists among A-quorums inside ``members``.
+
+    Enumerates every subset ``S`` of ``members`` (the quorum-bearing SCC
+    of family A — all minimal A-quorums live inside it, exactly the
+    argument the single-family sweep rests on); per window the greatest
+    A-quorum within ``S`` is one fixpoint, and each *distinct* nonempty
+    A-quorum runs the :func:`cross_family_disjoint_quorum` B-side guard
+    once (memoized — many windows collapse to the same greatest quorum).
+    Returns ``(qa, qb, windows_enumerated)`` with ``qa``/``qb`` None when
+    every A-quorum meets every B-quorum.
+
+    Unlike the single-family search there is no complement symmetry (the
+    B-side quorum is not confined to ``members`` under whole-graph
+    availability), so all ``2^m - 1`` nonempty windows are enumerated
+    rather than ``2^(m-1)`` — the certificate ledger records exactly
+    that space and the checker re-verifies the arithmetic
+    (docs/PARITY.md §Two-family invariants).
+    """
+    nodes = list(members)
+    m = len(nodes)
+    avail = [False] * graph_a.n
+    enumerated = 0
+    seen: Dict[frozenset, bool] = {}
+    for window in range(1, 1 << m):
+        enumerated += 1
+        chosen = [nodes[i] for i in range(m) if window >> i & 1]
+        for v in chosen:
+            avail[v] = True
+        qa = max_quorum(graph_a, chosen, avail)
+        for v in chosen:
+            avail[v] = False
+        if not qa:
+            continue
+        key = frozenset(qa)
+        if key in seen:
+            continue
+        qb = cross_family_disjoint_quorum(graph_b, qa)
+        seen[key] = bool(qb)
+        if qb:
+            return sorted(qa), sorted(qb), enumerated
+    return None, None, enumerated
 
 
 def is_quorum(graph: TrustGraph, members: Sequence[int]) -> bool:

@@ -253,6 +253,132 @@ def near_disjoint_cores(
     return nodes
 
 
+def nested_hierarchy(
+    n_nodes: int,
+    *,
+    core_orgs: int = 5,
+    per_org: int = 3,
+    fanout: int = 6,
+    orgs_per_level: int = 64,
+    broken: bool = False,
+    seed: int = 0,
+) -> List[Dict]:
+    """Scale preset (qi-query, ROADMAP scenario diversity): a nested
+    multi-level org hierarchy that generates honestly at 10k+ nodes.
+
+    Tier 0 is a ``core_orgs × per_org`` org-majority core (the
+    quorum-bearing sink SCC, same structure as :func:`hierarchical_fbas`).
+    Every later tier is organizations of ``per_org`` validators whose
+    slice is a majority over ``fanout`` org inner sets sampled from the
+    *previous* tier — nesting depth 2, trust flowing strictly rootward, so
+    the tiers are watcher SCCs and the NP-hard search stays confined to
+    the core while parse/graph/Tarjan/scan chew through the full node
+    count (exactly the front-end load a 10k-node serving request costs).
+    Tiers are capped at ``orgs_per_level`` orgs; generation stops at
+    ``n_nodes`` (the final org may be partial).
+
+    ``broken=True`` turns the one fixture-pair knob in the core (org 0's
+    threshold → 1, the ``stellar_like_fbas`` methodology).  Same
+    arguments ⇒ byte-identical snapshot (pinned by seed tests).
+    """
+    if n_nodes < core_orgs * per_org:
+        raise ValueError(
+            f"need n_nodes >= {core_orgs * per_org} for the core, "
+            f"got {n_nodes}"
+        )
+    rng = random.Random(seed)
+    core_org_keys = [keys(per_org, f"HIER0O{o}N") for o in range(core_orgs)]
+    core_inner = [_qset(per_org // 2 + 1, list(ok)) for ok in core_org_keys]
+    t_core = core_orgs // 2 + 1
+    nodes: List[Dict] = []
+    for o in range(core_orgs):
+        for i, key in enumerate(core_org_keys[o]):
+            t = 1 if (broken and o == 0) else t_core
+            nodes.append(
+                _node(key, f"t0-org{o}-v{i}", _qset(t, [], list(core_inner)))
+            )
+    prev_inner = core_inner
+    level = 1
+    while len(nodes) < n_nodes:
+        level_inner: List[Dict] = []
+        for o in range(orgs_per_level):
+            if len(nodes) >= n_nodes:
+                break
+            org_keys = keys(per_org, f"HIER{level}O{o}N")
+            picked = rng.sample(prev_inner, min(fanout, len(prev_inner)))
+            t_up = len(picked) // 2 + 1
+            slice_q = _qset(t_up, [], [dict(q) for q in picked])
+            for i, key in enumerate(org_keys):
+                if len(nodes) >= n_nodes:
+                    break
+                nodes.append(_node(key, f"t{level}-org{o}-v{i}", slice_q))
+            level_inner.append(_qset(per_org // 2 + 1, org_keys))
+        prev_inner = level_inner or prev_inner
+        level += 1
+    rng.shuffle(nodes)  # snapshot order is arbitrary; vertex 0 ≠ core
+    return nodes
+
+
+def two_family_preset(
+    core: int = 9,
+    watchers: int = 6,
+    *,
+    broken: bool = False,
+    seed: int = 0,
+) -> Tuple[List[Dict], List[Dict]]:
+    """Adversarial two-family preset (qi-query relaxed mode, Fast Flexible
+    Paxos arXiv:2008.02671): ``(family_a, family_b)`` — two quorum-set
+    families over ONE node set in ONE vertex order (the relaxed query's
+    parse-time contract).
+
+    Family A is the *classic* family: ``k``-of-core majorities
+    (``k = core // 2 + 1``).  Family B is the *fast* family: symmetric
+    supermajority ``t``-of-core slices with ``t = 3·core//4 + 1`` in the
+    correct twin — comfortably above the Fast Paxos safety bound
+    ``k + t > core``, so every fast quorum meets every classic quorum.
+    ``broken=True`` turns the one knob down to ``t = core - k``: a fast
+    quorum of ``t`` core nodes can now dodge a classic quorum of the
+    other ``k`` — a cross-family split that is INVISIBLE to family A's
+    own single-family verdict (classic majorities still pairwise
+    intersect), which is exactly what makes the preset adversarial: fast
+    quorums need not intersect each other in Fast Paxos, only the
+    cross-family overlap is safety-critical, so no per-family check can
+    stand in for the relaxed query.  Watcher nodes (identical in both
+    families) trust a core majority and pad the vertex space so the
+    witness bits spread across the window order.  Same arguments ⇒
+    byte-identical pair.
+    """
+    if core < 4:
+        raise ValueError(f"need core >= 4, got {core}")
+    rng = random.Random(seed)
+    core_keys = keys(core, "TFC")
+    k_classic = core // 2 + 1
+    t_fast = (core - k_classic) if broken else (3 * core // 4 + 1)
+    t_fast = max(t_fast, 1)
+    order = list(range(core + watchers))
+    rng.shuffle(order)  # one arbitrary vertex order shared by BOTH families
+
+    def family(threshold: int) -> List[Dict]:
+        out: List[Dict] = []
+        for ix in order:
+            if ix < core:
+                key = core_keys[ix]
+                out.append(_node(key, f"c{ix}", _qset(threshold, list(core_keys))))
+            else:
+                w = ix - core
+                trusted = rng_w[w]
+                out.append(_node(
+                    f"TFW{w:04d}", f"w{w}",
+                    _qset(len(trusted) // 2 + 1, trusted),
+                ))
+        return out
+
+    rng_w = [
+        rng.sample(core_keys, min(core, 4)) for _ in range(watchers)
+    ]
+    return family(k_classic), family(t_fast)
+
+
 # The default churn mix (the three bounded mutations a live stellarbeat
 # feed actually produces — see churn_trace_steps); the restructuring kinds
 # scc_split / scc_merge are opt-in via ``kinds`` because they change the

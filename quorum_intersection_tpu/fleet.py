@@ -77,6 +77,7 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple, Union
 from quorum_intersection_tpu.delta import SharedSccStore
 from quorum_intersection_tpu.fbas.graph import build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.query import Query
 from quorum_intersection_tpu.serve import (
     RequestJournal,
     ServeEngine,
@@ -309,10 +310,13 @@ class ProcWorker:
         return self._ready.wait(timeout)
 
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
-               deadline_s: Optional[float]) -> bool:
+               deadline_s: Optional[float],
+               query: Optional[Dict[str, object]] = None) -> bool:
         line: Dict[str, object] = {"request_id": request_id, "nodes": nodes}
         if deadline_s is not None:
             line["deadline_s"] = deadline_s
+        if query is not None:
+            line["query"] = query
         return self._write(line)
 
     def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
@@ -421,12 +425,14 @@ class LocalWorker:
         self._respond(ticket_response(ticket, emit_certs=True))
 
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
-               deadline_s: Optional[float]) -> bool:
+               deadline_s: Optional[float],
+               query: Optional[Dict[str, object]] = None) -> bool:
         if self._dead:
             return False
         try:
             ticket = self.engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
+                query=query,
             )
         except ServeError as exc:
             self._respond({"request_id": request_id,
@@ -434,7 +440,9 @@ class LocalWorker:
             return True
         except (ValueError, TypeError, FaultInjected) as exc:
             self._respond({"request_id": request_id,
-                           "error": {"code": "invalid", "message": str(exc)}})
+                           "error": {"code": str(getattr(exc, "code",
+                                                         "invalid")),
+                                     "message": str(exc)}})
             return True
         ticket.add_done_callback(self._on_ticket_done)
         return True
@@ -477,6 +485,7 @@ class _Pending:
     worker_id: str = ""
     internal: bool = False  # journal-inherited work with no client ticket
     replaying: bool = False  # dispatched by a failover; gates /readyz
+    query: Optional[Dict[str, object]] = None  # qi-query/1 wire form
 
 
 class FleetEngine:
@@ -506,6 +515,7 @@ class FleetEngine:
         vnodes: Optional[int] = None,
         probe_interval_s: Optional[float] = None,
         probe_fails: Optional[int] = None,
+        respawn_max: Optional[int] = None,
     ) -> None:
         if worker_mode not in ("subprocess", "local"):
             raise ValueError(f"unknown worker_mode {worker_mode!r}")
@@ -532,6 +542,15 @@ class FleetEngine:
             else qi_env_int("QI_FLEET_PROBE_FAILS", 2),
             1,
         )
+        # Worker auto-respawn (ROADMAP follow-up: without it the ring
+        # shrinks on every eviction until restart).  Bounded per SLOT so a
+        # crash-looping worker cannot respawn forever; 0 disables.
+        self.respawn_max = max(
+            respawn_max if respawn_max is not None
+            else qi_env_int("QI_FLEET_RESPAWN_MAX", 2),
+            0,
+        )
+        self._respawn_counts: Dict[str, int] = {}
         self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
         if journal_dir is None:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="qi-fleet-")
@@ -578,23 +597,11 @@ class FleetEngine:
         rec.gauge("fleet.replay_complete", 0)
         self.journal_dir.mkdir(parents=True, exist_ok=True)
         self.store_dir.mkdir(parents=True, exist_ok=True)
-        make = ProcWorker if self.worker_mode == "subprocess" else LocalWorker
         with rec.span("fleet.start", workers=self.n_workers,
                       mode=self.worker_mode):
             for i in range(self.n_workers):
                 wid = f"w{i}"
-                worker = make(
-                    wid, self.journal_dir / f"{wid}.journal",
-                    self._on_response,
-                    backend=self.backend, store_dir=self.store_dir,
-                    deadline_s=self.deadline_s, batch_max=self.batch_max,
-                    cache_max=self.cache_max, queue_depth=self.queue_depth,
-                    dangling=self.dangling,
-                    scc_select=self.scc_select,
-                    scope_to_scc=self.scope_to_scc,
-                    on_exit=self._on_worker_exit,
-                )
-                self._workers[wid] = worker
+                self._workers[wid] = self._make_worker(wid)
             reports: Dict[str, object] = {}
             for wid, worker in self._workers.items():
                 if not worker.wait_ready(timeout=120.0):
@@ -627,6 +634,24 @@ class FleetEngine:
             "mode": self.worker_mode,
             "replay": reports,
         }
+
+    def _make_worker(self, wid: str) -> Union[ProcWorker, LocalWorker]:
+        """Construct one worker for slot/replacement id ``wid`` — shared
+        by :meth:`start` and the auto-respawn path, so a replacement is
+        configured byte-identically to the worker it replaces (only its
+        journal file is fresh: the dead journal already failed over)."""
+        make = ProcWorker if self.worker_mode == "subprocess" else LocalWorker
+        return make(
+            wid, self.journal_dir / f"{wid}.journal",
+            self._on_response,
+            backend=self.backend, store_dir=self.store_dir,
+            deadline_s=self.deadline_s, batch_max=self.batch_max,
+            cache_max=self.cache_max, queue_depth=self.queue_depth,
+            dangling=self.dangling,
+            scc_select=self.scc_select,
+            scope_to_scc=self.scope_to_scc,
+            on_exit=self._on_worker_exit,
+        )
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Close admission, drain (or kill) every worker, resolve whatever
@@ -664,9 +689,14 @@ class FleetEngine:
         *,
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        query: Optional[object] = None,
     ) -> Ticket:
         """Admit one request: fingerprint, route, dispatch.  Same contract
-        as ``ServeEngine.submit`` (typed errors, Ticket immediately)."""
+        as ``ServeEngine.submit`` (typed errors, Ticket immediately).
+        ``query`` (qi-query/1) extends the ROUTING key with the query
+        kind + params, so identical snapshots asked different questions
+        route (and coalesce) independently — fingerprints never cross
+        query types fleet-wide either."""
         rec = get_run_record()
         with self._lock:
             closed = self._closed
@@ -677,6 +707,9 @@ class FleetEngine:
             request_id
             or f"flt-{os.getpid()}-{time.monotonic_ns():x}"
         )
+        parsed_query = (
+            query if isinstance(query, Query) else Query.parse(query)
+        )
         fbas = source if isinstance(source, Fbas) else parse_fbas(source)
         nodes = _raw_nodes(source, fbas)
         graph = build_graph(fbas, dangling=self.dangling)
@@ -684,11 +717,15 @@ class FleetEngine:
             graph, scc_select=self.scc_select,
             scope_to_scc=self.scope_to_scc,
         )
+        qfp = parsed_query.fingerprint()
+        if qfp:
+            fp = f"{fp}:q:{qfp}"
         ticket = Ticket(request_id, time.monotonic(), deadline_t=None)
         pending = _Pending(
             ticket=ticket, wire_id=request_id, fingerprint=fp, nodes=nodes,
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
+            query=parsed_query.to_wire(),
         )
         with self._lock:
             # A client may reuse a request_id while the first request is
@@ -748,7 +785,7 @@ class FleetEngine:
                     return  # a concurrent failover re-routed it already
                 worker = self._workers.get(wid) if wid in self._live else None
             if worker is not None and worker.submit(
-                rid, pending.nodes, pending.deadline_s,
+                rid, pending.nodes, pending.deadline_s, pending.query,
             ):
                 rec.add("fleet.routed")
                 return
@@ -794,6 +831,7 @@ class FleetEngine:
         seconds = time.monotonic() - pending.ticket.submitted_t
         cert = obj.get("cert")
         stats = obj.get("stats")
+        result = obj.get("result")
         response = ServeResponse(
             # The CLIENT's id, not the wire id (a deduplicated duplicate
             # answers under the id its client actually sent).
@@ -803,6 +841,7 @@ class FleetEngine:
             stats=dict(stats) if isinstance(stats, dict) else {},
             cached=bool(obj.get("cached")),
             seconds=seconds,
+            result=result if isinstance(result, dict) else None,
         )
         if not pending.internal:
             rec.add("fleet.verdicts")
@@ -964,6 +1003,85 @@ class FleetEngine:
             worker_id,
             worker.journal_path if worker is not None else None,
         )
+        self._maybe_respawn(worker_id)
+
+    # ---- auto-respawn ----------------------------------------------------
+
+    def _maybe_respawn(self, dead_id: str) -> None:
+        """Schedule a replacement for a dead worker's slot (ROADMAP
+        follow-up: pre-respawn the ring shrank on every eviction until
+        restart).  Bounded per slot by ``QI_FLEET_RESPAWN_MAX`` so a
+        crash-looping configuration cannot respawn forever; the spawn
+        itself runs off-thread with exponential backoff — eviction and
+        failover never wait on a subprocess start."""
+        slot = dead_id.split(".", 1)[0]
+        with self._lock:
+            if self._closed or self.respawn_max <= 0:
+                return
+            n = self._respawn_counts.get(slot, 0) + 1
+            if n > self.respawn_max:
+                exhausted = True
+            else:
+                exhausted = False
+                self._respawn_counts[slot] = n
+        if exhausted:
+            get_run_record().event(
+                "fleet.respawn_exhausted", worker=dead_id,
+                max=self.respawn_max,
+            )
+            log.warning(
+                "fleet worker slot %s exhausted its %d respawns; the ring "
+                "stays shrunk for this slot", slot, self.respawn_max,
+            )
+            return
+        new_id = f"{slot}.r{n}"
+        # qi-lint: allow(cancel-token-plumbed) — bounded one-shot respawn; stop() flips _closed and an arriving replacement is torn down
+        threading.Thread(
+            target=self._respawn_worker, args=(new_id, n),
+            name=f"qi-fleet-respawn-{new_id}", daemon=True,
+        ).start()
+
+    def _respawn_worker(self, new_id: str, attempt: int) -> None:
+        rec = get_run_record()
+        # Bounded exponential backoff: a dying host gets breathing room,
+        # a one-off crash gets its replacement almost immediately.
+        time.sleep(min(0.1 * (2 ** (attempt - 1)), 2.0))
+        with self._lock:
+            if self._closed:
+                return  # stop() won the backoff window; nothing to restore
+        _fleet_sync("respawn.begin")
+        try:
+            worker = self._make_worker(new_id)
+        except Exception as exc:  # noqa: BLE001 — a failed spawn must not kill the probe loop
+            rec.add("fleet.respawn_errors")
+            rec.event("fleet.respawn_failed", worker=new_id, error=str(exc))
+            log.warning("fleet respawn %s failed (%s)", new_id, exc)
+            return
+        if not worker.wait_ready(timeout=120.0):
+            rec.add("fleet.respawn_errors")
+            rec.event("fleet.respawn_failed", worker=new_id,
+                      error="never reported replay-complete")
+            worker.kill()
+            return
+        with self._lock:
+            arrived_dead = self._closed
+            if not arrived_dead:
+                self._workers[new_id] = worker
+                self._live.add(new_id)
+                self._ring.add(new_id)
+                live, ring_size = len(self._live), len(self._ring)
+        if arrived_dead:
+            worker.kill()
+            return
+        rec.add("fleet.respawns")
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        rec.event("fleet.respawned", worker=new_id, attempt=attempt)
+        log.info(
+            "fleet worker %s respawned (attempt %d); ring restored to %d "
+            "worker(s)", new_id, attempt, ring_size,
+        )
+        _fleet_sync("respawn.done")
 
     def adopt_journal(self, journal_path: Union[str, Path],
                       worker_id: str = "adopted") -> int:
@@ -1051,6 +1169,7 @@ class FleetEngine:
                     known = rid in self._pending
                 if known:
                     continue  # already re-routed under a different owner
+                entry_query = entry.get("query")
                 pending = _Pending(
                     ticket=Ticket(rid, time.monotonic(), None),
                     wire_id=rid,
@@ -1059,6 +1178,11 @@ class FleetEngine:
                     deadline_s=None,  # its original budget is long since moot
                     internal=True,
                     replaying=True,
+                    # Inherited typed queries re-ask the SAME question on
+                    # the inheriting peer (the journal carries the wire
+                    # form; the fingerprint already keys the kind).
+                    query=(entry_query
+                           if isinstance(entry_query, dict) else None),
                 )
                 with self._lock:
                     self._pending[rid] = pending

@@ -86,6 +86,12 @@ from quorum_intersection_tpu.delta import (
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
 from quorum_intersection_tpu.pipeline import SolveResult, check_many
+from quorum_intersection_tpu.query import (
+    Query,
+    QueryEngine,
+    QueryError,
+    QueryResult,
+)
 from quorum_intersection_tpu.utils.env import (
     qi_env,
     qi_env_float,
@@ -234,7 +240,11 @@ def snapshot_fingerprint(
 
 @dataclass
 class ServeResponse:
-    """One served verdict: the solve result plus serve-side provenance."""
+    """One served verdict: the solve result plus serve-side provenance.
+
+    ``result`` carries a typed query's structured payload (qi-query/1,
+    ISSUE 12) — None for the legacy boolean intersection path, so the
+    pre-query response shape is untouched."""
 
     request_id: str
     intersects: bool
@@ -242,6 +252,7 @@ class ServeResponse:
     stats: Dict[str, object]
     cached: bool
     seconds: float  # admission → delivery latency
+    result: Optional[Dict[str, object]] = None
 
 
 _Outcome = Tuple[str, object]  # ("ok", ServeResponse) | ("err", Exception)
@@ -307,12 +318,15 @@ class Ticket:
 @dataclass
 class _Entry:
     """One solve unit: a fingerprint-distinct admitted request plus every
-    coalesced waiter sharing its verdict (single-flight)."""
+    coalesced waiter sharing its verdict (single-flight).  ``query`` is
+    the typed qi-query/1 request (the default is the degenerate
+    intersection query — the legacy path)."""
 
     request_id: str
     fingerprint: str
     fbas: Fbas
     nodes: List[Dict[str, object]]
+    query: Query = field(default_factory=Query)
     waiters: List[Ticket] = field(default_factory=list)
     journaled: bool = False
     replayed: bool = False
@@ -372,12 +386,20 @@ class RequestJournal:
 
     def append_request(self, request_id: str, fingerprint: str,
                        nodes: List[Dict[str, object]],
-                       deadline_s: Optional[float]) -> bool:
-        ok = self._append_line({
+                       deadline_s: Optional[float],
+                       query: Optional[Dict[str, object]] = None) -> bool:
+        payload: Dict[str, object] = {
             "kind": "req", "request_id": request_id,
             "fingerprint": fingerprint, "deadline_s": deadline_s,
             "nodes": nodes, "t_wall": round(time.time(), 3),
-        })
+        }
+        if query is not None:
+            # Typed queries (qi-query/1) journal their wire form so a
+            # replay re-resolves the SAME question — the fingerprint
+            # already carries the query kind, so a replayed relaxed query
+            # can never serve from an intersection cache line.
+            payload["query"] = query
+        ok = self._append_line(payload)
         if ok:
             get_run_record().add("serve.journal_entries")
         return ok
@@ -580,12 +602,20 @@ class ServeEngine:
             )
             if delta_on else None
         )
+        # Typed query resolver (qi-query, ISSUE 12): shares this engine's
+        # front-end options, so every query kind answers the same FBAS
+        # under the same flags as the boolean verdict; the drain injects
+        # its delta-aware, deadline-cancellable batch solver per batch.
+        self._query_engine = QueryEngine(
+            dangling=dangling, scc_select=scc_select,
+            scope_to_scc=scope_to_scc, pack=pack,
+        )
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: Deque[_Entry] = deque()
         self._reserved = 0  # admission slots between depth check and enqueue
         self._inflight: Dict[str, _Entry] = {}  # fingerprint → live entry
-        self._cache: "OrderedDict[str, SolveResult]" = OrderedDict()
+        self._cache: "OrderedDict[str, Union[SolveResult, QueryResult]]" = OrderedDict()
         self._latencies: Deque[float] = deque(maxlen=LATENCY_WINDOW)
         self._closed = False
         self._stopping = False
@@ -669,6 +699,7 @@ class ServeEngine:
         *,
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        query: Optional[object] = None,
     ) -> Ticket:
         """Admit one snapshot-verdict request.
 
@@ -677,6 +708,15 @@ class ServeEngine:
         synchronous backpressure, so a shed request costs its client one
         exception, not a timeout.  Returns a :class:`Ticket` immediately;
         a cache hit resolves it before this call returns.
+
+        ``query`` (qi-query/1, ISSUE 12) is the raw wire query — a kind
+        string, a params object, or an already-parsed :class:`Query`;
+        ``None`` means the degenerate intersection query and the whole
+        path stays byte-identical to the pre-query engine.  A malformed
+        query raises typed :class:`QueryError` at admission.  The
+        fingerprint is extended with the query kind + params, so the
+        verdict cache, single-flight coalescing and journal replay never
+        cross query types.
         """
         rec = get_run_record()
         fault_point("serve.admit")
@@ -687,12 +727,18 @@ class ServeEngine:
             request_id, now,
             deadline_t=(now + budget) if budget and budget > 0 else None,
         )
+        parsed_query = (
+            query if isinstance(query, Query) else Query.parse(query)
+        )
         fbas = source if isinstance(source, Fbas) else parse_fbas(source)
         nodes = _raw_nodes(source, fbas)
         graph = build_graph(fbas, dangling=self.dangling)
         fp = snapshot_fingerprint(
             graph, scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
         )
+        qfp = parsed_query.fingerprint()
+        if qfp:
+            fp = f"{fp}:q:{qfp}"
         rec.add("serve.requests")
 
         # Cache probe (its own fault point: an injected cache failure
@@ -751,6 +797,7 @@ class ServeEngine:
             if self._journal is not None and self._journal.append_request(
                 request_id, fp, nodes,
                 budget if budget and budget > 0 else None,
+                query=parsed_query.to_wire(),
             ):
                 journal = self._journal
 
@@ -783,12 +830,14 @@ class ServeEngine:
         # kill from this point on (the crash-only contract).
         entry = _Entry(
             request_id=request_id, fingerprint=fp, fbas=fbas, nodes=nodes,
+            query=parsed_query,
             waiters=[ticket], cache_bypass=cache_bypass, admitted_t=now,
         )
         if self._journal is not None:
             entry.journaled = self._journal.append_request(
                 request_id, fp, nodes,
                 budget if budget and budget > 0 else None,
+                query=parsed_query.to_wire(),
             )
         with self._cond:
             self._reserved -= 1
@@ -934,17 +983,24 @@ class ServeEngine:
         live = self._partition_expired(batch, time.monotonic())
         if not live:
             return
+        # Typed queries (qi-query, ISSUE 12) split out of the batched
+        # intersection path: each kind resolves through its own engine
+        # chain (whatif expands into its OWN lane-packed check_many batch;
+        # relaxed/analytics never batch), under the same deadline
+        # supervisor as the intersection batch they drained with.
+        q_live = [e for e in live if e.query.kind != "intersection"]
+        live = [e for e in live if e.query.kind == "intersection"]
         deadlines = [
-            t.deadline_t for e in live for t in e.waiters
+            t.deadline_t for e in (live + q_live) for t in e.waiters
             if t.deadline_t is not None
         ]
         deadline_cancel = CancelToken() if deadlines else None
         timer: Optional[threading.Timer] = None
         counters0, _ = rec.snapshot()
         with rec.span(
-            "serve.batch", requests=len(live),
-            waiters=sum(len(e.waiters) for e in live),
-            per_request=per_request,
+            "serve.batch", requests=len(live) + len(q_live),
+            waiters=sum(len(e.waiters) for e in live + q_live),
+            per_request=per_request, queries=len(q_live),
         ):
             try:
                 if deadline_cancel is not None:
@@ -957,10 +1013,15 @@ class ServeEngine:
                     )
                     timer.daemon = True
                     timer.start()
-                if per_request:
-                    self._solve_per_request(live, deadline_cancel, counters0)
-                else:
-                    self._solve_batch(live, deadline_cancel, counters0)
+                if live:
+                    if per_request:
+                        self._solve_per_request(
+                            live, deadline_cancel, counters0
+                        )
+                    else:
+                        self._solve_batch(live, deadline_cancel, counters0)
+                if q_live:
+                    self._solve_queries(q_live, deadline_cancel, counters0)
             finally:
                 if timer is not None:
                     timer.cancel()
@@ -1009,6 +1070,46 @@ class ServeEngine:
                 self._resolve_err(entry, exc, outcome="error")
                 continue
             self._deliver_ok(entry, results[0])
+
+    def _solve_queries(
+        self,
+        entries: List[_Entry],
+        cancel: Optional[CancelToken],
+        counters0: Dict[str, float],
+    ) -> None:
+        """Resolve the drained typed-query entries one by one (qi-query).
+
+        Every failure is a typed outcome: a ``query.dispatch`` degrade or
+        resolver error lands as :class:`QueryError`, a deadline cancel
+        follows the same partial-coverage path as the intersection batch
+        — never a wedged ticket, never a wrong verdict."""
+        rec = get_run_record()
+        for ix, entry in enumerate(entries):
+            if cancel is not None and cancel.cancelled:
+                self._after_deadline_cancel(entries[ix:], counters0)
+                return
+            backend = self._make_backend(cancel)
+
+            def run(sources: List[Fbas],
+                    _backend: SearchBackend = backend) -> List[SolveResult]:
+                return self._check_many(sources, _backend)
+
+            try:
+                qres = self._query_engine.resolve(
+                    entry.nodes, entry.query, check_many_fn=run,
+                    cancel=cancel,
+                )
+            except SearchCancelled:
+                self._after_deadline_cancel(entries[ix:], counters0)
+                return
+            except QueryError as exc:
+                self._resolve_err(entry, exc, outcome="error")
+                continue
+            except Exception as exc:  # noqa: BLE001 — one bad query must not starve the rest
+                rec.add("serve.drain_errors")
+                self._resolve_err(entry, exc, outcome="error")
+                continue
+            self._deliver_ok(entry, qres)
 
     def _after_deadline_cancel(
         self, entries: List[_Entry], counters0: Dict[str, float]
@@ -1083,7 +1184,9 @@ class ServeEngine:
         if self._inflight.get(entry.fingerprint) is entry:
             del self._inflight[entry.fingerprint]
 
-    def _deliver_ok(self, entry: _Entry, res: SolveResult) -> None:
+    def _deliver_ok(
+        self, entry: _Entry, res: Union[SolveResult, QueryResult]
+    ) -> None:
         """One solved entry: cache, journal-done, respond to every waiter."""
         rec = get_run_record()
         evicted = 0
@@ -1131,7 +1234,7 @@ class ServeEngine:
     def _resolve_ok(
         self,
         ticket: Ticket,
-        res: SolveResult,
+        res: Union[SolveResult, QueryResult],
         fingerprint: str,
         *,
         cached: bool,
@@ -1162,6 +1265,9 @@ class ServeEngine:
             stats=dict(res.stats),
             cached=cached,
             seconds=seconds,
+            # Typed-query payload (qi-query): None on the legacy boolean
+            # path, the structured result table/witness/report otherwise.
+            result=getattr(res, "result", None),
         )
         outcome_err: Optional[BaseException] = None
         try:
@@ -1272,12 +1378,19 @@ class ServeEngine:
                     raise ValueError(
                         "journaled nodes payload is not a node array"
                     )
+                # Typed queries (qi-query) journal their wire form; an
+                # unparseable query quarantines exactly like unparseable
+                # nodes — a replayed request must re-ask the SAME question.
+                query = Query.parse(e.get("query"))
                 fbas = parse_fbas(nodes)
                 graph = build_graph(fbas, dangling=self.dangling)
                 fp = snapshot_fingerprint(
                     graph, scc_select=self.scc_select,
                     scope_to_scc=self.scope_to_scc,
                 )
+                qfp = query.fingerprint()
+                if qfp:
+                    fp = f"{fp}:q:{qfp}"
             except (ValueError, TypeError, KeyError, AttributeError) as exc:
                 foreign.append(json.dumps(e, default=str))
                 log.warning(
@@ -1297,7 +1410,10 @@ class ServeEngine:
                     e.get("request_id"), e.get("fingerprint"), fp,
                 )
                 continue
-            pending.append({"entry": e, "fbas": fbas, "fingerprint": fp})
+            pending.append({
+                "entry": e, "fbas": fbas, "nodes": nodes,
+                "fingerprint": fp, "query": query,
+            })
         if foreign:
             self._journal.quarantine(foreign, "foreign fingerprint / payload")
         report: Dict[str, object] = {
@@ -1320,7 +1436,52 @@ class ServeEngine:
             quarantined=report["quarantined"],
         )
         still_pending: List[Dict[str, object]] = []
-        with rec.span("serve.replay", pending=len(pending)):
+        # Typed-query entries replay one at a time through the query
+        # resolver (their batches, if any, are their own — a whatif
+        # expands its own lane-packed frontier); intersection entries keep
+        # the batched replay below.
+        q_pending = [
+            p for p in pending if p["query"].kind != "intersection"  # type: ignore[attr-defined]
+        ]
+        pending = [
+            p for p in pending if p["query"].kind == "intersection"  # type: ignore[attr-defined]
+        ]
+        with rec.span("serve.replay",
+                      pending=len(pending) + len(q_pending)):
+            for p in q_pending:
+                rid = str(p["entry"].get("request_id"))
+                fp = str(p["fingerprint"])
+                backend = self._make_backend(None)
+
+                def run(sources: List[Fbas],
+                        _backend: SearchBackend = backend,
+                        ) -> List[SolveResult]:
+                    return self._check_many(sources, _backend)
+
+                try:
+                    res = self._query_engine.resolve(
+                        p["nodes"], p["query"],  # type: ignore[arg-type]
+                        check_many_fn=run,
+                    )
+                except Exception as exc:  # noqa: BLE001 — replay must not block startup
+                    report["errors"][rid] = (  # type: ignore[index]
+                        f"{type(exc).__name__}: {exc}"
+                    )
+                    still_pending.append(p["entry"])  # type: ignore[arg-type]
+                    rec.add("serve.replay_errors")
+                    continue
+                with self._lock:
+                    self._cache[fp] = res
+                    self._cache.move_to_end(fp)
+                    while len(self._cache) > self.cache_max:
+                        self._cache.popitem(last=False)
+                self._journal.append_done(
+                    rid, fp, "verdict", bool(res.intersects),
+                )
+                rec.add("serve.journal_replayed")
+                report["verdicts"][rid] = bool(  # type: ignore[index]
+                    res.intersects
+                )
             for i in range(0, len(pending), self.batch_max):
                 chunk = pending[i:i + self.batch_max]
                 try:

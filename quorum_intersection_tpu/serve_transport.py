@@ -109,14 +109,22 @@ def ticket_response(
         return {"request_id": ticket.request_id,
                 "error": {"code": exc.code, "message": str(exc)}}
     except Exception as exc:  # noqa: BLE001 — an untyped failure still gets a response line
+        # Typed non-serve failures (the query layer's QueryError rides
+        # here) keep their machine-readable code; anything else is
+        # "internal" — still a response line, never a silent drop.
         return {"request_id": ticket.request_id,
-                "error": {"code": "internal", "message": str(exc)}}
+                "error": {"code": str(getattr(exc, "code", "internal")),
+                          "message": str(exc)}}
     line: Dict[str, object] = {
         "request_id": resp.request_id,
         "verdict": resp.intersects,
         "cached": resp.cached,
         "seconds": round(resp.seconds, 6),
     }
+    if resp.result is not None:
+        # Typed-query payload (qi-query/1): verdict stays the boolean
+        # summary, the structured table/witness/report rides alongside.
+        line["result"] = resp.result
     if emit_certs:
         line["cert"] = resp.cert
         line["stats"] = resp.stats
@@ -170,25 +178,33 @@ class JsonlSession:
                 return
             nodes = obj
             deadline_s: Optional[float] = None
+            query: Optional[object] = None
             if isinstance(obj, dict):
                 request_id = obj.get("request_id")
                 nodes = obj.get("nodes")
                 raw_deadline = obj.get("deadline_s")
                 if raw_deadline is not None:
                     deadline_s = float(raw_deadline)
+                # qi-query/1 (ISSUE 12): absent ⇒ intersection, the
+                # byte-compatible legacy request.
+                query = obj.get("query")
             if not isinstance(nodes, list):
                 raise ValueError("expected a node array or "
                                  '{"request_id", "nodes"}')
             ticket = self._engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
+                query=query,
             )
         except ServeError as exc:
             self.emit({"request_id": request_id or f"line-{n + 1}",
                        "error": {"code": exc.code, "message": str(exc)}})
             return
         except (ValueError, TypeError, FaultInjected) as exc:
+            # A typed QueryError keeps its own code (unknown_query /
+            # invalid_query / ...); other parse failures stay "invalid".
             self.emit({"request_id": request_id or f"line-{n + 1}",
-                       "error": {"code": "invalid", "message": str(exc)}})
+                       "error": {"code": str(getattr(exc, "code", "invalid")),
+                                 "message": str(exc)}})
             return
         with self._drained:
             self._outstanding += 1

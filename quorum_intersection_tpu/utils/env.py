@@ -255,6 +255,30 @@ QI_FLEET_PROBE_FAILS = _declare(
     "peers inheriting its hash range (fleet.py); a dead process is "
     "evicted immediately regardless.",
 )
+QI_FLEET_STORE_MAX_MB = _declare(
+    "QI_FLEET_STORE_MAX_MB", "0",
+    "Size budget (megabytes) of the shared SCC-fragment store directory "
+    "(delta.py SharedSccStore): past it a publish triggers an "
+    "LRU-by-mtime sweep deleting the stalest fragments until the "
+    "directory fits again (delta.store_evictions counter + "
+    "delta.store_gc event — loud, the fragments re-solve on next miss).  "
+    "0 (default): unbounded, the pre-GC behavior.",
+)
+QI_FLEET_RESPAWN_MAX = _declare(
+    "QI_FLEET_RESPAWN_MAX", "2",
+    "Replacement workers the fleet supervisor may spawn per worker SLOT "
+    "after an eviction (fleet.py): each respawn re-inserts a fresh "
+    "worker into the consistent-hash ring with bounded exponential "
+    "backoff (fleet.respawns counter), so a long-lived fleet does not "
+    "shrink until restart.  0: never respawn (the pre-respawn behavior).",
+)
+QI_QUERY_WHATIF_LIMIT = _declare(
+    "QI_QUERY_WHATIF_LIMIT", "512",
+    "Most removal subsets one what-if query may expand (query.py): the "
+    "k-subset frontier over the candidate validators is truncated at "
+    "this bound with a loud result field (truncated: true) — a typed "
+    "cap, never an unbounded batch from one request.",
+)
 QI_SERVE_JOURNAL = _declare(
     "QI_SERVE_JOURNAL", "",
     "Path of the serving layer's crash-only request journal (serve.py): "

@@ -274,6 +274,16 @@ FLEET_STORE = _declare(
     "verdict is not), and an unparseable/forged fragment is a miss, "
     "never trusted.",
 )
+QUERY_DISPATCH = _declare(
+    "query.dispatch",
+    "Typed-query dispatch (query.py QueryEngine.resolve, fired once per "
+    "non-intersection query before any resolver runs): error simulates a "
+    "broken query layer — the request degrades to a typed QueryError "
+    "(query.errors counter + query.degraded event), NEVER a wrong or "
+    "silently-absent verdict; the boolean intersection path does not "
+    "route through this point, so injected query faults cannot touch "
+    "the byte-compatible legacy protocol.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "

@@ -480,7 +480,9 @@ class TestFleetSchedules:
         from tools.analyze.schedules import run_fleet_schedules
 
         results = run_fleet_schedules()
-        assert len(results) == 4
+        # 3 schedules (route-during-eviction, replay-races-new-request,
+        # respawn-restores-ring since ISSUE 12) × both topologies.
+        assert len(results) == 6
         for r in results:
             assert r.ok, f"{r.schedule} on {r.topology}: {r.error}"
 

@@ -1,0 +1,817 @@
+"""qi-query differential suite (ISSUE 12): every query kind checked
+against a stdlib oracle on the fixture pairs, whatif packed-vs-sequential
+byte parity, relaxed witness certificates validated by the independent
+checker, the query.dispatch fault degrade (typed, never a wrong verdict),
+serve/fleet round-trips with mixed query streams, journal replay of typed
+queries, the synth scale presets' seed determinism, and the fleet
+respawn / shared-store GC satellites."""
+
+import json
+import tempfile
+import time
+
+import pytest
+
+from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
+from quorum_intersection_tpu.delta import SharedSccStore
+from quorum_intersection_tpu.encode.circuit import (
+    encode_circuit,
+    max_quorum_np,
+    restrict_two_family,
+)
+from quorum_intersection_tpu.fbas import synth
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.semantics import (
+    cross_family_disjoint_quorum,
+    max_quorum,
+    relaxed_disjoint_witness,
+)
+from quorum_intersection_tpu.fleet import FleetEngine
+from quorum_intersection_tpu.pipeline import quorum_bearing_sccs, solve
+from quorum_intersection_tpu.query import (
+    Query,
+    QueryEngine,
+    QueryError,
+    _relaxed_search,
+    mask_nodes,
+)
+from quorum_intersection_tpu.serve import (
+    RequestJournal,
+    ServeEngine,
+    snapshot_fingerprint,
+)
+from quorum_intersection_tpu.utils import faults, telemetry
+from tools.check_cert import CheckFailure, check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+def roundtrip(obj):
+    """JSON round-trip: what the serve/fleet wire would deliver."""
+    return json.loads(json.dumps(obj, default=str))
+
+
+def subset_oracle(nodes_a, nodes_b):
+    """Independent stdlib relaxed oracle over the WHOLE node set: a
+    disjoint cross-family pair exists iff some split S of all vertices
+    holds a family-A quorum inside S and a family-B quorum inside its
+    complement (any disjoint pair (QA, QB) induces the split S = QA ∪
+    (V ∖ QB) ⊇ QA with QB ∩ S = ∅, and the converse is immediate).
+    2^n host fixpoints — no SCC confinement, no guard memoization, so it
+    shares nothing with the query engine's search structure."""
+    ga = build_graph(parse_fbas(nodes_a))
+    gb = build_graph(parse_fbas(nodes_b))
+    n = ga.n
+    avail = [False] * n
+    for window in range(1, 1 << n):
+        chosen = [v for v in range(n) if window >> v & 1]
+        for v in chosen:
+            avail[v] = True
+        qa = max_quorum(ga, chosen, avail)
+        for v in chosen:
+            avail[v] = False
+        if not qa:
+            continue
+        rest = [v for v in range(n) if v not in set(qa)]
+        for v in rest:
+            avail[v] = True
+        qb = max_quorum(gb, rest, avail)
+        for v in rest:
+            avail[v] = False
+        if qb:
+            return False  # disjoint pair exists
+    return True
+
+
+# ---------------------------------------------------------------------------
+# query parsing + fingerprints
+
+
+class TestQueryParse:
+    def test_absent_is_intersection(self):
+        q = Query.parse(None)
+        assert q.kind == "intersection"
+        assert q.fingerprint() == ""
+        assert q.to_wire() is None
+
+    def test_unknown_kind_typed(self):
+        with pytest.raises(QueryError) as exc:
+            Query.parse({"kind": "bogus"})
+        assert exc.value.code == "unknown_query"
+
+    def test_relaxed_requires_family_b(self):
+        with pytest.raises(QueryError) as exc:
+            Query.parse({"kind": "relaxed"})
+        assert exc.value.code == "invalid_query"
+
+    def test_bad_max_k_typed(self):
+        with pytest.raises(QueryError):
+            Query.parse({"kind": "whatif", "max_k": 0})
+        with pytest.raises(QueryError):
+            Query.parse({"kind": "whatif", "max_k": True})
+
+    def test_unknown_metric_typed(self):
+        with pytest.raises(QueryError) as exc:
+            Query.parse({"kind": "analytics", "metric": "nope"})
+        assert exc.value.code == "unknown_query"
+
+    def test_fingerprints_never_cross_kinds(self):
+        fa, fb = synth.two_family_preset(core=4, watchers=0)
+        fps = {
+            Query.parse({"kind": "whatif", "max_k": 1}).fingerprint(),
+            Query.parse({"kind": "whatif", "max_k": 2}).fingerprint(),
+            Query.parse({"kind": "relaxed", "family_b": fb}).fingerprint(),
+            Query.parse({"kind": "analytics",
+                         "metric": "pagerank"}).fingerprint(),
+            Query.parse({"kind": "analytics",
+                         "metric": "top_tier"}).fingerprint(),
+            "",  # intersection
+        }
+        assert len(fps) == 6  # all distinct, intersection empty
+
+    def test_wire_roundtrip(self):
+        fa, fb = synth.two_family_preset(core=4, watchers=0)
+        for raw in (
+            {"kind": "relaxed", "family_b": fb},
+            {"kind": "whatif", "max_k": 2, "candidates": ["TFC0000"]},
+            {"kind": "analytics", "metric": "splitting_set",
+             "splitting_max_k": 1},
+        ):
+            q = Query.parse(raw)
+            assert Query.parse(roundtrip(q.to_wire())) == q
+
+
+# ---------------------------------------------------------------------------
+# relaxed two-family mode
+
+
+class TestRelaxedDifferential:
+    @pytest.mark.parametrize("broken", [False, True])
+    def test_preset_vs_subset_oracle(self, rec, broken):
+        fa, fb = synth.two_family_preset(
+            core=8, watchers=3, broken=broken, seed=7,
+        )
+        out = QueryEngine(backend="python").resolve(
+            fa, Query.parse({"kind": "relaxed", "family_b": fb})
+        )
+        assert out.verdict == subset_oracle(fa, fb)
+        assert out.verdict == (not broken)
+        check_certificate(roundtrip(out.cert), fa)
+        if not out.verdict:
+            wit = out.result["witness"]
+            assert not set(wit["family_a"]) & set(wit["family_b"])
+
+    @pytest.mark.parametrize("fixture,verdict", FIXTURE_PAIRS)
+    def test_self_family_matches_intersection(self, rec, fixture, verdict):
+        """relaxed(A, A) degenerates to the single-family question: the
+        verdict must equal the one-shot pipeline's on both fixture
+        pairs (the trivial pair also brute-forced by the subset
+        oracle)."""
+        nodes = fixture_nodes(fixture)
+        out = QueryEngine(backend="python").resolve(
+            nodes, Query.parse({"kind": "relaxed", "family_b": nodes})
+        )
+        assert out.verdict is verdict
+        check_certificate(roundtrip(out.cert), nodes)
+        if "trivial" in fixture:
+            assert out.verdict == subset_oracle(nodes, nodes)
+
+    def test_vectorized_matches_host_oracle(self, rec):
+        """The circuit-vectorized search and the stdlib semantics oracle
+        agree window-for-window: same verdict, same first-witness
+        A-quorum, same enumeration count."""
+        for broken in (False, True):
+            fa, fb = synth.two_family_preset(
+                core=7, watchers=2, broken=broken, seed=11,
+            )
+            ga = build_graph(parse_fbas(fa))
+            gb = build_graph(parse_fbas(fb))
+            (_sid, members), = quorum_bearing_sccs(ga)
+            qa_v, qb_v, enum_v, _engine = _relaxed_search(ga, gb, members)
+            qa_h, qb_h, enum_h = relaxed_disjoint_witness(ga, gb, members)
+            assert (qa_v is None) == (qa_h is None)
+            assert enum_v == enum_h
+            assert qa_v == qa_h
+            if qb_v is not None:
+                # The fast scoped guard may return a smaller B-quorum
+                # than the host's whole-graph greatest fixpoint; both
+                # must be real B-quorums disjoint from qa.
+                assert not set(qa_v) & set(qb_v)
+                assert cross_family_disjoint_quorum(gb, qa_v)
+
+    def test_two_circuit_restriction_parity(self, rec):
+        """restrict_two_family's scoped circuits agree with the host
+        semantics on both families for every singleton-and-pair window
+        of the SCC."""
+        import numpy as np
+
+        fa, fb = synth.two_family_preset(core=6, watchers=2, seed=3)
+        ga = build_graph(parse_fbas(fa))
+        gb = build_graph(parse_fbas(fb))
+        (_sid, members), = quorum_bearing_sccs(ga)
+        a_scoped, b_scoped, _ = restrict_two_family(
+            encode_circuit(ga), encode_circuit(gb), members
+        )
+        m = len(members)
+        masks = np.zeros((m * m, m), dtype=bool)
+        k = 0
+        for i in range(m):
+            for j in range(m):
+                masks[k, i] = True
+                masks[k, j] = True
+                k += 1
+        for circ, graph in ((a_scoped, ga), (b_scoped, gb)):
+            fix = max_quorum_np(circ, masks)
+            for row, mask in zip(fix, masks):
+                chosen = [members[i] for i in range(m) if mask[i]]
+                avail = [False] * graph.n
+                for v in chosen:
+                    avail[v] = True
+                host = max_quorum(graph, chosen, avail)
+                assert sorted(members[i] for i in range(m) if row[i]) \
+                    == sorted(host)
+
+    def test_mismatched_node_set_typed(self, rec):
+        fa, _fb = synth.two_family_preset(core=4, watchers=0)
+        other = synth.majority_fbas(4, prefix="OTHER")
+        with pytest.raises(QueryError) as exc:
+            QueryEngine(backend="python").resolve(
+                fa, Query.parse({"kind": "relaxed", "family_b": other})
+            )
+        assert exc.value.code == "invalid_query"
+
+    def test_forged_relaxed_witness_rejected(self, rec):
+        fa, fb = synth.two_family_preset(
+            core=8, watchers=3, broken=True, seed=7,
+        )
+        out = QueryEngine(backend="python").resolve(
+            fa, Query.parse({"kind": "relaxed", "family_b": fb})
+        )
+        bad = roundtrip(out.cert)
+        bad["witness"]["q2"] = bad["witness"]["q1"]
+        with pytest.raises(CheckFailure):
+            check_certificate(bad, fa)
+        short = roundtrip(out.cert)
+        short["verdict"] = True
+        short["coverage"] = {"sccs": [{
+            "size": 8, "window_space": 255, "windows_enumerated": 100,
+            "nodes": [],
+        }]}
+        del short["witness"]
+        with pytest.raises(CheckFailure):
+            check_certificate(short, fa)
+
+
+# ---------------------------------------------------------------------------
+# whatif removal sweeps
+
+
+class TestWhatif:
+    def test_table_vs_sequential_oracle(self, rec):
+        """Every frontier row's verdict equals a from-scratch solve of
+        the masked variant — the stdlib parity bar."""
+        base = synth.majority_fbas(5, prefix="WIF")
+        out = QueryEngine(backend="python").resolve(
+            base, Query.parse({"kind": "whatif", "max_k": 3})
+        )
+        assert out.result["table"][0]["removed"] == []
+        for row in out.result["table"]:
+            expect = solve(
+                mask_nodes(base, row["removed"]), backend="python"
+            ).intersects
+            assert row["verdict"] is expect
+        # 3-of-5 majority: any 3 departures silence every quorum.
+        assert out.verdict is False
+        assert len(out.result["minimal_failing"]) == 3
+        check_certificate(roundtrip(out.cert), base)
+        check_certificate(
+            roundtrip(out.result["failing_cert"]),
+            mask_nodes(base, out.result["minimal_failing"]),
+        )
+
+    def test_packed_vs_sequential_byte_parity(self, rec):
+        """The acceptance bar: the whatif verdict table is byte-identical
+        between the lane-packed batch and the never-packed sequential
+        path (same variants, same masks, same sweep backend)."""
+        base = synth.majority_fbas(6, prefix="WIP")
+        q = Query.parse({"kind": "whatif", "max_k": 2})
+        tables = {}
+        for label, pack in (("packed", True), ("sequential", False)):
+            out = QueryEngine(
+                backend=TpuSweepBackend(batch=256), pack=pack,
+            ).resolve(base, q)
+            tables[label] = json.dumps(
+                {"table": out.result["table"],
+                 "minimal_failing": out.result["minimal_failing"],
+                 "verdict": out.verdict},
+                sort_keys=True,
+            )
+        assert tables["packed"] == tables["sequential"]
+
+    def test_unknown_candidate_typed(self, rec):
+        base = synth.majority_fbas(5, prefix="WIF")
+        with pytest.raises(QueryError) as exc:
+            QueryEngine(backend="python").resolve(
+                base,
+                Query.parse({"kind": "whatif", "candidates": ["GHOST"]}),
+            )
+        assert exc.value.code == "invalid_query"
+
+    def test_frontier_truncation_is_loud(self, rec):
+        base = synth.majority_fbas(9, prefix="WIT")
+        out = QueryEngine(backend="python", whatif_limit=5).resolve(
+            base, Query.parse({"kind": "whatif", "max_k": 2})
+        )
+        assert out.result["truncated"] is True
+        assert out.result["variants"] == 5
+
+    def test_delta_reuse_across_frontier_steps(self, rec):
+        """Acceptance bar: watcher-only removals leave the core SCC's
+        fingerprint untouched, so a k-frontier step through a
+        delta-enabled serve engine composes the core fragment from the
+        store — delta_scc_reuse_pct > 0 across the step."""
+        base = synth.stellar_like_fbas(
+            n_core_orgs=3, per_org=2, n_watchers=6, n_null=1,
+            n_dangling=0, seed=5,
+        )
+        watchers = sorted(
+            n["publicKey"] for n in base
+            if str(n.get("publicKey", "")).startswith("WATCH")
+        )[:3]
+        with _engine(ServeEngine(backend="python")) as eng:
+            t1 = eng.submit(base, query={
+                "kind": "whatif", "candidates": watchers, "max_k": 1,
+            })
+            assert t1.result(60.0).intersects is True
+            t2 = eng.submit(base, query={
+                "kind": "whatif", "candidates": watchers, "max_k": 2,
+            })
+            assert t2.result(60.0).intersects is True
+        counters, gauges = rec.snapshot()
+        assert counters.get("delta.scc_hits", 0) > 0
+        assert gauges.get("delta.scc_reuse_pct", 0.0) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# analytics queries
+
+
+class TestAnalyticsQueries:
+    def test_top_tier_matches_module(self, rec):
+        from quorum_intersection_tpu.analytics.top_tier import top_tier
+
+        nodes = fixture_nodes("nested_correct")
+        graph = build_graph(parse_fbas(nodes))
+        expect = []
+        for _sid, scc in quorum_bearing_sccs(graph):
+            part, _count = top_tier(graph, scc)
+            expect.extend(graph.node_ids[v] for v in part)
+        out = QueryEngine(backend="python").resolve(
+            nodes, Query.parse({"kind": "analytics", "metric": "top_tier"})
+        )
+        assert out.verdict is True
+        assert out.result["members"] == sorted(expect)
+        check_certificate(roundtrip(out.cert), nodes)
+
+    def test_blocking_set_matches_module_and_reproves(self, rec):
+        from quorum_intersection_tpu.analytics.resilience import (
+            minimal_blocking_set,
+        )
+
+        base = synth.majority_fbas(7, prefix="ABQ")
+        graph = build_graph(parse_fbas(base))
+        expect = []
+        for _sid, scc in quorum_bearing_sccs(graph):
+            expect.extend(
+                graph.node_ids[v] for v in minimal_blocking_set(graph, scc)
+            )
+        out = QueryEngine(backend="python").resolve(
+            base,
+            Query.parse({"kind": "analytics", "metric": "blocking_set"}),
+        )
+        assert out.result["blocking"] == sorted(expect)
+        notes = check_certificate(roundtrip(out.cert), base)
+        assert any("blocking-halts" in n for n in notes)
+
+    def test_splitting_set_matches_module_and_reproves(self, rec):
+        from quorum_intersection_tpu.analytics.splitting import (
+            minimum_splitting_set,
+        )
+
+        base = synth.majority_fbas(5, prefix="ASQ")
+        expect = minimum_splitting_set(base, max_k=2)
+        out = QueryEngine(backend="python").resolve(
+            base,
+            Query.parse({"kind": "analytics", "metric": "splitting_set",
+                         "splitting_max_k": 2}),
+        )
+        assert out.result["splitting"] == expect
+        notes = check_certificate(roundtrip(out.cert), base)
+        assert any("splitting-witness" in n for n in notes)
+
+    def test_forged_blocking_proof_rejected(self, rec):
+        base = synth.majority_fbas(7, prefix="ABF")
+        out = QueryEngine(backend="python").resolve(
+            base,
+            Query.parse({"kind": "analytics", "metric": "blocking_set"}),
+        )
+        bad = roundtrip(out.cert)
+        # Swap the proof's node list for a DIFFERENT (unmasked) network:
+        # the checker must re-derive the mask and refuse.
+        bad["proof"]["nodes"] = base
+        with pytest.raises(CheckFailure):
+            check_certificate(bad, base)
+
+    def test_forged_splitting_proof_rejected(self, rec):
+        base = synth.majority_fbas(5, prefix="ASF")
+        out = QueryEngine(backend="python").resolve(
+            base,
+            Query.parse({"kind": "analytics", "metric": "splitting_set",
+                         "splitting_max_k": 2}),
+        )
+        bad = roundtrip(out.cert)
+        # Swap the proof's reduced network for a DIFFERENT genuinely
+        # split network of the right size: the checker must re-derive
+        # the byzantine deletion from the primary and refuse.
+        forged = synth.majority_fbas(
+            len(bad["proof"]["nodes"]), broken=True, prefix="FRG",
+        )
+        bad["proof"]["nodes"] = forged
+        with pytest.raises(CheckFailure):
+            check_certificate(bad, base)
+
+    def test_pagerank_matches_module(self, rec):
+        from quorum_intersection_tpu.analytics.pagerank import pagerank_auto
+
+        nodes = fixture_nodes("trivial_correct")
+        graph = build_graph(parse_fbas(nodes))
+        ranks, _engine_name = pagerank_auto(graph)
+        out = QueryEngine(backend="python").resolve(
+            nodes, Query.parse({"kind": "analytics", "metric": "pagerank"})
+        )
+        got = dict((k, v) for k, v in out.result["ranks"])
+        for v in range(graph.n):
+            assert got[graph.node_ids[v]] == pytest.approx(
+                float(ranks[v]), abs=1e-6
+            )
+
+    def test_splitting_pool_overbudget_typed(self, rec):
+        base = synth.majority_fbas(24, prefix="POOL")
+        with pytest.raises(QueryError) as exc:
+            QueryEngine(backend="python").resolve(
+                base,
+                Query.parse({"kind": "analytics",
+                             "metric": "splitting_set"}),
+            )
+        assert exc.value.code == "query_overbudget"
+
+
+# ---------------------------------------------------------------------------
+# fault degrade
+
+
+class TestDispatchFault:
+    def test_fault_degrades_typed_never_wrong(self, rec):
+        fa, fb = synth.two_family_preset(core=6, watchers=0, seed=1)
+        eng = QueryEngine(backend="python")
+        q = Query.parse({"kind": "relaxed", "family_b": fb})
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule(point="query.dispatch", mode="error",
+                             first=1, every=False),
+        ]))
+        with pytest.raises(QueryError) as exc:
+            eng.resolve(fa, q)
+        assert exc.value.code == "query_degraded"
+        # Second resolution (the rule fired exactly once): full verdict.
+        out = eng.resolve(fa, q)
+        assert out.verdict is True
+        counters, _ = rec.snapshot()
+        assert counters.get("query.errors", 0) == 1
+
+    def test_cancel_token_stops_relaxed_and_analytics(self, rec):
+        """The serve deadline supervisor's CancelToken is honored inside
+        the relaxed chunk loop and the analytics SCC loops — a tripped
+        token raises SearchCancelled instead of holding the drain
+        thread through the whole enumeration."""
+        from quorum_intersection_tpu.backends.base import (
+            CancelToken,
+            SearchCancelled,
+        )
+
+        fa, fb = synth.two_family_preset(core=8, watchers=2, seed=6)
+        cancel = CancelToken()
+        cancel.cancel()
+        eng = QueryEngine(backend="python")
+        with pytest.raises(SearchCancelled):
+            eng.resolve(fa, Query.parse({"kind": "relaxed",
+                                         "family_b": fb}), cancel=cancel)
+        with pytest.raises(SearchCancelled):
+            eng.resolve(fa, Query.parse({"kind": "analytics",
+                                         "metric": "top_tier"}),
+                        cancel=cancel)
+
+    def test_intersection_path_never_routes_through_dispatch(self, rec):
+        base = synth.majority_fbas(5, prefix="FLT")
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule(point="query.dispatch", mode="error"),
+        ]))
+        out = QueryEngine(backend="python").resolve(base, Query.parse(None))
+        assert out.verdict is True  # every-hit rule, yet untouched
+
+    def test_served_query_fault_is_typed_error_line(self, rec):
+        base = synth.majority_fbas(5, prefix="FSV")
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule(point="query.dispatch", mode="error",
+                             first=1, every=False),
+        ]))
+        with _engine(ServeEngine(backend="python")) as eng:
+            t = eng.submit(base, query={"kind": "analytics",
+                                        "metric": "pagerank"})
+            with pytest.raises(QueryError):
+                t.result(60.0)
+            # The legacy path keeps serving while queries degrade.
+            assert eng.submit(base).result(60.0).intersects is True
+
+
+# ---------------------------------------------------------------------------
+# serve / fleet round-trips
+
+
+class _engine:
+    def __init__(self, engine):
+        self.engine = engine
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=30.0)
+        return False
+
+
+def _mixed_stream():
+    base = synth.majority_fbas(7, prefix="MIX")
+    fa, fb = synth.two_family_preset(core=8, watchers=3, broken=True, seed=2)
+    fa2, fb2 = synth.two_family_preset(core=8, watchers=3, seed=2)
+    return [
+        (base, None),
+        (base, {"kind": "whatif", "max_k": 1}),
+        (fa, {"kind": "relaxed", "family_b": fb}),
+        (fa2, {"kind": "relaxed", "family_b": fb2}),
+        (base, {"kind": "analytics", "metric": "top_tier"}),
+        (base, {"kind": "analytics", "metric": "blocking_set"}),
+    ]
+
+
+def _oracle_verdicts(stream):
+    oracle = QueryEngine(backend="python")
+    return [
+        oracle.resolve(nodes, Query.parse(raw)).verdict
+        for nodes, raw in stream
+    ]
+
+
+class TestServeFleetRoundTrip:
+    def test_serve_mixed_stream(self, rec):
+        stream = _mixed_stream()
+        expected = _oracle_verdicts(stream)
+        with _engine(ServeEngine(backend="python")) as eng:
+            tickets = [
+                eng.submit(nodes, query=raw) for nodes, raw in stream
+            ]
+            responses = [t.result(120.0) for t in tickets]
+        for (nodes, raw), resp, expect in zip(stream, responses, expected):
+            assert resp.intersects is expect
+            if raw is None:
+                assert resp.result is None
+            else:
+                assert resp.result["kind"] == raw["kind"]
+                assert resp.cert is not None
+                if raw["kind"] == "relaxed":
+                    check_certificate(roundtrip(resp.cert), nodes)
+
+    def test_fleet_mixed_stream(self, rec, tmp_path):
+        stream = _mixed_stream()
+        expected = _oracle_verdicts(stream)
+        fleet = FleetEngine(
+            2, backend="python", worker_mode="local",
+            journal_dir=tmp_path / "flt", probe_interval_s=60.0,
+        )
+        fleet.start()
+        try:
+            tickets = [
+                fleet.submit(nodes, query=raw) for nodes, raw in stream
+            ]
+            responses = [t.result(120.0) for t in tickets]
+        finally:
+            fleet.stop(drain=True, timeout=60.0)
+        for (nodes, raw), resp, expect in zip(stream, responses, expected):
+            assert resp.intersects is expect
+            if raw is not None:
+                # The worker's result payload and certificate relay
+                # through the front door intact, checker-valid.
+                assert resp.result["kind"] == raw["kind"]
+                assert resp.cert is not None
+                if raw["kind"] == "relaxed":
+                    check_certificate(roundtrip(resp.cert), nodes)
+
+    def test_query_journal_replay(self, rec, tmp_path):
+        """A journaled-but-unanswered typed query replays on restart and
+        re-resolves the SAME question (the extended fingerprint pins
+        it to its kind)."""
+        fa, fb = synth.two_family_preset(
+            core=8, watchers=3, broken=True, seed=4,
+        )
+        raw = {"kind": "relaxed", "family_b": fb}
+        q = Query.parse(raw)
+        fp = snapshot_fingerprint(build_graph(parse_fbas(fa)))
+        fp = f"{fp}:q:{q.fingerprint()}"
+        path = tmp_path / "q.journal"
+        journal = RequestJournal(path)
+        journal.append_request("qr-1", fp, fa, None, query=q.to_wire())
+        journal.close()
+        with _engine(ServeEngine(backend="python", journal=path)) as eng:
+            report = eng._replay_report
+            assert report["verdicts"] == {"qr-1": False}
+            # The replayed result is cached under the extended key: an
+            # identical relaxed query is a hit, a bare intersection on
+            # the same snapshot is NOT.
+            hit = eng.submit(fa, query=raw).result(60.0)
+            assert hit.cached is True and hit.intersects is False
+            miss = eng.submit(fa).result(60.0)
+            assert miss.cached is False and miss.intersects is True
+
+    def test_query_journal_bad_query_quarantined(self, rec, tmp_path):
+        base = synth.majority_fbas(5, prefix="QJQ")
+        fp = snapshot_fingerprint(build_graph(parse_fbas(base)))
+        path = tmp_path / "bad.journal"
+        journal = RequestJournal(path)
+        journal.append_request("qr-bad", fp, base, None,
+                               query={"kind": "bogus"})
+        journal.close()
+        with _engine(ServeEngine(backend="python", journal=path)) as eng:
+            report = eng._replay_report
+        assert report["verdicts"] == {}
+        assert report["quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# synth scale presets
+
+
+class TestSynthPresets:
+    def test_nested_hierarchy_deterministic(self):
+        a = synth.nested_hierarchy(400, seed=3)
+        b = synth.nested_hierarchy(400, seed=3)
+        c = synth.nested_hierarchy(400, seed=4)
+        assert json.dumps(a) == json.dumps(b)
+        assert json.dumps(a) != json.dumps(c)
+        assert len(a) == 400
+
+    def test_nested_hierarchy_10k_generates(self):
+        nodes = synth.nested_hierarchy(10_000, seed=0)
+        assert len(nodes) == 10_000
+        # Deterministic and JSON-serializable (the serving layer
+        # journals exactly these dicts).
+        json.dumps(nodes[-1])
+
+    def test_nested_hierarchy_verdict_pair(self, rec):
+        correct = synth.nested_hierarchy(60, seed=1)
+        broken = synth.nested_hierarchy(60, seed=1, broken=True)
+        assert solve(correct, backend="python").intersects is True
+        assert solve(broken, backend="python").intersects is False
+
+    def test_two_family_preset_deterministic(self):
+        a = synth.two_family_preset(core=8, watchers=4, seed=5)
+        b = synth.two_family_preset(core=8, watchers=4, seed=5)
+        assert json.dumps(a) == json.dumps(b)
+
+    def test_two_family_broken_invisible_to_family_a(self, rec):
+        """The adversarial point: the broken twin's cross-family split is
+        invisible to family A's own single-family verdict."""
+        fa, fb = synth.two_family_preset(
+            core=9, watchers=3, broken=True, seed=0,
+        )
+        assert solve(fa, backend="python").intersects is True
+        out = QueryEngine(backend="python").resolve(
+            fa, Query.parse({"kind": "relaxed", "family_b": fb})
+        )
+        assert out.verdict is False
+
+
+# ---------------------------------------------------------------------------
+# fleet respawn + shared-store GC satellites
+
+
+class TestFleetRespawn:
+    def test_respawn_restores_ring_and_serves(self, rec, tmp_path):
+        base = synth.majority_fbas(7, prefix="RSP")
+        fleet = FleetEngine(
+            2, backend="python", worker_mode="local",
+            journal_dir=tmp_path / "rsp", probe_interval_s=60.0,
+        )
+        fleet.start()
+        try:
+            fleet.kill_worker(fleet.worker_ids()[0], evict=True)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                counters, _ = rec.snapshot()
+                if counters.get("fleet.respawns", 0) >= 1:
+                    break
+                time.sleep(0.05)
+            counters, gauges = rec.snapshot()
+            assert counters.get("fleet.respawns", 0) == 1
+            assert len(fleet.worker_ids()) == 2
+            assert gauges.get("fleet.ring_size") == 2
+            assert fleet.submit(base).result(60.0).intersects is True
+        finally:
+            fleet.stop(drain=True, timeout=60.0)
+
+    def test_respawn_disabled_keeps_shrunken_ring(self, rec, tmp_path):
+        fleet = FleetEngine(
+            2, backend="python", worker_mode="local",
+            journal_dir=tmp_path / "off", probe_interval_s=60.0,
+            respawn_max=0,
+        )
+        fleet.start()
+        try:
+            fleet.kill_worker(fleet.worker_ids()[0], evict=True)
+            time.sleep(0.5)
+            counters, _ = rec.snapshot()
+            assert counters.get("fleet.respawns", 0) == 0
+            assert len(fleet.worker_ids()) == 1
+        finally:
+            fleet.stop(drain=True, timeout=60.0)
+
+    def test_respawn_bounded_per_slot(self, rec, tmp_path):
+        fleet = FleetEngine(
+            2, backend="python", worker_mode="local",
+            journal_dir=tmp_path / "bnd", probe_interval_s=60.0,
+            respawn_max=1,
+        )
+        fleet.start()
+        try:
+            slot = fleet.worker_ids()[0]
+            fleet.kill_worker(slot, evict=True)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if f"{slot}.r1" in fleet.worker_ids():
+                    break
+                time.sleep(0.05)
+            assert f"{slot}.r1" in fleet.worker_ids()
+            fleet.kill_worker(f"{slot}.r1", evict=True)
+            time.sleep(0.6)
+            counters, _ = rec.snapshot()
+            assert counters.get("fleet.respawns", 0) == 1  # budget spent
+            assert len(fleet.worker_ids()) == 1
+        finally:
+            fleet.stop(drain=True, timeout=60.0)
+
+
+class TestSharedStoreGC:
+    def test_gc_sweeps_lru_by_mtime(self, rec):
+        with tempfile.TemporaryDirectory() as tmp:
+            store = SharedSccStore(tmp, max_mb=0.001)  # ~1 KiB budget
+            for i in range(20):
+                assert store.put(
+                    "scan", f"fp{i:03d}",
+                    {"quorum_local": list(range(40))},
+                )
+            counters, _ = rec.snapshot()
+            assert counters.get("delta.store_evictions", 0) > 0
+            # The stalest fragments went first; the newest survives and
+            # an evicted one is a plain miss.
+            assert store.get("scan", "fp019") is not None
+            assert store.get("scan", "fp000") is None
+
+    def test_gc_disabled_by_default(self, rec):
+        with tempfile.TemporaryDirectory() as tmp:
+            store = SharedSccStore(tmp)
+            for i in range(20):
+                store.put("scan", f"fp{i:03d}",
+                          {"quorum_local": list(range(40))})
+            assert store.get("scan", "fp000") is not None
+            counters, _ = rec.snapshot()
+            assert counters.get("delta.store_evictions", 0) == 0

@@ -621,6 +621,7 @@ def run_delta_schedules() -> List[ScheduleResult]:
 FLEET_SCHEDULES = (
     "fleet_route_during_eviction",
     "fleet_replay_races_new_request",
+    "fleet_respawn_restores_ring",
 )
 
 _REQUIRED_FLEET_POINTS: Dict[str, tuple] = {
@@ -632,6 +633,12 @@ _REQUIRED_FLEET_POINTS: Dict[str, tuple] = {
     # and both must complete (replay.done) with exactly one outcome each.
     "fleet_replay_races_new_request": (
         "replay.begin", "route.resolved", "replay.done",
+    ),
+    # the eviction must complete, then the bounded-backoff replacement
+    # must actually rejoin the ring (ISSUE 12 satellite) before the
+    # post-respawn request serves through the restored ring.
+    "fleet_respawn_restores_ring": (
+        "evict.removed", "respawn.begin", "respawn.done",
     ),
 }
 
@@ -729,6 +736,22 @@ def _run_fleet_one(schedule: str, data: object, expected: bool,
                     f"journal replay count {box2.get('replayed')!r} != 1 "
                     f"(pending ghost entry not inherited exactly once)"
                 )
+        elif schedule == "fleet_respawn_restores_ring":
+            # ISSUE 12 satellite: after an eviction the supervisor spawns
+            # a bounded-backoff replacement that re-inserts into the ring
+            # — the ring must return to full strength and the NEXT
+            # request must serve through the restored ring with the
+            # correct verdict (pre-respawn the fleet shrank until
+            # restart).
+            engine.kill_worker(target, evict=True)
+            if not ctl.reached_event("respawn.done").wait(WAIT_S):
+                raise ScheduleError("respawned worker never rejoined")
+            with engine._lock:
+                ring_size = len(engine._ring)
+            if ring_size != 2:
+                error = f"ring size {ring_size} != 2 after respawn"
+            else:
+                verdict = engine.submit(data).result(WAIT_S).intersects
         else:
             raise ValueError(f"unknown fleet schedule {schedule!r}")
     finally:

@@ -64,6 +64,12 @@ TYPING_TARGETS = (
     # worker at once.
     "quorum_intersection_tpu/fleet.py",
     "quorum_intersection_tpu/serve_transport.py",
+    # ISSUE 12: the typed query subsystem joins the spine — a type
+    # confusion between the two families' coordinate spaces, or between
+    # a masked variant and its base snapshot, is exactly the
+    # wrong-answer-with-confidence failure the typed schema exists to
+    # prevent.
+    "quorum_intersection_tpu/query.py",
 )
 
 

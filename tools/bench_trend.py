@@ -112,6 +112,16 @@ TRACKED: Dict[str, str] = {
     "fleet_verdicts_per_sec": "higher",
     "fleet_p99_ms": "lower",
     "fleet_store_hit_pct": "higher",
+    # qi-query typed queries (ISSUE 12): benchmarks/serve.py --queries
+    # rows.  One headline plus a per-kind breakdown, so a regression in
+    # ONE resolver (a relaxed enumeration that stopped vectorizing, a
+    # whatif frontier that stopped lane-packing) shows up even when the
+    # mixed-workload aggregate hides it behind the cheap kinds.
+    "query_verdicts_per_sec": "higher",
+    "query_intersection_per_sec": "higher",
+    "query_relaxed_per_sec": "higher",
+    "query_whatif_per_sec": "higher",
+    "query_analytics_per_sec": "higher",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",

@@ -506,16 +506,281 @@ def _check_ledger_entry(
     raise CheckFailure(f"ledger entry with unknown backend {backend!r}")
 
 
+def _check_relaxed_certificate(
+    cert: dict, nodes: Sequence[dict], query: dict
+) -> List[str]:
+    """Validate a relaxed two-family certificate (qi-query, ISSUE 12).
+
+    The second family rides inside the certificate (``query.family_b``),
+    so the claim is self-contained: this checker re-resolves BOTH
+    families with its own evaluator, re-proves the family-A guard count,
+    and for a ``false`` verdict re-proves the cross-family witness —
+    ``q1`` a family-A quorum, ``q2`` a family-B quorum, disjoint, every
+    member's slice evidence agreeing with this checker's own evaluation.
+    A ``true`` verdict's ledger must claim FULL coverage of the
+    ``2^m - 1`` nonempty windows of each family-A quorum-bearing SCC
+    (docs/PARITY.md §Two-family invariants)."""
+    notes: List[str] = []
+    verdict = cert.get("verdict")
+    _require(isinstance(verdict, bool), "certificate without a boolean verdict")
+    dangling = str(cert.get("dangling", "strict"))
+    fam_b = query.get("family_b")
+    _require(
+        isinstance(fam_b, list) and bool(fam_b),
+        "relaxed certificate without an embedded family_b node array",
+    )
+    ev_a = Evaluator(nodes, dangling)
+    ev_b = Evaluator(fam_b, dangling)  # type: ignore[arg-type]
+    _require(
+        ev_a.ids == ev_b.ids,
+        "relaxed families do not share one node set in one order",
+    )
+    qb_a = ev_a.quorum_bearing_sccs()
+    guard = cert.get("guard") or {}
+    _require(
+        guard.get("quorum_bearing_sccs") == len(qb_a),
+        f"relaxed guard claims {guard.get('quorum_bearing_sccs')} "
+        f"family-A quorum-bearing SCC(s); this checker found {len(qb_a)}",
+    )
+    notes.append(
+        f"relaxed guard: {len(qb_a)} family-A quorum-bearing SCC(s) "
+        f"confirmed"
+    )
+    if not verdict:
+        witness = cert.get("witness") or {}
+        evidence = witness.get("evidence") or {}
+        s1 = _check_witness_quorum(ev_a, "q1", witness.get("q1") or [],
+                                   evidence.get("q1") or [])
+        s2 = _check_witness_quorum(ev_b, "q2", witness.get("q2") or [],
+                                   evidence.get("q2") or [])
+        _require(not (s1 & s2), "cross-family witness quorums intersect")
+        notes.append(
+            f"cross-family witness confirmed: disjoint A-quorum "
+            f"({len(s1)}) and B-quorum ({len(s2)})"
+        )
+        return notes
+    vacuous = cert.get("vacuous")
+    if vacuous == "no_quorum_family_a":
+        _require(len(qb_a) == 0,
+                 "vacuous no_quorum_family_a but family A bears a quorum")
+        notes.append("vacuous true confirmed: family A holds no quorum")
+        return notes
+    if vacuous == "no_quorum_family_b":
+        _require(
+            not ev_b.max_quorum(list(range(ev_b.n))),
+            "vacuous no_quorum_family_b but family B's graph-wide "
+            "fixpoint is nonempty",
+        )
+        notes.append("vacuous true confirmed: family B holds no quorum")
+        return notes
+    entries = (cert.get("coverage") or {}).get("sccs") or []
+    _require(bool(entries), "relaxed true verdict without a coverage ledger")
+    scc_sets = [frozenset(ev_a.ids[v] for v in scc) for scc in qb_a]
+    for entry in entries:
+        size = entry.get("size")
+        space = entry.get("window_space")
+        enumerated = entry.get("windows_enumerated")
+        _require(isinstance(size, int) and size > 0,
+                 "relaxed ledger entry without a positive SCC size")
+        _require(
+            space == (1 << size) - 1,
+            f"relaxed window space {space} != 2^{size} - 1",
+        )
+        _require(
+            enumerated == space,
+            f"relaxed coverage incomplete: {enumerated} of {space} "
+            f"windows enumerated",
+        )
+        entry_nodes = frozenset(entry.get("nodes") or [])
+        _require(
+            entry_nodes in scc_sets,
+            "relaxed ledger entry's nodes are not a family-A "
+            "quorum-bearing SCC",
+        )
+        notes.append(
+            f"relaxed coverage: {enumerated}/{space} windows over a "
+            f"{size}-node SCC"
+        )
+    _require(
+        len(entries) == len(qb_a),
+        f"relaxed ledger covers {len(entries)} SCC(s); family A bears "
+        f"{len(qb_a)}",
+    )
+    return notes
+
+
+def _check_query_result_certificate(
+    cert: dict, nodes: Sequence[dict], sample: Optional[int]
+) -> List[str]:
+    """Validate a ``qi-query-cert/1`` analytics result certificate.
+
+    Splitting/blocking results carry a re-provable proof block — a full
+    ``qi-cert/1`` for the reduced/masked network plus the exact node
+    list it is against — which re-validates through this checker's
+    normal witness-evidence / no-quorum paths.  A blocking proof's
+    masked node list is additionally RE-DERIVED from the primary
+    snapshot (masking is pure quorumSet nulling), so a forged embedded
+    list cannot smuggle a different network past the re-proof."""
+    notes: List[str] = []
+    query = cert.get("query") or {}
+    _require(query.get("kind") == "analytics",
+             f"unknown query-cert kind {query.get('kind')!r}")
+    digest = cert.get("result_digest")
+    _require(isinstance(digest, str) and len(digest) == 32,
+             "query certificate without a result digest")
+    metric = query.get("metric")
+    notes.append(f"analytics result cert ({metric}) digest present")
+    proof = cert.get("proof")
+    if proof is None:
+        return notes
+    _require(
+        isinstance(proof, dict) and isinstance(proof.get("cert"), dict)
+        and isinstance(proof.get("nodes"), list),
+        "analytics proof block without cert + nodes",
+    )
+    claim = proof.get("claim")
+    proof_nodes = proof["nodes"]
+    result = cert.get("result") or {}
+    if claim == "blocking-halts":
+        blocking = result.get("blocking")
+        _require(isinstance(blocking, list) and bool(blocking),
+                 "blocking proof without the claimed blocking set")
+        gone = set(blocking)
+        rederived = [
+            {**node, "quorumSet": None}
+            if node.get("publicKey") in gone else dict(node)
+            for node in nodes
+        ]
+        _require(
+            _canon_nodes(rederived) == _canon_nodes(proof_nodes),
+            "blocking proof nodes differ from masking the primary "
+            "snapshot with the claimed blocking set",
+        )
+        _require(
+            proof["cert"].get("verdict") is False
+            and proof["cert"].get("no_quorum") is True,
+            "blocking proof cert does not claim a halted network "
+            "(false + no_quorum)",
+        )
+    elif claim == "splitting-witness":
+        splitting = result.get("splitting")
+        _require(isinstance(splitting, list) and bool(splitting),
+                 "splitting proof without the claimed splitting set")
+        primary_ids = {n.get("publicKey") for n in nodes}
+        _require(
+            all(k in primary_ids for k in splitting),
+            "splitting set names nodes outside the primary snapshot",
+        )
+        rederived = _byzantine_delete(nodes, splitting)
+        _require(
+            _canon_nodes(rederived) == _canon_nodes(proof_nodes),
+            "splitting proof nodes differ from this checker's own "
+            "byzantine deletion of the claimed set from the primary "
+            "snapshot",
+        )
+        _require(
+            proof["cert"].get("verdict") is False
+            and isinstance(proof["cert"].get("witness"), dict),
+            "splitting proof cert does not witness a disjoint pair",
+        )
+    else:
+        raise CheckFailure(f"unknown analytics proof claim {claim!r}")
+    notes.extend(check_certificate(proof["cert"], proof_nodes, sample=sample))
+    notes.append(f"analytics proof re-proved ({claim})")
+    return notes
+
+
+def _canon_nodes(nodes: Sequence[dict]) -> str:
+    return json.dumps(list(nodes), sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def _scrub_qset(qset: object, removed: frozenset) -> Tuple[object, bool]:
+    """Byzantine ``delete`` on one raw quorum set: ``(qset', trivial)``.
+
+    This checker's OWN implementation of the FBAS delete semantics
+    (threshold decremented per deleted member — byzantine nodes vote for
+    everyone; a set driven to threshold <= 0 becomes trivially
+    satisfiable and folds into its parent), deliberately sharing no code
+    with ``analytics/splitting.py``: the splitting proof's reduced
+    network is re-derived HERE, so a forged embedded node list cannot
+    smuggle a different network past the re-proof.  Degenerate
+    thresholds (<= 0 to begin with, non-numeric) are left untouched,
+    mirroring the engine's pinned Q3 handling."""
+    if not isinstance(qset, dict):
+        return qset, False
+    t = qset.get("threshold")
+    if isinstance(t, str):
+        try:
+            t = int(t)
+        except ValueError:
+            return qset, False
+    if not isinstance(t, int) or isinstance(t, bool):
+        return qset, False
+    if t <= 0:
+        return qset, False
+    validators = [
+        v for v in (qset.get("validators") or []) if v not in removed
+    ]
+    t -= len(qset.get("validators") or []) - len(validators)
+    inner: List[dict] = []
+    for child in qset.get("innerQuorumSets") or []:
+        scrubbed, trivial = _scrub_qset(child, removed)
+        if trivial:
+            t -= 1  # the child now votes unconditionally
+        else:
+            inner.append(scrubbed)  # type: ignore[arg-type]
+    if t <= 0:
+        return None, True
+    return {"threshold": t, "validators": validators,
+            "innerQuorumSets": inner}, False
+
+
+def _byzantine_delete(
+    nodes: Sequence[dict], removed_keys: Sequence[str]
+) -> List[dict]:
+    """The FBAS ``delete`` operation over a raw node list (see
+    :func:`_scrub_qset`) — the checker's independent twin of the
+    analytics engine's reduction."""
+    removed = frozenset(removed_keys)
+    out: List[dict] = []
+    for node in nodes:
+        key = node.get("publicKey")
+        if key in removed:
+            continue
+        q = node.get("quorumSet")
+        if q is None:
+            out.append(dict(node))
+            continue
+        scrubbed, trivial = _scrub_qset(q, removed)
+        if trivial:
+            scrubbed = {"threshold": 1, "validators": [key],
+                        "innerQuorumSets": []}
+        out.append({**node, "quorumSet": scrubbed})
+    return out
+
+
 def check_certificate(
     cert: dict, nodes: Sequence[dict], sample: Optional[int] = None
 ) -> List[str]:
     """Validate ``cert`` against the raw node list; returns human-readable
     notes, raises :class:`CheckFailure` on the first unsound claim.
     ``sample``: re-verify at most that many pruned blocks per ledger entry
-    (deterministic stride); None/0 re-verifies every block."""
+    (deterministic stride); None/0 re-verifies every block.
+
+    Since qi-query (ISSUE 12) two further shapes validate here: a
+    ``qi-cert/1`` carrying a ``query`` block with ``kind: relaxed`` (the
+    two-family certificate — family B rides inside it) and the
+    ``qi-query-cert/1`` analytics result certificate (re-provable
+    splitting/blocking proofs)."""
+    if cert.get("schema") == "qi-query-cert/1":
+        return _check_query_result_certificate(cert, nodes, sample)
     notes: List[str] = []
     _require(cert.get("schema") == "qi-cert/1",
              f"unknown certificate schema {cert.get('schema')!r}")
+    query = cert.get("query")
+    if isinstance(query, dict) and query.get("kind") == "relaxed":
+        return _check_relaxed_certificate(cert, nodes, query)
     verdict = cert.get("verdict")
     _require(isinstance(verdict, bool), "certificate without a boolean verdict")
     dangling = str(cert.get("dangling", "strict"))

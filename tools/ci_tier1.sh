@@ -186,6 +186,38 @@ env JAX_PLATFORMS=cpu python tools/soak.py --fleet --chaos \
 fsrc=$?
 echo "FLEET_CHAOS=exit $fsrc"
 
+# qi-query gate (ISSUE 12): the typed-query smoke — the mixed-workload
+# parity phase (benchmarks/serve.py --queries: every served
+# intersection/relaxed/whatif/analytics verdict equals a direct
+# QueryEngine oracle resolution, silent drops exit 1) plus a one-shot
+# relaxed CLI round over the adversarial two-family preset with its
+# cross-family witness certificate re-validated by the independent
+# stdlib checker.
+env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick --queries
+qrc=$?
+echo "QUERY_BENCH=exit $qrc"
+env JAX_PLATFORMS=cpu python - <<'PYEOF' || qrc=1
+import json, os, subprocess, sys, tempfile
+sys.path.insert(0, os.getcwd())
+from quorum_intersection_tpu.fbas.synth import two_family_preset
+from tools.check_cert import check_certificate
+
+fa, fb = two_family_preset(core=8, watchers=3, broken=True, seed=0)
+with tempfile.TemporaryDirectory() as tmp:
+    fbp = os.path.join(tmp, "famb.json")
+    open(fbp, "w").write(json.dumps(fb))
+    certp = os.path.join(tmp, "relaxed.cert.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "quorum_intersection_tpu", "query",
+         "--kind", "relaxed", "--family-b", fbp, "--cert-out", certp,
+         "--backend", "python"],
+        input=json.dumps(fa), capture_output=True, text=True)
+    assert p.returncode == 1, (p.returncode, p.stderr)  # split found
+    notes = check_certificate(json.load(open(certp)), fa)
+    print(f"QUERY: relaxed cert re-validated ({notes[-1]})")
+PYEOF
+echo "QUERY=exit $qrc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -205,4 +237,5 @@ echo "TREND=exit $trc"
 [ "$ssrc" -ne 0 ] && exit "$ssrc"
 [ "$frc" -ne 0 ] && exit "$frc"
 [ "$fsrc" -ne 0 ] && exit "$fsrc"
+[ "$qrc" -ne 0 ] && exit "$qrc"
 exit "$trc"
