@@ -468,7 +468,7 @@ def check_many(
 
 
 def solve(
-    source,
+    source: Union[str, bytes, List[Dict[str, object]], Fbas],
     *,
     backend: Union[str, SearchBackend] = "auto",
     dangling: str = "strict",

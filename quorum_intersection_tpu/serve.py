@@ -84,7 +84,7 @@ from quorum_intersection_tpu.delta import (
     SharedSccStore,
 )
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
-from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
+from quorum_intersection_tpu.fbas.schema import Fbas, QSet, parse_fbas
 from quorum_intersection_tpu.pipeline import SolveResult, check_many
 from quorum_intersection_tpu.query import (
     Query,
@@ -373,6 +373,7 @@ class RequestJournal:
                         }) + "\n")
                 self._fh.write(json.dumps(payload, default=str) + "\n")
                 self._fh.flush()
+                # qi-lint: allow(lock-blocking) — fsync-before-release IS the journal contract: an append is not durable until fsync returns, and a later entry must never land before an earlier one
                 os.fsync(self._fh.fileno())
         except (OSError, FaultInjected) as exc:
             rec.add("serve.journal_errors")
@@ -492,6 +493,7 @@ class RequestJournal:
                     for entry in keep:
                         fh.write(json.dumps(entry, default=str) + "\n")
                     fh.flush()
+                    # qi-lint: allow(lock-blocking) — compaction must publish a fully fsynced replacement before any concurrent append reopens the journal; the lock covers exactly that atomic swap
                     os.fsync(fh.fileno())
                 os.replace(tmp, self.path)
             try:
@@ -1539,7 +1541,7 @@ def _percentile(sorted_samples: List[float], pct: float) -> float:
     return sorted_samples[min(rank, len(sorted_samples) - 1)]
 
 
-def _qset_raw(q) -> Optional[Dict[str, object]]:
+def _qset_raw(q: Optional[QSet]) -> Optional[Dict[str, object]]:
     """Stellarbeat-shaped dict of one parsed QSet (``None`` for the
     never-satisfiable null qset) — the inverse of ``schema._parse_qset``."""
     if q is None or q.threshold is None:

@@ -1,0 +1,8 @@
+"""Fixture: a telemetry name built at runtime — invisible to qi-surface."""
+
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+
+def emit(kind: str) -> None:
+    rec = get_run_record()
+    rec.add("fixture." + kind)  # BAD: concatenation is not statically resolvable

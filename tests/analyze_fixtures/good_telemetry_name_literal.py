@@ -1,0 +1,15 @@
+"""Fixture twin: every statically-resolvable name shape the rule allows."""
+
+from quorum_intersection_tpu.utils.faults import fault_point
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+FIXTURE_COUNTER = "fixture.counter"
+
+
+def emit(flag: bool) -> None:
+    rec = get_run_record()
+    rec.add(FIXTURE_COUNTER)  # module-level constant
+    rec.add("fixture.hits" if flag else "fixture.misses")  # both branches literal
+    rec.event(f"fixture.{'on' if flag else 'off'}")  # dotted-prefix f-string
+    rec.gauge("fixture.gauge", 1.0)  # plain literal
+    fault_point("checkpoint.write")  # literal catalog key

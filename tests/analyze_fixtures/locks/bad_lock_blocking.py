@@ -1,0 +1,15 @@
+"""Fixture: a subprocess run while holding the class lock."""
+
+import subprocess
+import threading
+
+
+class Builder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.artifacts = []
+
+    def build(self) -> None:
+        with self._lock:
+            subprocess.run(["true"], check=False)  # BAD: blocks every waiter
+            self.artifacts.append("built")

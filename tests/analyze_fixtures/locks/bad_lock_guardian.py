@@ -1,0 +1,17 @@
+"""Fixture: a guarded attribute mutated lock-free on the worker thread."""
+
+import threading
+
+
+class Collector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.items = []
+        self._thread = threading.Thread(target=self._worker)
+
+    def add_item(self, x: object) -> None:
+        with self._lock:
+            self.items.append(x)
+
+    def _worker(self) -> None:
+        self.items.append("tick")  # BAD: lock-free on the spawned thread

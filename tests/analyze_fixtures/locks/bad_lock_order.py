@@ -1,0 +1,25 @@
+"""Fixture: inconsistent lock order across interprocedural call edges."""
+
+import threading
+
+
+class Orderer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+
+    def ab(self) -> None:
+        with self._lock:
+            self._grab_other()  # BAD: _lock then _other ...
+
+    def _grab_other(self) -> None:
+        with self._other:
+            pass
+
+    def ba(self) -> None:
+        with self._other:
+            self._grab_lock()  # BAD: ... while this path takes _other then _lock
+
+    def _grab_lock(self) -> None:
+        with self._lock:
+            pass

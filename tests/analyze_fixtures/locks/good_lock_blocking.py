@@ -1,0 +1,21 @@
+"""Fixture twin: blocking work outside the lock; the sanctioned
+condition-wait on the innermost held lock stays unflagged."""
+
+import subprocess
+import threading
+
+
+class Builder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self.artifacts = []
+
+    def build(self) -> None:
+        subprocess.run(["true"], check=False)
+        with self._lock:
+            self.artifacts.append("built")
+
+    def wait_built(self) -> None:
+        with self._done:
+            self._done.wait_for(lambda: bool(self.artifacts))

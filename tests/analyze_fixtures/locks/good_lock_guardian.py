@@ -1,0 +1,18 @@
+"""Fixture twin: every mutation of the attribute holds its guardian."""
+
+import threading
+
+
+class Collector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.items = []
+        self._thread = threading.Thread(target=self._worker)
+
+    def add_item(self, x: object) -> None:
+        with self._lock:
+            self.items.append(x)
+
+    def _worker(self) -> None:
+        with self._lock:
+            self.items.append("tick")

@@ -1,0 +1,22 @@
+"""Fixture twin: the same two locks, always acquired in one global order."""
+
+import threading
+
+
+class Orderer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+
+    def ab(self) -> None:
+        with self._lock:
+            self._grab_other()
+
+    def _grab_other(self) -> None:
+        with self._other:
+            pass
+
+    def ba(self) -> None:
+        with self._lock:
+            with self._other:
+                pass

@@ -1,0 +1,12 @@
+"""Fixture: one emission of every unregistered/undeclared kind."""
+
+from quorum_intersection_tpu.utils.env import qi_env
+from quorum_intersection_tpu.utils.faults import fault_point
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+
+def emit() -> None:
+    rec = get_run_record()
+    rec.add("fixture.unregistered")  # BAD: counter missing from the registry
+    fault_point("fixture.undeclared")  # BAD: not in the fault catalog
+    qi_env("QI_UNDECLARED")  # BAD: not in the env registry

@@ -1,0 +1,17 @@
+"""Fixture: emissions that exactly match the synthetic registries."""
+
+from quorum_intersection_tpu.utils.env import qi_env
+from quorum_intersection_tpu.utils.faults import fault_point
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+
+def emit(name: str) -> None:
+    rec = get_run_record()
+    rec.add("fixture.registered")
+    rec.gauge("fixture.gauge", 1.0)
+    rec.event("fixture.event")
+    with rec.span("fixture.span"):
+        with rec.span(f"fixture.dyn.{name}"):
+            pass
+    fault_point("fixture.point")
+    qi_env("QI_FIXTURE")

@@ -1,0 +1,11 @@
+"""Fixture: a consumer reading a field no producer of the channel writes."""
+
+
+def produce(x: object) -> dict:
+    return {"a": x, "kind": "row"}
+
+
+def consume(obj: dict) -> object:
+    if obj.get("kind") != "row":
+        return None
+    return obj.get("missing")  # BAD: nobody produces "missing"

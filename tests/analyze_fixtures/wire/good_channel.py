@@ -1,0 +1,13 @@
+"""Fixture twin: every consumed field is produced."""
+
+
+def produce(x: object) -> dict:
+    out = {"a": x, "kind": "row"}
+    out["b"] = repr(x)
+    return out
+
+
+def consume(obj: dict) -> object:
+    if "kind" in obj:
+        return (obj.get("a"), obj["b"])
+    return None

@@ -41,6 +41,9 @@ RULE_FIXTURES = {
     # Path-gated rule: its fixture pair lives under a backends/ subdir so
     # the relative path matches the gate (the rule is scoped to engines).
     "degrade-via-ladder": "backends/degrade_via_ladder",
+    # ISSUE 13: telemetry/fault names must stay statically extractable so
+    # the qi-surface registry drift gate sees every emission.
+    "telemetry-name-literal": "telemetry_name_literal",
 }
 
 
@@ -123,6 +126,27 @@ class TestRepoClean:
 
         scanned = {str(p) for p in iter_python_files(REPO_ROOT, DEFAULT_SCAN)}
         assert not any("analyze_fixtures" in s for s in scanned)
+
+    def test_surface_clean_and_inventory_current(self):
+        # The whole-program drift gate (ISSUE 13): the registries agree
+        # with the code, and the COMMITTED inventory matches a fresh
+        # extraction (regenerating it in CI must produce no diff).
+        from tools.analyze.surface import run_surface
+
+        findings, _notes = run_surface(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_locks_clean(self):
+        from tools.analyze.locks import run_locks
+
+        findings, _notes = run_locks(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_wire_clean(self):
+        from tools.analyze.wire import run_wire
+
+        findings, _notes = run_wire(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
 
 
 class TestTypingRatchet:
@@ -356,6 +380,416 @@ class TestScheduleDegenerationIsLoud:
         assert r.verdict is True  # the oracle still answered correctly...
         assert not r.ok  # ...but the harness refuses to call it clean
         assert r.error is not None and "sweep_error" in r.error
+
+
+class TestSurfacePass:
+    """qi-surface (ISSUE 13 tentpole): extraction, wildcard matching, every
+    drift direction, inventory determinism + staleness."""
+
+    FAULTS = {"fixture.point", "fixture.unfired"}
+    ENV = {"QI_FIXTURE", "QI_UNREAD"}
+
+    def _fixture_root(self, tmp_path, with_bad):
+        import shutil
+
+        root = tmp_path / "repo"
+        shutil.copytree(FIXTURES / "surface" / "docs", root / "docs")
+        shutil.copytree(FIXTURES / "surface" / "pkg", root / "pkg")
+        if not with_bad:
+            (root / "pkg" / "bad_emits.py").unlink()
+        return root
+
+    def _run(self, root, tmp_path, **kw):
+        from tools.analyze.surface import run_surface
+
+        kw.setdefault("inventory_path", tmp_path / "inv.json")
+        return run_surface(
+            root, scan=("pkg",), declared_faults=self.FAULTS,
+            declared_env=self.ENV, **kw,
+        )
+
+    def test_planted_drift_directions_fire_exactly(self, tmp_path):
+        # The GOOD emission file against registries with planted drift:
+        # one finding per planted direction, nothing else.
+        root = self._fixture_root(tmp_path, with_bad=False)
+        findings, _ = self._run(root, tmp_path, update_inventory=True)
+        by_rule = {}
+        for f in findings:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert sorted(by_rule) == [
+            "surface-env-doc-stale",       # QI_GHOST row, undeclared
+            "surface-env-unread",          # QI_UNREAD declared, never read
+            "surface-fault-doc-stale",     # fixture.ghost row, undeclared
+            "surface-fault-undocumented",  # fixture.unfired missing its row
+            "surface-fault-unfired",       # fixture.unfired never fires
+            "surface-registry-stale",      # fixture.stale row, never emitted
+        ]
+        assert all(len(v) == 1 for v in by_rule.values()), by_rule
+        assert "fixture.stale" in by_rule["surface-registry-stale"][0].message
+
+    def test_emission_side_drift_and_inventory_staleness(self, tmp_path):
+        root = self._fixture_root(tmp_path, with_bad=False)
+        self._run(root, tmp_path, update_inventory=True)  # bank the inventory
+        # Adding the bad file changes the surface: unregistered counter,
+        # undeclared fault point + env read, AND a stale inventory.
+        import shutil
+
+        shutil.copy(FIXTURES / "surface" / "pkg" / "bad_emits.py",
+                    root / "pkg")
+        findings, _ = self._run(root, tmp_path)
+        rules = {f.rule for f in findings}
+        assert "surface-telemetry-unregistered" in rules
+        assert "surface-fault-undeclared" in rules
+        assert "surface-env-undeclared" in rules
+        assert "surface-inventory-stale" in rules
+        bad = [f for f in findings
+               if f.rule == "surface-telemetry-unregistered"]
+        assert bad[0].path.endswith("bad_emits.py")
+        marked = [
+            i + 1 for i, line in enumerate(
+                (root / "pkg" / "bad_emits.py").read_text().splitlines())
+            if "BAD" in line
+        ]
+        assert bad[0].line in marked
+
+    def test_registered_good_surface_is_clean(self, tmp_path):
+        # With the planted-drift registry rows honored (unfired/unread
+        # entries removed from the declared sets), the good file is CLEAN.
+        root = self._fixture_root(tmp_path, with_bad=False)
+        obs = (root / "docs" / "OBSERVABILITY.md").read_text()
+        (root / "docs" / "OBSERVABILITY.md").write_text(
+            "\n".join(l for l in obs.splitlines()
+                      if "fixture.stale" not in l) + "\n")
+        rob = (root / "docs" / "ROBUSTNESS.md").read_text()
+        (root / "docs" / "ROBUSTNESS.md").write_text(
+            "\n".join(l for l in rob.splitlines()
+                      if "ghost" not in l and "GHOST" not in l) + "\n")
+        from tools.analyze.surface import run_surface
+
+        findings, _ = run_surface(
+            root, scan=("pkg",), inventory_path=tmp_path / "inv.json",
+            declared_faults={"fixture.point"}, declared_env={"QI_FIXTURE"},
+            update_inventory=True,
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+        # ... and a second run against the banked inventory stays clean.
+        findings, _ = run_surface(
+            root, scan=("pkg",), inventory_path=tmp_path / "inv.json",
+            declared_faults={"fixture.point"}, declared_env={"QI_FIXTURE"},
+        )
+        assert findings == []
+
+    def test_inventory_deterministic_across_runs(self):
+        import json
+
+        from tools.analyze.surface import extract_surface
+
+        a = json.dumps(extract_surface(REPO_ROOT).to_inventory(),
+                       sort_keys=True)
+        b = json.dumps(extract_surface(REPO_ROOT).to_inventory(),
+                       sort_keys=True)
+        assert a == b
+
+    def test_committed_inventory_matches_fresh_extraction(self):
+        import json
+
+        from tools.analyze.surface import INVENTORY_PATH, extract_surface
+
+        committed = json.loads(INVENTORY_PATH.read_text())
+        assert committed == extract_surface(REPO_ROOT).to_inventory()
+        assert committed["schema"] == "qi-surface/1"
+        # The journal field-stability slice the wire pass banks here.
+        assert "kind" in committed["wire"]["serve.journal"]["producer"]
+        assert "fingerprint" in committed["wire"]["serve.journal"]["consumer"]
+
+    def test_wildcard_matching(self):
+        from tools.analyze.surface import _covered
+
+        assert _covered("phase.parse", {"phase.*"})
+        assert _covered("phase.*", {"phase.parse"})   # wildcard vs exact row
+        assert _covered("bench.*", {"bench.*"})
+        assert not _covered("serve.batch", {"phase.*"})
+        assert not _covered("phaseparse", {"phase.*"})
+        # Mid-name placeholders (`serve.<op>.latency` rows) must match the
+        # concrete emission (code-review finding).
+        assert _covered("serve.drain.latency", {"serve.*.latency"})
+        assert not _covered("serve.drain.count", {"serve.*.latency"})
+
+    def test_keyword_name_argument_is_extracted(self, tmp_path):
+        # rec.add(name="...") / fault_point(name="...") are legal call
+        # shapes and must not bypass extraction (code-review finding).
+        from tools.analyze.surface import Surface, _extract_file
+        from tools.analyze.lint import FileContext
+
+        src = (
+            "def f(rec):\n"
+            "    rec.add(name='kw.counter')\n"
+            "    fault_point(name='kw.point')\n"
+            "    qi_env(name='QI_KW')\n"
+        )
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        surface = Surface()
+        _extract_file(FileContext(p, "m.py", src), surface)
+        assert "kw.counter" in surface.names("counter")
+        assert {e.name for e in surface.fault_fires} == {"kw.point"}
+        assert {e.name for e in surface.env_reads} == {"QI_KW"}
+
+    def test_code_side_findings_honor_allow_suppression(self, tmp_path):
+        # The qi-lint suppression discipline applies to surface findings
+        # at the emitting call site (doc-side rows have no code line).
+        import shutil
+
+        root = self._fixture_root(tmp_path, with_bad=False)
+        (root / "pkg" / "suppressed.py").write_text(
+            "from quorum_intersection_tpu.utils.telemetry import "
+            "get_run_record\n\n\n"
+            "def emit() -> None:\n"
+            "    rec = get_run_record()\n"
+            "    # qi-lint: allow(surface-telemetry-unregistered) — "
+            "fixture reason\n"
+            "    rec.add('fixture.suppressed_counter')\n"
+        )
+        findings, _ = self._run(root, tmp_path, update_inventory=True)
+        assert "surface-telemetry-unregistered" not in {
+            f.rule for f in findings
+        }, findings
+
+    def test_placeholderless_fstring_is_exact_not_wildcard(self, tmp_path):
+        from tools.analyze.lint import FileContext, resolve_name_arg
+        import ast as ast_mod
+
+        src = "def f(rec):\n    rec.add(f'serve.hits')\n"
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        ctx = FileContext(p, "m.py", src)
+        call = next(n for n in ast_mod.walk(ctx.tree)
+                    if isinstance(n, ast_mod.Call))
+        assert resolve_name_arg(ctx, call.args[0]) == "serve.hits"
+
+    def test_conditional_and_fstring_names_extract(self, tmp_path):
+        from tools.analyze.lint import FileContext, resolve_name_args
+
+        src = (
+            "K = 'mod.const'\n"
+            "def f(rec, flag, kind):\n"
+            "    rec.add('a.hits' if flag else 'a.misses')\n"
+            "    rec.event(f'q.{kind}')\n"
+            "    rec.gauge(K, 1)\n"
+            "    rec.add('x' + kind)\n"
+        )
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        ctx = FileContext(p, "m.py", src)
+        import ast as ast_mod
+
+        calls = [n for n in ast_mod.walk(ctx.tree)
+                 if isinstance(n, ast_mod.Call)]
+        resolved = [resolve_name_args(ctx, c.args[0]) for c in calls]
+        assert ["a.hits", "a.misses"] in resolved
+        assert ["q.*"] in resolved
+        assert ["mod.const"] in resolved
+        assert [] in resolved  # concatenation: unextractable
+
+
+class TestLocksPass:
+    """qi-locks (ISSUE 13 tentpole): one fixture pair per finding kind."""
+
+    PAIRS = {
+        "lock-order-cycle": "locks/lock_order",
+        "lock-blocking": "locks/lock_blocking",
+        "lock-guardian": "locks/lock_guardian",
+    }
+
+    @pytest.mark.parametrize("rule,stem", sorted(PAIRS.items()))
+    def test_bad_fixture_yields_exactly_one_finding(self, rule, stem):
+        from tools.analyze.locks import run_locks
+
+        rel = str(Path("tests/analyze_fixtures") / f"{Path(stem).parent}" /
+                  f"bad_{Path(stem).name}.py")
+        findings, _ = run_locks(REPO_ROOT, targets=[rel])
+        assert [f.rule for f in findings] == [rule], findings
+        marked = [
+            i + 1 for i, line in enumerate(
+                (REPO_ROOT / rel).read_text().splitlines())
+            if "BAD" in line
+        ]
+        assert findings[0].line in marked
+
+    @pytest.mark.parametrize("rule,stem", sorted(PAIRS.items()))
+    def test_good_fixture_is_clean(self, rule, stem):
+        from tools.analyze.locks import run_locks
+
+        rel = str(Path("tests/analyze_fixtures") / f"{Path(stem).parent}" /
+                  f"good_{Path(stem).name}.py")
+        findings, _ = run_locks(REPO_ROOT, targets=[rel])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_suppression_applies(self, tmp_path):
+        from tools.analyze.locks import run_locks
+
+        src = (REPO_ROOT / "tests/analyze_fixtures/locks/"
+               "bad_lock_blocking.py").read_text()
+        src = src.replace(
+            "            subprocess.run",
+            "            # qi-lint: allow(lock-blocking) — fixture reason\n"
+            "            subprocess.run",
+        )
+        (tmp_path / "suppressed.py").write_text(src)
+        findings, _ = run_locks(tmp_path, targets=["suppressed.py"])
+        assert findings == []
+
+    def test_rlock_reentry_is_not_a_cycle(self, tmp_path):
+        # RLocks exist to re-enter: a re-acquisition through a call edge
+        # must not be reported as a deadlock (code-review finding).
+        from tools.analyze.locks import run_locks
+
+        (tmp_path / "reentrant.py").write_text(
+            "import threading\n\n\n"
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self.inner()\n\n"
+            "    def inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        findings, _ = run_locks(tmp_path, targets=["reentrant.py"])
+        assert findings == [], findings
+        # The plain-Lock twin IS a re-entry deadlock.
+        (tmp_path / "plain.py").write_text(
+            (tmp_path / "reentrant.py").read_text().replace("RLock", "Lock")
+        )
+        findings, _ = run_locks(tmp_path, targets=["plain.py"])
+        assert [f.rule for f in findings] == ["lock-order-cycle"]
+        assert findings[0].message.count("R._lock") >= 2  # the self-cycle
+
+    def test_blocking_in_locked_helper_is_interprocedural(self, tmp_path):
+        # A *_locked helper's sleep inherits the caller's lock via
+        # entry_held and must still be a finding (code-review finding).
+        from tools.analyze.locks import run_locks
+
+        (tmp_path / "helper.py").write_text(
+            "import threading\n"
+            "import time\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n\n"
+            "    def slow(self):\n"
+            "        with self._lock:\n"
+            "            self._slow_locked()\n\n"
+            "    def _slow_locked(self):\n"
+            "        time.sleep(5)\n"
+        )
+        findings, _ = run_locks(tmp_path, targets=["helper.py"])
+        assert [f.rule for f in findings] == ["lock-blocking"], findings
+        assert "time.sleep" in findings[0].message
+
+    def test_thread_target_entry_resets_entry_held(self, tmp_path):
+        # A function used BOTH as a thread target and as a callee under a
+        # lock starts lock-free on the thread side: its lock-free mutation
+        # must stay a guardian finding (code-review finding).
+        from tools.analyze.locks import run_locks
+
+        (tmp_path / "dual.py").write_text(
+            "import threading\n\n\n"
+            "class D:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.items = []\n"
+            "        self.t = threading.Thread(target=self._work)\n\n"
+            "    def inline(self):\n"
+            "        with self._lock:\n"
+            "            self._work()\n\n"
+            "    def _work(self):\n"
+            "        self.items.append(1)\n"
+        )
+        findings, _ = run_locks(tmp_path, targets=["dual.py"])
+        assert "lock-guardian" in {f.rule for f in findings}, findings
+
+    def test_condition_alias_is_one_lock(self):
+        # Condition(self._lock) aliases to _lock: the sanctioned wait in
+        # the good blocking fixture must resolve to the SAME lock id.
+        from tools.analyze.locks import build_model
+
+        model = build_model(
+            REPO_ROOT,
+            ["tests/analyze_fixtures/locks/good_lock_blocking.py"],
+        )
+        cls = next(iter(model.classes.values()))
+        assert cls.lock_id("_done") == cls.lock_id("_lock")
+
+
+class TestWirePass:
+    """qi-wire (ISSUE 13 tentpole): producer ⊇ consumer per channel, site
+    integrity, and the real protocol's extraction shape."""
+
+    def _patched(self, monkeypatch, specs):
+        import tools.analyze.wire as wire_mod
+
+        monkeypatch.setattr(wire_mod, "CHANNEL_SPECS", specs)
+        return wire_mod
+
+    def test_unproduced_consumer_field_is_a_finding(self, monkeypatch):
+        wire_mod = self._patched(monkeypatch, (
+            ("fixture",
+             (("tests/analyze_fixtures/wire/bad_channel.py", "produce"),),
+             (("tests/analyze_fixtures/wire/bad_channel.py", "consume",
+               ("obj",)),)),
+        ))
+        findings, _ = wire_mod.run_wire(REPO_ROOT)
+        assert [f.rule for f in findings] == ["wire-consumer-unproduced"]
+        assert "'missing'" in findings[0].message
+        marked = [
+            i + 1 for i, line in enumerate(
+                (REPO_ROOT / "tests/analyze_fixtures/wire/bad_channel.py"
+                 ).read_text().splitlines())
+            if "BAD" in line
+        ]
+        assert findings[0].line in marked
+
+    def test_matched_channel_is_clean(self, monkeypatch):
+        wire_mod = self._patched(monkeypatch, (
+            ("fixture",
+             (("tests/analyze_fixtures/wire/good_channel.py", "produce"),),
+             (("tests/analyze_fixtures/wire/good_channel.py", "consume",
+               ("obj",)),)),
+        ))
+        findings, _ = wire_mod.run_wire(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_missing_site_is_loud(self, monkeypatch):
+        # A refactor that moves a spec'd function must fail the gate, not
+        # silently stop checking the protocol.
+        wire_mod = self._patched(monkeypatch, (
+            ("fixture",
+             (("tests/analyze_fixtures/wire/good_channel.py", "vanished"),),
+             (("tests/analyze_fixtures/wire/good_channel.py", "consume",
+               ("obj",)),)),
+        ))
+        findings, _ = wire_mod.run_wire(REPO_ROOT)
+        assert "wire-site-missing" in {f.rule for f in findings}
+
+    def test_real_channels_extract_the_protocol(self):
+        from tools.analyze.wire import extract_channels
+
+        channels = {c.name: c for c in extract_channels(REPO_ROOT)}
+        assert not any(c.findings for c in channels.values())
+        req = channels["serve.request"]
+        assert {"request_id", "nodes", "deadline_s", "query", "ping"} \
+            <= set(req.consumer_fields)
+        assert set(req.consumer_fields) <= set(req.producer_fields)
+        journal = channels["serve.journal"]
+        assert {"kind", "request_id", "fingerprint", "nodes", "query"} \
+            <= set(journal.consumer_fields)
+        resp = channels["serve.response"]
+        assert {"verdict", "cached", "error", "code", "message", "cert",
+                "stats", "result", "pong"} <= set(resp.consumer_fields)
+        for ch in channels.values():
+            missing = set(ch.consumer_fields) - set(ch.producer_fields)
+            assert not missing, (ch.name, missing)
 
 
 class TestTracerLeakPrecision:

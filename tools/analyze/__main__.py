@@ -2,10 +2,11 @@
 
 Usage::
 
-    python -m tools.analyze                      # all passes: lint typing race
+    python -m tools.analyze                      # all: lint surface locks wire typing race
     python -m tools.analyze lint typing          # a subset
     python -m tools.analyze --jsonl out.jsonl    # findings as qi-telemetry/1
     python -m tools.analyze typing --update-ratchet
+    python -m tools.analyze surface --update-inventory
 
 Exit status: 0 when every pass ran clean, 1 on any finding (2 on usage
 errors).  ``--jsonl`` writes one ``qi-telemetry/1`` stream — a meta line,
@@ -30,7 +31,7 @@ from tools.analyze.typing_gate import run_typing_gate
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
-PASSES = ("lint", "typing", "race")
+PASSES = ("lint", "surface", "locks", "wire", "typing", "race")
 
 
 def _race_pass(root: Path) -> tuple:
@@ -255,6 +256,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--update-ratchet", action="store_true",
         help="record improved typing measurements into the ratchet file",
     )
+    parser.add_argument(
+        "--update-inventory", action="store_true",
+        help="regenerate the committed qi-surface/1 inventory "
+             "(tools/analyze/surface_inventory.json) from a fresh "
+             "extraction — review the diff like any contract change",
+    )
     args = parser.parse_args(argv)
 
     passes = args.passes or list(PASSES)
@@ -268,6 +275,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     for pass_name in passes:
         if pass_name == "lint":
             per_pass["lint"] = run_lint(REPO_ROOT)
+        elif pass_name == "surface":
+            from tools.analyze.surface import run_surface
+
+            findings, ns = run_surface(
+                REPO_ROOT, update_inventory=args.update_inventory
+            )
+            per_pass["surface"] = findings
+            notes.extend(ns)
+        elif pass_name == "locks":
+            from tools.analyze.locks import run_locks
+
+            findings, ns = run_locks(REPO_ROOT)
+            per_pass["locks"] = findings
+            notes.extend(ns)
+        elif pass_name == "wire":
+            from tools.analyze.wire import run_wire
+
+            findings, ns = run_wire(REPO_ROOT)
+            per_pass["wire"] = findings
+            notes.extend(ns)
         elif pass_name == "typing":
             findings, ns = run_typing_gate(
                 REPO_ROOT, update_ratchet=args.update_ratchet

@@ -103,6 +103,158 @@ def _idents_in(node: ast.AST) -> Set[str]:
 
 
 # ---------------------------------------------------------------------------
+# telemetry call-site detection (shared with tools/analyze/surface.py)
+
+# RunRecord emission methods → the surface kind they emit.
+_TELEMETRY_METHODS: Dict[str, str] = {
+    "add": "counter", "declare": "counter", "gauge": "gauge",
+    "event": "event", "span": "span",
+}
+
+
+def _module_str_constants(ctx: FileContext) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (cached per context) —
+    the one indirection the telemetry-name-literal rule allows."""
+    cached = getattr(ctx, "_mod_str_consts", None)
+    if cached is None:
+        cached = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                cached[node.targets[0].id] = node.value.value
+        ctx._mod_str_consts = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def resolve_name_arg(ctx: FileContext, node: ast.AST) -> Optional[str]:
+    """Resolve a telemetry/fault/env *name* argument statically: a string
+    literal, a module-level string constant, or a dotted-prefix f-string
+    (``f"phase.{x}"`` → ``"phase.*"`` — a sound wildcard for the surface
+    inventory).  Anything else is ``None`` (unextractable — the
+    telemetry-name-literal rule's finding)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return _module_str_constants(ctx).get(node.id)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            # A placeholder-less f-string is just a literal — banking it
+            # as a wildcard would let stale registry rows sharing the
+            # prefix ride free past the drift gate.
+            return "".join(
+                v.value for v in node.values
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            ) or None
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+                and "." in first.value:
+            return first.value + "*"
+    return None
+
+
+def resolve_name_args(ctx: FileContext, node: ast.AST) -> List[str]:
+    """Like :func:`resolve_name_arg` but handles conditional expressions
+    (``"a.hits" if hit else "a.misses"``) by resolving every branch —
+    empty list means unextractable (the telemetry-name-literal finding)."""
+    if isinstance(node, ast.IfExp):
+        body = resolve_name_args(ctx, node.body)
+        orelse = resolve_name_args(ctx, node.orelse)
+        return body + orelse if body and orelse else []
+    one = resolve_name_arg(ctx, node)
+    return [one] if one is not None else []
+
+
+def name_arg_expr(node: ast.Call) -> Optional[ast.AST]:
+    """The *name* argument of an emission/fault/env call — positional
+    first arg or the ``name=`` keyword (``rec.add(name="x")`` is legal
+    and must not bypass extraction)."""
+    if node.args:
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _looks_like_record(ctx: FileContext, recv: ast.AST) -> bool:
+    """Does this receiver expression denote the process-wide RunRecord —
+    ``rec``/``record`` by convention, a ``get_run_record()`` call, a
+    ``.rec``/``.record`` attribute, or ``self`` inside telemetry.py?"""
+    if isinstance(recv, ast.Name):
+        if recv.id in ("rec", "record"):
+            return True
+        return recv.id == "self" and ctx.rel.replace("\\", "/").endswith(
+            "utils/telemetry.py")
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in ("rec", "record", "_rec", "_record")
+    if isinstance(recv, ast.Call):
+        f = recv.func
+        return (isinstance(f, ast.Name) and f.id == "get_run_record") or (
+            isinstance(f, ast.Attribute) and f.attr == "get_run_record")
+    return False
+
+
+def telemetry_calls(ctx: FileContext) -> Iterator[tuple]:
+    """``(kind, resolved_names_list, call_node)`` for every run-record
+    emission call in the file — the one detector behind both the surface
+    extraction and the telemetry-name-literal rule.  The names list is
+    empty when the argument is not statically resolvable."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        kind = _TELEMETRY_METHODS.get(node.func.attr)
+        if kind is None or not _looks_like_record(ctx, node.func.value):
+            continue
+        arg = name_arg_expr(node)
+        if arg is None:
+            continue
+        yield kind, resolve_name_args(ctx, arg), node
+
+
+# ---------------------------------------------------------------------------
+# rule: telemetry-name-literal
+
+
+def rule_telemetry_name_literal(ctx: FileContext) -> Iterator[Finding]:
+    """Telemetry and fault-point names must be statically resolvable —
+    string literals, module-level constants, or dotted-prefix f-strings —
+    so the qi-surface extraction (tools/analyze/surface.py) stays sound: a
+    name built at runtime is invisible to the registry drift gate, which
+    is exactly how an undocumented counter ships."""
+    for kind, names, node in telemetry_calls(ctx):
+        if not names:
+            yield from ctx.finding(
+                "telemetry-name-literal", node,
+                f"{kind} name is not statically resolvable (use a string "
+                f"literal, a module-level constant, or an f-string with a "
+                f"dotted literal prefix) — qi-surface cannot extract it, "
+                f"so the OBSERVABILITY registry gate cannot see it",
+            )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname != "fault_point":
+            continue
+        arg = name_arg_expr(node)
+        resolved = resolve_name_arg(ctx, arg) if arg is not None else None
+        if resolved is None or resolved.endswith("*"):
+            yield from ctx.finding(
+                "telemetry-name-literal", node,
+                "fault-point name is not a string literal or module-level "
+                "constant — fault points are exact catalog keys (no "
+                "wildcards), and qi-surface must see every firing site to "
+                "prove the catalog has no dead entries",
+            )
+
+
+# ---------------------------------------------------------------------------
 # rule: import-at-top
 
 # Modules whose import cost is noise: lazy-importing them buys nothing and
@@ -579,6 +731,7 @@ def rule_jax_tracer_leak(ctx: FileContext) -> Iterator[Finding]:
 # driver
 
 RULES = {
+    "telemetry-name-literal": rule_telemetry_name_literal,
     "import-at-top": rule_import_at_top,
     "no-bare-env-read": rule_no_bare_env_read,
     "span-balance": rule_span_balance,

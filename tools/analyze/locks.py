@@ -1,0 +1,774 @@
+"""qi-locks: interprocedural lockset + lock-order analysis (Eraser lineage).
+
+The per-file ``lock-discipline`` lint rule polices the telemetry record's
+own guarded attributes; since PRs 8-12 the threaded surface is much wider
+— the serve engine's drain/supervisor threads, the fleet's reader/probe/
+respawn threads, the delta store's single-flight leases — and the race
+harness (tools/analyze/schedules.py) only *samples* those interleavings
+dynamically.  This pass analyzes them statically, whole-program, over
+:data:`TARGETS`:
+
+- **Lock model**: every ``self.X = threading.Lock()/RLock()/Condition()``
+  (and module-level twin) becomes a lock identity; ``Condition(self.Y)``
+  aliases to ``Y`` (they are one lock); ``threading.Event()`` attrs are
+  tracked for blocking-call detection; ``Thread(...)`` attrs/locals for
+  join detection.
+- **Lock-order graph** (``lock-order-cycle``): a ``with`` acquisition or a
+  *call into a function that acquires* while already holding a lock adds
+  an order edge, call edges resolved interprocedurally (``self.m()``,
+  module functions, cross-module imports within the target set,
+  unique-method-name fallback, and run-record emission calls — which take
+  ``RunRecord._lock``).  Any cycle — including a self-edge, which is a
+  non-reentrant re-acquisition deadlock — is a finding.
+- **Blocking under a lock** (``lock-blocking``): ``Thread.join``,
+  ``Event.wait``/``Condition.wait`` (except the sanctioned wait on the
+  innermost held lock's own condition), ``subprocess.run``/``Popen``/
+  ``communicate``, ``os.fsync`` and ``time.sleep`` reached while a lock is
+  held stall every thread parked on that lock.
+- **Guardian locksets** (``lock-guardian``): per class attribute, the
+  intersection of locks held across its mutation sites (``__init__``
+  exempt; a helper only ever called under a lock inherits that lock via
+  the intersection of its observed call sites).  An attribute mutated
+  under a lock somewhere but reachable lock-free from a ``Thread`` target
+  (or a registered callback — those run on other threads here) has an
+  empty guardian and a real interleaving that loses the write.
+
+The analysis is deliberately conservative where it cannot resolve (unknown
+receivers are skipped, not guessed), so a finding is worth reading;
+suppress a reviewed one with ``# qi-lint: allow(rule) — reason`` on the
+flagged line, like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.lint import FileContext, Finding, _looks_like_record
+
+# The heavily-threaded surface this pass covers (ISSUE 13).
+TARGETS = (
+    "quorum_intersection_tpu/serve.py",
+    "quorum_intersection_tpu/serve_transport.py",
+    "quorum_intersection_tpu/fleet.py",
+    "quorum_intersection_tpu/delta.py",
+    "quorum_intersection_tpu/backends/auto.py",
+    "quorum_intersection_tpu/utils/telemetry.py",
+    "quorum_intersection_tpu/utils/metrics_server.py",
+)
+
+RECORD_LOCK = "quorum_intersection_tpu/utils/telemetry.py:RunRecord._lock"
+_RECORD_METHODS = frozenset({
+    "add", "gauge", "event", "declare", "snapshot", "span", "event_count",
+    "events_since", "events_truncated", "add_sink",
+})
+_MUTATING_METHODS = frozenset({
+    "append", "appendleft", "add", "setdefault", "update", "pop", "popleft",
+    "clear", "extend", "remove", "discard", "insert",
+})
+_SUBPROCESS_BLOCKING = frozenset({
+    "run", "call", "check_call", "check_output",
+})
+
+FnKey = Tuple[str, str]  # (rel path, qualname)
+
+# Method names the unique-name call-resolution fallback must never claim:
+# they collide with builtin container/file/threading APIs (``counters.get``
+# is a dict read, not SharedSccStore.get), and a wrong edge here invents a
+# deadlock cycle out of thin air.  Typed receivers (``self.X`` whose class
+# is known from its constructor assignment) still resolve these precisely.
+_AMBIGUOUS_METHODS = frozenset({
+    "get", "add", "pop", "append", "appendleft", "popleft", "update",
+    "clear", "extend", "remove", "discard", "insert", "setdefault", "keys",
+    "values", "items", "copy", "join", "split", "strip", "sort", "index",
+    "count", "read", "write", "close", "flush", "open", "set", "wait",
+    "notify", "notify_all", "acquire", "release", "put", "send", "recv",
+    "emit", "finish", "start", "stop", "run", "scan",
+})
+
+
+@dataclass
+class ClassModel:
+    """Lock/event/thread attribute kinds of one class."""
+
+    name: str
+    rel: str
+    locks: Dict[str, str] = field(default_factory=dict)    # attr -> lock id
+    aliases: Dict[str, str] = field(default_factory=dict)  # cond attr -> lock attr
+    reentrant: Set[str] = field(default_factory=set)       # RLock ids
+    conditions: Set[str] = field(default_factory=set)
+    events: Set[str] = field(default_factory=set)
+    threads: Set[str] = field(default_factory=set)
+    methods: Set[str] = field(default_factory=set)
+    # attr -> class name it is constructed from (``self.X = ClassName(...)``)
+    instances: Dict[str, str] = field(default_factory=dict)
+
+    def lock_id(self, attr: str) -> Optional[str]:
+        attr = self.aliases.get(attr, attr)
+        return self.locks.get(attr)
+
+
+@dataclass
+class FnModel:
+    """One analyzed function/method body."""
+
+    key: FnKey
+    cls: Optional[ClassModel]
+    node: ast.AST
+    # (held-before frozenset, acquired lock id, line)
+    acquisitions: List[Tuple[FrozenSet[str], str, int]] = field(default_factory=list)
+    # (held frozenset, callee key-or-None spec, line)
+    calls: List[Tuple[FrozenSet[str], "CallRef", int]] = field(default_factory=list)
+    # (attr, held frozenset, line)  — self-attr mutations (not __init__)
+    mutations: List[Tuple[str, FrozenSet[str], int]] = field(default_factory=list)
+    # (description, held frozenset, line, condition-lock-or-None) — every
+    # candidate blocking op, judged against held ∪ entry_held at report
+    # time so a *_locked helper's sleep/fsync is still caught
+    blocking: List[Tuple[str, FrozenSet[str], int, Optional[str]]] = field(
+        default_factory=list)
+    # function refs spawned as threads / registered as callbacks
+    thread_refs: List["CallRef"] = field(default_factory=list)
+    entry_held: FrozenSet[str] = frozenset()
+    entry_seen: bool = False
+    # Union of held sets over observed entry contexts: nonempty while
+    # entry_held (the intersection) is empty means the function is
+    # reached BOTH under a lock and lock-free — mixed-context evidence
+    # the guardian check must not ignore.
+    entry_union: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class CallRef:
+    """An unresolved callee reference, resolved against the whole model."""
+
+    kind: str          # "self" | "name" | "attr"
+    name: str
+    rel: str           # referencing file
+    cls: Optional[str] = None  # class of the referencing method
+
+
+class Model:
+    """Whole-program model over the target files."""
+
+    def __init__(self) -> None:
+        self.classes: Dict[Tuple[str, str], ClassModel] = {}
+        self.functions: Dict[FnKey, FnModel] = {}
+        self.module_fns: Dict[str, Set[str]] = {}
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        self.reentrant: Set[str] = set()  # RLock ids (legal re-acquisition)
+        self.imports: Dict[Tuple[str, str], str] = {}  # (rel, name) -> target rel
+        self.method_index: Dict[str, List[FnKey]] = {}
+        self.ctxs: Dict[str, FileContext] = {}
+
+    def resolve(self, ref: CallRef) -> Optional[FnKey]:
+        if ref.kind == "self" and ref.cls is not None:
+            key = (ref.rel, f"{ref.cls}.{ref.name}")
+            if key in self.functions:
+                return key
+            return None
+        if ref.kind == "name":
+            if (ref.rel, ref.name) in self.imports:
+                target_rel = self.imports[(ref.rel, ref.name)]
+                key = (target_rel, ref.name)
+                return key if key in self.functions else None
+            key = (ref.rel, ref.name)
+            if key in self.functions:
+                return key
+            # nested function of some scope in the same file
+            for cand_key in self.functions:
+                if cand_key[0] == ref.rel and cand_key[1].endswith(
+                        f".{ref.name}"):
+                    return cand_key
+            return None
+        if ref.kind == "instattr":
+            # self.<attr>.<method>() with the attr's class known from its
+            # constructor assignment
+            cls_name, method = ref.name.split(".", 1)
+            for (rel, name), cls in self.classes.items():
+                if name == cls_name and method in cls.methods:
+                    return (rel, f"{name}.{method}")
+            return None
+        # attribute call on an unknown receiver: unique-method-name
+        # fallback, builtin-collection collisions excluded
+        if ref.name in _AMBIGUOUS_METHODS:
+            return None
+        cands = self.method_index.get(ref.name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# model construction
+
+
+def _is_threading_call(node: ast.AST, names: Iterable[str]) -> Optional[str]:
+    """``threading.X(...)`` / bare ``X(...)`` for X in names → X."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return name if name in set(names) else None
+
+
+def _scan_class(rel: str, cls: ast.ClassDef) -> ClassModel:
+    model = ClassModel(name=cls.name, rel=rel)
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(n is node for n in cls.body):
+                model.methods.add(node.name)
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        kind = _is_threading_call(
+            node.value, ("Lock", "RLock", "Condition", "Event", "Thread"))
+        if kind in ("Lock", "RLock"):
+            lock_id = f"{rel}:{cls.name}.{tgt.attr}"
+            model.locks[tgt.attr] = lock_id
+            if kind == "RLock":
+                model.reentrant.add(lock_id)
+        elif kind == "Condition":
+            model.conditions.add(tgt.attr)
+            args = node.value.args if isinstance(node.value, ast.Call) else []
+            if args and isinstance(args[0], ast.Attribute) \
+                    and isinstance(args[0].value, ast.Name) \
+                    and args[0].value.id == "self":
+                model.aliases[tgt.attr] = args[0].attr
+            else:
+                model.locks[tgt.attr] = f"{rel}:{cls.name}.{tgt.attr}"
+        elif kind == "Event":
+            model.events.add(tgt.attr)
+        elif kind == "Thread":
+            model.threads.add(tgt.attr)
+        elif kind is None and isinstance(node.value, ast.Call):
+            f = node.value.func
+            ctor = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None
+            )
+            if ctor is not None and ctor[:1].isupper():
+                model.instances[tgt.attr] = ctor
+    return model
+
+
+class _FnScanner:
+    """Walk one function body tracking the syntactically held lock set."""
+
+    def __init__(self, model: Model, fn: FnModel, ctx: FileContext) -> None:
+        self.model = model
+        self.fn = fn
+        self.ctx = ctx
+        self.local_threads: Set[str] = set()
+        self.local_events: Set[str] = set()
+
+    # -- lock expr resolution ------------------------------------------------
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and self.fn.cls is not None:
+                return self.fn.cls.lock_id(expr.attr)
+            # module-qualified or foreign receiver: unique-attr fallback
+            owners = [
+                c for c in self.model.classes.values()
+                if c.lock_id(expr.attr) is not None
+            ]
+            if len(owners) == 1:
+                return owners[0].lock_id(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            return self.model.module_locks.get((self.fn.key[0], expr.id))
+        return None
+
+    def _cond_lock_of(self, expr: ast.AST) -> Optional[str]:
+        """Lock id of a condition receiver (for the sanctioned-wait check)."""
+        return self._lock_of(expr)
+
+    # -- walking -------------------------------------------------------------
+
+    def scan(self) -> None:
+        body = getattr(self.fn.node, "body", [])
+        for stmt in body:
+            self._visit(stmt, frozenset())
+
+    def _visit(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are modeled as their own functions
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                self._visit(item.context_expr, held)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.fn.acquisitions.append((inner, lock, node.lineno))
+                    inner = inner | {lock}
+            for child in node.body:
+                self._visit(child, inner)
+            return
+        if isinstance(node, ast.Assign):
+            self._note_locals(node)
+            self._note_mutation(node, held)
+        elif isinstance(node, ast.AugAssign):
+            self._note_mutation(node, held)
+        elif isinstance(node, ast.Call):
+            self._note_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _note_locals(self, node: ast.Assign) -> None:
+        kind = _is_threading_call(node.value, ("Thread", "Event"))
+        if kind is None or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            return
+        tgt = node.targets[0].id
+        (self.local_threads if kind == "Thread" else self.local_events).add(tgt)
+
+    def _note_mutation(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript):
+                tgt = tgt.value
+            if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                self.fn.mutations.append((tgt.attr, held, tgt.lineno))
+
+    def _thread_like(self, recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Attribute):
+            attr = recv.attr
+            if self.fn.cls is not None and attr in self.fn.cls.threads:
+                return True
+            return "thread" in attr.lower() or "worker" in attr.lower() \
+                or "proc" in attr.lower()
+        if isinstance(recv, ast.Name):
+            return recv.id in self.local_threads \
+                or "thread" in recv.id.lower() or "worker" in recv.id.lower() \
+                or "proc" in recv.id.lower()
+        return False
+
+    def _note_blocking(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        # Candidates are recorded even with an empty syntactic held set:
+        # a helper only ever called under a lock inherits that lock via
+        # entry_held, and its sleep/fsync must still be a finding.
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv, attr = f.value, f.attr
+            if attr == "join" and self._thread_like(recv):
+                self.fn.blocking.append(
+                    ("Thread.join", held, node.lineno, None))
+            elif attr in ("wait", "wait_for"):
+                # The condition's lock rides along so the sanctioned
+                # wait-on-the-only-held-lock pattern can be recognized
+                # against the EFFECTIVE held set at report time.
+                cond_lock = self._cond_lock_of(recv)
+                self.fn.blocking.append(
+                    (f"{attr}() on a gate/condition", held, node.lineno,
+                     cond_lock))
+            elif attr == "communicate":
+                self.fn.blocking.append(
+                    ("subprocess communicate", held, node.lineno, None))
+            elif attr == "fsync":
+                self.fn.blocking.append(("fsync", held, node.lineno, None))
+            elif attr == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id == "time":
+                self.fn.blocking.append(
+                    ("time.sleep", held, node.lineno, None))
+            elif attr in _SUBPROCESS_BLOCKING and isinstance(recv, ast.Name) \
+                    and recv.id == "subprocess":
+                self.fn.blocking.append(
+                    (f"subprocess.{attr}", held, node.lineno, None))
+
+    def _ref_of(self, expr: ast.AST) -> Optional[CallRef]:
+        rel = self.fn.key[0]
+        cls = self.fn.cls.name if self.fn.cls is not None else None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return CallRef("self", expr.attr, rel, cls)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Attribute) \
+                and isinstance(expr.value.value, ast.Name) \
+                and expr.value.value.id == "self" and self.fn.cls is not None:
+            inst_cls = self.fn.cls.instances.get(expr.value.attr)
+            if inst_cls is not None:
+                return CallRef("instattr", f"{inst_cls}.{expr.attr}", rel, cls)
+        if isinstance(expr, ast.Name):
+            return CallRef("name", expr.id, rel, cls)
+        if isinstance(expr, ast.Attribute):
+            return CallRef("attr", expr.attr, rel, cls)
+        return None
+
+    def _note_call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        self._note_blocking(node, held)
+        f = node.func
+        # Mutating container-method calls on a self attribute count as
+        # mutations of that attribute (``self.items.append(x)``).
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS \
+                and isinstance(f.value, ast.Attribute) \
+                and isinstance(f.value.value, ast.Name) \
+                and f.value.value.id == "self":
+            self.fn.mutations.append((f.value.attr, held, node.lineno))
+        # Run-record emission: takes RunRecord._lock (the order edge the
+        # per-file lint rule cannot see).
+        if isinstance(f, ast.Attribute) and f.attr in _RECORD_METHODS \
+                and _looks_like_record(self.ctx, f.value):
+            if any(c.rel.endswith("utils/telemetry.py")
+                   for c in self.model.classes.values()):
+                self.fn.acquisitions.append((held, RECORD_LOCK, node.lineno))
+            return
+        ref = self._ref_of(f)
+        if ref is not None:
+            self.fn.calls.append((held, ref, node.lineno))
+        # Thread targets + registered callbacks run on other threads.
+        spawn = _is_threading_call(node, ("Thread",))
+        if spawn:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tref = self._ref_of(kw.value)
+                    if tref is not None:
+                        self.fn.thread_refs.append(tref)
+        else:
+            for arg in node.args:
+                if isinstance(arg, (ast.Attribute, ast.Name)):
+                    tref = self._ref_of(arg)
+                    if tref is not None and self.model.resolve(tref) is not None:
+                        self.fn.thread_refs.append(tref)
+
+
+def build_model(root: Path, targets: Sequence[str]) -> Model:
+    model = Model()
+    trees: List[Tuple[str, ast.Module, FileContext]] = []
+    for rel in targets:
+        path = root / rel
+        if not path.is_file():
+            continue
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = FileContext(path, rel, source)
+        except (OSError, SyntaxError):
+            continue
+        model.ctxs[rel] = ctx
+        trees.append((rel, ctx.tree, ctx))
+    rel_by_module = {
+        rel[:-3].replace("/", "."): rel for rel, _, _ in trees
+    }
+    # pass 1: classes, module locks/functions, imports
+    for rel, tree, _ in trees:
+        model.module_fns[rel] = set()
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls_model = _scan_class(rel, node)
+                model.classes[(rel, node.name)] = cls_model
+                model.reentrant |= cls_model.reentrant
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.module_fns[rel].add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = _is_threading_call(node.value, ("Lock", "RLock"))
+                if kind:
+                    name = node.targets[0].id
+                    model.module_locks[(rel, name)] = f"{rel}:{name}"
+                    if kind == "RLock":
+                        model.reentrant.add(f"{rel}:{name}")
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                target_rel = rel_by_module.get(node.module)
+                if target_rel is not None:
+                    for alias in node.names:
+                        model.imports[(rel, alias.asname or alias.name)] = \
+                            target_rel
+    # pass 2: function bodies (methods, module functions, nested defs)
+    for rel, tree, ctx in trees:
+        def register(fn_node: ast.AST, qual: str,
+                     cls: Optional[ClassModel]) -> None:
+            fn = FnModel(key=(rel, qual), cls=cls, node=fn_node)
+            model.functions[fn.key] = fn
+            # nested defs get their own entries (they run on other threads
+            # or as callbacks, never inline at the def site)
+            for stmt in ast.walk(fn_node):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt is not fn_node \
+                        and f"{qual}.{stmt.name}" not in (
+                            k[1] for k in model.functions):
+                    nested = FnModel(
+                        key=(rel, f"{qual}.{stmt.name}"), cls=cls, node=stmt)
+                    model.functions[nested.key] = nested
+
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls_model = model.classes[(rel, node.name)]
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        register(sub, f"{node.name}.{sub.name}", cls_model)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                register(node, node.name, None)
+    # method-name index for unique-name resolution
+    for key, fn in model.functions.items():
+        model.method_index.setdefault(key[1].split(".")[-1], []).append(key)
+    # pass 3: scan bodies
+    for fn in list(model.functions.values()):
+        _FnScanner(model, fn, model.ctxs[fn.key[0]]).scan()
+    return model
+
+
+# ---------------------------------------------------------------------------
+# interprocedural propagation
+
+
+def _propagate_entry_held(model: Model, rounds: Optional[int] = None) -> None:
+    """entry_held(f) = intersection of held sets over every observed call
+    site — the static twin of the repo's ``*_locked`` helper convention.
+    A function spawned as a Thread target (or registered as a callback)
+    ALSO starts with nothing held: that entry point contributes an empty
+    set to the intersection, so a probe/worker loop called both inline
+    under a lock and from its own thread is never unsoundly exempted
+    from the guardian check."""
+    thread_roots: Set[FnKey] = set()
+    for fn in model.functions.values():
+        for ref in fn.thread_refs:
+            resolved = model.resolve(ref)
+            if resolved is not None:
+                thread_roots.add(resolved)
+    # Iterate to convergence: a 4-deep *_locked helper chain needs 4
+    # rounds to inherit the lock — a fixed small cap would silently drop
+    # the context (and the finding).  Function count bounds the longest
+    # acyclic call chain, so this always terminates.
+    if rounds is None:
+        rounds = max(len(model.functions), 8)
+    for _ in range(rounds):
+        observed: Dict[FnKey, Optional[FrozenSet[str]]] = {
+            key: frozenset() for key in thread_roots
+        }
+        unions: Dict[FnKey, FrozenSet[str]] = {
+            key: frozenset() for key in thread_roots
+        }
+        for fn in model.functions.values():
+            base = fn.entry_held
+            for held, ref, _line in fn.calls:
+                callee = model.resolve(ref)
+                if callee is None:
+                    continue
+                eff = held | base
+                prev = observed.get(callee)
+                observed[callee] = eff if prev is None else (prev & eff)
+                unions[callee] = unions.get(callee, frozenset()) | eff
+        changed = False
+        for key, inter in observed.items():
+            fn = model.functions[key]
+            new = inter or frozenset()
+            fn.entry_union = unions.get(key, frozenset())
+            if not fn.entry_seen or new != fn.entry_held:
+                fn.entry_seen = True
+                if new != fn.entry_held:
+                    fn.entry_held = new
+                    changed = True
+        if not changed:
+            break
+
+
+def _transitive_acquires(model: Model) -> Dict[FnKey, Set[str]]:
+    acq: Dict[FnKey, Set[str]] = {
+        key: {lock for _, lock, _ in fn.acquisitions}
+        for key, fn in model.functions.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, fn in model.functions.items():
+            for _, ref, _line in fn.calls:
+                callee = model.resolve(ref)
+                if callee is None:
+                    continue
+                before = len(acq[key])
+                acq[key] |= acq[callee]
+                if len(acq[key]) != before:
+                    changed = True
+    return acq
+
+
+def _thread_reachable(model: Model) -> Set[FnKey]:
+    roots: Set[FnKey] = set()
+    for fn in model.functions.values():
+        for ref in fn.thread_refs:
+            resolved = model.resolve(ref)
+            if resolved is not None:
+                roots.add(resolved)
+    reachable = set(roots)
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        for _, ref, _line in model.functions[key].calls:
+            callee = model.resolve(ref)
+            if callee is not None and callee not in reachable:
+                reachable.add(callee)
+                frontier.append(callee)
+    return reachable
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+def _emit(ctx: Optional[FileContext], rule: str, rel: str, line: int,
+          message: str, findings: List[Finding]) -> bool:
+    if ctx is not None and ctx.suppressed(rule, line):
+        return False
+    findings.append(Finding(rule=rule, path=rel, line=line, message=message))
+    return True
+
+
+def _order_cycles(model: Model, findings: List[Finding]) -> int:
+    acq = _transitive_acquires(model)
+    edges: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+
+    def add_edge(a: str, b: str, rel: str, line: int, why: str) -> None:
+        edges.setdefault(a, {}).setdefault(b, (rel, line, why))
+
+    for key, fn in model.functions.items():
+        base = fn.entry_held
+        for held, lock, line in fn.acquisitions:
+            for h in held | base:
+                if h != lock:
+                    add_edge(h, lock, key[0], line, f"in {key[1]}")
+                elif h == lock and lock != RECORD_LOCK \
+                        and lock not in model.reentrant:
+                    # re-acquisition of a non-reentrant lock (RLocks may
+                    # legally re-enter — that is what they are for)
+                    add_edge(h, lock, key[0], line, f"re-entry in {key[1]}")
+        for held, ref, line in fn.calls:
+            callee = model.resolve(ref)
+            if callee is None:
+                continue
+            for h in held | base:
+                for lock in acq[callee]:
+                    if h == lock and (lock == RECORD_LOCK
+                                      or lock in model.reentrant):
+                        continue  # re-entrant / the record's own re-reads
+                    add_edge(h, lock, key[0], line,
+                             f"{key[1]} calls {callee[1]}")
+
+    # cycle detection (DFS over the order graph)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    reported: Set[FrozenSet[str]] = set()
+    count = 0
+
+    def dfs(node: str, stack: List[str]) -> None:
+        nonlocal count
+        color[node] = GRAY
+        stack.append(node)
+        for nxt, (rel, line, why) in sorted(edges.get(node, {}).items()):
+            if color.get(nxt, WHITE) == GRAY:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                ident = frozenset(cycle)
+                if ident not in reported:
+                    reported.add(ident)
+                    ctx = model.ctxs.get(rel)
+                    count += _emit(
+                        ctx, "lock-order-cycle", rel, line,
+                        "lock-acquisition-order cycle (potential deadlock): "
+                        + " -> ".join(c.split(":")[-1] for c in cycle)
+                        + f" ({why}); acquire these locks in one global "
+                          f"order or copy data out and release first",
+                        findings,
+                    )
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, stack)
+        stack.pop()
+        color[node] = BLACK
+
+    for node in sorted(edges):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node, [])
+    return count
+
+
+def _blocking_findings(model: Model, findings: List[Finding]) -> int:
+    count = 0
+    for key, fn in model.functions.items():
+        base = fn.entry_held
+        for desc, held, line, cond_lock in fn.blocking:
+            eff = held | base
+            if not eff:
+                continue
+            if cond_lock is not None and eff == {cond_lock}:
+                continue  # the sanctioned wait on the only held lock
+            locks = ", ".join(sorted(h.split(":")[-1] for h in eff))
+            count += _emit(
+                model.ctxs.get(key[0]), "lock-blocking", key[0], line,
+                f"blocking call ({desc}) while holding {locks} in "
+                f"{key[1]}: every thread parked on that lock stalls for "
+                f"the full wait — move the blocking work outside the "
+                f"critical section",
+                findings,
+            )
+    return count
+
+
+def _guardian_findings(model: Model, findings: List[Finding]) -> int:
+    reachable = _thread_reachable(model)
+    count = 0
+    # (rel, class, attr) -> [(fnkey, heldset, line)]
+    sites: Dict[Tuple[str, str, str], List[Tuple[FnKey, FrozenSet[str], int]]] = {}
+    for key, fn in model.functions.items():
+        if fn.cls is None:
+            continue
+        method = key[1].split(".")[-1]
+        if method == "__init__":
+            continue
+        for attr, held, line in fn.mutations:
+            sites.setdefault((key[0], fn.cls.name, attr), []).append(
+                (key, held | fn.entry_held, line))
+    for (rel, cls_name, attr), attr_sites in sorted(sites.items()):
+        locksets = [held for _, held, _ in attr_sites]
+        # Evidence a guardian was ever claimed: a site holding a lock, OR
+        # a site in a function reached under a lock in SOME context
+        # (mixed entry — the thread-target-plus-locked-call case where
+        # the per-site intersection is already empty).
+        claimed = any(locksets) or any(
+            not held and model.functions[key].entry_union
+            for key, held, _ in attr_sites
+        )
+        if not claimed:
+            continue  # no guardian ever claimed — not a discipline drift
+        guardian = frozenset.intersection(*locksets)
+        if guardian:
+            continue  # a consistent guardian lock exists
+        for key, held, line in attr_sites:
+            if held:
+                continue
+            if key not in reachable:
+                continue
+            count += _emit(
+                model.ctxs.get(rel), "lock-guardian", rel, line,
+                f"attribute {cls_name}.{attr} is mutated under a lock "
+                f"elsewhere but lock-free here in {key[1]}, which is "
+                f"reachable from a Thread target — a concurrent "
+                f"interleaving can lose this write; take the guardian "
+                f"lock (or suppress with the reason it is single-threaded)",
+                findings,
+            )
+    return count
+
+
+def run_locks(root: Path, targets: Optional[Sequence[str]] = None,
+              ) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)`` — the whole-program lock analysis."""
+    model = build_model(root, targets if targets is not None else TARGETS)
+    _propagate_entry_held(model)
+    findings: List[Finding] = []
+    cycles = _order_cycles(model, findings)
+    blocking = _blocking_findings(model, findings)
+    guardians = _guardian_findings(model, findings)
+    locks = len({
+        lock for c in model.classes.values() for lock in c.locks.values()
+    } | set(model.module_locks.values()))
+    notes = [
+        f"locks: {len(model.functions)} functions over "
+        f"{len(model.classes)} classes, {locks} locks modeled; "
+        f"{cycles} order cycle(s), {blocking} blocking-under-lock, "
+        f"{guardians} guardian violation(s)"
+    ]
+    return findings, notes

@@ -1,0 +1,532 @@
+"""qi-surface: whole-program contract extraction + registry drift gates.
+
+PRs 8-12 grew five hand-maintained contract registries — telemetry names
+(docs/OBSERVABILITY.md), fault points (utils/faults.py + docs/ROBUSTNESS.md),
+env knobs (utils/env.py), forced schedules (tools/analyze/schedules.py), and
+the JSONL wire/cert field sets — whose agreement with the code was enforced
+only by reviewer discipline.  This pass is the machine that holds them:
+
+1. **Extraction**: walk the package AST and collect every *emitted*
+   telemetry name (``counter``/``gauge``/``event``/``span`` call sites on
+   the run record), every *fired* fault point (``fault_point("...")``),
+   every ``qi_env*("QI_...")`` read, every forced-schedule name, and the
+   JSONL wire fields (:mod:`tools.analyze.wire`).  Names must be string
+   literals, module-level string constants, or dotted-prefix f-strings
+   (recorded as ``prefix.*`` wildcards) — the ``telemetry-name-literal``
+   lint rule keeps that sound.
+2. **Inventory**: the extraction is serialized as a deterministic
+   ``qi-surface/1`` JSON (:data:`INVENTORY_PATH`, committed).  A diff
+   between the committed file and a fresh extraction is a finding
+   (``surface-inventory-stale``) — regenerate with
+   ``python -m tools.analyze surface --update-inventory`` and review the
+   diff like any other contract change (this is also the wire pass's
+   field-stability gate: a renamed journal/protocol field shows up here
+   even when producer ⊇ consumer still holds).
+3. **Drift gates**, both directions:
+
+   - code emits a telemetry name the docs/OBSERVABILITY.md registry does
+     not list (``surface-telemetry-unregistered``);
+   - the registry lists a name the code never emits
+     (``surface-registry-stale``);
+   - a fault point is declared in utils/faults.py but no code path can
+     fire it (``surface-fault-unfired``), or fired but undeclared
+     (``surface-fault-undeclared``), or the docs/ROBUSTNESS.md fault
+     table disagrees with the catalog in either direction
+     (``surface-fault-undocumented`` / ``surface-fault-doc-stale``);
+   - an env knob is declared in utils/env.py but never read
+     (``surface-env-unread``), read but undeclared
+     (``surface-env-undeclared``), or listed in a docs knob table
+     without a declaration (``surface-env-doc-stale``).
+
+The OBSERVABILITY/ROBUSTNESS registry *tables* are parsed as the source of
+truth — their format is frozen (each doc says so): one row per line,
+backticked names in the first cell, multiple names per row separated by
+``/``, ``<placeholder>`` segments treated as wildcards.  Suppression uses
+the qi-lint discipline (``# qi-lint: allow(rule) — reason``) at the
+emitting call site; doc-side findings have no code line to suppress on and
+must be fixed in the doc.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.lint import (
+    FileContext,
+    Finding,
+    iter_python_files,
+    name_arg_expr,
+    resolve_name_arg,
+    telemetry_calls,
+)
+
+SCHEMA = "qi-surface/1"
+INVENTORY_PATH = Path(__file__).with_name("surface_inventory.json")
+
+# Env-read extraction additionally covers tests/conftest.py: QI_TEST_PLATFORM
+# is read there (the suite's platform pin) and nowhere else — the one
+# infrastructure file outside the lint scan that legitimately consumes a
+# declared knob.
+ENV_EXTRA_SCAN = ("tests/conftest.py",)
+
+_ENV_READERS = frozenset({"qi_env", "qi_env_flag", "qi_env_int", "qi_env_float"})
+
+
+# ---------------------------------------------------------------------------
+# extraction
+
+
+class Emit:
+    """One extracted emission site: ``name`` may end in ``*`` (wildcard
+    from a dotted-prefix f-string)."""
+
+    __slots__ = ("name", "path", "line")
+
+    def __init__(self, name: str, path: str, line: int) -> None:
+        self.name = name
+        self.path = path
+        self.line = line
+
+
+class Surface:
+    """The whole-program extraction (everything sorted-deterministic)."""
+
+    def __init__(self) -> None:
+        self.telemetry: Dict[str, List[Emit]] = {
+            "counter": [], "gauge": [], "event": [], "span": [],
+        }
+        self.fault_fires: List[Emit] = []
+        self.env_reads: List[Emit] = []
+        self.schedules: List[str] = []
+        self.wire: Dict[str, Dict[str, List[str]]] = {}
+        # rel -> FileContext of every scanned file (suppression lookups)
+        self.ctxs: Dict[str, FileContext] = {}
+
+    def names(self, kind: str) -> Set[str]:
+        return {e.name for e in self.telemetry[kind]}
+
+    def to_inventory(self) -> Dict[str, object]:
+        return {
+            "schema": SCHEMA,
+            "telemetry": {
+                kind: sorted({e.name for e in emits})
+                for kind, emits in sorted(self.telemetry.items())
+            },
+            "fault_points": sorted({e.name for e in self.fault_fires}),
+            "env_reads": sorted({e.name for e in self.env_reads}),
+            "schedules": sorted(self.schedules),
+            "wire": {
+                ch: {role: sorted(fields) for role, fields in sorted(spec.items())}
+                for ch, spec in sorted(self.wire.items())
+            },
+        }
+
+
+def _extract_file(ctx: FileContext, surface: Surface) -> None:
+    for kind, names, node in telemetry_calls(ctx):
+        for name in names:
+            surface.telemetry[kind].append(Emit(name, ctx.rel, node.lineno))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        arg = name_arg_expr(node)
+        if arg is None:
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname == "fault_point":
+            name = resolve_name_arg(ctx, arg)
+            if name is not None:
+                surface.fault_fires.append(Emit(name, ctx.rel, node.lineno))
+        elif fname in _ENV_READERS or fname in ("getenv",) or (
+            # bare os.environ.get("QI_X"): allowed only outside the lint
+            # scan (tests/conftest.py reads the platform pin before the
+            # package loads) — it is still a READ the unread-knob gate
+            # must see.
+            fname == "get" and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "environ"
+        ):
+            name = resolve_name_arg(ctx, arg)
+            if name is not None and name.startswith("QI_"):
+                surface.env_reads.append(Emit(name, ctx.rel, node.lineno))
+
+
+def extract_surface(root: Path,
+                    scan: Optional[Sequence[str]] = None) -> Surface:
+    """Extract the full emission surface of the repo (AST only — nothing
+    under scan is ever imported)."""
+    surface = Surface()
+    files = iter_python_files(root, scan)
+    for extra in ENV_EXTRA_SCAN if scan is None else ():
+        p = root / extra
+        if p.is_file():
+            files.append(p)
+    for path in files:
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = FileContext(path, str(path.relative_to(root)), source)
+        except (OSError, SyntaxError):
+            continue  # the lint pass reports parse errors
+        surface.ctxs[ctx.rel] = ctx
+        _extract_file(ctx, surface)
+
+    from tools.analyze import schedules as sched_mod
+
+    surface.schedules = [
+        *sched_mod.SCHEDULES, *sched_mod.SERVE_SCHEDULES,
+        *sched_mod.DELTA_SCHEDULES, *sched_mod.FLEET_SCHEDULES,
+    ]
+
+    from tools.analyze.wire import extract_channels
+
+    surface.wire = {
+        ch.name: {"producer": sorted(ch.producer_fields),
+                  "consumer": sorted(ch.consumer_fields)}
+        for ch in extract_channels(root)
+    }
+    return surface
+
+
+# ---------------------------------------------------------------------------
+# registry parsing (docs tables — format frozen, see the docs' notes)
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _table_rows(text: str, heading: str) -> List[Tuple[int, List[str]]]:
+    """``(lineno, cells)`` for each body row of the first markdown table
+    after ``heading`` (cells stripped; header + separator rows skipped)."""
+    lines = text.splitlines()
+    rows: List[Tuple[int, List[str]]] = []
+    in_section = False
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        if line.strip().startswith("#"):
+            if in_table:
+                break
+            in_section = line.strip().lstrip("#").strip().startswith(heading)
+            continue
+        if not in_section:
+            continue
+        if line.lstrip().startswith("|"):
+            if not in_table:
+                in_table = True
+                continue  # header row
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if cells and set(cells[0]) <= {"-", ":", " "}:
+                continue  # separator row
+            rows.append((i, cells))
+        elif in_table:
+            break  # table ended
+    return rows
+
+
+def _cell_names(cell: str) -> List[str]:
+    """Backticked names in a table cell (``<x>`` placeholders → ``*``)."""
+    out = []
+    for name in _BACKTICK_RE.findall(cell):
+        name = re.sub(r"<[^>]*>", "*", name).strip()
+        if name:
+            out.append(name)
+    return out
+
+
+class Registry:
+    """One parsed doc registry: name → (doc_path, lineno)."""
+
+    def __init__(self, doc: str) -> None:
+        self.doc = doc
+        self.entries: Dict[str, int] = {}
+
+    def add(self, name: str, line: int) -> None:
+        self.entries.setdefault(name, line)
+
+    def names(self) -> Set[str]:
+        return set(self.entries)
+
+
+def parse_observability(root: Path) -> Dict[str, Registry]:
+    """The OBSERVABILITY.md span / counter+gauge / event registries."""
+    doc = "docs/OBSERVABILITY.md"
+    text = (root / doc).read_text(encoding="utf-8")
+    spans = Registry(doc)
+    for line, cells in _table_rows(text, "Span inventory"):
+        for name in _cell_names(cells[0] if cells else ""):
+            spans.add(name, line)
+    counters, gauges = Registry(doc), Registry(doc)
+    for line, cells in _table_rows(text, "Counter / gauge inventory"):
+        if len(cells) < 2:
+            continue
+        target = gauges if "gauge" in cells[1] else counters
+        for name in _cell_names(cells[0]):
+            target.add(name, line)
+    events = Registry(doc)
+    for line, cells in _table_rows(text, "Event inventory"):
+        for name in _cell_names(cells[0] if cells else ""):
+            events.add(name, line)
+    return {"span": spans, "counter": counters, "gauge": gauges,
+            "event": events}
+
+
+def parse_robustness(root: Path) -> Tuple[Registry, Registry]:
+    """``(fault_table, knob_table)`` from docs/ROBUSTNESS.md."""
+    doc = "docs/ROBUSTNESS.md"
+    text = (root / doc).read_text(encoding="utf-8")
+    faults = Registry(doc)
+    for line, cells in _table_rows(text, "Fault points"):
+        for name in _cell_names(cells[0] if cells else ""):
+            faults.add(name, line)
+    knobs = Registry(doc)
+    for line, cells in _table_rows(text, "Knobs"):
+        for name in _cell_names(cells[0] if cells else ""):
+            knobs.add(name, line)
+    return faults, knobs
+
+
+# ---------------------------------------------------------------------------
+# wildcard matching
+
+def _covered(name: str, names: Set[str]) -> bool:
+    """Is ``name`` matched by ``names`` — exactly, via an fnmatch-style
+    wildcard on the registry side (``a.*`` and the mid-name
+    ``a.*.latency`` a ``<placeholder>`` row produces both work), or via a
+    code-side wildcard (a dotted-prefix f-string) whose literal prefix
+    intersects a registry entry?"""
+    if name in names:
+        return True
+    if "*" not in name:
+        return any(
+            "*" in n and fnmatch.fnmatchcase(name, n) for n in names
+        )
+    # Code-side wildcard: match on the literal prefix before the first *.
+    prefix = name.split("*", 1)[0]
+    for n in names:
+        if n == name:
+            continue
+        if "*" in n:
+            other = n.split("*", 1)[0]
+            if prefix.startswith(other) or other.startswith(prefix):
+                return True
+        elif n.startswith(prefix):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the pass
+
+
+def _declared_line(path: Path, literal: str) -> int:
+    """Line of the first occurrence of ``"literal"`` in ``path`` (for
+    pointing a declared-but-unused finding at the declaration)."""
+    try:
+        for i, line in enumerate(
+                path.read_text(encoding="utf-8").splitlines(), start=1):
+            if f'"{literal}"' in line:
+                return i
+    except OSError:
+        pass
+    return 1
+
+
+def _suppressed(surface: Surface, rule: str, rel: str, line: int) -> bool:
+    """qi-lint ``allow()`` lookup for code-side surface findings (doc-side
+    rows have no code line to suppress on — fix the doc instead)."""
+    ctx = surface.ctxs.get(rel)
+    return ctx is not None and ctx.suppressed(rule, line)
+
+
+def run_surface(root: Path, update_inventory: bool = False,
+                scan: Optional[Sequence[str]] = None,
+                inventory_path: Optional[Path] = None,
+                declared_faults: Optional[Set[str]] = None,
+                declared_env: Optional[Set[str]] = None,
+                ) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)`` — the full surface pass: extraction, registry
+    drift gates, and the committed-inventory stability gate.
+
+    ``scan``/``inventory_path``/``declared_faults``/``declared_env`` exist
+    for the fixture tests (tests/analyze_fixtures/surface/): they swap the
+    scanned tree, the inventory file, and the runtime catalogs without
+    touching the real ones.  Production callers pass only ``root``.
+    """
+    findings: List[Finding] = []
+    notes: List[str] = []
+    surface = extract_surface(root, scan)
+
+    # -- telemetry names vs the OBSERVABILITY registries --------------------
+    registries = parse_observability(root)
+    for kind in ("counter", "gauge", "event", "span"):
+        reg = registries[kind]
+        reg_names = reg.names()
+        code_names = surface.names(kind)
+        flagged: Set[str] = set()
+        for emit in surface.telemetry[kind]:
+            if emit.name in flagged or _covered(emit.name, reg_names):
+                continue
+            if _suppressed(surface, "surface-telemetry-unregistered",
+                           emit.path, emit.line):
+                continue
+            flagged.add(emit.name)
+            findings.append(Finding(
+                rule="surface-telemetry-unregistered", path=emit.path,
+                line=emit.line,
+                message=(
+                    f"{kind} {emit.name!r} is emitted here but missing from "
+                    f"the docs/OBSERVABILITY.md {kind} registry — add its "
+                    f"row (the registry is the machine-parsed contract)"
+                ),
+            ))
+        for name, line in sorted(reg.entries.items()):
+            if not _covered(name, code_names):
+                findings.append(Finding(
+                    rule="surface-registry-stale", path=reg.doc, line=line,
+                    message=(
+                        f"registry row claims {kind} {name!r} but no code "
+                        f"path emits it — delete the row or restore the "
+                        f"emission"
+                    ),
+                ))
+
+    # -- fault points: catalog vs fires vs the ROBUSTNESS table -------------
+    if declared_faults is None:
+        from quorum_intersection_tpu.utils import faults as faults_mod
+
+        declared = set(faults_mod.registry())
+    else:
+        declared = set(declared_faults)
+    fired = {e.name for e in surface.fault_fires}
+    faults_path = root / "quorum_intersection_tpu/utils/faults.py"
+    for name in sorted(declared - fired):
+        decl_line = _declared_line(faults_path, name)
+        if _suppressed(surface, "surface-fault-unfired",
+                       "quorum_intersection_tpu/utils/faults.py", decl_line):
+            continue
+        findings.append(Finding(
+            rule="surface-fault-unfired", path="quorum_intersection_tpu/utils/faults.py",
+            line=decl_line,
+            message=(
+                f"fault point {name!r} is declared but no code path fires "
+                f"it — an uninjectable boundary is dead robustness; wire a "
+                f"fault_point({name!r}) call or drop the declaration"
+            ),
+        ))
+    for emit in surface.fault_fires:
+        if emit.name not in declared and not _suppressed(
+                surface, "surface-fault-undeclared", emit.path, emit.line):
+            findings.append(Finding(
+                rule="surface-fault-undeclared", path=emit.path,
+                line=emit.line,
+                message=(
+                    f"fault_point({emit.name!r}) is not in the "
+                    f"utils/faults.py catalog (this call raises KeyError "
+                    f"at runtime)"
+                ),
+            ))
+    fault_table, knob_table = parse_robustness(root)
+    for name, line in sorted(fault_table.entries.items()):
+        if name not in declared:
+            findings.append(Finding(
+                rule="surface-fault-doc-stale", path=fault_table.doc,
+                line=line,
+                message=(
+                    f"docs fault-table row {name!r} is not a declared "
+                    f"fault point — delete the row or declare the point"
+                ),
+            ))
+    for name in sorted(declared - fault_table.names()):
+        findings.append(Finding(
+            rule="surface-fault-undocumented", path=fault_table.doc, line=1,
+            message=(
+                f"declared fault point {name!r} has no row in the "
+                f"docs/ROBUSTNESS.md fault table — the catalog and the "
+                f"table must agree in both directions"
+            ),
+        ))
+
+    # -- env knobs: registry vs reads vs the ROBUSTNESS knob table ----------
+    if declared_env is None:
+        from quorum_intersection_tpu.utils import env as env_mod
+
+        declared_env = {v.name for v in env_mod.registry()}
+    read_env = {e.name for e in surface.env_reads}
+    env_path = root / "quorum_intersection_tpu/utils/env.py"
+    for name in sorted(declared_env - read_env):
+        decl_line = _declared_line(env_path, name)
+        if _suppressed(surface, "surface-env-unread",
+                       "quorum_intersection_tpu/utils/env.py", decl_line):
+            continue
+        findings.append(Finding(
+            rule="surface-env-unread", path="quorum_intersection_tpu/utils/env.py",
+            line=decl_line,
+            message=(
+                f"env knob {name!r} is declared but never read through "
+                f"qi_env* — a knob nobody reads is documentation drift; "
+                f"wire the read or drop the declaration"
+            ),
+        ))
+    for emit in surface.env_reads:
+        if emit.name not in declared_env and not _suppressed(
+                surface, "surface-env-undeclared", emit.path, emit.line):
+            findings.append(Finding(
+                rule="surface-env-undeclared", path=emit.path, line=emit.line,
+                message=(
+                    f"qi_env read of undeclared knob {emit.name!r} (raises "
+                    f"KeyError at runtime — declare it in utils/env.py)"
+                ),
+            ))
+    for name, line in sorted(knob_table.entries.items()):
+        if name.startswith("QI_") and name not in declared_env:
+            findings.append(Finding(
+                rule="surface-env-doc-stale", path=knob_table.doc, line=line,
+                message=(
+                    f"docs knob-table row {name!r} is not declared in "
+                    f"utils/env.py — delete the row or declare the knob"
+                ),
+            ))
+
+    # -- inventory stability -----------------------------------------------
+    inv_path = inventory_path if inventory_path is not None else INVENTORY_PATH
+    inventory = surface.to_inventory()
+    rendered = json.dumps(inventory, indent=2, sort_keys=True) + "\n"
+    committed = (
+        inv_path.read_text(encoding="utf-8") if inv_path.exists() else ""
+    )
+    if update_inventory:
+        if rendered != committed:
+            inv_path.write_text(rendered, encoding="utf-8")
+            notes.append(f"surface inventory updated: {inv_path}")
+        else:
+            notes.append("surface inventory already current")
+    elif rendered != committed:
+        findings.append(Finding(
+            rule="surface-inventory-stale",
+            path="tools/analyze/surface_inventory.json", line=1,
+            message=(
+                "committed qi-surface/1 inventory does not match a fresh "
+                "extraction — the emission surface changed; regenerate "
+                "with `python -m tools.analyze surface --update-inventory` "
+                "and review the diff (wire-field renames, new telemetry, "
+                "dropped fault points all land here)"
+            ),
+        ))
+
+    notes.append(
+        "surface: "
+        f"{len(surface.names('counter'))} counters, "
+        f"{len(surface.names('gauge'))} gauges, "
+        f"{len(surface.names('event'))} events, "
+        f"{len(surface.names('span'))} spans, "
+        f"{len(fired)} fault points, {len(read_env)} env knobs, "
+        f"{len(surface.schedules)} schedules, "
+        f"{len(surface.wire)} wire channels"
+    )
+    return findings, notes

@@ -70,6 +70,12 @@ TYPING_TARGETS = (
     # wrong-answer-with-confidence failure the typed schema exists to
     # prevent.
     "quorum_intersection_tpu/query.py",
+    # ISSUE 13: the serving engine and the pipeline entry join the spine
+    # — the serve engine hands batches across threads (a type confusion
+    # in its entry/ticket bookkeeping loses a request), and pipeline.py
+    # is the one seam every backend, cert and batch path flows through.
+    "quorum_intersection_tpu/serve.py",
+    "quorum_intersection_tpu/pipeline.py",
 )
 
 

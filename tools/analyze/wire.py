@@ -1,0 +1,307 @@
+"""qi-wire: JSONL wire-schema conformance between producers and consumers.
+
+The serve/fleet/query tier speaks four JSONL dialects — requests
+(``qi-serve/1`` request lines + the nested ``qi-query/1`` object),
+responses (verdict/error/replay/listening/pong lines), and the crash-only
+request journal.  Each is produced in one module and consumed in another,
+and nothing used to stop a producer rename (``"verdict"`` → ``"result"``)
+from silently making every consumer read a default forever — the exact
+skew class the fleet's cross-process pipes make invisible until a kill
+round loses work.
+
+This pass extracts, per **channel**, the field set each producer writes
+(string keys of dict literals and ``obj["k"] = ...`` stores inside the
+spec'd functions) and each consumer reads (``var.get("k")`` / ``var["k"]``
+/ ``"k" in var`` on the spec'd variable names), then gates:
+
+- **producer ⊇ consumer** — every field a consumer reads is written by
+  some producer of the channel (``wire-consumer-unproduced``);
+- **site integrity** — every spec'd producer/consumer function still
+  exists and still touches the wire (``wire-site-missing`` /
+  ``wire-site-empty``), so a refactor cannot silently move the protocol
+  out from under the gate;
+- **field stability** — the channel field sets land in the committed
+  ``qi-surface/1`` inventory (tools/analyze/surface.py), so ANY field
+  rename — including journal fields a replay must re-parse across a
+  restart — is a reviewed inventory diff, not a silent skew.
+
+Producer extraction over-approximates deliberately (every dict literal in
+the function counts): a too-big producer set can only *weaken* the
+consumer gate, never fail a clean tree; consumer extraction is restricted
+to named variables so it stays exact.  Channel specs live in
+:data:`CHANNEL_SPECS`; a new transport field needs no spec change unless a
+new function joins the protocol.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analyze.lint import FileContext, Finding
+
+Site = Tuple[str, int]  # (rel path, line)
+
+
+@dataclass
+class Channel:
+    """One extracted wire channel."""
+
+    name: str
+    producer_fields: Dict[str, Site] = field(default_factory=dict)
+    consumer_fields: Dict[str, Site] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+
+# (channel, producers, consumers):
+#   producer = (rel_path, qualname)
+#   consumer = (rel_path, qualname, (var, ...))
+CHANNEL_SPECS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...],
+                           Tuple[Tuple[str, str, Tuple[str, ...]], ...]], ...] = (
+    (
+        # Client → engine request lines (qi-serve/1): the fleet front door
+        # is the in-repo producer; the transport seam parses them.
+        "serve.request",
+        (
+            ("quorum_intersection_tpu/fleet.py", "ProcWorker.submit"),
+            ("quorum_intersection_tpu/fleet.py", "ProcWorker.ping"),
+        ),
+        (
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession.handle_line", ("obj",)),
+        ),
+    ),
+    (
+        # Engine → client response lines: verdicts, typed errors, replay
+        # reports, the listening announcement, and pong health snapshots;
+        # the fleet's reader demux is the consumer.
+        "serve.response",
+        (
+            ("quorum_intersection_tpu/serve_transport.py", "ticket_response"),
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession.handle_line"),
+            ("quorum_intersection_tpu/serve_transport.py", "pong_payload"),
+            ("quorum_intersection_tpu/serve_transport.py", "serve_main"),
+            ("quorum_intersection_tpu/serve.py",
+             "ServeEngine._replay_journal"),
+        ),
+        (
+            ("quorum_intersection_tpu/fleet.py", "ProcWorker._read_loop",
+             ("obj",)),
+            ("quorum_intersection_tpu/fleet.py", "FleetEngine._on_response",
+             ("obj", "err")),
+            ("quorum_intersection_tpu/fleet.py",
+             "FleetEngine._aggregate_health", ("pong",)),
+        ),
+    ),
+    (
+        # The nested qi-query/1 object riding a request's "query" field:
+        # Query.to_wire is the canonical producer (the CLI builds the same
+        # shape), Query.parse the one consumer everywhere.
+        "query",
+        (
+            ("quorum_intersection_tpu/query.py", "Query.to_wire"),
+            ("quorum_intersection_tpu/query.py", "query_main"),
+        ),
+        (
+            ("quorum_intersection_tpu/query.py", "Query.parse", ("raw",)),
+        ),
+    ),
+    (
+        # The crash-only request journal (qi-serve-journal/1): replay
+        # across a restart — and across a dead fleet worker's inheritance
+        # — must re-parse exactly what the appenders wrote.
+        "serve.journal",
+        (
+            ("quorum_intersection_tpu/serve.py",
+             "RequestJournal._append_line"),
+            ("quorum_intersection_tpu/serve.py",
+             "RequestJournal.append_request"),
+            ("quorum_intersection_tpu/serve.py",
+             "RequestJournal.append_done"),
+            ("quorum_intersection_tpu/serve.py", "RequestJournal.compact"),
+        ),
+        (
+            ("quorum_intersection_tpu/serve.py", "RequestJournal.scan",
+             ("obj",)),
+            ("quorum_intersection_tpu/serve.py",
+             "ServeEngine._replay_journal", ("e",)),
+            ("quorum_intersection_tpu/fleet.py", "FleetEngine._failover",
+             ("e", "entry")),
+        ),
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# function lookup + field extraction
+
+
+def _find_function(tree: ast.Module, qualname: str) -> Optional[ast.FunctionDef]:
+    parts = qualname.split(".")
+    body: Sequence[ast.stmt] = tree.body
+    for i, part in enumerate(parts):
+        found = None
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == part and i == len(parts) - 1:
+                return node
+            if isinstance(node, ast.ClassDef) and node.name == part:
+                found = node
+                break
+        if found is None:
+            return None
+        body = found.body
+    return None
+
+
+def _producer_fields(fn: ast.AST, rel: str) -> Dict[str, Site]:
+    out: Dict[str, Site] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    out.setdefault(key.value, (rel, key.lineno))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and isinstance(tgt.slice.value, str):
+                    out.setdefault(tgt.slice.value, (rel, tgt.lineno))
+    return out
+
+
+def _consumer_fields(fn: ast.AST, rel: str,
+                     varnames: Sequence[str]) -> Dict[str, Site]:
+    names = set(varnames)
+    out: Dict[str, Site] = {}
+
+    def is_wire_var(node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in names
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" and is_wire_var(node.func.value) \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.setdefault(node.args[0].value, (rel, node.lineno))
+        elif isinstance(node, ast.Subscript) and is_wire_var(node.value) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            out.setdefault(node.slice.value, (rel, node.lineno))
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str) \
+                and node.comparators and is_wire_var(node.comparators[0]):
+            out.setdefault(node.left.value, (rel, node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the pass
+
+
+def _load_ctx(root: Path, rel: str,
+              cache: Dict[str, Optional[FileContext]]) -> Optional[FileContext]:
+    if rel not in cache:
+        try:
+            source = (root / rel).read_text(encoding="utf-8")
+            cache[rel] = FileContext(root / rel, rel, source)
+        except (OSError, SyntaxError):
+            cache[rel] = None
+    return cache[rel]
+
+
+def extract_channels(root: Path) -> List[Channel]:
+    """Extract every spec'd channel (site-integrity findings attached)."""
+    cache: Dict[str, Optional[FileContext]] = {}
+    channels: List[Channel] = []
+    for name, producers, consumers in CHANNEL_SPECS:
+        ch = Channel(name)
+        for rel, qualname in producers:
+            ctx = _load_ctx(root, rel, cache)
+            fn = _find_function(ctx.tree, qualname) if ctx else None
+            if fn is None:
+                ch.findings.append(Finding(
+                    rule="wire-site-missing", path=rel, line=1,
+                    message=(
+                        f"wire channel {name!r} producer {qualname!r} not "
+                        f"found — update tools/analyze/wire.py "
+                        f"CHANNEL_SPECS so the protocol stays gated"
+                    ),
+                ))
+                continue
+            fields = _producer_fields(fn, rel)
+            if not fields:
+                ch.findings.append(Finding(
+                    rule="wire-site-empty", path=rel, line=fn.lineno,
+                    message=(
+                        f"wire channel {name!r} producer {qualname!r} "
+                        f"writes no statically visible fields — the gate "
+                        f"is checking nothing; fix the spec or the function"
+                    ),
+                ))
+            for f_name, site in fields.items():
+                ch.producer_fields.setdefault(f_name, site)
+        for rel, qualname, varnames in consumers:
+            ctx = _load_ctx(root, rel, cache)
+            fn = _find_function(ctx.tree, qualname) if ctx else None
+            if fn is None:
+                ch.findings.append(Finding(
+                    rule="wire-site-missing", path=rel, line=1,
+                    message=(
+                        f"wire channel {name!r} consumer {qualname!r} not "
+                        f"found — update tools/analyze/wire.py "
+                        f"CHANNEL_SPECS so the protocol stays gated"
+                    ),
+                ))
+                continue
+            fields = _consumer_fields(fn, rel, varnames)
+            if not fields:
+                ch.findings.append(Finding(
+                    rule="wire-site-empty", path=rel, line=fn.lineno,
+                    message=(
+                        f"wire channel {name!r} consumer {qualname!r} reads "
+                        f"no fields from {'/'.join(varnames)} — the gate is "
+                        f"checking nothing; fix the spec or the function"
+                    ),
+                ))
+            for f_name, site in fields.items():
+                ch.consumer_fields.setdefault(f_name, site)
+        channels.append(ch)
+    return channels
+
+
+def run_wire(root: Path) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)``: producer ⊇ consumer per channel, plus the
+    site-integrity findings from extraction."""
+    findings: List[Finding] = []
+    notes: List[str] = []
+    cache: Dict[str, Optional[FileContext]] = {}
+    for ch in extract_channels(root):
+        findings.extend(ch.findings)
+        for f_name, (rel, line) in sorted(ch.consumer_fields.items()):
+            if f_name in ch.producer_fields:
+                continue
+            ctx = _load_ctx(root, rel, cache)
+            if ctx is not None and ctx.suppressed("wire-consumer-unproduced",
+                                                  line):
+                continue
+            findings.append(Finding(
+                rule="wire-consumer-unproduced", path=rel, line=line,
+                message=(
+                    f"wire channel {ch.name!r}: consumer reads field "
+                    f"{f_name!r} that no producer of the channel writes — "
+                    f"a renamed/dropped protocol field reads a default "
+                    f"forever; fix the producer or the consumer"
+                ),
+            ))
+        notes.append(
+            f"wire {ch.name}: {len(ch.producer_fields)} produced, "
+            f"{len(ch.consumer_fields)} consumed fields"
+        )
+    return findings, notes

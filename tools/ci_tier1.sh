@@ -15,8 +15,12 @@
 #     qi-telemetry/1 JSONL file, so a perf regression spotted in CI is
 #     inspectable (tools/metrics_report.py) instead of anecdotal;
 #   - the static-analysis suite (docs/STATIC_ANALYSIS.md) runs after the
-#     tests: `python -m tools.analyze` must exit clean, and its findings
-#     stream to $TIER1_ANALYZE in the same qi-telemetry/1 shape;
+#     tests: `python -m tools.analyze` must exit clean — ALL SIX passes
+#     (qi-lint, qi-surface contract/registry drift incl. the committed
+#     surface_inventory.json staleness gate, qi-locks lock-order/lockset,
+#     qi-wire producer⊇consumer, typing ratchet, race schedules + tsan) —
+#     and its findings stream to $TIER1_ANALYZE in the same
+#     qi-telemetry/1 shape;
 #   - a qi-cert gate (ISSUE 7): CLI-written verdict certificates for the
 #     vendored fixture pairs re-validated by the independent stdlib
 #     checker tools/check_cert.py ($TIER1_CERTS holds the artifacts);
