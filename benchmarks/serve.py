@@ -411,6 +411,10 @@ def run_fleet_phase(args, record) -> tuple:
                 "fleet.delta_scc_reuse_pct",
                 gauges.get("delta.scc_reuse_pct", 0.0),
             ),
+            # qi-pulse: the aggregation plane's fleet-MERGED e2e p99 —
+            # computed over the union of the workers' histogram buckets
+            # (0.0 until a probe cycle aggregated, or QI_PULSE_AGG=0).
+            "e2e_p99_ms": gauges.get("fleet.e2e_p99_ms", 0.0),
         }
         if kill_at is not None and run["evictions"] < 1:
             mismatches.append(
@@ -443,6 +447,7 @@ def run_fleet_phase(args, record) -> tuple:
         "fleet_skew": args.fleet_skew,
         "fleet_verdicts_per_sec": per_n[n_top]["verdicts_per_sec"],
         "fleet_p99_ms": per_n[n_top]["p99_ms"],
+        "fleet_e2e_p99_ms": per_n[n_top]["e2e_p99_ms"],
         "fleet_store_hit_pct": per_n[n_top]["store_hit_pct"],
         "fleet_delta_scc_reuse_pct": per_n[n_top]["delta_scc_reuse_pct"],
         "fleet_kill_evictions": kill_run["evictions"],
@@ -656,6 +661,14 @@ def main(argv=None) -> int:
         # gauges, so the bench rows and the live gauges stay comparable.
         "serve_p50_ms": round(_percentile(latencies_ms, 50.0), 3),
         "serve_p99_ms": round(_percentile(latencies_ms, 99.0), 3),
+        # Decomposed stage p99s (qi-pulse, ISSUE 15): bucket-resolution
+        # estimates from the serving layer's stage histograms, so the
+        # trend sentinel can tell a slowed drain (queue_wait growing)
+        # from a slowed engine (solve growing), not just watch e2e move.
+        "serve_queue_wait_p99_ms": record.histogram(
+            "pulse.queue_wait_ms").quantile_ms(99.0),
+        "serve_solve_p99_ms": record.histogram(
+            "pulse.solve_ms").quantile_ms(99.0),
         "serve_cache_hit_pct": round(100.0 * hits / admitted, 2) if admitted else 0.0,
         "requests": args.requests,
         "admitted": admitted,

@@ -69,10 +69,9 @@ import sys
 import tempfile
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Callable, Deque, Dict, List, Optional, Set, Tuple, Union
+from typing import Callable, Dict, List, Optional, Set, Tuple, Union
 
 from quorum_intersection_tpu.delta import SharedSccStore
 from quorum_intersection_tpu.fbas.graph import build_graph
@@ -84,7 +83,6 @@ from quorum_intersection_tpu.serve import (
     ServeError,
     ServeResponse,
     Ticket,
-    _percentile,
     _raw_nodes,
     snapshot_fingerprint,
 )
@@ -101,7 +99,12 @@ from quorum_intersection_tpu.utils.env import (
 )
 from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
-from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.telemetry import (
+    Histogram,
+    RunRecord,
+    TraceContext,
+    get_run_record,
+)
 
 log = get_logger("fleet")
 
@@ -113,9 +116,10 @@ FLEET_SCHEMA = "qi-fleet/1"
 # route-during-eviction, replay-races-new-request.
 _fleet_sync: Callable[[str], None] = lambda point: None
 
-# Latency window for the fleet p50/p99 gauges (same rationale as
-# serve.LATENCY_WINDOW: track the CURRENT load shape).
-LATENCY_WINDOW = 512
+# The fleet p50/p99 gauge window and nearest-rank estimator live with the
+# Histogram primitive in utils/telemetry.py (ISSUE 15 dedupe) — the front
+# door's pulse.fleet_e2e_ms histogram carries both the mergeable buckets
+# and the bounded raw window those gauges derive from.
 
 
 # ---- consistent-hash ring ---------------------------------------------------
@@ -311,12 +315,17 @@ class ProcWorker:
 
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
                deadline_s: Optional[float],
-               query: Optional[Dict[str, object]] = None) -> bool:
+               query: Optional[Dict[str, object]] = None,
+               trace: Optional[str] = None) -> bool:
         line: Dict[str, object] = {"request_id": request_id, "nodes": nodes}
         if deadline_s is not None:
             line["deadline_s"] = deadline_s
         if query is not None:
             line["query"] = query
+        if trace is not None:
+            # qi-pulse: the front door's request-span context — the worker
+            # adopts it so its spans join this request's trace.
+            line["trace"] = trace
         return self._write(line)
 
     def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
@@ -426,13 +435,14 @@ class LocalWorker:
 
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
                deadline_s: Optional[float],
-               query: Optional[Dict[str, object]] = None) -> bool:
+               query: Optional[Dict[str, object]] = None,
+               trace: Optional[str] = None) -> bool:
         if self._dead:
             return False
         try:
             ticket = self.engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
-                query=query,
+                query=query, trace=trace,
             )
         except ServeError as exc:
             self._respond({"request_id": request_id,
@@ -486,6 +496,11 @@ class _Pending:
     internal: bool = False  # journal-inherited work with no client ticket
     replaying: bool = False  # dispatched by a failover; gates /readyz
     query: Optional[Dict[str, object]] = None  # qi-query/1 wire form
+    # qi-pulse (ISSUE 15): the wire trace context stamped at admission
+    # ("trace_id:span_id[:pid]", parented on the fleet.request span) —
+    # re-sent on every failover re-dispatch so the inheriting worker's
+    # spans still join the original request's trace.
+    trace: Optional[str] = None
 
 
 class FleetEngine:
@@ -569,7 +584,10 @@ class FleetEngine:
         self._dead_handled: Set[str] = set()
         self._failovers_active = 0
         self._replays_outstanding = 0
-        self._latencies: Deque[float] = deque(maxlen=LATENCY_WINDOW)
+        # Aggregation plane (qi-pulse, ISSUE 15): merge the workers'
+        # pong-carried pulse histograms fleet-wide each probe cycle.
+        # "0" restores per-worker-only metrics.
+        self._pulse_agg = qi_env("QI_PULSE_AGG") not in ("", "0")
         self._pongs: Dict[str, Dict[str, object]] = {}
         self._closed = False
         self._started = False
@@ -690,14 +708,22 @@ class FleetEngine:
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
         query: Optional[object] = None,
+        trace: Optional[str] = None,
     ) -> Ticket:
         """Admit one request: fingerprint, route, dispatch.  Same contract
         as ``ServeEngine.submit`` (typed errors, Ticket immediately).
         ``query`` (qi-query/1) extends the ROUTING key with the query
         kind + params, so identical snapshots asked different questions
         route (and coalesce) independently — fingerprints never cross
-        query types fleet-wide either."""
+        query types fleet-wide either.
+
+        ``trace`` (qi-pulse): an upstream client's wire trace context.
+        The front door's ``fleet.request`` span adopts it (grafting under
+        the client's span), then re-stamps the wire with its OWN span as
+        the workers' remote parent — the chain stays one trace end to
+        end: client → front door request span → worker spans."""
         rec = get_run_record()
+        client_ctx = TraceContext.from_env(trace) if trace else None
         with self._lock:
             closed = self._closed
         if closed:
@@ -707,39 +733,53 @@ class FleetEngine:
             request_id
             or f"flt-{os.getpid()}-{time.monotonic_ns():x}"
         )
-        parsed_query = (
-            query if isinstance(query, Query) else Query.parse(query)
-        )
-        fbas = source if isinstance(source, Fbas) else parse_fbas(source)
-        nodes = _raw_nodes(source, fbas)
-        graph = build_graph(fbas, dangling=self.dangling)
-        fp = snapshot_fingerprint(
-            graph, scc_select=self.scc_select,
-            scope_to_scc=self.scope_to_scc,
-        )
-        qfp = parsed_query.fingerprint()
-        if qfp:
-            fp = f"{fp}:q:{qfp}"
-        ticket = Ticket(request_id, time.monotonic(), deadline_t=None)
-        pending = _Pending(
-            ticket=ticket, wire_id=request_id, fingerprint=fp, nodes=nodes,
-            deadline_s=deadline_s if deadline_s is not None
-            else self.deadline_s,
-            query=parsed_query.to_wire(),
-        )
-        with self._lock:
-            # A client may reuse a request_id while the first request is
-            # still in flight (the serve contract answers every
-            # submission): give the duplicate a unique wire id so the
-            # earlier pending entry is never orphaned — both tickets
-            # resolve, the client-facing request_id stays its own.
-            n = 0
-            while pending.wire_id in self._pending:
-                n += 1
-                pending.wire_id = f"{request_id}~dup{n}"
-            self._pending[pending.wire_id] = pending
-        rec.add("fleet.requests")
-        self._dispatch(pending)
+        # The front-door REQUEST SPAN (qi-pulse, ISSUE 15): it covers
+        # fingerprint + route + dispatch, and its span id — stamped into
+        # the wire "trace" field in the QI_TRACE_CONTEXT format — is the
+        # remote parent every worker span for this request grafts under,
+        # so one fleet request renders as one cross-process trace.
+        with rec.adopted(client_ctx), rec.span(
+            "fleet.request", request_id=request_id,
+        ) as req_span:
+            parsed_query = (
+                query if isinstance(query, Query) else Query.parse(query)
+            )
+            fbas = source if isinstance(source, Fbas) else parse_fbas(source)
+            nodes = _raw_nodes(source, fbas)
+            graph = build_graph(fbas, dangling=self.dangling)
+            fp = snapshot_fingerprint(
+                graph, scc_select=self.scc_select,
+                scope_to_scc=self.scope_to_scc,
+            )
+            qfp = parsed_query.fingerprint()
+            if qfp:
+                fp = f"{fp}:q:{qfp}"
+            ticket = Ticket(request_id, time.monotonic(), deadline_t=None)
+            pending = _Pending(
+                ticket=ticket, wire_id=request_id, fingerprint=fp,
+                nodes=nodes,
+                deadline_s=deadline_s if deadline_s is not None
+                else self.deadline_s,
+                query=parsed_query.to_wire(),
+                trace=TraceContext(
+                    client_ctx.trace_id if client_ctx is not None
+                    else rec.trace_id,
+                    req_span.span_id, rec.pid,
+                ).to_env(),
+            )
+            with self._lock:
+                # A client may reuse a request_id while the first request
+                # is still in flight (the serve contract answers every
+                # submission): give the duplicate a unique wire id so the
+                # earlier pending entry is never orphaned — both tickets
+                # resolve, the client-facing request_id stays its own.
+                n = 0
+                while pending.wire_id in self._pending:
+                    n += 1
+                    pending.wire_id = f"{request_id}~dup{n}"
+                self._pending[pending.wire_id] = pending
+            rec.add("fleet.requests")
+            self._dispatch(pending)
         return ticket
 
     def _route(self, fingerprint: str) -> str:
@@ -770,6 +810,18 @@ class FleetEngine:
         re-routes through the shrunken ring."""
         rec = get_run_record()
         rid = pending.wire_id
+        route_t0 = time.perf_counter()
+        try:
+            self._dispatch_inner(pending, rec, rid)
+        finally:
+            # Stage histogram (qi-pulse): ring lookup + wire write per
+            # dispatch attempt chain (failover re-dispatches book again).
+            rec.histogram("pulse.route_ms").observe(
+                (time.perf_counter() - route_t0) * 1000.0
+            )
+
+    def _dispatch_inner(self, pending: _Pending, rec: RunRecord,
+                        rid: str) -> None:
         for _ in range(len(self._workers) + 1):
             try:
                 wid = self._route(pending.fingerprint)
@@ -786,6 +838,7 @@ class FleetEngine:
                 worker = self._workers.get(wid) if wid in self._live else None
             if worker is not None and worker.submit(
                 rid, pending.nodes, pending.deadline_s, pending.query,
+                pending.trace,
             ):
                 rec.add("fleet.routed")
                 return
@@ -832,6 +885,7 @@ class FleetEngine:
         cert = obj.get("cert")
         stats = obj.get("stats")
         result = obj.get("result")
+        wire_trace = obj.get("trace")
         response = ServeResponse(
             # The CLIENT's id, not the wire id (a deduplicated duplicate
             # answers under the id its client actually sent).
@@ -842,6 +896,11 @@ class FleetEngine:
             cached=bool(obj.get("cached")),
             seconds=seconds,
             result=result if isinstance(result, dict) else None,
+            # Trace echo (qi-pulse): the worker echoes the context this
+            # front door stamped; fall back to the pending record so the
+            # client sees the trace even from a pre-pulse worker.
+            trace=(wire_trace if isinstance(wire_trace, str)
+                   else pending.trace),
         )
         if not pending.internal:
             rec.add("fleet.verdicts")
@@ -870,13 +929,15 @@ class FleetEngine:
             get_run_record().gauge("fleet.replay_complete", 1)
 
     def _note_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._latencies.append(seconds * 1000.0)
-            samples = list(self._latencies)
-        samples.sort()
+        # Front-door end-to-end histogram (qi-pulse): submit→delivery as
+        # the CLIENT experienced it.  The fleet.p50_ms/p99_ms gauges stay
+        # byte-compatible — same nearest-rank estimator over the same
+        # 512-sample window the pre-pulse deque carried.
         rec = get_run_record()
-        rec.gauge("fleet.p50_ms", round(_percentile(samples, 50.0), 3))
-        rec.gauge("fleet.p99_ms", round(_percentile(samples, 99.0), 3))
+        h = rec.histogram("pulse.fleet_e2e_ms")
+        h.observe(seconds * 1000.0)
+        rec.gauge("fleet.p50_ms", round(h.window_percentile(50.0), 3))
+        rec.gauge("fleet.p99_ms", round(h.window_percentile(99.0), 3))
 
     # ---- health probing / eviction ---------------------------------------
 
@@ -928,7 +989,17 @@ class FleetEngine:
         fleet_ring_size / fleet_store_hit_pct)."""
         rec = get_run_record()
         with self._lock:
-            self._pongs = dict(pongs)
+            # Retain the last-known pong per STILL-LIVE worker: a single
+            # missed ping (or one slow cycle) must not drop that worker
+            # from the merged histograms — counts on /metrics would go
+            # backwards and Prometheus rate() would read the dip+bounce
+            # as a counter reset.  Evicted workers are pruned here.
+            retained = {
+                wid: pong for wid, pong in self._pongs.items()
+                if wid in self._live
+            }
+            retained.update(pongs)
+            self._pongs = retained
             live, ring_size = len(self._live), len(self._ring)
         rec.gauge("fleet.workers_live", live)
         rec.gauge("fleet.ring_size", ring_size)
@@ -951,6 +1022,54 @@ class FleetEngine:
             rec.gauge(
                 "fleet.delta_scc_reuse_pct",
                 round(100.0 * d_hits / (d_hits + d_misses), 2),
+            )
+        # The pulse merge covers every live worker's LAST-KNOWN pong, not
+        # just this cycle's successes, so the merged view is monotonic
+        # between evictions.
+        self._aggregate_pulse(retained, rec)
+
+    def _aggregate_pulse(self, pongs: Dict[str, Dict[str, object]],
+                         rec: RunRecord) -> None:
+        """The qi-pulse aggregation plane (ISSUE 15): merge the workers'
+        pong-carried pulse histogram snapshots bucket-wise into the front
+        door's ``fleet.pulse.*`` views — mergeable by construction, so
+        the fleet-wide p99 is computed over the UNION of worker samples,
+        not the max of per-worker gauges.  Behind the ``pulse.aggregate``
+        fault point: any failure degrades this CYCLE to per-worker-only
+        metrics (loud counters, stale fleet view) and can never touch a
+        verdict — aggregation sits entirely off the request path."""
+        if not self._pulse_agg or not pongs:
+            return
+        try:
+            fault_point("pulse.aggregate")
+            # One snapshot per distinct worker PROCESS: in local-worker
+            # mode every in-process engine shares one RunRecord, so N
+            # pongs alias the same histograms — summing them would
+            # multiply the fleet view N-fold.  Keyed by the pong's pid,
+            # subprocess fleets (distinct pids) merge every worker.
+            by_pid: Dict[str, Dict[object, Dict[str, object]]] = {}
+            for pong in pongs.values():
+                pulse = pong.get("pulse")
+                if not isinstance(pulse, dict):
+                    continue
+                for name, snap in pulse.items():
+                    if isinstance(snap, dict):
+                        by_pid.setdefault(str(name), {})[
+                            pong.get("pid")] = snap
+            for name, snaps in sorted(by_pid.items()):
+                merged = Histogram.merge_wire(list(snaps.values()))
+                rec.histogram(f"fleet.{name}").set_from_wire(merged)
+            if "pulse.e2e_ms" in by_pid:
+                rec.gauge(
+                    "fleet.e2e_p99_ms",
+                    rec.histogram("fleet.pulse.e2e_ms").quantile_ms(99.0),
+                )
+        except (FaultInjected, OSError, ValueError, TypeError, KeyError) as exc:
+            rec.add("pulse.agg_errors")
+            rec.event("pulse.agg_degraded", error=str(exc))
+            log.warning(
+                "pulse aggregation degraded this cycle (%s); per-worker "
+                "metrics remain available", exc,
             )
 
     def healthz(self) -> Dict[str, object]:
@@ -1170,6 +1289,7 @@ class FleetEngine:
                 if known:
                     continue  # already re-routed under a different owner
                 entry_query = entry.get("query")
+                entry_trace = entry.get("trace")
                 pending = _Pending(
                     ticket=Ticket(rid, time.monotonic(), None),
                     wire_id=rid,
@@ -1183,6 +1303,11 @@ class FleetEngine:
                     # form; the fingerprint already keys the kind).
                     query=(entry_query
                            if isinstance(entry_query, dict) else None),
+                    # qi-pulse: the dead worker journaled the original
+                    # wire trace — the inheriting peer's re-solve joins
+                    # the request's trace, not a fresh one.
+                    trace=(entry_trace
+                           if isinstance(entry_trace, str) else None),
                 )
                 with self._lock:
                     self._pending[rid] = pending

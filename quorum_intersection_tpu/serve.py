@@ -62,7 +62,6 @@ from __future__ import annotations
 import argparse
 import hashlib
 import json
-import math
 import os
 import threading
 import time
@@ -99,7 +98,13 @@ from quorum_intersection_tpu.utils.env import (
 )
 from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
-from quorum_intersection_tpu.utils.telemetry import get_run_record
+from quorum_intersection_tpu.utils.telemetry import (
+    LATENCY_WINDOW,
+    TraceContext,
+    dump_exemplar,
+    get_run_record,
+    percentile,
+)
 
 log = get_logger("serve")
 
@@ -113,10 +118,11 @@ _serve_sync: Callable[[str], None] = lambda point: None
 SERVE_SCHEMA = "qi-serve/1"
 JOURNAL_SCHEMA = "qi-serve-journal/1"
 
-# Latency window for the p50/p99 gauges: big enough to smooth scheduler
-# noise, small enough that the gauges track the CURRENT load shape (a
-# 10-minute-old latency spike must age out of a live /metrics scrape).
-LATENCY_WINDOW = 512
+# The p50/p99 gauge window (LATENCY_WINDOW) and the nearest-rank estimator
+# now live in utils/telemetry.py beside the Histogram primitive they feed
+# (ISSUE 15 dedupe) — re-exported here so the import surface
+# (`serve._percentile`, the bench driver and tests) stays stable.
+_percentile = percentile
 
 # One deadline-cancelled batch requeues its surviving (un-expired)
 # requests for a fresh solve; past this many attempts a request returns a
@@ -253,6 +259,11 @@ class ServeResponse:
     cached: bool
     seconds: float  # admission → delivery latency
     result: Optional[Dict[str, object]] = None
+    # Wire trace echo (qi-pulse, ISSUE 15): the request's carried
+    # ``trace_id:span_id[:pid]`` context, echoed back so the client (and
+    # the fleet front door relaying worker responses) can join the
+    # response to its distributed trace.  None on trace-less requests.
+    trace: Optional[str] = None
 
 
 _Outcome = Tuple[str, object]  # ("ok", ServeResponse) | ("err", Exception)
@@ -266,6 +277,9 @@ class Ticket:
         self.request_id = request_id
         self.submitted_t = submitted_t
         self.deadline_t = deadline_t  # absolute monotonic, None = no deadline
+        # qi-pulse: THIS submission's wire trace — a coalesced waiter's
+        # response must echo its OWN context, not the leader entry's.
+        self.trace: Optional[str] = None
         self._event = threading.Event()
         self._outcome: Optional[_Outcome] = None
         self._callbacks: List[Callable[["Ticket"], None]] = []
@@ -334,6 +348,14 @@ class _Entry:
     attempts: int = 0
     done: bool = False
     admitted_t: float = 0.0
+    # qi-pulse (ISSUE 15): the wire-carried trace context this request
+    # arrived with (the drain adopts it around the solve) and the
+    # per-stage latency breakdown the exemplar dump reports.
+    trace: Optional[str] = None
+    stages: Dict[str, float] = field(default_factory=dict)
+
+    def trace_ctx(self) -> Optional[TraceContext]:
+        return TraceContext.from_env(self.trace) if self.trace else None
 
 
 # ---- crash-only request journal --------------------------------------------
@@ -388,12 +410,18 @@ class RequestJournal:
     def append_request(self, request_id: str, fingerprint: str,
                        nodes: List[Dict[str, object]],
                        deadline_s: Optional[float],
-                       query: Optional[Dict[str, object]] = None) -> bool:
+                       query: Optional[Dict[str, object]] = None,
+                       trace: Optional[str] = None) -> bool:
         payload: Dict[str, object] = {
             "kind": "req", "request_id": request_id,
             "fingerprint": fingerprint, "deadline_s": deadline_s,
             "nodes": nodes, "t_wall": round(time.time(), 3),
         }
+        if trace is not None:
+            # Wire trace context (qi-pulse): journaled so a replay
+            # re-adopts the ORIGINAL request's trace — the recovered
+            # solve's spans join the trace the front door started.
+            payload["trace"] = trace
         if query is not None:
             # Typed queries (qi-query/1) journal their wire form so a
             # replay re-resolves the SAME question — the fingerprint
@@ -612,13 +640,16 @@ class ServeEngine:
             dangling=dangling, scc_select=scc_select,
             scope_to_scc=scope_to_scc, pack=pack,
         )
+        # Slow-request exemplars (qi-pulse, ISSUE 15): a served request
+        # slower end-to-end than this many ms dumps a qi-exemplar/1
+        # record through the crash-only dump path.  0: off.
+        self._slow_ms = qi_env_float("QI_PULSE_SLOW_MS", 0.0)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: Deque[_Entry] = deque()
         self._reserved = 0  # admission slots between depth check and enqueue
         self._inflight: Dict[str, _Entry] = {}  # fingerprint → live entry
         self._cache: "OrderedDict[str, Union[SolveResult, QueryResult]]" = OrderedDict()
-        self._latencies: Deque[float] = deque(maxlen=LATENCY_WINDOW)
         self._closed = False
         self._stopping = False
         self._started = False
@@ -702,6 +733,7 @@ class ServeEngine:
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
         query: Optional[object] = None,
+        trace: Optional[str] = None,
     ) -> Ticket:
         """Admit one snapshot-verdict request.
 
@@ -719,6 +751,13 @@ class ServeEngine:
         fingerprint is extended with the query kind + params, so the
         verdict cache, single-flight coalescing and journal replay never
         cross query types.
+
+        ``trace`` (qi-pulse, ISSUE 15) is the wire-carried trace context
+        ``trace_id:span_id[:pid]`` — the ``QI_TRACE_CONTEXT`` format the
+        fleet front door stamps on dispatch: admission and the eventual
+        solve adopt it, so this request's spans (admit, solve, ladder
+        rung, native call) parent under the remote request span and the
+        response/journal echo it.  ``None``: the engine's own trace.
         """
         rec = get_run_record()
         fault_point("serve.admit")
@@ -729,6 +768,31 @@ class ServeEngine:
             request_id, now,
             deadline_t=(now + budget) if budget and budget > 0 else None,
         )
+        ticket.trace = trace
+        ctx = TraceContext.from_env(trace) if trace else None
+        with rec.adopted(ctx), rec.span(
+            "serve.admit", request_id=request_id,
+        ) as admit_span:
+            outcome = self._admit(
+                source, ticket, request_id, budget, now, query, trace,
+            )
+            admit_span.set(outcome=outcome)
+        return ticket
+
+    def _admit(
+        self,
+        source: Union[str, bytes, List[Dict[str, object]], Fbas],
+        ticket: Ticket,
+        request_id: str,
+        budget: float,
+        now: float,
+        query: Optional[object],
+        trace: Optional[str],
+    ) -> str:
+        """The admission body (under :meth:`submit`'s adopted trace +
+        ``serve.admit`` span).  Returns the admission outcome for the
+        span; typed rejections raise through."""
+        rec = get_run_record()
         parsed_query = (
             query if isinstance(query, Query) else Query.parse(query)
         )
@@ -745,9 +809,11 @@ class ServeEngine:
 
         # Cache probe (its own fault point: an injected cache failure
         # bypasses the cache for this request and solves from scratch —
-        # never costs the verdict).
+        # never costs the verdict).  Timed into the pulse.cache_ms stage
+        # histogram (qi-pulse).
         cache_bypass = False
         hit: Optional[SolveResult] = None
+        cache_t0 = time.perf_counter()
         try:
             fault_point("serve.cache")
         except (FaultInjected, OSError) as exc:
@@ -772,6 +838,9 @@ class ServeEngine:
                     shed = (depth, self.queue_depth)
                 else:
                     self._reserved += 1
+        rec.histogram("pulse.cache_ms").observe(
+            (time.perf_counter() - cache_t0) * 1000.0
+        )
         if closed:
             rec.add("serve.errors")
             raise ServeClosed("serve engine is closed to new requests")
@@ -785,8 +854,8 @@ class ServeEngine:
             # moment it holds the ticket — and an fsync per hit would put
             # the durability tax on exactly the path the cache exists to
             # make cheap.
-            self._resolve_ok(ticket, hit, fp, cached=True)
-            return ticket
+            self._resolve_ok(ticket, hit, fp, cached=True, trace=trace)
+            return "cache_hit"
         if coalesced:
             rec.add("serve.coalesced")
             # A coalesced request is ACCEPTED: it must survive a hard kill
@@ -799,7 +868,7 @@ class ServeEngine:
             if self._journal is not None and self._journal.append_request(
                 request_id, fp, nodes,
                 budget if budget and budget > 0 else None,
-                query=parsed_query.to_wire(),
+                query=parsed_query.to_wire(), trace=trace,
             ):
                 journal = self._journal
 
@@ -815,7 +884,7 @@ class ServeEngine:
 
                 ticket.add_done_callback(_mark_done)
             _serve_sync("admit.coalesced")
-            return ticket
+            return "coalesced"
         rec.add("serve.cache_misses")
         if shed is not None:
             rec.add("serve.shed")
@@ -834,12 +903,13 @@ class ServeEngine:
             request_id=request_id, fingerprint=fp, fbas=fbas, nodes=nodes,
             query=parsed_query,
             waiters=[ticket], cache_bypass=cache_bypass, admitted_t=now,
+            trace=trace,
         )
         if self._journal is not None:
             entry.journaled = self._journal.append_request(
                 request_id, fp, nodes,
                 budget if budget and budget > 0 else None,
-                query=parsed_query.to_wire(),
+                query=parsed_query.to_wire(), trace=trace,
             )
         with self._cond:
             self._reserved -= 1
@@ -865,7 +935,7 @@ class ServeEngine:
         if depth < self.queue_depth:
             rec.gauge("serve.shed_state", 0)
         _serve_sync("admit.queued")
-        return ticket
+        return "queued"
 
     # ---- drain loop ------------------------------------------------------
 
@@ -985,6 +1055,15 @@ class ServeEngine:
         live = self._partition_expired(batch, time.monotonic())
         if not live:
             return
+        # Stage histogram (qi-pulse): admission→pop queue wait, per solve
+        # unit (a requeued entry's wait accumulates from its original
+        # admission — the client-visible number).
+        queue_h = rec.histogram("pulse.queue_wait_ms")
+        pop_t = time.monotonic()
+        for entry in live:
+            wait_ms = max((pop_t - entry.admitted_t) * 1000.0, 0.0)
+            queue_h.observe(wait_ms)
+            entry.stages["queue_wait_ms"] = round(wait_ms, 3)
         # Typed queries (qi-query, ISSUE 12) split out of the batched
         # intersection path: each kind resolves through its own engine
         # chain (whatif expands into its OWN lane-packed check_many batch;
@@ -1034,22 +1113,50 @@ class ServeEngine:
         cancel: Optional[CancelToken],
         counters0: Dict[str, float],
     ) -> None:
+        rec = get_run_record()
         backend = self._make_backend(cancel)
+        # Wire-trace adoption (qi-pulse): a single-entry batch solves
+        # entirely under the request's carried trace, so the ladder-rung /
+        # native-call spans the backends open on this thread graft under
+        # the front door's request span.  A fused multi-trace batch keeps
+        # the engine's own trace (batch-level attribution, like batched
+        # certs) — the per-request e2e histogram still covers every entry.
+        ctx = live[0].trace_ctx() if len(live) == 1 else None
+        t0 = time.perf_counter()
         try:
-            results = self._check_many([e.fbas for e in live], backend)
+            with rec.adopted(ctx), rec.span(
+                "serve.solve", requests=len(live),
+                delta=self._delta is not None,
+            ):
+                results = self._check_many([e.fbas for e in live], backend)
         except SearchCancelled:
             self._after_deadline_cancel(live, counters0)
             return
         except Exception as exc:  # noqa: BLE001 — degrade to per-request, never wedge the batch
-            get_run_record().add("serve.drain_errors")
+            rec.add("serve.drain_errors")
             log.info(
                 "batched drain failed (%s: %s); degrading to per-request "
                 "solves", type(exc).__name__, exc,
             )
             self._solve_per_request(live, cancel, counters0)
             return
+        self._note_solve(live, (time.perf_counter() - t0) * 1000.0)
         for entry, res in zip(live, results):
             self._deliver_ok(entry, res)
+
+    def _note_solve(self, live: List[_Entry], solve_ms: float) -> None:
+        """Book one solve call into the qi-pulse stage histograms and the
+        entries' exemplar breakdowns (a fused batch's wall is shared —
+        batch-level attribution, the cancelled-batch cert discipline)."""
+        rec = get_run_record()
+        rec.histogram("pulse.solve_ms").observe(solve_ms)
+        if self._delta is not None:
+            # The delta-aware chain answered this solve: the same wall,
+            # bucketed separately so a reuse regression (delta_ms growing
+            # toward solve-from-scratch) is visible in one scrape.
+            rec.histogram("pulse.delta_ms").observe(solve_ms)
+        for entry in live:
+            entry.stages["solve_ms"] = round(solve_ms, 3)
 
     def _solve_per_request(
         self,
@@ -1057,20 +1164,27 @@ class ServeEngine:
         cancel: Optional[CancelToken],
         counters0: Dict[str, float],
     ) -> None:
+        rec = get_run_record()
         for ix, entry in enumerate(live):
             if cancel is not None and cancel.cancelled:
                 self._after_deadline_cancel(live[ix:], counters0)
                 return
             backend = self._make_backend(cancel)
+            t0 = time.perf_counter()
             try:
-                results = self._check_many([entry.fbas], backend)
+                with rec.adopted(entry.trace_ctx()), rec.span(
+                    "serve.solve", requests=1,
+                    delta=self._delta is not None,
+                ):
+                    results = self._check_many([entry.fbas], backend)
             except SearchCancelled:
                 self._after_deadline_cancel(live[ix:], counters0)
                 return
             except Exception as exc:  # noqa: BLE001 — one bad request must not starve the rest
-                get_run_record().add("serve.drain_errors")
+                rec.add("serve.drain_errors")
                 self._resolve_err(entry, exc, outcome="error")
                 continue
+            self._note_solve([entry], (time.perf_counter() - t0) * 1000.0)
             self._deliver_ok(entry, results[0])
 
     def _solve_queries(
@@ -1096,11 +1210,15 @@ class ServeEngine:
                     _backend: SearchBackend = backend) -> List[SolveResult]:
                 return self._check_many(sources, _backend)
 
+            t0 = time.perf_counter()
             try:
-                qres = self._query_engine.resolve(
-                    entry.nodes, entry.query, check_many_fn=run,
-                    cancel=cancel,
-                )
+                with rec.adopted(entry.trace_ctx()), rec.span(
+                    "serve.solve", requests=1, query=entry.query.kind,
+                ):
+                    qres = self._query_engine.resolve(
+                        entry.nodes, entry.query, check_many_fn=run,
+                        cancel=cancel,
+                    )
             except SearchCancelled:
                 self._after_deadline_cancel(entries[ix:], counters0)
                 return
@@ -1111,6 +1229,7 @@ class ServeEngine:
                 rec.add("serve.drain_errors")
                 self._resolve_err(entry, exc, outcome="error")
                 continue
+            self._note_solve([entry], (time.perf_counter() - t0) * 1000.0)
             self._deliver_ok(entry, qres)
 
     def _after_deadline_cancel(
@@ -1225,13 +1344,51 @@ class ServeEngine:
         # late coalescer silently outlives its budget.  (The verdict is
         # cached above, so the typed error costs one retry, not a solve.)
         now = time.monotonic()
+        respond_t0 = time.perf_counter()
+        delivered: List[Ticket] = []
         for ticket in waiters:
             if ticket.deadline_t is not None and now >= ticket.deadline_t:
                 self._resolve_deadline(entry, ticket, partial=None)
             else:
                 self._resolve_ok(ticket, res, entry.fingerprint,
-                                 cached=False, replayed=entry.replayed)
+                                 cached=False, replayed=entry.replayed,
+                                 trace=ticket.trace)
+                delivered.append(ticket)
+        rec.histogram("pulse.respond_ms").observe(
+            (time.perf_counter() - respond_t0) * 1000.0
+        )
+        self._maybe_exemplar(entry, delivered)
         _serve_sync("drain.delivered")
+
+    def _maybe_exemplar(self, entry: _Entry,
+                        delivered: List[Ticket]) -> None:
+        """Slow-request exemplar (qi-pulse), ONE per solve entry however
+        many waiters coalesced onto it (a per-waiter dump would fsync the
+        same file K times inside the delivery loop).  Fired after every
+        waiter already holds its verdict, so neither the dump nor an
+        injected dump failure can touch an outcome."""
+        if self._slow_ms <= 0 or not delivered:
+            return
+        now = time.monotonic()
+        slowest = max(delivered, key=lambda t: now - t.submitted_t)
+        e2e_ms = (now - slowest.submitted_t) * 1000.0
+        if e2e_ms <= self._slow_ms:
+            return
+        ctx = entry.trace_ctx()
+        rec = get_run_record()
+        breakdown = dict(entry.stages)
+        breakdown["e2e_ms"] = round(e2e_ms, 3)
+        dump_exemplar({
+            "reason": "slow-request",
+            "request_id": slowest.request_id,
+            "fingerprint": entry.fingerprint,
+            "trace_id": ctx.trace_id if ctx is not None else rec.trace_id,
+            "trace": entry.trace,
+            "e2e_ms": round(e2e_ms, 3),
+            "slow_ms": self._slow_ms,
+            "waiters": len(delivered),
+            "stages": breakdown,
+        })
 
     def _resolve_ok(
         self,
@@ -1241,6 +1398,7 @@ class ServeEngine:
         *,
         cached: bool,
         replayed: bool = False,
+        trace: Optional[str] = None,
     ) -> None:
         rec = get_run_record()
         seconds = time.monotonic() - ticket.submitted_t
@@ -1270,6 +1428,9 @@ class ServeEngine:
             # Typed-query payload (qi-query): None on the legacy boolean
             # path, the structured result table/witness/report otherwise.
             result=getattr(res, "result", None),
+            # Wire trace echo (qi-pulse): the request's carried context
+            # rides the response line so the caller can join the trace.
+            trace=trace,
         )
         outcome_err: Optional[BaseException] = None
         try:
@@ -1339,15 +1500,16 @@ class ServeEngine:
             ticket._resolve(("err", exc))
 
     def _note_latency(self, seconds: float) -> None:
-        # Snapshot under the lock, sort outside it: the O(W log W) sort
-        # must not serialize against admission on the hot delivery path.
-        with self._lock:
-            self._latencies.append(seconds * 1000.0)
-            samples = list(self._latencies)
-        samples.sort()
+        # End-to-end stage histogram (qi-pulse): the buckets are what the
+        # fleet aggregation plane merges; the histogram's bounded raw
+        # window keeps the serve.p50_ms/p99_ms gauges byte-compatible
+        # (same nearest-rank estimator over the same 512-sample window
+        # the pre-pulse deque carried, sorted outside any engine lock).
         rec = get_run_record()
-        rec.gauge("serve.p50_ms", round(_percentile(samples, 50.0), 3))
-        rec.gauge("serve.p99_ms", round(_percentile(samples, 99.0), 3))
+        h = rec.histogram("pulse.e2e_ms")
+        h.observe(seconds * 1000.0)
+        rec.gauge("serve.p50_ms", round(h.window_percentile(50.0), 3))
+        rec.gauge("serve.p99_ms", round(h.window_percentile(99.0), 3))
 
     # ---- journal replay --------------------------------------------------
 
@@ -1412,9 +1574,14 @@ class ServeEngine:
                     e.get("request_id"), e.get("fingerprint"), fp,
                 )
                 continue
+            raw_trace = e.get("trace")
             pending.append({
                 "entry": e, "fbas": fbas, "nodes": nodes,
                 "fingerprint": fp, "query": query,
+                # qi-pulse: the journaled wire trace — replay re-adopts
+                # it so the recovered solve joins the ORIGINAL request's
+                # distributed trace instead of minting a disconnected one.
+                "trace": raw_trace if isinstance(raw_trace, str) else None,
             })
         if foreign:
             self._journal.quarantine(foreign, "foreign fingerprint / payload")
@@ -1460,11 +1627,16 @@ class ServeEngine:
                         ) -> List[SolveResult]:
                     return self._check_many(sources, _backend)
 
+                replay_ctx = (
+                    TraceContext.from_env(p["trace"])  # type: ignore[arg-type]
+                    if p["trace"] else None
+                )
                 try:
-                    res = self._query_engine.resolve(
-                        p["nodes"], p["query"],  # type: ignore[arg-type]
-                        check_many_fn=run,
-                    )
+                    with rec.adopted(replay_ctx):
+                        res = self._query_engine.resolve(
+                            p["nodes"], p["query"],  # type: ignore[arg-type]
+                            check_many_fn=run,
+                        )
                 except Exception as exc:  # noqa: BLE001 — replay must not block startup
                     report["errors"][rid] = (  # type: ignore[index]
                         f"{type(exc).__name__}: {exc}"
@@ -1486,11 +1658,19 @@ class ServeEngine:
                 )
             for i in range(0, len(pending), self.batch_max):
                 chunk = pending[i:i + self.batch_max]
+                # Trace re-adoption follows the drain's batching rule: a
+                # single-entry chunk re-solves entirely under its journaled
+                # trace; a fused chunk keeps batch-level attribution.
+                replay_ctx = (
+                    TraceContext.from_env(chunk[0]["trace"])  # type: ignore[arg-type]
+                    if len(chunk) == 1 and chunk[0]["trace"] else None
+                )
                 try:
-                    results = self._check_many(
-                        [p["fbas"] for p in chunk],
-                        self._make_backend(None),
-                    )
+                    with rec.adopted(replay_ctx):
+                        results = self._check_many(
+                            [p["fbas"] for p in chunk],
+                            self._make_backend(None),
+                        )
                 except Exception as exc:  # noqa: BLE001 — replay must not block startup
                     for p in chunk:
                         rid = str(p["entry"].get("request_id"))
@@ -1528,17 +1708,6 @@ class ServeEngine:
             report["already_done"], report["quarantined"],
         )
         return report
-
-
-def _percentile(sorted_samples: List[float], pct: float) -> float:
-    """Nearest-rank percentile of an ascending sample list (0 if empty):
-    ``ceil(pct/100 * N)`` — a true ceiling, because ``round(x + 0.5)``
-    banker's-rounds exact-integer ranks one slot too high (p99 of exactly
-    100 samples would report the maximum)."""
-    if not sorted_samples:
-        return 0.0
-    rank = max(math.ceil(pct / 100.0 * len(sorted_samples)) - 1, 0)
-    return sorted_samples[min(rank, len(sorted_samples) - 1)]
 
 
 def _qset_raw(q: Optional[QSet]) -> Optional[Dict[str, object]]:

@@ -81,11 +81,28 @@ PONG_GAUGES = (
     "delta.store_size",
 )
 
+# The pulse histograms one pong ships (qi-pulse, ISSUE 15): the worker's
+# own per-stage latency distributions, merged bucket-wise by the fleet
+# front door's aggregation plane.  Deliberately the serve-side stage set
+# only — a front door's fleet.* merged views must never ride a pong back
+# into another aggregation (no fleet-of-fleets double counting).
+PONG_PULSE = (
+    "pulse.queue_wait_ms",
+    "pulse.cache_ms",
+    "pulse.delta_ms",
+    "pulse.solve_ms",
+    "pulse.respond_ms",
+    "pulse.e2e_ms",
+)
+
 
 def pong_payload(token: object) -> Dict[str, object]:
-    """The ``{"ping": token}`` reply: readiness + a health snapshot."""
+    """The ``{"ping": token}`` reply: readiness + a health snapshot +
+    the worker's pulse histogram snapshots (the aggregation plane's
+    transport — piggybacked here instead of N scrape ports)."""
     rec = get_run_record()
     counters, gauges = rec.snapshot()
+    hists = rec.histograms_snapshot()
     replay = gauges.get("serve.replay_complete")
     return {
         "pong": token,
@@ -94,6 +111,7 @@ def pong_payload(token: object) -> Dict[str, object]:
         "ready": bool(replay) if replay is not None else True,
         "counters": {k: counters.get(k, 0) for k in PONG_COUNTERS},
         "gauges": {k: gauges.get(k, 0) for k in PONG_GAUGES},
+        "pulse": {k: hists[k] for k in PONG_PULSE if k in hists},
     }
 
 
@@ -121,6 +139,11 @@ def ticket_response(
         "cached": resp.cached,
         "seconds": round(resp.seconds, 6),
     }
+    if resp.trace is not None:
+        # Wire trace echo (qi-pulse): the request's carried context rides
+        # the response so the fleet front door (and any client) can join
+        # the verdict to its distributed trace.
+        line["trace"] = resp.trace
     if resp.result is not None:
         # Typed-query payload (qi-query/1): verdict stays the boolean
         # summary, the structured table/witness/report rides alongside.
@@ -179,6 +202,7 @@ class JsonlSession:
             nodes = obj
             deadline_s: Optional[float] = None
             query: Optional[object] = None
+            trace: Optional[str] = None
             if isinstance(obj, dict):
                 request_id = obj.get("request_id")
                 nodes = obj.get("nodes")
@@ -188,12 +212,17 @@ class JsonlSession:
                 # qi-query/1 (ISSUE 12): absent ⇒ intersection, the
                 # byte-compatible legacy request.
                 query = obj.get("query")
+                # qi-pulse (ISSUE 15): optional wire trace context
+                # "trace_id:span_id[:pid]" — absent ⇒ the engine's own
+                # trace, the byte-compatible legacy request.
+                raw_trace = obj.get("trace")
+                trace = raw_trace if isinstance(raw_trace, str) else None
             if not isinstance(nodes, list):
                 raise ValueError("expected a node array or "
                                  '{"request_id", "nodes"}')
             ticket = self._engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
-                query=query,
+                query=query, trace=trace,
             )
         except ServeError as exc:
             self.emit({"request_id": request_id or f"line-{n + 1}",

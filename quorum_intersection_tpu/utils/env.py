@@ -279,6 +279,33 @@ QI_QUERY_WHATIF_LIMIT = _declare(
     "this bound with a loud result field (truncated: true) — a typed "
     "cap, never an unbounded batch from one request.",
 )
+QI_PULSE_SLOW_MS = _declare(
+    "QI_PULSE_SLOW_MS", "0",
+    "Slow-request exemplar threshold in milliseconds (serve.py, qi-pulse): "
+    "a served request whose end-to-end latency exceeds it fires the "
+    "pulse.exemplar event + pulse.exemplars counter and dumps a "
+    "qi-exemplar/1 record (stage breakdown + flight-recorder tail + trace "
+    "identity) to <QI_FLIGHT_RECORDER>.exemplar via the crash-only dump "
+    "path (utils/telemetry.py dump_exemplar).  0 (default): exemplars off.",
+)
+QI_PULSE_AGG = _declare(
+    "QI_PULSE_AGG", "1",
+    "Fleet metrics aggregation plane (fleet.py, qi-pulse): while truthy "
+    "and not '0', each health-probe cycle merges the workers' pong-carried "
+    "pulse.* histogram snapshots bucket-wise into the front door's "
+    "fleet.pulse.* histograms (served on /metrics) and the fleet-wide "
+    "fleet.e2e_p99_ms gauge.  '0': per-worker metrics only, the pre-pulse "
+    "behavior.",
+)
+QI_PULSE_BUCKETS = _declare(
+    "QI_PULSE_BUCKETS", "",
+    "Histogram bucket override (utils/telemetry.py hist_bounds): a "
+    "comma-separated ASCENDING list of bucket upper edges in milliseconds "
+    "replacing the default log-spaced ladder for every histogram the "
+    "process creates.  Must be identical across a fleet — bucket-wise "
+    "merging refuses mismatched ladders.  Empty (default): the built-in "
+    "ladder; malformed values log and fall back.",
+)
 QI_SERVE_JOURNAL = _declare(
     "QI_SERVE_JOURNAL", "",
     "Path of the serving layer's crash-only request journal (serve.py): "

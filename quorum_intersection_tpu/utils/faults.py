@@ -284,6 +284,15 @@ QUERY_DISPATCH = _declare(
     "route through this point, so injected query faults cannot touch "
     "the byte-compatible legacy protocol.",
 )
+PULSE_AGGREGATE = _declare(
+    "pulse.aggregate",
+    "Fleet metrics aggregation cycle (fleet.py _aggregate_health, fired "
+    "once per probe cycle before the workers' pong-carried pulse "
+    "histograms merge): error simulates a broken aggregation plane — the "
+    "cycle degrades to per-worker-only metrics (pulse.agg_errors counter "
+    "+ pulse.agg_degraded event, loud; the fleet-wide /metrics view goes "
+    "stale, per-worker scrapes and every verdict are untouched).",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "

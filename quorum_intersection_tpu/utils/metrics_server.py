@@ -90,6 +90,11 @@ def healthz_payload() -> dict:
         "fleet_workers_live": gauges.get("fleet.workers_live", 0),
         "fleet_ring_size": gauges.get("fleet.ring_size", 0),
         "fleet_store_hit_pct": gauges.get("fleet.store_hit_pct", 0.0),
+        # qi-pulse (ISSUE 15): the aggregation plane's fleet-wide tail
+        # latency — p99 over the UNION of the workers' merged pulse.e2e_ms
+        # histograms, not the max of per-worker gauges.  0.0 until the
+        # first aggregation cycle lands (or with QI_PULSE_AGG=0).
+        "fleet_e2e_p99_ms": gauges.get("fleet.e2e_p99_ms", 0.0),
     }
 
 

@@ -61,13 +61,38 @@ identity and crash forensics**:
   degrade/quarantine, or unhandled exception (``QI_FLIGHT_RECORDER``);
 - ``QI_METRICS_PORT`` starts the live ``/healthz`` + ``/metrics`` endpoint
   (:mod:`quorum_intersection_tpu.utils.metrics_server`).
+
+Since ISSUE 15 (**qi-pulse**) the record is also the home of fleet-wide
+*request* observability:
+
+- :class:`Histogram` — a first-class **mergeable** latency histogram
+  (fixed log-spaced buckets, lock-protected, exact count/sum): the serving
+  tier's per-stage latency distributions (``pulse.queue_wait_ms`` …
+  ``pulse.e2e_ms``) are histograms, not windowed percentiles, so the fleet
+  front door can add workers' buckets together and compute p99 over the
+  UNION of samples instead of the max of per-worker gauges.  Rendered in
+  the JSONL stream as ``{"kind": "histogram", ...}`` lines and on
+  ``/metrics`` / the textfile in Prometheus histogram format by the shared
+  :func:`prom_lines` encoder.
+- :meth:`RunRecord.adopted` — per-REQUEST trace adoption: a serve worker
+  handed a wire ``"trace"`` field (``trace_id:span_id[:pid]``, the
+  ``QI_TRACE_CONTEXT`` format) scopes its spans/events for that request
+  under the front door's request span, so one fleet request is ONE trace
+  across processes (the span lines carry ``remote_parent_span`` /
+  ``remote_parent_pid`` and ``tools/metrics_report.py`` grafts on them).
+- :func:`dump_exemplar` — slow-request exemplars: a request whose
+  end-to-end latency exceeds ``QI_PULSE_SLOW_MS`` dumps a ``qi-exemplar/1``
+  record (stage breakdown + flight-recorder tail + trace identity) through
+  the same crash-only write path as the flight recorder.
 """
 
 from __future__ import annotations
 
 import atexit
+import bisect
 import io
 import json
+import math
 import os
 import sys
 import threading
@@ -76,7 +101,7 @@ import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Deque, Dict, Iterator, List, Optional, Protocol, Tuple
+from typing import Deque, Dict, Iterator, List, Optional, Protocol, Sequence, Tuple
 
 from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.logging import get_logger
@@ -85,6 +110,197 @@ log = get_logger("utils.telemetry")
 
 SCHEMA = "qi-telemetry/1"
 FLIGHT_SCHEMA = "qi-flight/1"
+PULSE_SCHEMA = "qi-pulse/1"
+EXEMPLAR_SCHEMA = "qi-exemplar/1"
+
+# Latency window behind the serve/fleet p50/p99 *gauges*: big enough to
+# smooth scheduler noise, small enough that the gauges track the CURRENT
+# load shape (a 10-minute-old latency spike must age out of a live
+# /metrics scrape).  One home since ISSUE 15 — serve.py and fleet.py used
+# to carry private copies.
+LATENCY_WINDOW = 512
+
+# Default Histogram bucket bounds (upper edges, milliseconds): log-spaced
+# from sub-ms cache hits to the minute-class NP-hard blowups deadlines
+# exist for.  Fixed and shared fleet-wide — bucket-wise addition is only
+# sound when every worker buckets identically (merge_wire enforces it).
+DEFAULT_HIST_BOUNDS_MS: Tuple[float, ...] = (
+    0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+    512.0, 1024.0, 2048.0, 4096.0, 8192.0, 16384.0, 32768.0, 65536.0,
+)
+
+
+def hist_bounds() -> Tuple[float, ...]:
+    """The process's histogram bucket ladder: ``QI_PULSE_BUCKETS`` (a
+    comma-separated ascending list of upper edges in ms) overrides the
+    default; a malformed override logs and falls back — a typo'd knob must
+    cost resolution, never a request."""
+    raw = qi_env("QI_PULSE_BUCKETS")
+    if not raw:
+        return DEFAULT_HIST_BOUNDS_MS
+    try:
+        bounds = tuple(float(part) for part in raw.split(",") if part.strip())
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            # STRICTLY ascending: a duplicate edge would render duplicate
+            # le labels and Prometheus rejects the whole scrape.
+            raise ValueError("bounds must be non-empty, strictly ascending")
+        return bounds
+    except ValueError as exc:
+        log.warning("malformed QI_PULSE_BUCKETS (%s); using defaults", exc)
+        return DEFAULT_HIST_BOUNDS_MS
+
+
+def percentile(sorted_samples: List[float], pct: float) -> float:
+    """Nearest-rank percentile of an ascending sample list (0 if empty):
+    ``ceil(pct/100 * N)`` — a true ceiling, because ``round(x + 0.5)``
+    banker's-rounds exact-integer ranks one slot too high (p99 of exactly
+    100 samples would report the maximum).  Moved here from serve.py
+    (ISSUE 15 dedupe); ``serve._percentile`` re-exports it."""
+    if not sorted_samples:
+        return 0.0
+    rank = max(math.ceil(pct / 100.0 * len(sorted_samples)) - 1, 0)
+    return sorted_samples[min(rank, len(sorted_samples) - 1)]
+
+
+class Histogram:
+    """Mergeable fixed-bucket latency histogram (``qi-pulse/1``).
+
+    Buckets are **non-cumulative** per-bucket counts over the fixed upper
+    edges in ``bounds`` plus one overflow bucket; ``count``/``sum`` are
+    exact.  Lock-protected: the drain thread, the transport threads and
+    the probe loop all observe concurrently.  Merging is bucket-wise
+    addition over *snapshots* (:meth:`snapshot` / :meth:`merge_wire`) —
+    never over live instances, so no code path ever holds two histogram
+    locks at once.
+
+    A bounded raw-sample window (``LATENCY_WINDOW``) rides along for the
+    byte-compatible ``serve.p50_ms``-family gauges: the window percentile
+    is exactly the estimator those gauges always used, while the buckets
+    are what crosses the wire and merges fleet-wide.
+    """
+
+    def __init__(self, name: str,
+                 bounds: Optional[Sequence[float]] = None,
+                 window: int = LATENCY_WINDOW) -> None:
+        self.name = name
+        self.bounds: Tuple[float, ...] = (
+            tuple(bounds) if bounds is not None else hist_bounds()
+        )
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._window: Optional[Deque[float]] = (
+            deque(maxlen=window) if window > 0 else None
+        )
+
+    def observe(self, value_ms: float) -> None:
+        """Record one sample (milliseconds)."""
+        ix = bisect.bisect_left(self.bounds, value_ms)
+        with self._lock:
+            self._counts[ix] += 1
+            self._count += 1
+            self._sum += value_ms
+            if self._window is not None:
+                self._window.append(value_ms)
+
+    def snapshot(self) -> Dict[str, object]:
+        """The wire form: ``{schema, bounds, counts, count, sum}`` —
+        what pongs carry and what :meth:`merge_wire` adds together."""
+        with self._lock:
+            return {
+                "schema": PULSE_SCHEMA,
+                "bounds": list(self.bounds),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": round(self._sum, 6),
+            }
+
+    def set_from_wire(self, wire: Dict[str, object]) -> None:
+        """Overwrite this histogram with a merged wire snapshot — the
+        fleet front door publishes each aggregation cycle's merge this
+        way.  The raw-sample window does not cross the wire and is
+        cleared (merged views answer quantiles from buckets)."""
+        bounds = tuple(float(b) for b in wire.get("bounds") or ())
+        counts = [int(c) for c in wire.get("counts") or ()]
+        if bounds != self.bounds or len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name!r}: wire bounds do not match "
+                f"(merging differently-bucketed histograms is unsound)"
+            )
+        with self._lock:
+            self._counts = counts
+            self._count = int(wire.get("count") or 0)
+            self._sum = float(wire.get("sum") or 0.0)
+            if self._window is not None:
+                self._window.clear()
+
+    def window_percentile(self, pct: float) -> float:
+        """Exact nearest-rank percentile over the bounded raw-sample
+        window — the estimator behind the byte-compatible p50/p99 gauges
+        (sort outside the lock, the serve delivery-path discipline)."""
+        with self._lock:
+            samples = list(self._window) if self._window is not None else []
+        samples.sort()
+        return percentile(samples, pct)
+
+    def quantile_ms(self, pct: float) -> float:
+        """Bucket-resolution quantile estimate: the upper edge of the
+        bucket holding the nearest-rank sample (the overflow bucket
+        answers the largest finite edge).  This is what a MERGED view can
+        honestly answer — raw samples never cross the wire."""
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if total <= 0:
+            return 0.0
+        rank = max(math.ceil(pct / 100.0 * total), 1)
+        seen = 0
+        for ix, n in enumerate(counts):
+            seen += n
+            if seen >= rank:
+                return self.bounds[min(ix, len(self.bounds) - 1)]
+        return self.bounds[-1]
+
+    @staticmethod
+    def merge_wire(parts: Sequence[Dict[str, object]]) -> Dict[str, object]:
+        """Bucket-wise addition of wire snapshots — mergeable by
+        construction: ``merge([h(A), h(B)]) == h(A + B)`` exactly, the
+        property tests/test_qi_pulse.py pins.  Raises ``ValueError`` on
+        mismatched bucket ladders (adding them would be silently wrong)."""
+        if not parts:
+            return {
+                "schema": PULSE_SCHEMA, "bounds": [], "counts": [],
+                "count": 0, "sum": 0.0,
+            }
+        bounds = list(parts[0].get("bounds") or ())
+        counts = [0] * len(list(parts[0].get("counts") or ()))
+        count = 0
+        total = 0.0
+        for part in parts:
+            if list(part.get("bounds") or ()) != bounds:
+                raise ValueError(
+                    "histogram merge: bucket bounds differ across parts"
+                )
+            part_counts = list(part.get("counts") or ())
+            if len(part_counts) != len(counts):
+                raise ValueError(
+                    "histogram merge: bucket count vectors differ in length"
+                )
+            for ix, n in enumerate(part_counts):
+                counts[ix] += int(n)
+            count += int(part.get("count") or 0)
+            total += float(part.get("sum") or 0.0)
+        return {
+            "schema": PULSE_SCHEMA, "bounds": bounds, "counts": counts,
+            "count": count, "sum": round(total, 6),
+        }
+
+    def to_line(self) -> Dict[str, object]:
+        """The JSONL stream line (``kind: histogram``)."""
+        snap = self.snapshot()
+        snap.pop("schema", None)
+        return {"kind": "histogram", "name": self.name, **snap}
 
 # In-memory retention caps: a 2^44 sweep drains millions of windows; the
 # JSONL sink streams them all, but the in-process lists (used by tests and
@@ -172,13 +388,20 @@ class Span:
     trace_id: str = ""
     tid: int = 0
     pid: int = 0
+    # Wire-carried remote parent (ISSUE 15, qi-pulse): a thread-root span
+    # opened under RunRecord.adopted() parents under ANOTHER process's
+    # span — the fleet front door's request span — via these fields;
+    # tools/metrics_report.py grafts cross-process trees on them.  Absent
+    # (None) on every pre-pulse span, so old streams render unchanged.
+    remote_parent_span: Optional[int] = None
+    remote_parent_pid: Optional[int] = None
 
     def set(self, **attrs: object) -> "Span":
         self.attrs.update(attrs)
         return self
 
     def to_line(self) -> dict:
-        return {
+        line = {
             "kind": "span",
             "name": self.name,
             "span_id": self.span_id,
@@ -190,6 +413,10 @@ class Span:
             "tid": self.tid,
             "attrs": _jsonable(self.attrs),
         }
+        if self.remote_parent_span is not None:
+            line["remote_parent_span"] = self.remote_parent_span
+            line["remote_parent_pid"] = self.remote_parent_pid
+        return line
 
 
 class JsonlSink:
@@ -254,6 +481,19 @@ def prom_lines(record: "RunRecord") -> List[str]:
         lines.append(f"{m} {round(total, 6)}")
         lines.append(f"# TYPE {m}_count counter")
         lines.append(f"{m}_count {count}")
+    # qi-pulse histograms (ISSUE 15): Prometheus histogram convention —
+    # cumulative le buckets, _sum, _count — from the non-cumulative wire
+    # snapshots, deterministically sorted like everything above.
+    for name, snap in sorted(record.histograms_snapshot().items()):
+        m = _prom_metric(name)
+        lines.append(f"# TYPE {m} histogram")
+        cumulative = 0
+        for bound, n in zip(snap["bounds"], snap["counts"]):
+            cumulative += int(n)
+            lines.append(f'{m}_bucket{{le="{bound:g}"}} {cumulative}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {snap["count"]}')
+        lines.append(f"{m}_sum {snap['sum']}")
+        lines.append(f"{m}_count {snap['count']}")
     return lines
 
 
@@ -413,6 +653,11 @@ class RunRecord:
         self.events: List[dict] = []
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, object] = {}
+        # qi-pulse (ISSUE 15): named mergeable histograms.  The dict is
+        # guarded by self._lock (get-or-create only); each Histogram
+        # guards its own buckets with its own lock, and no path holds
+        # both at once (snapshots are taken outside the record lock).
+        self._histograms: Dict[str, Histogram] = {}
         self.dropped = 0
         self.events_dropped = 0
         self._next_id = 0
@@ -442,6 +687,54 @@ class RunRecord:
         touch the record's lock)."""
         with self._lock:
             return dict(self.counters), dict(self.gauges)
+
+    def histogram(self, name: str,
+                  bounds: Optional[Sequence[float]] = None) -> Histogram:
+        """Get-or-create the named mergeable histogram (qi-pulse).  The
+        registry lookup holds the record lock; the returned instance is
+        observed under its OWN lock only."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, bounds)
+        return h
+
+    def histograms_snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Wire snapshots of every histogram, name-keyed.  The registry
+        copy is taken under the record lock; each per-histogram snapshot
+        is taken afterwards under that histogram's own lock — the two
+        locks are never nested."""
+        with self._lock:
+            hists = dict(self._histograms)
+        return {name: h.snapshot() for name, h in hists.items()}
+
+    @contextmanager
+    def adopted(self, ctx: Optional["TraceContext"]) -> Iterator[None]:
+        """Per-request wire-trace adoption (ISSUE 15, qi-pulse): while
+        active on this thread, spans and events carry ``ctx.trace_id``
+        instead of the process's own, and a thread-ROOT span records
+        ``ctx.span_id``/``ctx.pid`` as its remote parent — so a serve
+        worker's admit/solve/ladder/native spans for one request all hang
+        under the fleet front door's request span, across the process
+        boundary.  ``None`` (or a blank trace) is a no-op, keeping every
+        pre-pulse call path byte-identical."""
+        if ctx is None or not ctx.trace_id:
+            yield
+            return
+        prev = getattr(self._local, "adopt", None)
+        prev_first = getattr(self._local, "adopt_first", False)
+        self._local.adopt = ctx
+        # The FIRST span of an adoption scope stamps the remote parent
+        # even when it has a local parent (an in-process fleet worker's
+        # admit span sits under fleet.request locally; a journal replay's
+        # solve sits under serve.replay): the wire link is what joins the
+        # recovered/in-process work to the original request's span.
+        self._local.adopt_first = True
+        try:
+            yield
+        finally:
+            self._local.adopt = prev
+            self._local.adopt_first = prev_first
 
     def flight_tail(self) -> List[dict]:
         """Copy of the flight-recorder ring, oldest first."""
@@ -536,17 +829,30 @@ class RunRecord:
         with self._lock:
             self._next_id += 1
             sid = self._next_id
+        adopt: Optional[TraceContext] = getattr(self._local, "adopt", None)
+        local_parent = parent_id if parent_id is not None else (
+            stack[-1] if stack else None
+        )
+        # Wire-adopted thread roots — and the FIRST span of an adoption
+        # scope even with a local parent — graft under the remote request
+        # span (qi-pulse); later nested spans keep their local parent and
+        # inherit the graft transitively.
+        graft = adopt is not None and (
+            local_parent is None or getattr(self._local, "adopt_first", False)
+        )
+        if adopt is not None:
+            self._local.adopt_first = False
         sp = Span(
             name=name,
             span_id=sid,
-            parent_id=parent_id if parent_id is not None else (
-                stack[-1] if stack else None
-            ),
+            parent_id=local_parent,
             start_s=time.monotonic() - self.t0,
             attrs=dict(attrs),
-            trace_id=self.trace_id,
+            trace_id=adopt.trace_id if adopt is not None else self.trace_id,
             tid=threading.get_native_id(),
             pid=self.pid,
+            remote_parent_span=adopt.span_id if graft else None,
+            remote_parent_pid=adopt.pid if graft else None,
         )
         stack.append(sid)
         try:
@@ -564,12 +870,13 @@ class RunRecord:
     # ---- events / counters / gauges -------------------------------------
 
     def event(self, name: str, **attrs: object) -> None:
+        adopt: Optional[TraceContext] = getattr(self._local, "adopt", None)
         ev = {
             "kind": "event",
             "name": name,
             "t_s": round(time.monotonic() - self.t0, 6),
             "span_id": self.current_span_id,
-            "trace_id": self.trace_id,
+            "trace_id": adopt.trace_id if adopt is not None else self.trace_id,
             "pid": self.pid,
             "tid": threading.get_native_id(),
             "attrs": _jsonable(attrs),
@@ -614,10 +921,11 @@ class RunRecord:
         )
 
     def final_lines(self) -> List[dict]:
-        """Counter/gauge lines emitted once at finish."""
+        """Counter/gauge/histogram lines emitted once at finish."""
         with self._lock:
             counters = dict(self.counters)
             gauges = dict(self.gauges)
+            hists = dict(self._histograms)
             dropped = self.dropped
         lines = [
             {"kind": "counter", "name": name, "value": value}
@@ -627,6 +935,10 @@ class RunRecord:
             {"kind": "gauge", "name": name, "value": _jsonable(value)}
             for name, value in sorted(gauges.items())
         ]
+        for name in sorted(hists):
+            hist_line = hists[name].to_line()
+            if hist_line["count"]:  # untouched histograms stay silent
+                lines.append(hist_line)
         if dropped:
             lines.append({"kind": "counter", "name": "telemetry.dropped",
                           "value": dropped})
@@ -755,6 +1067,88 @@ def finish() -> None:
 _dump_state = threading.local()
 
 
+def _write_crash_only(target: str, payload: dict, rec: "RunRecord") -> bool:
+    """One crash-only dump write (tmp + flush + fsync + rename +
+    best-effort dir fsync), behind the ``telemetry.dump`` fault point.
+    Shared by the flight recorder and the qi-pulse slow-request exemplars
+    — any failure downgrades to the ``telemetry.dump_errors`` counter and
+    returns False: a forensic dump must never be the crash."""
+    try:
+        from quorum_intersection_tpu.utils.faults import fault_point
+
+        # Injectable boundary: the dump write itself can hit a full disk
+        # mid-crash; it downgrades to a counter, never a second crash.
+        fault_point("telemetry.dump")
+        tmp = f"{target}.tmp{rec.pid}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(payload, default=str))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, target)
+        try:
+            dir_fd = os.open(
+                os.path.dirname(os.path.abspath(target)), os.O_RDONLY
+            )
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        except OSError:
+            pass  # directory fsync is best-effort (utils/checkpoint.py)
+    except Exception as exc:  # noqa: BLE001 — a crash dump must never be the crash
+        rec.add("telemetry.dump_errors")
+        log.warning("crash-only dump failed (%s); run continues", exc)
+        return False
+    return True
+
+
+def dump_exemplar(payload: dict, path: Optional[str] = None) -> Optional[str]:
+    """Dump one slow-request exemplar (``qi-exemplar/1``, ISSUE 15).
+
+    Fired by the serving layer when a request's end-to-end latency
+    exceeds ``QI_PULSE_SLOW_MS``: the caller's stage breakdown + trace
+    identity, augmented here with the flight-recorder tail — the same
+    forensic ring a crash dump carries, so a slow request's last-N
+    spans/events are inspectable without reproducing the slowness.
+
+    Writes to ``path``, or ``<QI_FLIGHT_RECORDER>.exemplar`` when the
+    flight recorder has a destination (the exemplar rides the crash-dump
+    path and its knob); with neither, the ``pulse.exemplar`` event and
+    ``pulse.exemplars`` counter still fire and no file is written.
+    Crash-only discipline and reentrancy guard shared with
+    :func:`dump_flight_recorder`.  Returns the path written, or None.
+    """
+    rec = get_run_record()
+    rec.add("pulse.exemplars")
+    rec.event(
+        "pulse.exemplar",
+        request_id=payload.get("request_id"),
+        e2e_ms=payload.get("e2e_ms"),
+        trace_id=payload.get("trace_id"),
+    )
+    flight = qi_env("QI_FLIGHT_RECORDER")
+    target = path or (f"{flight}.exemplar" if flight else "")
+    if not target:
+        return None
+    if getattr(_dump_state, "active", False):
+        return None  # one dump per trigger chain is enough
+    _dump_state.active = True
+    try:
+        full = {
+            "schema": EXEMPLAR_SCHEMA,
+            "pid": rec.pid,
+            "t_wall": round(time.time(), 3),
+            **payload,
+            "tail": rec.flight_tail(),
+        }
+        if not _write_crash_only(target, full, rec):
+            return None
+        rec.add("telemetry.dumps")
+        return str(target)
+    finally:
+        _dump_state.active = False
+
+
 def dump_flight_recorder(reason: str, path: Optional[str] = None) -> Optional[str]:
     """Dump the flight-recorder ring crash-only: the last-N span/event lines
     plus a counter/gauge snapshot, written with the checkpoint discipline
@@ -787,31 +1181,7 @@ def dump_flight_recorder(reason: str, path: Optional[str] = None) -> Optional[st
             "gauges": _jsonable(gauges),
             "tail": rec.flight_tail(),
         }
-        try:
-            from quorum_intersection_tpu.utils.faults import fault_point
-
-            # Injectable boundary: the dump write itself can hit a full disk
-            # mid-crash; it downgrades to a counter, never a second crash.
-            fault_point("telemetry.dump")
-            tmp = f"{target}.tmp{rec.pid}"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                fh.write(json.dumps(payload, default=str))
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, target)
-            try:
-                dir_fd = os.open(
-                    os.path.dirname(os.path.abspath(target)), os.O_RDONLY
-                )
-                try:
-                    os.fsync(dir_fd)
-                finally:
-                    os.close(dir_fd)
-            except OSError:
-                pass  # directory fsync is best-effort (utils/checkpoint.py)
-        except Exception as exc:  # noqa: BLE001 — a crash dump must never be the crash
-            rec.add("telemetry.dump_errors")
-            log.warning("flight-recorder dump failed (%s); run continues", exc)
+        if not _write_crash_only(str(target), payload, rec):
             return None
         rec.add("telemetry.dumps")
         rec.event("telemetry.dumped", path=str(target), reason=reason)

@@ -1,0 +1,544 @@
+"""qi-pulse suite (ISSUE 15): the mergeable histogram primitive
+(unit/merge/property/Prometheus/JSONL), per-request wire trace
+propagation front-door→worker→response→journal-replay, the pong-carried
+aggregation plane (merged /metrics histogram == bucket-wise sum of the
+worker scrapes; ``pulse.aggregate`` fault degrade parity), slow-request
+exemplars (fire exactly for slow requests, never flip a verdict), and
+the metrics_report cross-process graft + Chrome ``--merge`` exporter
+with a pre-pulse-stream regression pin."""
+
+import json
+import random
+import sys
+import time
+
+import pytest
+
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.fleet import FleetEngine
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.serve import (
+    RequestJournal,
+    ServeEngine,
+    snapshot_fingerprint,
+)
+from quorum_intersection_tpu.serve_transport import pong_payload
+from quorum_intersection_tpu.utils import faults, telemetry
+from quorum_intersection_tpu.utils.faults import FaultPlan, FaultRule
+from quorum_intersection_tpu.utils.metrics_server import healthz_payload
+from quorum_intersection_tpu.utils.telemetry import (
+    DEFAULT_HIST_BOUNDS_MS,
+    Histogram,
+    TraceContext,
+    hist_bounds,
+    percentile,
+    prom_lines,
+)
+from tools.metrics_report import (
+    export_chrome,
+    load_stream,
+    render,
+    span_table,
+)
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+class _Engine:
+    """Context manager: a started ServeEngine that always stops."""
+
+    def __init__(self, **kw):
+        self.engine = ServeEngine(**kw)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=30.0)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the histogram primitive
+
+
+class TestHistogram:
+    def test_exact_count_and_sum(self):
+        h = Histogram("t")
+        for v in (0.1, 3.0, 700.0, 100000.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 4
+        assert abs(snap["sum"] - 100703.1) < 1e-6
+        # one overflow bucket beyond the bounded edges
+        assert len(snap["counts"]) == len(snap["bounds"]) + 1
+        assert snap["counts"][-1] == 1  # the 100 s outlier
+
+    def test_bucket_edges_are_inclusive(self):
+        h = Histogram("t", bounds=(1.0, 2.0, 4.0))
+        h.observe(2.0)  # exactly an upper edge: belongs to that bucket
+        assert h.snapshot()["counts"] == [0, 1, 0, 0]
+
+    def test_merge_equals_histogram_of_union(self):
+        # The mergeability law the whole aggregation plane rests on:
+        # merge(h(A), h(B)) == h(A + B), bucket-exact, over random data.
+        rng = random.Random(7)
+        a = [rng.uniform(0.01, 90000.0) for _ in range(700)]
+        b = [rng.expovariate(1 / 50.0) for _ in range(400)]
+        ha, hb, hu = Histogram("x"), Histogram("x"), Histogram("x")
+        for v in a:
+            ha.observe(v)
+        for v in b:
+            hb.observe(v)
+        for v in a + b:
+            hu.observe(v)
+        merged = Histogram.merge_wire([ha.snapshot(), hb.snapshot()])
+        union = hu.snapshot()
+        assert merged["counts"] == union["counts"]
+        assert merged["count"] == union["count"]
+        assert abs(merged["sum"] - union["sum"]) < 1e-3
+
+    def test_merge_refuses_mismatched_bounds(self):
+        a = Histogram("a", bounds=(1.0, 2.0)).snapshot()
+        b = Histogram("b", bounds=(1.0, 3.0)).snapshot()
+        with pytest.raises(ValueError):
+            Histogram.merge_wire([a, b])
+
+    def test_set_from_wire_refuses_mismatched_bounds(self):
+        h = Histogram("t", bounds=(1.0, 2.0))
+        with pytest.raises(ValueError):
+            h.set_from_wire(Histogram("o", bounds=(1.0, 4.0)).snapshot())
+
+    def test_bucket_override_env(self, monkeypatch):
+        monkeypatch.setenv("QI_PULSE_BUCKETS", "1, 2,4")
+        assert hist_bounds() == (1.0, 2.0, 4.0)
+        monkeypatch.setenv("QI_PULSE_BUCKETS", "4,2,nope")
+        assert hist_bounds() == DEFAULT_HIST_BOUNDS_MS  # malformed: fallback
+        # Duplicate edges would render duplicate Prometheus le labels
+        # (the whole scrape would be rejected): strictly ascending only.
+        monkeypatch.setenv("QI_PULSE_BUCKETS", "1,1,2")
+        assert hist_bounds() == DEFAULT_HIST_BOUNDS_MS
+        monkeypatch.delenv("QI_PULSE_BUCKETS")
+        assert hist_bounds() == DEFAULT_HIST_BOUNDS_MS
+
+    def test_window_percentile_is_the_legacy_estimator(self):
+        h = Histogram("t")
+        samples = [float(i) for i in range(1, 101)]
+        for v in samples:
+            h.observe(v)
+        assert h.window_percentile(99.0) == percentile(samples, 99.0) == 99.0
+        assert h.window_percentile(50.0) == percentile(samples, 50.0)
+
+    def test_quantile_ms_is_bucket_upper_bound(self):
+        h = Histogram("t", bounds=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 5.0, 50.0):
+            h.observe(v)
+        assert h.quantile_ms(50.0) == 10.0  # rank 2 lands in the ≤10 bucket
+        assert h.quantile_ms(100.0) == 100.0
+        assert Histogram("e").quantile_ms(99.0) == 0.0
+
+    def test_prometheus_rendering(self, rec):
+        h = rec.histogram("pulse.e2e_ms")
+        h.observe(0.1)
+        h.observe(3.0)
+        h.observe(10 ** 9)  # overflow bucket
+        lines = prom_lines(rec)
+        assert "# TYPE qi_pulse_e2e_ms histogram" in lines
+        # Cumulative le convention; +Inf equals the exact count.
+        assert 'qi_pulse_e2e_ms_bucket{le="+Inf"} 3' in lines
+        assert 'qi_pulse_e2e_ms_bucket{le="4"} 2' in lines
+        assert any(line.startswith("qi_pulse_e2e_ms_sum ") for line in lines)
+        assert "qi_pulse_e2e_ms_count 3" in lines
+        # Deterministic: two renders are byte-identical.
+        assert lines == prom_lines(rec)
+
+    def test_jsonl_final_lines(self, rec, tmp_path):
+        rec.histogram("pulse.e2e_ms").observe(5.0)
+        rec.histogram("pulse.untouched_ms")  # no samples: stays silent
+        lines = rec.final_lines()
+        hist_lines = [ln for ln in lines if ln["kind"] == "histogram"]
+        assert [ln["name"] for ln in hist_lines] == ["pulse.e2e_ms"]
+        assert hist_lines[0]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the reporter: cross-process graft, histogram section, chrome export
+
+
+def _write_stream(path, lines):
+    path.write_text("".join(json.dumps(ln) + "\n" for ln in lines))
+    return str(path)
+
+
+def _old_style_stream():
+    """A PR-6-era two-process stream: colliding span ids, NO remote-parent
+    fields — the pid-scoped lookup must keep the processes apart."""
+    return [
+        {"kind": "meta", "schema": "qi-telemetry/1", "pid": 100,
+         "argv0": "a", "t_wall": 1000.0, "trace_id": "aaaa"},
+        {"kind": "meta", "schema": "qi-telemetry/1", "pid": 200,
+         "argv0": "b", "t_wall": 1000.5, "trace_id": "aaaa"},
+        {"kind": "span", "name": "parent", "span_id": 1, "parent_id": None,
+         "start_s": 0.0, "seconds": 1.0, "trace_id": "aaaa", "pid": 100,
+         "tid": 1, "attrs": {}},
+        {"kind": "span", "name": "child", "span_id": 2, "parent_id": 1,
+         "start_s": 0.1, "seconds": 0.5, "trace_id": "aaaa", "pid": 100,
+         "tid": 1, "attrs": {}},
+        # Same ids in ANOTHER pid: must not graft under pid 100's parent.
+        {"kind": "span", "name": "other_root", "span_id": 2, "parent_id": None,
+         "start_s": 0.2, "seconds": 0.3, "trace_id": "aaaa", "pid": 200,
+         "tid": 2, "attrs": {}},
+        {"kind": "counter", "name": "c", "value": 3},
+    ]
+
+
+class TestReporter:
+    def test_pre_pulse_stream_renders_unchanged(self, tmp_path):
+        # The regression pin: no remote-parent fields ⇒ the tree is
+        # exactly the old pid-scoped one (cross-pid spans stay roots) and
+        # no histogram section appears.
+        path = _write_stream(tmp_path / "old.jsonl", _old_style_stream())
+        out = render(path)
+        table = span_table(load_stream(path)["spans"])
+        assert "  child" in table.splitlines()[3] or any(
+            ln.startswith("  child") for ln in table.splitlines()
+        )
+        assert any(ln.startswith("other_root") for ln in table.splitlines())
+        assert "latency histograms" not in out
+
+    def test_remote_parent_grafts_across_pids(self, tmp_path):
+        lines = _old_style_stream()
+        # A qi-pulse worker span: thread root + wire-carried remote parent
+        # pointing at pid 100's span 1 — must graft under it.
+        lines.append({
+            "kind": "span", "name": "serve.solve", "span_id": 9,
+            "parent_id": None, "start_s": 0.3, "seconds": 0.4,
+            "trace_id": "aaaa", "pid": 200, "tid": 2, "attrs": {},
+            "remote_parent_span": 1, "remote_parent_pid": 100,
+        })
+        path = _write_stream(tmp_path / "graft.jsonl", lines)
+        table = span_table(load_stream(path)["spans"])
+        assert any(ln.startswith("  serve.solve")
+                   for ln in table.splitlines())
+
+    def test_histogram_lines_aggregate_bucketwise(self, tmp_path):
+        bounds = [1.0, 10.0]
+        lines = [
+            {"kind": "histogram", "name": "pulse.e2e_ms", "bounds": bounds,
+             "counts": [1, 2, 0], "count": 3, "sum": 12.0},
+            {"kind": "histogram", "name": "pulse.e2e_ms", "bounds": bounds,
+             "counts": [0, 1, 1], "count": 2, "sum": 105.0},
+        ]
+        path = _write_stream(tmp_path / "h.jsonl", lines)
+        data = load_stream(path)
+        agg = data["histograms"]["pulse.e2e_ms"]
+        assert agg["counts"] == [1, 3, 1] and agg["count"] == 5
+        assert abs(agg["sum"] - 117.0) < 1e-9
+        assert "latency histograms" in render(path)
+
+    def test_chrome_export_merge_flows(self, tmp_path):
+        lines = _old_style_stream()
+        lines.append({
+            "kind": "span", "name": "serve.solve", "span_id": 9,
+            "parent_id": None, "start_s": 0.3, "seconds": 0.4,
+            "trace_id": "aaaa", "pid": 200, "tid": 2, "attrs": {},
+            "remote_parent_span": 1, "remote_parent_pid": 100,
+        })
+        path = _write_stream(tmp_path / "c.jsonl", lines)
+        plain = tmp_path / "plain.json"
+        merged = tmp_path / "merged.json"
+        export_chrome(load_stream(path), str(plain), merge=False)
+        export_chrome(load_stream(path), str(merged), merge=True)
+        plain_events = json.loads(plain.read_text())
+        merged_events = json.loads(merged.read_text())
+        assert not [e for e in plain_events if e["ph"] in ("s", "f")]
+        flows = [e for e in merged_events if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        start = next(e for e in flows if e["ph"] == "s")
+        finish = next(e for e in flows if e["ph"] == "f")
+        assert start["id"] == finish["id"]
+        assert start["pid"] == 100 and finish["pid"] == 200
+
+
+# ---------------------------------------------------------------------------
+# serve: stage histograms, trace adoption, journal replay, exemplars
+
+
+class TestServePulse:
+    def test_stage_histograms_and_byte_compatible_gauges(self, rec):
+        with _Engine(backend="python") as engine:
+            for n in (3, 4, 3):  # one repeat ⇒ one cache hit
+                engine.submit(majority_fbas(n)).result(timeout=60.0)
+        hists = rec.histograms_snapshot()
+        for name in ("pulse.queue_wait_ms", "pulse.cache_ms",
+                     "pulse.solve_ms", "pulse.respond_ms", "pulse.e2e_ms"):
+            assert hists[name]["count"] > 0, name
+        _, gauges = rec.snapshot()
+        h = rec.histogram("pulse.e2e_ms")
+        assert gauges["serve.p50_ms"] == round(h.window_percentile(50.0), 3)
+        assert gauges["serve.p99_ms"] == round(h.window_percentile(99.0), 3)
+
+    def test_trace_adoption_and_response_echo(self, rec):
+        wire = "feedbeef12345678:7:4242"
+        with _Engine(backend="python") as engine:
+            resp = engine.submit(
+                majority_fbas(3), request_id="r0", trace=wire,
+            ).result(timeout=60.0)
+        assert resp.trace == wire
+        admit = [sp for sp in rec.spans if sp.name == "serve.admit"]
+        assert admit and admit[0].trace_id == "feedbeef12345678"
+        assert admit[0].remote_parent_span == 7
+        assert admit[0].remote_parent_pid == 4242
+        solve_spans = [sp for sp in rec.spans if sp.name == "serve.solve"]
+        assert solve_spans and all(
+            sp.trace_id == "feedbeef12345678" for sp in solve_spans
+        )
+        # Spans the solve opens UNDER the adopted scope (the pipeline's
+        # check_many span) carry the adopted trace too — the chain the
+        # acceptance criterion pins: request span is an ancestor.
+        inner = [sp for sp in rec.spans
+                 if sp.trace_id == "feedbeef12345678"
+                 and sp.name not in ("serve.admit", "serve.solve")]
+        assert inner, [sp.name for sp in rec.spans]
+
+    def test_coalesced_waiter_echoes_its_own_trace(self, rec):
+        # Two clients, one fingerprint, two different wire traces: the
+        # coalescer's response must echo ITS context, not the leader's.
+        faults.install_plan(FaultPlan([
+            FaultRule("serve.drain", "hang", first=1, every=False,
+                      seconds=0.4),
+        ]))
+        try:
+            with _Engine(backend="python") as engine:
+                lead = engine.submit(majority_fbas(5), request_id="lead",
+                                     trace="aaaa111100000000:1:10")
+                time.sleep(0.1)  # lands inside the hung drain cycle
+                coal = engine.submit(majority_fbas(5), request_id="coal",
+                                     trace="bbbb222200000000:2:20")
+                r1 = lead.result(timeout=60.0)
+                r2 = coal.result(timeout=60.0)
+        finally:
+            faults.clear_plan()
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.coalesced") == 1
+        assert r1.trace == "aaaa111100000000:1:10"
+        assert r2.trace == "bbbb222200000000:2:20"
+
+    def test_traceless_requests_stay_pre_pulse(self, rec):
+        with _Engine(backend="python") as engine:
+            resp = engine.submit(majority_fbas(3)).result(timeout=60.0)
+        assert resp.trace is None
+        assert all(sp.trace_id == rec.trace_id for sp in rec.spans)
+        assert all(sp.remote_parent_span is None for sp in rec.spans)
+
+    def test_journal_carries_trace_and_replay_adopts(self, rec, tmp_path):
+        nodes = majority_fbas(3)
+        fp = snapshot_fingerprint(build_graph(parse_fbas(nodes)))
+        wire = "cafe0123deadbeef:9:77"
+        journal = RequestJournal(tmp_path / "j.journal")
+        assert journal.append_request("lost-1", fp, nodes, None, trace=wire)
+        journal.close()
+        raw = (tmp_path / "j.journal").read_text()
+        assert json.loads(raw.splitlines()[1])["trace"] == wire
+        with _Engine(backend="python", journal=tmp_path / "j.journal",
+                     batch_max=1) as engine:
+            report = engine._replay_report
+            assert report["verdicts"] == {"lost-1": True}
+        replayed = [sp for sp in rec.spans
+                    if sp.trace_id == "cafe0123deadbeef"]
+        assert replayed, "replay did not re-adopt the journaled trace"
+        roots = [sp for sp in replayed if sp.remote_parent_span is not None]
+        assert roots and roots[0].remote_parent_span == 9
+        assert roots[0].remote_parent_pid == 77
+
+    def test_exemplar_fires_exactly_for_slow_requests(
+            self, rec, tmp_path, monkeypatch):
+        flight = tmp_path / "flight.json"
+        monkeypatch.setenv("QI_PULSE_SLOW_MS", "60")
+        monkeypatch.setenv("QI_FLIGHT_RECORDER", str(flight))
+        # Hang the SECOND drain cycle only: request 1 serves fast (no
+        # exemplar), request 2 crosses the threshold (one exemplar).
+        faults.install_plan(FaultPlan([
+            FaultRule("serve.drain", "hang", first=2, every=True,
+                      seconds=0.25),
+        ]))
+        with _Engine(backend="python") as engine:
+            fast = engine.submit(majority_fbas(3)).result(timeout=60.0)
+            slow = engine.submit(majority_fbas(4)).result(timeout=60.0)
+        faults.clear_plan()
+        assert fast.intersects is True and slow.intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("pulse.exemplars") == 1
+        exemplar = json.loads((tmp_path / "flight.json.exemplar").read_text())
+        assert exemplar["schema"] == "qi-exemplar/1"
+        assert exemplar["reason"] == "slow-request"
+        assert exemplar["e2e_ms"] > 60
+        assert exemplar["stages"]["e2e_ms"] == exemplar["e2e_ms"]
+        assert "queue_wait_ms" in exemplar["stages"]
+        assert isinstance(exemplar["tail"], list) and exemplar["tail"]
+
+    def test_exemplars_off_by_default(self, rec):
+        with _Engine(backend="python") as engine:
+            engine.submit(majority_fbas(3)).result(timeout=60.0)
+        counters, _ = rec.snapshot()
+        assert counters.get("pulse.exemplars", 0) == 0
+
+    def test_pong_carries_pulse_snapshots(self, rec):
+        with _Engine(backend="python") as engine:
+            engine.submit(majority_fbas(3)).result(timeout=60.0)
+        pong = pong_payload("tok")
+        assert pong["pulse"]["pulse.e2e_ms"]["count"] >= 1
+        assert "fleet.pulse.e2e_ms" not in pong["pulse"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: request span, merged histograms, aggregate fault degrade
+
+
+class _Fleet:
+    def __init__(self, n=2, **kw):
+        kw.setdefault("worker_mode", "local")
+        kw.setdefault("backend", "python")
+        kw.setdefault("probe_interval_s", 0.05)
+        self.engine = FleetEngine(n, **kw)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True)
+        return False
+
+
+def _wait_for_merge(rec, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = rec.histograms_snapshot().get("fleet.pulse.e2e_ms")
+        if snap and snap["count"] > 0:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError("aggregation plane never merged worker pulses")
+
+
+class TestFleetPulse:
+    def test_end_to_end_trace_identity_local(self, rec):
+        with _Fleet(2) as fleet:
+            resp = fleet.submit(majority_fbas(3), request_id="q1").result(
+                timeout=60.0,
+            )
+        assert resp.intersects is True
+        ctx = TraceContext.from_env(resp.trace)
+        assert ctx is not None and ctx.trace_id == rec.trace_id
+        req_spans = [sp for sp in rec.spans if sp.name == "fleet.request"]
+        assert req_spans and ctx.span_id in {sp.span_id for sp in req_spans}
+        # The worker's admission span grafts under the front door's
+        # request span: same trace, remote parent == fleet.request.
+        admits = [sp for sp in rec.spans if sp.name == "serve.admit"
+                  and sp.remote_parent_span == ctx.span_id]
+        assert admits and admits[0].trace_id == rec.trace_id
+        hists = rec.histograms_snapshot()
+        assert hists["pulse.route_ms"]["count"] >= 1
+        assert hists["pulse.fleet_e2e_ms"]["count"] >= 1
+
+    def test_merged_metrics_equal_sum_of_worker_scrapes(self, rec):
+        with _Fleet(2) as fleet:
+            for n in (3, 4, 5, 3):
+                fleet.submit(majority_fbas(n)).result(timeout=60.0)
+            merged = _wait_for_merge(rec)
+            health = fleet.healthz()
+        # One snapshot per distinct worker PROCESS (local workers share
+        # one record, so their pongs alias the same histogram — summing
+        # them would double-count; the plane dedupes by pid).
+        by_pid = {
+            w.get("pid"): w["pulse"]["pulse.e2e_ms"]
+            for w in health["workers"].values()
+            if isinstance(w.get("pulse"), dict) and "pulse.e2e_ms" in w["pulse"]
+        }
+        assert by_pid, health
+        expected = Histogram.merge_wire(list(by_pid.values()))
+        assert merged["counts"] == expected["counts"]
+        assert merged["count"] == expected["count"]
+        assert abs(merged["sum"] - expected["sum"]) < 1e-6
+        _, gauges = rec.snapshot()
+        assert gauges["fleet.e2e_p99_ms"] > 0
+        assert healthz_payload()["fleet_e2e_p99_ms"] == \
+            gauges["fleet.e2e_p99_ms"]
+
+    def test_pulse_aggregate_fault_degrades_not_verdicts(self, rec):
+        faults.install_plan(FaultPlan([
+            FaultRule("pulse.aggregate", "error", first=1, every=True),
+        ]))
+        try:
+            with _Fleet(2) as fleet:
+                verdicts = [
+                    fleet.submit(majority_fbas(n)).result(timeout=60.0)
+                    for n in (3, 4)
+                ]
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    counters, _ = rec.snapshot()
+                    if counters.get("pulse.agg_errors", 0) > 0:
+                        break
+                    time.sleep(0.05)
+        finally:
+            faults.clear_plan()
+        assert [r.intersects for r in verdicts] == [True, True]
+        counters, _ = rec.snapshot()
+        assert counters.get("pulse.agg_errors", 0) > 0
+        # Per-worker metrics stayed; the merged view never formed.
+        hists = rec.histograms_snapshot()
+        assert "fleet.pulse.e2e_ms" not in hists
+        assert hists["pulse.e2e_ms"]["count"] > 0
+
+    def test_pulse_agg_off_switch(self, rec, monkeypatch):
+        monkeypatch.setenv("QI_PULSE_AGG", "0")
+        with _Fleet(2) as fleet:
+            fleet.submit(majority_fbas(3)).result(timeout=60.0)
+            time.sleep(0.3)  # several probe cycles
+        assert "fleet.pulse.e2e_ms" not in rec.histograms_snapshot()
+
+
+@pytest.mark.slow
+class TestSubprocessDifferential:
+    """The real cross-process pin: one subprocess worker, front-door
+    trace_id in the worker's OWN telemetry stream, echoed on the wire."""
+
+    def test_trace_crosses_the_pipe(self, rec, tmp_path, monkeypatch):
+        stream = tmp_path / "worker.jsonl"
+        monkeypatch.setenv("QI_METRICS_JSON", str(stream))
+        engine = FleetEngine(
+            1, worker_mode="subprocess", backend="python",
+            journal_dir=tmp_path / "fleet",
+        )
+        engine.start()
+        try:
+            resp = engine.submit(
+                majority_fbas(3), request_id="x1",
+            ).result(timeout=120.0)
+        finally:
+            engine.stop(drain=True)
+        assert resp.intersects is True
+        ctx = TraceContext.from_env(resp.trace)
+        assert ctx is not None and ctx.trace_id == rec.trace_id
+        lines = [json.loads(ln) for ln in stream.read_text().splitlines()]
+        worker_spans = [
+            ln for ln in lines
+            if ln.get("kind") == "span" and ln.get("pid") != rec.pid
+            and ln.get("trace_id") == rec.trace_id
+        ]
+        assert worker_spans, "no worker span joined the front door's trace"
+        assert any(ln.get("remote_parent_pid") == rec.pid
+                   for ln in worker_spans)
+        oracle = solve(majority_fbas(3), backend="python")
+        assert resp.intersects == oracle.intersects

@@ -94,6 +94,11 @@ CHANNEL_SPECS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...],
              ("obj", "err")),
             ("quorum_intersection_tpu/fleet.py",
              "FleetEngine._aggregate_health", ("pong",)),
+            # qi-pulse (ISSUE 15): the aggregation plane reads the pong's
+            # histogram snapshots — a renamed "pulse" field must fail the
+            # producer ⊇ consumer gate, not silently stall the fleet view.
+            ("quorum_intersection_tpu/fleet.py",
+             "FleetEngine._aggregate_pulse", ("pong",)),
         ),
     ),
     (

@@ -92,6 +92,14 @@ TRACKED: Dict[str, str] = {
     "serve_cache_hit_pct": "higher",
     "serve_p50_ms": "lower",
     "serve_p99_ms": "lower",
+    # qi-pulse decomposed stage rows (ISSUE 15): the e2e pair above can
+    # only say "slower"; these say WHERE — a drain loop that stopped
+    # batching shows in queue_wait, a de-optimized engine in solve, and
+    # the fleet-MERGED e2e p99 (union of worker histogram buckets, not
+    # max of per-worker gauges) is the honest fleet tail.
+    "serve_queue_wait_p99_ms": "lower",
+    "serve_solve_p99_ms": "lower",
+    "fleet_e2e_p99_ms": "lower",
     # qi-delta incremental re-analysis (ISSUE 9): benchmarks/serve.py
     # --churn rows.  `delta_scc_reuse_pct` is per-SCC verdict-store hits
     # as a % of lookups over the churn trace — a collapse to 0 under the
@@ -153,6 +161,7 @@ TELEMETRY_GAUGES = (
     "fleet.workers_live",
     "fleet.store_hit_pct",
     "fleet.p99_ms",
+    "fleet.e2e_p99_ms",
     "fleet.bench_verdicts_per_sec",
 )
 

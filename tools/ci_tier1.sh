@@ -222,6 +222,49 @@ with tempfile.TemporaryDirectory() as tmp:
 PYEOF
 echo "QUERY=exit $qrc"
 
+# qi-pulse gate (ISSUE 15, docs/OBSERVABILITY.md §Pulse): cross-process
+# trace identity — one request through a 1-subprocess-worker fleet must
+# land the SAME trace_id in both the front door's and the worker's
+# span lines of one shared telemetry stream (the worker inherits
+# QI_METRICS_JSON), with worker spans carrying the front door's request
+# span as their wire-stamped remote parent, and the response echoing the
+# trace on the wire.
+PULSE_METRICS="${TIER1_PULSE_METRICS:-/tmp/_t1_pulse.jsonl}"
+rm -f "$PULSE_METRICS"
+env JAX_PLATFORMS=cpu QI_METRICS_JSON="$PULSE_METRICS" python - <<'PYEOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.fleet import FleetEngine
+from quorum_intersection_tpu.utils.telemetry import (
+    TraceContext, finish, get_run_record,
+)
+
+rec = get_run_record()
+eng = FleetEngine(1, worker_mode="subprocess", backend="python")
+eng.start()
+try:
+    resp = eng.submit(majority_fbas(3),
+                      request_id="pulse-smoke").result(timeout=180.0)
+finally:
+    eng.stop(drain=True)
+assert resp.intersects is True
+ctx = TraceContext.from_env(resp.trace)
+assert ctx is not None and ctx.trace_id == rec.trace_id, resp.trace
+finish()
+lines = [json.loads(l) for l in open(os.environ["QI_METRICS_JSON"])]
+spans = [l for l in lines
+         if l.get("kind") == "span" and l.get("trace_id") == rec.trace_id]
+pids = {l["pid"] for l in spans}
+assert len(pids) >= 2, f"trace never crossed the pipe (pids {pids})"
+grafted = [l for l in spans if l.get("remote_parent_pid") == rec.pid]
+assert grafted, "no worker span grafted under the front door's request span"
+print(f"PULSE: trace {rec.trace_id} spans from {len(pids)} processes, "
+      f"{len(grafted)} grafted under the front door")
+PYEOF
+purc=$?
+echo "PULSE=exit $purc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -242,4 +285,5 @@ echo "TREND=exit $trc"
 [ "$frc" -ne 0 ] && exit "$frc"
 [ "$fsrc" -ne 0 ] && exit "$fsrc"
 [ "$qrc" -ne 0 ] && exit "$qrc"
+[ "$purc" -ne 0 ] && exit "$purc"
 exit "$trc"

@@ -18,6 +18,19 @@ parent_id, scoped per pid so multi-process id collisions never graft one
 process's spans onto another's), and ``--diff`` compares two streams'
 counters/gauges/span totals — the delta engine ``tools/bench_trend.py``
 reuses for its telemetry half.
+
+Since ISSUE 15 (qi-pulse) the tree GRAFTS across processes on
+wire-carried trace context: a span whose line carries
+``remote_parent_span``/``remote_parent_pid`` (a serve worker's span
+adopted under a fleet front door's request span) hangs under that remote
+parent instead of rooting its own tree.  The pid scoping is unchanged for
+spans without those fields, so a pre-pulse single-process stream renders
+byte-identically (pinned by tests/test_qi_pulse.py).  ``kind:
+"histogram"`` lines (the mergeable pulse latency histograms) aggregate
+bucket-wise across processes and render as their own section, and
+``--chrome OUT`` exports the stream as Chrome/Perfetto trace-event JSON —
+with ``--merge``, cross-process parent links additionally render as flow
+arrows, so one fleet request reads as one flow in the timeline.
 """
 
 from __future__ import annotations
@@ -29,12 +42,39 @@ from collections import defaultdict
 from typing import Dict, List
 
 
+def _merge_histogram(into: Dict[str, dict], line: dict) -> None:
+    """Fold one ``kind: histogram`` line into the per-name aggregate —
+    bucket-wise addition, the primitive's own merge law, so a
+    multi-process stream's histograms read as one fleet distribution.
+    Mismatched bucket ladders keep the first and count the line bad."""
+    name = str(line.get("name", "?"))
+    cur = into.get(name)
+    if cur is None:
+        into[name] = {
+            "bounds": list(line.get("bounds") or ()),
+            "counts": [int(c) for c in line.get("counts") or ()],
+            "count": int(line.get("count") or 0),
+            "sum": float(line.get("sum") or 0.0),
+        }
+        return
+    if list(line.get("bounds") or ()) != cur["bounds"]:
+        raise ValueError("histogram bounds mismatch")
+    counts = [int(c) for c in line.get("counts") or ()]
+    if len(counts) != len(cur["counts"]):
+        raise ValueError("histogram counts length mismatch")
+    cur["counts"] = [a + b for a, b in zip(cur["counts"], counts)]
+    cur["count"] += int(line.get("count") or 0)
+    cur["sum"] += float(line.get("sum") or 0.0)
+
+
 def load_stream(path: str) -> dict:
-    """Parse one JSONL file into {spans, events, counters, gauges, meta}."""
+    """Parse one JSONL file into {spans, events, counters, gauges,
+    histograms, meta}."""
     spans: List[dict] = []
     events: List[dict] = []
     counters: Dict[str, float] = defaultdict(float)
     gauges: Dict[str, object] = {}
+    histograms: Dict[str, dict] = {}
     meta: List[dict] = []
     bad = 0
     with open(path, "r", encoding="utf-8") as fh:
@@ -56,6 +96,11 @@ def load_stream(path: str) -> dict:
                 counters[line.get("name", "?")] += line.get("value", 0) or 0
             elif kind == "gauge":
                 gauges[line.get("name", "?")] = line.get("value")
+            elif kind == "histogram":
+                try:
+                    _merge_histogram(histograms, line)
+                except ValueError:
+                    bad += 1
             elif kind == "meta":
                 meta.append(line)
             # "log" lines (QI_LOG_JSON interleaving) pass through silently
@@ -64,6 +109,7 @@ def load_stream(path: str) -> dict:
         "events": events,
         "counters": dict(counters),
         "gauges": gauges,
+        "histograms": histograms,
         "meta": meta,
         "bad_lines": bad,
     }
@@ -80,14 +126,31 @@ def _table(rows: List[List[str]], header: List[str]) -> str:
     return "\n".join(lines)
 
 
+def _parent_key(sp: dict) -> tuple:
+    """The parent lookup key of one span: its in-process ``parent_id``
+    scoped by pid or — for a thread-root span carrying wire-adopted trace
+    context (qi-pulse, ISSUE 15) — the REMOTE parent ``(pid, span_id)``
+    the fleet front door stamped on dispatch.  Pre-pulse spans have
+    neither field set beyond parent_id, so old streams resolve exactly
+    as they always did (pid-scoped, cross-process joins impossible)."""
+    if sp.get("parent_id") is not None:
+        return (sp.get("pid", 0), sp.get("parent_id"))
+    if sp.get("remote_parent_span") is not None:
+        return (sp.get("remote_parent_pid", 0), sp.get("remote_parent_span"))
+    return (None, None)
+
+
 def _span_paths(spans: List[dict]) -> List[tuple]:
     """Name-path of every span, root-first (ISSUE 6 satellite).
 
     Parent links are ``(pid, parent_id)`` — span ids are per-process
     counters, so a multi-process stream must scope the lookup by pid (old
     streams without a ``pid`` field fall back to one shared scope).  A
-    parent beyond the retention cap (or in another process) roots the
-    subtree rather than dropping it.
+    parent beyond the retention cap roots the subtree rather than
+    dropping it.  Cross-PROCESS joins happen only on wire-carried trace
+    context: a span with ``remote_parent_span``/``remote_parent_pid``
+    grafts under that remote span (qi-pulse, ISSUE 15) — never on a bare
+    id collision.
     """
     by_key = {
         (sp.get("pid", 0), sp.get("span_id")): sp
@@ -99,10 +162,10 @@ def _span_paths(spans: List[dict]) -> List[tuple]:
         chain = [sp.get("name", "?")]
         cur = sp
         seen = set()
-        while cur.get("parent_id") is not None:
-            key = (cur.get("pid", 0), cur.get("parent_id"))
-            if key in seen:
-                break  # defensive: a cyclic id would otherwise spin
+        while True:
+            key = _parent_key(cur)
+            if key == (None, None) or key in seen:
+                break  # root, or defensive: a cyclic id would otherwise spin
             seen.add(key)
             cur = by_key.get(key)
             if cur is None:
@@ -236,6 +299,116 @@ def event_summary(events: List[dict]) -> str:
     return "\n".join(lines) if lines else "(no events)"
 
 
+def _wire_quantile(hist: dict, pct: float) -> float:
+    """Bucket-resolution quantile of one aggregated histogram (nearest
+    rank; the upper edge of the holding bucket) — stdlib-only twin of
+    ``Histogram.quantile_ms`` so this reporter stays import-free of the
+    package (the bench-trend CI job's contract)."""
+    total = int(hist.get("count") or 0)
+    bounds = hist.get("bounds") or []
+    if total <= 0 or not bounds:
+        return 0.0
+    rank = max(-(-pct * total // 100), 1)  # ceil without math
+    seen = 0
+    for ix, n in enumerate(hist.get("counts") or []):
+        seen += int(n)
+        if seen >= rank:
+            return float(bounds[min(ix, len(bounds) - 1)])
+    return float(bounds[-1])
+
+
+def histogram_table(histograms: Dict[str, dict]) -> str:
+    """The qi-pulse latency-distribution section: per histogram the exact
+    count/sum plus bucket-resolution p50/p99 estimates — aggregated
+    bucket-wise across every process in the stream."""
+    rows = [
+        [name, int(h.get("count") or 0), f"{float(h.get('sum') or 0.0):.3f}",
+         f"{(float(h.get('sum') or 0.0) / h['count']):.3f}" if h.get("count") else "-",
+         f"{_wire_quantile(h, 50.0):g}", f"{_wire_quantile(h, 99.0):g}"]
+        for name, h in sorted(histograms.items())
+    ]
+    if not rows:
+        return "(no histograms)"
+    return _table(rows, ["histogram", "count", "sum_ms", "mean_ms",
+                         "p50_le_ms", "p99_le_ms"])
+
+
+def export_chrome(data: dict, out_path: str, merge: bool = False) -> int:
+    """Export a loaded stream as Chrome/Perfetto trace-event JSON
+    (ISSUE 15): spans become complete duration events on their real
+    pid/tid tracks (wall-clock anchored per process by the meta lines),
+    telemetry events become instant marks.  With ``merge``, every span
+    carrying wire-adopted remote-parent context additionally emits a
+    flow-event pair from the front door's request span to the worker's
+    span — one fleet request renders as ONE flow arrow across process
+    tracks.  Returns the number of trace events written."""
+    anchors = {
+        m.get("pid", 0): float(m.get("t_wall") or 0.0) for m in data["meta"]
+    }
+    fallback = min((t for t in anchors.values() if t), default=0.0)
+
+    def ts(pid: object, rel: object) -> float:
+        anchor = anchors.get(pid) or fallback
+        return round((anchor + float(rel or 0.0)) * 1e6, 1)
+
+    out: List[dict] = []
+    for m in data["meta"]:
+        out.append({
+            "ph": "M", "name": "process_name", "pid": m.get("pid", 0),
+            "tid": 0,
+            "args": {"name": (
+                f"{m.get('argv0') or 'python'} (pid {m.get('pid')}, "
+                f"trace {m.get('trace_id', '?')})"
+            )},
+        })
+    for sp in data["spans"]:
+        if sp.get("seconds") is None:
+            continue
+        out.append({
+            "ph": "X", "cat": "span", "name": sp.get("name", "?"),
+            "pid": sp.get("pid", 0), "tid": int(sp.get("tid") or 0),
+            "ts": ts(sp.get("pid", 0), sp.get("start_s")),
+            "dur": max(round(float(sp["seconds"]) * 1e6, 1), 1.0),
+            "args": sp.get("attrs") or {},
+        })
+    for ev in data["events"]:
+        out.append({
+            "ph": "i", "cat": "event", "name": ev.get("name", "?"),
+            "pid": ev.get("pid", 0), "tid": int(ev.get("tid") or 0),
+            "ts": ts(ev.get("pid", 0), ev.get("t_s")), "s": "t",
+            "args": ev.get("attrs") or {},
+        })
+    if merge:
+        by_key = {
+            (sp.get("pid", 0), sp.get("span_id")): sp
+            for sp in data["spans"] if sp.get("span_id") is not None
+        }
+        flow = 0
+        for sp in data["spans"]:
+            remote = sp.get("remote_parent_span")
+            if remote is None:
+                continue
+            parent = by_key.get((sp.get("remote_parent_pid", 0), remote))
+            if parent is None:
+                continue  # the front-door half was not in this stream
+            flow += 1
+            out.append({
+                "ph": "s", "cat": "qi-pulse", "name": "request", "id": flow,
+                "pid": parent.get("pid", 0),
+                "tid": int(parent.get("tid") or 0),
+                "ts": ts(parent.get("pid", 0), parent.get("start_s")),
+            })
+            out.append({
+                "ph": "f", "bp": "e", "cat": "qi-pulse", "name": "request",
+                "id": flow,
+                "pid": sp.get("pid", 0), "tid": int(sp.get("tid") or 0),
+                "ts": ts(sp.get("pid", 0), sp.get("start_s")),
+            })
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(out, fh)
+    return len(out)
+
+
 def scalar_table(counters: Dict[str, float], gauges: Dict[str, object]) -> str:
     def pretty(v):
         if isinstance(v, float) and v.is_integer():
@@ -325,6 +498,14 @@ def render(path: str, tail: int = 0) -> str:
         "\n== counters / gauges ==\n"
         + scalar_table(data["counters"], data["gauges"]),
     ]
+    if data["histograms"]:
+        # Appended only when the stream carries histogram lines, so a
+        # pre-pulse stream's report stays byte-identical (the qi-pulse
+        # regression contract).
+        sections.append(
+            "\n== latency histograms (qi-pulse) ==\n"
+            + histogram_table(data["histograms"])
+        )
     return "\n".join(sections)
 
 
@@ -337,12 +518,27 @@ def main() -> int:
                         help="compare PATH (baseline) against PATH_B: "
                              "counter/gauge/span-total deltas instead of "
                              "the full report (bench_trend reuses this)")
+    parser.add_argument("--chrome", metavar="OUT", default=None,
+                        help="also export the stream as Chrome/Perfetto "
+                             "trace-event JSON (open in ui.perfetto.dev)")
+    parser.add_argument("--merge", action="store_true",
+                        help="with --chrome: render wire-carried "
+                             "cross-process parent links as flow arrows — "
+                             "one fleet request reads as one flow")
     args = parser.parse_args()
+    if args.merge and not args.chrome:
+        print("--merge requires --chrome OUT", file=sys.stderr)
+        return 1
     try:
         if args.diff:
             print(render_diff(args.path, args.diff))
         else:
             print(render(args.path, args.windows))
+        if args.chrome:
+            n = export_chrome(load_stream(args.path), args.chrome,
+                              merge=args.merge)
+            print(f"chrome trace: {args.chrome} ({n} events"
+                  + (", merged flows" if args.merge else "") + ")")
     except OSError as exc:
         print(f"cannot read {args.path}: {exc}", file=sys.stderr)
         return 1
