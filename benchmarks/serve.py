@@ -486,6 +486,147 @@ def run_fleet_phase(args, record) -> tuple:
     return row, mismatches
 
 
+def run_fuse_phase(args, record) -> tuple:
+    """The qi-fuse phase (ISSUE 16): the same quick zipfian mixed stream —
+    sweep-sized intersection snapshots of several distinct topologies plus
+    what-if queries — driven twice through pack-enabled engines, fusion
+    off then on.  Measures MXU-tile utilization (``sweep_pack_fill_pct``:
+    verdict-bearing lanes over dispatched 128-lane tiles — the device pads
+    every sub-tile program's lane axis to a full tile, so fewer fuller
+    packs is the entire win), the cross-request share of fused lanes
+    (``fuse_cross_request_lane_pct``), and the fused-vs-unfused solve p99.
+    Hard gates (mismatches): per-request verdict parity between the two
+    runs and the one-shot oracle, ``fuse.cross_request_lanes > 0``, and
+    fill strictly improving with fusion on."""
+    from quorum_intersection_tpu.encode.circuit import LANE_TILE
+    from quorum_intersection_tpu.fbas import synth
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.serve import ServeEngine, _percentile
+
+    # The packer only exists on the sweep path: force an auto-routed,
+    # pack-enabled engine (the driver default "python" never packs).
+    backend = args.backend if args.backend in ("auto", "tpu") else "auto"
+    n_req = 10 if args.quick else 24
+    bases = {
+        n: synth.majority_fbas(n, prefix=f"FUSE{n}") for n in (7, 9, 11, 13)
+    }
+    sizes = sorted(bases)
+    # Deterministic zipf-ish pick order: the hot topology re-emits, the
+    # tail rotates — repeats exercise cache/coalescing, distinct
+    # fingerprints land in one drain batch and fuse across requests.
+    # Every third request is a what-if sweep: the legacy drain expands
+    # each one into its OWN partially-filled pack (queries resolve one at
+    # a time), which is exactly the under-fill fusion closes.
+    picks = (0, 1, 0, 2, 0, 1, 3)
+    workload = []
+    for i in range(n_req):
+        nodes = bases[sizes[picks[i % len(picks)]]]
+        query = {"kind": "whatif", "max_k": 1} if i % 3 == 2 else None
+        workload.append((nodes, query))
+    oracle = {
+        n: solve(nodes, backend="python").intersects
+        for n, nodes in bases.items()
+    }
+
+    def one_run(window_ms):
+        n0 = record.event_count()
+        c0, _ = record.snapshot()
+        engine = ServeEngine(
+            backend=backend, pack=True, fuse_window_ms=window_ms,
+            batch_max=len(workload) + 2, queue_depth=len(workload) + 8,
+            cache_max=args.cache_max,
+        )
+        # Queue the whole stream BEFORE the drain starts: one popped
+        # batch, so the fused run's cross-request window actually sees
+        # every distinct topology at once (the --quick preset is far too
+        # short for open-loop arrival overlap to do it).
+        tickets = [engine.submit(nodes, query=q) for nodes, q in workload]
+        t0 = time.perf_counter()
+        engine.start()
+        responses = [t.result(timeout=300.0) for t in tickets]
+        engine.stop(drain=True, timeout=600.0)
+        wall = time.perf_counter() - t0
+        c1, _ = record.snapshot()
+        events = record.events_since(n0)
+        useful = 0.0
+        tile_lanes = 0
+        packs = 0
+        for e in events:
+            if e["name"] != "sweep.packed":
+                continue
+            attrs = e["attrs"]
+            packs += 1
+            useful += attrs["fill_pct"] * attrs["lanes"] / 100.0
+            tile_lanes += max(-(-attrs["lanes"] // LANE_TILE), 1) * LANE_TILE
+        lat = sorted(r.seconds * 1000.0 for r in responses)
+        diff = {
+            k: c1.get(k, 0) - c0.get(k, 0)
+            for k in ("fuse.packs_formed", "fuse.pack_lanes",
+                      "fuse.cross_request_lanes")
+        }
+        return {
+            "responses": responses,
+            "wall_s": wall,
+            "packs": packs,
+            "fill_pct": (
+                round(100.0 * useful / tile_lanes, 2) if tile_lanes else 0.0
+            ),
+            "p99_ms": round(_percentile(lat, 99.0), 3),
+            "counters": diff,
+        }
+
+    mismatches = []
+    # Unfused first: the fused run then reuses the XLA compile cache, so
+    # the p99 comparison favors neither run on compile amortization (both
+    # presets solve the same compiled shapes).
+    unfused = one_run(0.0)
+    fused = one_run(args.fuse_window)
+    for i, ((nodes, query), r_plain, r_fused) in enumerate(
+        zip(workload, unfused["responses"], fused["responses"])
+    ):
+        if r_fused.intersects is not r_plain.intersects:
+            mismatches.append(
+                f"fuse step {i}: fused {r_fused.intersects} != unfused "
+                f"{r_plain.intersects}"
+            )
+        if query is None and r_plain.intersects is not oracle[len(nodes)]:
+            mismatches.append(
+                f"fuse step {i}: unfused {r_plain.intersects} != oracle "
+                f"{oracle[len(nodes)]}"
+            )
+    if fused["counters"]["fuse.cross_request_lanes"] <= 0:
+        mismatches.append(
+            "fuse phase: no cross-request lanes — fusion never merged two "
+            "requests into one pack"
+        )
+    if fused["fill_pct"] <= unfused["fill_pct"]:
+        mismatches.append(
+            f"fuse phase: tile fill did not improve (fused "
+            f"{fused['fill_pct']}% <= unfused {unfused['fill_pct']}%)"
+        )
+    pack_lanes = fused["counters"]["fuse.pack_lanes"]
+    cross_pct = (
+        100.0 * fused["counters"]["fuse.cross_request_lanes"] / pack_lanes
+        if pack_lanes else 0.0
+    )
+    row = {
+        "fuse_requests": n_req,
+        "fuse_window_ms": args.fuse_window,
+        "fuse_backend": backend,
+        "sweep_pack_fill_pct": fused["fill_pct"],
+        "sweep_pack_fill_pct_unfused": unfused["fill_pct"],
+        "fuse_cross_request_lane_pct": round(cross_pct, 2),
+        "fuse_packs_formed": int(fused["counters"]["fuse.packs_formed"]),
+        "fuse_packs_unfused": unfused["packs"],
+        "fuse_serve_solve_p99_ms": fused["p99_ms"],
+        "fuse_serve_solve_p99_unfused_ms": unfused["p99_ms"],
+    }
+    record.gauge("fuse.bench_fill_pct", row["sweep_pack_fill_pct"])
+    record.gauge("fuse.bench_cross_request_lane_pct",
+                 row["fuse_cross_request_lane_pct"])
+    return row, mismatches
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--requests", type=int, default=300,
@@ -556,6 +697,20 @@ def main(argv=None) -> int:
                         help="zipfian temporal skew of the fleet churn "
                              "trace (fbas/synth.py churn_trace; default "
                              "1.1)")
+    parser.add_argument("--fuse", action="store_true",
+                        help="append the qi-fuse phase (ISSUE 16): the "
+                             "quick zipfian mixed intersection+whatif "
+                             "stream through a pack-enabled engine, fusion "
+                             "off then on — measures sweep_pack_fill_pct / "
+                             "fuse_cross_request_lane_pct and the fused-vs-"
+                             "unfused solve p99 (tools/bench_trend.py "
+                             "gates them), hard-failing unless "
+                             "cross-request lanes formed and tile fill "
+                             "strictly improved")
+    parser.add_argument("--fuse-window", type=float, default=25.0,
+                        help="fused-run batch-former window in ms "
+                             "(QI_SERVE_FUSE_WINDOW_MS equivalent; "
+                             "default 25)")
     parser.add_argument("--fleet-local", action="store_true",
                         help="run fleet workers in-process instead of as "
                              "subprocesses (faster smoke, same routing/"
@@ -703,6 +858,11 @@ def main(argv=None) -> int:
         fleet_row, fleet_mismatches = run_fleet_phase(args, record)
         row.update(fleet_row)
         mismatches.extend(fleet_mismatches)
+        row["verdict_ok"] = not mismatches
+    if args.fuse:
+        fuse_row, fuse_mismatches = run_fuse_phase(args, record)
+        row.update(fuse_row)
+        mismatches.extend(fuse_mismatches)
         row["verdict_ok"] = not mismatches
     for m in mismatches:
         print(f"SERVE PARITY MISMATCH: {m}", file=sys.stderr)

@@ -485,7 +485,13 @@ class _WatchedNativeOracle:
             except BaseException as exc:  # noqa: BLE001
                 holder["exc"] = exc
 
-        worker = threading.Thread(target=work, name="qi-native-watchdog")
+        # daemon=False is EXPLICIT, not the default-by-inheritance: the
+        # fused serve drain calls this rung from daemon worker threads,
+        # and a daemon watchdog hard-killed mid-native-call at interpreter
+        # exit aborts the process.
+        worker = threading.Thread(
+            target=work, name="qi-native-watchdog", daemon=False
+        )
         worker.start()
         deadline = time.monotonic() + self._watchdog_s
         grace_deadline: Optional[float] = None
@@ -607,6 +613,10 @@ def _platform_sweep_limit() -> int:
 
 class AutoBackend:
     name = "auto"
+    # qi-fuse: the batch entry accepts per-job cancel tokens and origins —
+    # the fused serve drain hands work from different requests to one
+    # check_sccs call, each job retiring on its own request's deadline.
+    supports_job_cancels = True
 
     def __init__(
         self,
@@ -865,7 +875,13 @@ class AutoBackend:
         # that environment already hangs the sequential router's post-burn
         # probe on the MAIN thread — `--no-race` (or JAX_PLATFORMS=cpu,
         # utils/platform.py) is the documented way out either way.
-        worker = threading.Thread(target=sweep_worker, name="qi-race-sweep")
+        # daemon=False must be EXPLICIT: Thread daemonness is inherited
+        # from the spawning thread, and the fused serve drain races from
+        # daemon worker threads — an inherited-daemon sweep hard-killed
+        # inside XLA at exit is exactly the abort described above.
+        worker = threading.Thread(
+            target=sweep_worker, name="qi-race-sweep", daemon=False
+        )
         worker.start()
 
         oracle_res = None
@@ -1139,6 +1155,8 @@ class AutoBackend:
         jobs: Sequence[Tuple[TrustGraph, Optional[Circuit], List[int]]],
         *,
         scope_to_scc: bool = False,
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> List[SccCheckResult]:
         """Batch entry (``pipeline.check_many``): route many SCC problems
         at once, fusing sweep-sized ones into lane packs.
@@ -1217,7 +1235,15 @@ class AutoBackend:
                     reason=f"lane-packed batch of {len(packable)} jobs",
                 )
                 return sweep.check_sccs(
-                    [jobs[i] for i in packable], scope_to_scc=scope_to_scc
+                    [jobs[i] for i in packable], scope_to_scc=scope_to_scc,
+                    cancels=(
+                        [cancels[i] for i in packable]
+                        if cancels is not None else None
+                    ),
+                    origins=(
+                        [origins[i] for i in packable]
+                        if origins is not None else None
+                    ),
                 )
 
             try:
@@ -1233,6 +1259,26 @@ class AutoBackend:
                 )
         for i, (graph, circuit, scc) in enumerate(jobs):
             if results[i] is None:
+                tok = cancels[i] if cancels is not None else None
+                if tok is not None and tok.cancelled:
+                    # qi-fuse: the request behind this leftover job is
+                    # already dead — book its whole window space as
+                    # cancelled coverage instead of burning an engine on a
+                    # verdict nobody will read.
+                    total = 1 << max(len(scc) - 1, 0)
+                    rec.add("cert.windows_cancelled", total)
+                    results[i] = SccCheckResult(intersects=False, stats={
+                        "backend": self.name, "cancelled": True,
+                        "candidates_checked": 0, "enumeration_total": total,
+                        "cert": {
+                            "window_space": total,
+                            "windows_enumerated": 0,
+                            "windows_pruned_guard": 0,
+                            "windows_skipped_pack_fill": 0,
+                            "windows_cancelled": total,
+                        },
+                    })
+                    continue
                 # A job whose budget already burned above must not re-burn
                 # it in the per-problem route (gate-dropped or packed-rung
                 # failure): _budget_burned skips straight to the post-burn

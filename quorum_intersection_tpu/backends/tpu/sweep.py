@@ -38,6 +38,7 @@ import numpy as np
 
 from quorum_intersection_tpu.backends.base import (
     INT32_MAX,
+    CancelToken,
     SccCheckResult,
     SearchCancelled,
 )
@@ -366,6 +367,11 @@ class _SweepJob:
     resolved: bool = False
     intersects: Optional[bool] = None
     result: Optional[SccCheckResult] = None
+    # qi-fuse: a per-job cancel (this request's deadline/client abort)
+    # retired the job's lane groups mid-pack; the unswept remainder is
+    # CANCELLED coverage on this job's ledger only.
+    cancelled: bool = False
+    cancelled_windows: int = 0
     # Rank-order provenance (ISSUE 10): stamped into the job's stats/cert
     # when the enumeration order was permuted.
     order_meta: Optional[Dict[str, object]] = None
@@ -420,6 +426,10 @@ class TpuSweepBackend:
 
     name = "tpu-sweep"
     needs_circuit = True
+    # qi-fuse: check_sccs accepts per-job cancel tokens and origins — a
+    # fused batch former may hand work from different requests to one
+    # call, each lane group retiring on its own request's deadline.
+    supports_job_cancels = True
 
     def __init__(
         self,
@@ -1362,6 +1372,8 @@ class TpuSweepBackend:
         jobs: Sequence[Tuple[TrustGraph, Optional[Circuit], List[int]]],
         *,
         scope_to_scc: bool = False,
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> List[SccCheckResult]:
         """Batched multi-problem sweep with LANE PACKING: K independent
         problems fuse into one block-diagonal circuit whose padded lane
@@ -1397,6 +1409,11 @@ class TpuSweepBackend:
             for i, (graph, circuit, scc) in enumerate(jobs):
                 if len(scc) - 1 > min(self.lo_bits, LO_BITS):
                     continue  # wide two-level enumerations stay unpacked
+                if (
+                    cancels is not None and cancels[i] is not None
+                    and cancels[i].cancelled
+                ):
+                    continue  # already dead: never let it occupy lanes
                 prepared[i] = self._prepare_job(graph, circuit, scc, scope_to_scc)
                 packable.append(i)
             if packable:
@@ -1414,18 +1431,66 @@ class TpuSweepBackend:
                     [prepared[i].circuit.n for i in packable]
                 ):
                     members = [prepared[packable[ix]] for ix in pack_ixs]
-                    self._run_pack(members)
+                    self._run_pack(
+                        members,
+                        cancels=(
+                            [cancels[packable[ix]] for ix in pack_ixs]
+                            if cancels is not None else None
+                        ),
+                        origins=(
+                            [origins[packable[ix]] for ix in pack_ixs]
+                            if origins is not None else None
+                        ),
+                    )
                     for ix in pack_ixs:
                         results[packable[ix]] = prepared[packable[ix]].result
         for i, (graph, circuit, scc) in enumerate(jobs):
             if results[i] is None:
-                results[i] = self.check_scc(
-                    graph, circuit, scc, scope_to_scc=scope_to_scc
-                )
+                tok = cancels[i] if cancels is not None else None
+                if tok is not None and tok.cancelled:
+                    # The request behind this leftover job is already dead
+                    # (deadline/client abort): never burn the NP-hard sweep
+                    # on it.  Its whole window space is CANCELLED coverage.
+                    results[i] = self._cancelled_result(scc)
+                else:
+                    results[i] = self.check_scc(
+                        graph, circuit, scc, scope_to_scc=scope_to_scc
+                    )
         return [res for res in results if res is not None]
 
-    def _run_pack(self, jobs: List[_SweepJob]) -> None:
-        """Sweep one pack of jobs to verdicts (stored on each job)."""
+    def _cancelled_result(self, scc: Sequence[int]) -> SccCheckResult:
+        """A per-job-cancelled job's result: no verdict claim, the full
+        window space booked as cancelled coverage (the ledger still sums
+        exactly: enumerated 0 + pruned 0 + skipped 0 + cancelled = 2^bits)."""
+        total = 1 << max(len(scc) - 1, 0)
+        get_run_record().add("cert.windows_cancelled", total)
+        return SccCheckResult(intersects=False, stats={
+            "backend": self.name,
+            "cancelled": True,
+            "candidates_checked": 0,
+            "enumeration_total": total,
+            "cert": {
+                "window_space": total,
+                "windows_enumerated": 0,
+                "windows_pruned_guard": 0,
+                "windows_skipped_pack_fill": 0,
+                "windows_cancelled": total,
+            },
+        })
+
+    def _run_pack(
+        self,
+        jobs: List[_SweepJob],
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Sweep one pack of jobs to verdicts (stored on each job).
+
+        ``cancels``/``origins`` (qi-fuse) are job-aligned: a tripped
+        per-job token retires THAT job's lane groups via the dead-lane
+        machinery (the remainder lands on its ledger as cancelled
+        coverage) without touching the co-packed jobs, and origins stamp
+        pack provenance per lane group (fuse.* telemetry)."""
         t0 = time.perf_counter()
         rec = get_run_record()
         n_jobs = len(jobs)
@@ -1484,7 +1549,13 @@ class TpuSweepBackend:
             for t in range(w):
                 groups.append(_PackGroup(job=j, lo=bounds[t], hi=bounds[t + 1]))
                 members.append((job.circuit, job.circuit_d))
-        packed = pack_circuits(members)
+        packed = pack_circuits(
+            members,
+            origins=(
+                [origins[g.job] for g in groups] if origins is not None
+                else None
+            ),
+        )
         pos, scc_mask, lane_group, group_ind = packed.decode_tables()
         k = packed.groups
 
@@ -1535,6 +1606,15 @@ class TpuSweepBackend:
             jobs=n_jobs, groups=k, slot=packed.slot, lanes=packed.circuit.n,
             fill_pct=round(packed.fill_pct, 2), engine=resolution.resolved,
         )
+        if origins is not None:
+            # qi-fuse provenance telemetry: how many verdict-bearing lanes
+            # this pack carries, and how many of them share a tile with a
+            # DIFFERENT request — the cross-request fusion meter.
+            rec.add("fuse.packs_formed")
+            rec.add("fuse.pack_lanes", sum(packed.sizes))
+            rec.gauge("fuse.fill_pct", round(packed.fill_pct, 2))
+            if packed.origin_count > 1:
+                rec.add("fuse.cross_request_lanes", sum(packed.sizes))
         log.debug(
             "packed sweep: %d jobs in %d lane groups (slot %d, %d lanes, "
             "%.1f%% fill, engine %s)",
@@ -1595,6 +1675,40 @@ class TpuSweepBackend:
                     f"packed sweep cancelled ({len(unresolved)} of "
                     f"{n_jobs} jobs unresolved)"
                 )
+
+        def retire_job(j: int) -> None:
+            """qi-fuse: THIS job's request died (its own deadline/client
+            abort) — freeze its lane groups via the dead-lane machinery and
+            book the unswept remainder as CANCELLED coverage on its ledger
+            alone.  Co-packed jobs keep sweeping; in-flight programs still
+            carry the dead lanes, but pos[] never advances past what was
+            actually drained, so the accounting stays exact."""
+            dropped = 0
+            for gix, g in enumerate(groups):
+                if g.job != j or g.done:
+                    continue
+                dropped += max(g.hi - pos[gix], 0) - pruned_in(
+                    j, pos[gix], g.hi
+                )
+                g.done = True
+            jobs[j].cancelled = True
+            jobs[j].cancelled_windows = dropped
+            jobs[j].resolved = True
+            unresolved.discard(j)
+            rec.add("cert.windows_cancelled", dropped)
+            rec.event(
+                "sweep.cancelled", packed=True,
+                windows_dropped=dropped,
+                jobs_unresolved=len(unresolved),
+            )
+
+        def check_job_cancels() -> None:
+            if cancels is None:
+                return
+            for j in list(unresolved):
+                tok = cancels[j]
+                if tok is not None and tok.cancelled:
+                    retire_job(j)
 
         def all_dispatched() -> bool:
             return all(
@@ -1691,6 +1805,9 @@ class TpuSweepBackend:
             ) as pack_span:
                 while unresolved:
                     check_cancel()
+                    check_job_cancels()
+                    if not unresolved:
+                        break
                     # Same injectable window boundary as the unpacked loop.
                     fault_point("sweep.window")
                     if not all_dispatched():
@@ -1763,7 +1880,9 @@ class TpuSweepBackend:
         # Same registry rule as the unpacked drive: only full-coverage
         # (no-hit) jobs speak for brute-force enumeration; a hit job's
         # retired pack-fill windows are early-exit savings, not pruning.
-        clean_jobs = [j for j in jobs if j.first_hit is None]
+        clean_jobs = [
+            j for j in jobs if j.first_hit is None and not j.cancelled
+        ]
         enum_all = sum(j.candidates for j in clean_jobs)
         total_all = sum(j.total for j in clean_jobs)
         if total_all:
@@ -1806,6 +1925,17 @@ class TpuSweepBackend:
                 }
             if job.order_meta is not None:
                 stats["order"] = dict(job.order_meta)
+            if origins is not None:
+                stats["pack_origin"] = origins[jix]
+            if job.cancelled:
+                # qi-fuse: the request behind this job died mid-pack.  Its
+                # ledger keeps the exact partition (enumerated before death
+                # + pruned + skipped + cancelled == window space); no
+                # verdict, no witness recheck.
+                stats["cancelled"] = True
+                stats["cert"]["windows_cancelled"] = job.cancelled_windows
+                job.result = SccCheckResult(intersects=False, stats=stats)
+                continue
             if job.first_hit is None:
                 job.result = SccCheckResult(intersects=True, stats=stats)
                 continue

@@ -54,9 +54,10 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Callable, Dict, List, Optional, Set, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from quorum_intersection_tpu.backends.base import (
+    CancelToken,
     SearchBackend,
     SearchCancelled,
 )
@@ -638,6 +639,8 @@ class DeltaEngine:
         *,
         backend: Union[str, SearchBackend] = "auto",
         pack: Optional[bool] = None,
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> List[SolveResult]:
         """Batch verdicts for ``sources``, reusing per-SCC work.
 
@@ -656,16 +659,18 @@ class DeltaEngine:
             fault_point("delta.diff")
         except (FaultInjected, OSError) as exc:
             rec.add("delta.diff_faults")
-            return self._degrade(sources, backend, pack, exc)
+            return self._degrade(sources, backend, pack, exc, cancels, origins)
         try:
-            return self._check_many_incremental(sources, backend, pack)
+            return self._check_many_incremental(
+                sources, backend, pack, cancels, origins
+            )
         except SearchCancelled:
             raise
         except Exception as exc:  # noqa: BLE001 — any differ/store failure
             # degrades to the full chain (docs/ROBUSTNESS.md contract);
             # the verdict must never depend on the optimization working.
             rec.add("delta.errors")
-            return self._degrade(sources, backend, pack, exc)
+            return self._degrade(sources, backend, pack, exc, cancels, origins)
 
     def _degrade(
         self,
@@ -673,6 +678,8 @@ class DeltaEngine:
         backend: Union[str, SearchBackend],
         pack: Optional[bool],
         exc: BaseException,
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> List[SolveResult]:
         rec = get_run_record()
         rec.event("delta.degraded", error=str(exc))
@@ -682,7 +689,7 @@ class DeltaEngine:
         return check_many(
             sources, backend=backend, dangling=self.dangling,
             scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
-            pack=pack,
+            pack=pack, cancels=cancels, origins=origins,
         )
 
     def _check_many_incremental(
@@ -690,6 +697,8 @@ class DeltaEngine:
         sources: List[object],
         backend: Union[str, SearchBackend],
         pack: Optional[bool],
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> List[SolveResult]:
         rec = get_run_record()
         allow_native = backend_name(backend) != "python"
@@ -727,7 +736,10 @@ class DeltaEngine:
                         held.add(st.target_fp)
                         misses.append(st)
                 if misses:
-                    self._solve_misses(misses, results, backend, pack, held)
+                    self._solve_misses(
+                        misses, results, backend, pack, held,
+                        cancels=cancels, origins=origins,
+                    )
                 for st in followers:
                     cached = self.store.peek_verdict(
                         st.target_fp, self.scope_to_scc
@@ -748,7 +760,10 @@ class DeltaEngine:
                     # witness escaped the SCC): solve the stragglers
                     # directly — correctness over reuse.
                     strag = [st for st in followers if results[st.ix] is None]
-                    self._solve_misses(strag, results, backend, pack, set())
+                    self._solve_misses(
+                        strag, results, backend, pack, set(),
+                        cancels=cancels, origins=origins,
+                    )
             finally:
                 # Any lease still held here (an exception mid-batch, a
                 # deadline cancel inside the backend solve) is released as
@@ -958,10 +973,16 @@ class DeltaEngine:
         backend: Union[str, SearchBackend],
         pack: Optional[bool],
         held: Set[str],
+        cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+        origins: Optional[Sequence[str]] = None,
     ) -> None:
         """Send the dirty/new target SCCs to the real backend (one batched
         ``check_many`` call — lane packing and the ladder apply as ever),
-        then bank each solved fragment and release its lease."""
+        then bank each solved fragment and release its lease.
+
+        ``cancels``/``origins`` (qi-fuse) are SOURCE-aligned on the outer
+        batch; only the miss subset rides along (``st.ix``) — which is the
+        fusion win: delta-reused SCCs never occupy lanes."""
         rec = get_run_record()
         rec.add("delta.solves", len(misses))
         # The classification prefix already scanned every one of these
@@ -990,6 +1011,14 @@ class DeltaEngine:
                 "resolved_sccs": 1,
             },
             scan=store_scan,
+            cancels=(
+                [cancels[st.ix] for st in misses]
+                if cancels is not None else None
+            ),
+            origins=(
+                [origins[st.ix] for st in misses]
+                if origins is not None else None
+            ),
         )
         for st, res in zip(misses, solved):
             results[st.ix] = res
@@ -1005,6 +1034,15 @@ class DeltaEngine:
         whole-graph availability, a guard flip mid-flight, or a witness
         that escaped the component."""
         publishable: Optional[SccVerdict] = None
+        if res.stats.get("cancelled"):
+            # qi-fuse: a retired lane's partial coverage is NOT a verdict —
+            # banking it would serve a non-answer to every future match.
+            if st.target_fp in held:
+                held.discard(st.target_fp)
+                self.store.publish_verdict(
+                    st.target_fp, self.scope_to_scc, None
+                )
+            return
         if st.cacheable and res.stats.get("reason") != "scc_guard":
             q1_local = localize(res.q1, st.target_scc)
             q2_local = localize(res.q2, st.target_scc)

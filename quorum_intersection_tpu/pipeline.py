@@ -18,10 +18,15 @@ from __future__ import annotations
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, TextIO, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, TextIO, Tuple, Union
 
-from quorum_intersection_tpu.backends.base import SearchBackend, get_backend
-from quorum_intersection_tpu.cert import build_certificate
+from quorum_intersection_tpu.backends.base import (
+    CancelToken,
+    SccCheckResult,
+    SearchBackend,
+    get_backend,
+)
+from quorum_intersection_tpu.cert import CERT_SCHEMA, build_certificate
 from quorum_intersection_tpu.encode.circuit import Circuit, encode_circuit
 from quorum_intersection_tpu.fbas.graph import TrustGraph, build_graph, group_sccs, tarjan_scc
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
@@ -310,6 +315,8 @@ def check_many(
     pack: Optional[bool] = None,
     delta: Optional[Dict[str, object]] = None,
     scan: Optional[Callable[..., List[Optional[List[int]]]]] = None,
+    cancels: Optional[Sequence[Optional[CancelToken]]] = None,
+    origins: Optional[Sequence[str]] = None,
 ) -> List[SolveResult]:
     """Batch entry point (ISSUE 5): decide quorum intersection for MANY
     FBAS sources in one call — the shape heavy multi-snapshot traffic
@@ -337,6 +344,14 @@ def check_many(
     substitutes the per-SCC scan provider (see :func:`_classify_sccs`) —
     the same engine passes its verdict-store-aware one so the re-solve leg
     still reuses every fingerprint-matched SCC's cached scan.
+
+    ``cancels``/``origins`` (qi-fuse) are source-aligned: when the backend
+    declares ``supports_job_cancels`` they ride into its batch entry so a
+    fused pack can retire one request's lanes on that request's own
+    deadline while its co-packed sources keep sweeping.  A cancelled
+    source comes back as a PARTIAL result (``stats["cancelled"]``, no
+    verdict-bearing certificate — just the exact cancelled-coverage
+    ledger); callers route it as a deadline miss, never as a verdict.
     """
     caller_backend = not isinstance(backend, str)
     if isinstance(backend, str):
@@ -421,16 +436,58 @@ def check_many(
                 "pipeline.check_many", sources=len(sources), jobs=len(jobs),
                 batched=batch is not None,
             ):
-                if batch is not None:
+                job_cancels = (
+                    [cancels[ix] for ix, _, _, _ in jobs]
+                    if cancels is not None else None
+                )
+                if batch is not None and (
+                    job_cancels is not None or origins is not None
+                ) and getattr(backend, "supports_job_cancels", False):
+                    scc_results = batch(
+                        [(g, c, s) for _, g, c, s in jobs],
+                        scope_to_scc=scope_to_scc,
+                        cancels=job_cancels,
+                        origins=(
+                            [origins[ix] for ix, _, _, _ in jobs]
+                            if origins is not None else None
+                        ),
+                    )
+                elif batch is not None:
                     scc_results = batch(
                         [(g, c, s) for _, g, c, s in jobs],
                         scope_to_scc=scope_to_scc,
                     )
                 else:
-                    scc_results = [
-                        backend.check_scc(g, c, s, scope_to_scc=scope_to_scc)
-                        for _, g, c, s in jobs
-                    ]
+                    scc_results = []
+                    for jx, (_, g, c, s) in enumerate(jobs):
+                        tok = (
+                            job_cancels[jx] if job_cancels is not None
+                            else None
+                        )
+                        if tok is not None and tok.cancelled:
+                            # qi-fuse: dead request — book the whole window
+                            # space as cancelled coverage instead of solving.
+                            total = 1 << max(len(s) - 1, 0)
+                            rec.add("cert.windows_cancelled", total)
+                            scc_results.append(SccCheckResult(
+                                intersects=False, stats={
+                                    "backend": getattr(backend, "name", "?"),
+                                    "cancelled": True,
+                                    "candidates_checked": 0,
+                                    "enumeration_total": total,
+                                    "cert": {
+                                        "window_space": total,
+                                        "windows_enumerated": 0,
+                                        "windows_pruned_guard": 0,
+                                        "windows_skipped_pack_fill": 0,
+                                        "windows_cancelled": total,
+                                    },
+                                },
+                            ))
+                            continue
+                        scc_results.append(backend.check_scc(
+                            g, c, s, scope_to_scc=scope_to_scc
+                        ))
             search_s = time.perf_counter() - t_search
             batch_events = rec.events_since(batch_ev0)
             for (ix, graph, _, target_scc), res in zip(jobs, scc_results):
@@ -442,6 +499,26 @@ def check_many(
                 # instead of a silently absent one.
                 timer_summary = dict(timer_summary)
                 timer_summary["search"] = search_s
+                if res.stats.get("cancelled"):
+                    # qi-fuse: the request behind this source died mid-
+                    # batch.  No verdict is claimed — the "cert" is an
+                    # explicitly PARTIAL coverage record (the exact
+                    # cancelled ledger), never a qi-cert verdict document,
+                    # so nothing downstream can mistake it for one.
+                    results[ix] = SolveResult(
+                        intersects=res.intersects, n_sccs=count,
+                        quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,
+                        q1=None, q2=None, stats=dict(res.stats),
+                        timers=timer_summary,
+                        cert={
+                            "schema": CERT_SCHEMA,
+                            "partial": True,
+                            "verdict": None,
+                            "reason": "cancelled",
+                            "coverage": dict(res.stats.get("cert", {})),
+                        },
+                    )
+                    continue
                 results[ix] = SolveResult(
                     intersects=res.intersects, n_sccs=count,
                     quorum_scc_ids=quorum_scc_ids, main_scc=main_scc,

@@ -616,6 +616,15 @@ class QueryEngine:
         ]
         rec.add("query.whatif_variants", len(variants))
         results = run(variants)
+        if any(res.stats.get("cancelled") for res in results):
+            # qi-fuse belt-and-braces: a fused ``run`` raises before we
+            # ever see a lane-retired variant, but NO caller contract may
+            # let partial coverage masquerade as a what-if verdict row.
+            from quorum_intersection_tpu.backends.base import SearchCancelled
+
+            raise SearchCancelled(
+                "what-if variants cancelled mid-solve (request deadline)"
+            )
         rows: List[Dict[str, object]] = []
         minimal_failing: Optional[List[str]] = None
         failing_cert: Optional[Dict[str, object]] = None

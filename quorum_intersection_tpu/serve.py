@@ -84,6 +84,7 @@ from quorum_intersection_tpu.delta import (
 )
 from quorum_intersection_tpu.fbas.graph import IndexedQSet, TrustGraph, build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, QSet, parse_fbas
+from quorum_intersection_tpu.fuse import BatchFormer
 from quorum_intersection_tpu.pipeline import SolveResult, check_many
 from quorum_intersection_tpu.query import (
     Query,
@@ -576,6 +577,7 @@ class ServeEngine:
         pack: Optional[bool] = None,
         delta: Optional[bool] = None,
         shared_store: Optional[SharedSccStore] = None,
+        fuse_window_ms: Optional[float] = None,
     ) -> None:
         self.backend = backend
         self.queue_depth = (
@@ -604,6 +606,14 @@ class ServeEngine:
         self.scc_select = scc_select
         self.scope_to_scc = scope_to_scc
         self.pack = pack
+        # Cross-request pack fusion (qi-fuse, ISSUE 16): while positive,
+        # the drain runs each popped entry in its own worker and a shared
+        # BatchFormer merges their window work into one lane-packed solve;
+        # 0 (the default) keeps the byte-compatible legacy drain.
+        self.fuse_window_ms = (
+            fuse_window_ms if fuse_window_ms is not None
+            else qi_env_float("QI_SERVE_FUSE_WINDOW_MS", 0.0)
+        )
         # Incremental re-analysis (qi-delta, ISSUE 9): the drain consults
         # the per-SCC verdict store BEFORE check_many, so a churn step that
         # leaves the quorum-bearing SCC structurally unchanged composes its
@@ -1052,6 +1062,17 @@ class ServeEngine:
             per_request = True
             rec.add("serve.drain_faults")
             rec.event("serve.drain_degraded", error=str(exc))
+        fuse_window = self.fuse_window_ms if not per_request else 0.0
+        if fuse_window > 0:
+            try:
+                fault_point("serve.fuse")
+            except (FaultInjected, OSError) as exc:
+                # Same discipline one layer up: fusion is an optimization,
+                # never a precondition for a verdict — a broken batch
+                # former degrades THIS batch in place to the unfused path.
+                fuse_window = 0.0
+                rec.add("serve.fuse_faults")
+                rec.event("serve.fuse_degraded", error=str(exc))
         live = self._partition_expired(batch, time.monotonic())
         if not live:
             return
@@ -1071,6 +1092,9 @@ class ServeEngine:
         # supervisor as the intersection batch they drained with.
         q_live = [e for e in live if e.query.kind != "intersection"]
         live = [e for e in live if e.query.kind == "intersection"]
+        if fuse_window > 0:
+            self._drain_batch_fused(live, q_live, fuse_window)
+            return
         deadlines = [
             t.deadline_t for e in (live + q_live) for t in e.waiters
             if t.deadline_t is not None
@@ -1106,6 +1130,195 @@ class ServeEngine:
             finally:
                 if timer is not None:
                     timer.cancel()
+
+    # ---- fused drain (qi-fuse, ISSUE 16) ---------------------------------
+
+    def _drain_batch_fused(
+        self, live: List[_Entry], q_live: List[_Entry], window_ms: float
+    ) -> None:
+        """Fleet-aware drain: one worker per entry, one shared
+        :class:`~.fuse.BatchFormer` merging every worker's window work —
+        plain intersection SCCs and what-if variants alike — into shared
+        lane packs (dispatching on tile-full, all-waiting, or the
+        deadline-aware ``window_ms`` timer).
+
+        Each entry keeps its OWN CancelToken + deadline supervisor: a
+        tripped token retires that request's lane groups mid-pack via the
+        sweep's dead-lane machinery while co-packed entries keep their
+        full-coverage certs; verdicts and certs stay byte-identical per
+        request to the unfused path (docs/PARITY.md §Fusion invariants)."""
+        rec = get_run_record()
+        entries = live + q_live
+        counters0, _ = rec.snapshot()
+        former = BatchFormer(self._fused_check_many, window_ms=window_ms)
+        with rec.span(
+            "serve.batch", requests=len(entries),
+            waiters=sum(len(e.waiters) for e in entries),
+            per_request=False, queries=len(q_live), fused=True,
+        ):
+            timers: List[threading.Timer] = []
+            threads: List[threading.Thread] = []
+            try:
+                for entry in entries:
+                    cancel = CancelToken()
+                    deadline_t = min(
+                        (
+                            t.deadline_t for t in entry.waiters
+                            if t.deadline_t is not None
+                        ),
+                        default=None,
+                    )
+                    if deadline_t is not None:
+                        # qi-lint: allow(cancel-token-plumbed) — this Timer
+                        # IS the per-entry deadline supervisor: its whole
+                        # job is to trip the entry's CancelToken; the
+                        # finally below disarms it.
+                        timer = threading.Timer(
+                            max(deadline_t - time.monotonic(), 0.001),
+                            cancel.cancel,
+                        )
+                        timer.daemon = True
+                        timer.start()
+                        timers.append(timer)
+                    former.register()
+                    # qi-lint: allow(cancel-token-plumbed) — each worker
+                    # carries its entry's own cancel token (argument 3).
+                    worker = threading.Thread(
+                        target=self._fuse_worker,
+                        args=(entry, former, cancel, deadline_t, counters0),
+                        name=f"qi-fuse-{entry.request_id}",
+                        daemon=True,
+                    )
+                    threads.append(worker)
+                for worker in threads:
+                    worker.start()
+            finally:
+                for worker in threads:
+                    worker.join()
+                for timer in timers:
+                    timer.cancel()
+
+    def _fuse_worker(
+        self,
+        entry: _Entry,
+        former: BatchFormer,
+        cancel: CancelToken,
+        deadline_t: Optional[float],
+        counters0: Dict[str, float],
+    ) -> None:
+        """Solve ONE drained entry through the shared batch former; every
+        outcome is delivered exactly as the legacy drain would — typed
+        errors, deadline partials with requeue, or the verdict."""
+        rec = get_run_record()
+        run = self._run_check_many(
+            former=former, origin=entry.request_id, cancel=cancel,
+            deadline_t=deadline_t,
+        )
+        t0 = time.perf_counter()
+        try:
+            try:
+                with rec.adopted(entry.trace_ctx()), rec.span(
+                    "serve.solve", requests=1, fused=True,
+                    delta=self._delta is not None,
+                    query=entry.query.kind,
+                ):
+                    if entry.query.kind == "intersection":
+                        # Direct submit (not ``run``): a lane-retired
+                        # result must come back AS a result here so its
+                        # exact per-request ledger rides the deadline
+                        # outcome below, not the raising wrapper the query
+                        # resolver needs.
+                        res: Union[SolveResult, QueryResult] = former.submit(
+                            [entry.fbas], origin=entry.request_id,
+                            cancel=cancel, deadline_t=deadline_t,
+                        )[0]
+                    else:
+                        res = self._query_engine.resolve(
+                            entry.nodes, entry.query, check_many_fn=run,
+                            cancel=cancel,
+                        )
+            finally:
+                former.done()
+        except SearchCancelled:
+            self._after_deadline_cancel([entry], counters0)
+            return
+        except QueryError as exc:
+            self._resolve_err(entry, exc, outcome="error")
+            return
+        except Exception as exc:  # noqa: BLE001 — one bad request must not starve the rest
+            rec.add("serve.drain_errors")
+            self._resolve_err(entry, exc, outcome="error")
+            return
+        if res.stats.get("cancelled"):
+            # The entry's own deadline retired its lanes mid-pack: its
+            # PARTIAL coverage cert (the exact per-request ledger, not the
+            # legacy batch-level counter diff) rides the deadline outcome;
+            # survivors requeue exactly as the legacy path.
+            self._after_deadline_cancel(
+                [entry], counters0,
+                partial_override=getattr(res, "cert", None),
+            )
+            return
+        self._note_solve([entry], (time.perf_counter() - t0) * 1000.0)
+        self._deliver_ok(entry, res)
+
+    def _fused_check_many(
+        self,
+        sources: List[Fbas],
+        cancels: List[Optional[CancelToken]],
+        origins: List[str],
+    ) -> List[SolveResult]:
+        """The batch former's flush target: the drain's usual delta-aware
+        chain with per-source cancels/origins riding down to the lane
+        packer (pipeline → check_sccs → the sweep's per-group ownership)."""
+        backend = self._make_backend(None)
+        if self._delta is not None:
+            return self._delta.check_many(
+                sources, backend=backend, pack=self.pack,
+                cancels=cancels, origins=origins,
+            )
+        return check_many(
+            sources, backend=backend, dangling=self.dangling,
+            scc_select=self.scc_select, scope_to_scc=self.scope_to_scc,
+            pack=self.pack, cancels=cancels, origins=origins,
+        )
+
+    def _run_check_many(
+        self,
+        backend: Optional[SearchBackend] = None,
+        *,
+        former: Optional[BatchFormer] = None,
+        origin: str = "",
+        cancel: Optional[CancelToken] = None,
+        deadline_t: Optional[float] = None,
+    ) -> Callable[[List[Fbas]], List[SolveResult]]:
+        """The ONE place every serve-side ``check_many`` closure is built
+        (drain queries, fused workers, journal replay): unfused callers
+        pass a ``backend`` and get the delta-aware chain; fused callers
+        pass the shared ``former`` and their work joins cross-request
+        packs.  A fused result that came back lane-retired raises
+        ``SearchCancelled`` — the uniform deadline outcome — so no caller
+        can mistake partial coverage for a verdict."""
+        if former is not None:
+            def run(sources: List[Fbas]) -> List[SolveResult]:
+                results = former.submit(
+                    sources, origin=origin, cancel=cancel,
+                    deadline_t=deadline_t,
+                )
+                for res in results:
+                    if res.stats.get("cancelled"):
+                        raise SearchCancelled(
+                            f"fused lanes retired by request {origin}'s "
+                            f"deadline"
+                        )
+                return results
+            return run
+
+        def run_backend(
+            sources: List[Fbas], _backend: Optional[SearchBackend] = backend,
+        ) -> List[SolveResult]:
+            return self._check_many(sources, _backend)
+        return run_backend
 
     def _solve_batch(
         self,
@@ -1205,11 +1418,7 @@ class ServeEngine:
                 self._after_deadline_cancel(entries[ix:], counters0)
                 return
             backend = self._make_backend(cancel)
-
-            def run(sources: List[Fbas],
-                    _backend: SearchBackend = backend) -> List[SolveResult]:
-                return self._check_many(sources, _backend)
-
+            run = self._run_check_many(backend)
             t0 = time.perf_counter()
             try:
                 with rec.adopted(entry.trace_ctx()), rec.span(
@@ -1233,15 +1442,22 @@ class ServeEngine:
             self._deliver_ok(entry, qres)
 
     def _after_deadline_cancel(
-        self, entries: List[_Entry], counters0: Dict[str, float]
+        self,
+        entries: List[_Entry],
+        counters0: Dict[str, float],
+        partial_override: Optional[Dict[str, object]] = None,
     ) -> None:
         """The deadline supervisor tripped the CancelToken mid-solve:
         expired waiters get DeadlineExceeded with the partial-coverage
         certificate; survivors requeue for a fresh solve (bounded by
-        MAX_SOLVE_ATTEMPTS)."""
+        MAX_SOLVE_ATTEMPTS).
+
+        ``partial_override`` (qi-fuse): the fused drain already holds the
+        cancelled request's OWN exact coverage ledger — it replaces the
+        legacy batch-level counter diff below."""
         rec = get_run_record()
         counters1, _ = rec.snapshot()
-        partial = {
+        partial = partial_override if partial_override is not None else {
             "schema": CERT_SCHEMA,
             "verdict": None,
             "partial": True,
@@ -1620,13 +1836,7 @@ class ServeEngine:
             for p in q_pending:
                 rid = str(p["entry"].get("request_id"))
                 fp = str(p["fingerprint"])
-                backend = self._make_backend(None)
-
-                def run(sources: List[Fbas],
-                        _backend: SearchBackend = backend,
-                        ) -> List[SolveResult]:
-                    return self._check_many(sources, _backend)
-
+                run = self._run_check_many(self._make_backend(None))
                 replay_ctx = (
                     TraceContext.from_env(p["trace"])  # type: ignore[arg-type]
                     if p["trace"] else None

@@ -314,6 +314,16 @@ QI_SERVE_JOURNAL = _declare(
     "with no lost or duplicated verdicts.  Empty (default): journaling "
     "off (the CLI serve subcommand's --journal flag sets it explicitly).",
 )
+QI_SERVE_FUSE_WINDOW_MS = _declare(
+    "QI_SERVE_FUSE_WINDOW_MS", "0",
+    "Cross-request pack-fusion window in milliseconds (serve.py qi-fuse): "
+    "while positive, the drain accumulates window work from DIFFERENT "
+    "requests — intersection SCCs, what-if variants — into one shared "
+    "batch former (fuse.py BatchFormer) and dispatches when the estimated "
+    "lane tile fills or this deadline-aware timer fires, so mixed traffic "
+    "fills compiled MXU tiles instead of dispatching partial packs per "
+    "request.  0 (default): fusion off, the byte-compatible legacy drain.",
+)
 
 
 # ---- reads -----------------------------------------------------------------

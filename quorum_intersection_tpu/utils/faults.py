@@ -231,6 +231,16 @@ SERVE_RESPOND = _declare(
     "a silent drop) while the verdict itself is already cached and "
     "journal-marked done, so a retry is a cache hit.",
 )
+SERVE_FUSE = _declare(
+    "serve.fuse",
+    "Cross-request batch-former setup in the drain (serve.py _drain_batch, "
+    "fired once per drained batch while QI_SERVE_FUSE_WINDOW_MS is "
+    "positive, before any fused dispatch): error simulates a broken "
+    "former — the batch degrades in place to the unfused per-batch path "
+    "(serve.fuse_faults counter + serve.fuse_degraded event), verdicts "
+    "unchanged; fusion is an optimization, never a precondition for a "
+    "verdict.",
+)
 DELTA_DIFF = _declare(
     "delta.diff",
     "Snapshot diff / SCC-fingerprint path of the incremental re-analysis "

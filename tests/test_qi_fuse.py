@@ -1,0 +1,415 @@
+"""qi-fuse (ISSUE 16): cross-request pack fusion at the serve drain.
+
+Acceptance, per ISSUE 16:
+
+- fused-vs-unfused per-request parity: verdict/witness/cert byte-identical
+  (modulo run provenance) across both vendored fixture pairs and mixed
+  query kinds, every fused cert revalidated by the independent checker;
+- a mid-pack cancel (one request's deadline) retires ONLY that request's
+  lane groups: its ledger books the unswept remainder exactly while the
+  co-packed request keeps a full-coverage cert;
+- the ``serve.fuse`` fault point degrades in place to the unfused path,
+  never flipping a verdict;
+- ``BatchFormer`` flush accounting: full / drain / timer reasons land in
+  ``flush_log`` in order;
+- the forced ``fuse_flush_races_late_submit`` interleaving
+  (tools/analyze/schedules.py) passes on both topologies.
+"""
+
+import copy
+import json
+import threading
+import time
+
+import pytest
+
+from quorum_intersection_tpu.backends.base import CancelToken
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.pipeline import check_many, solve
+from quorum_intersection_tpu.serve import DeadlineExceeded, ServeEngine
+from quorum_intersection_tpu.utils import faults, telemetry
+import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+import quorum_intersection_tpu.fuse as fuse_mod
+from quorum_intersection_tpu.fuse import BatchFormer, estimate_lanes
+from tools.check_cert import check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+FUSE_MS = 50.0
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+class _Engine:
+    """Context manager: a started ServeEngine that always stops."""
+
+    def __init__(self, **kw):
+        self.engine = ServeEngine(**kw)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=30.0)
+        return False
+
+
+def normalized(cert):
+    """A cert with the run-volatile provenance block dropped: everything
+    load-bearing — verdict, witness, graph digest, guard, ledgers — must
+    be byte-identical between the fused and unfused paths."""
+    out = copy.deepcopy(cert)
+    out.pop("provenance", None)
+    return out
+
+
+def serve_one(nodes, *, fuse, query=None, **kw):
+    with _Engine(
+        backend=kw.pop("backend", "python"),
+        fuse_window_ms=(FUSE_MS if fuse else 0.0), **kw,
+    ) as engine:
+        return engine.submit(nodes, query=query).result(timeout=120.0)
+
+
+class TestFusedParity:
+    """Per-request byte-parity: the fused drain is invisible in results."""
+
+    @pytest.mark.parametrize("fixture,verdict", FIXTURE_PAIRS)
+    def test_fixture_pairs_byte_identical(self, rec, fixture, verdict):
+        nodes = fixture_nodes(fixture)
+        plain = serve_one(nodes, fuse=False)
+        fused = serve_one(nodes, fuse=True)
+        assert plain.intersects is verdict
+        assert fused.intersects is verdict
+        assert json.dumps(normalized(fused.cert), sort_keys=True) == \
+            json.dumps(normalized(plain.cert), sort_keys=True)
+        # The independent checker accepts the fused cert unmodified.
+        check_certificate(fused.cert, nodes)
+
+    def test_mixed_query_kinds_fused(self, rec):
+        """Intersection + whatif + relaxed queries drain through ONE fused
+        batch; every answer equals its unfused twin."""
+        nodes = majority_fbas(9)
+        ids = [n["publicKey"] for n in nodes]
+        queries = [
+            None,
+            {"kind": "whatif", "candidates": ids[:3], "max_k": 2},
+            {"kind": "relaxed", "family_b": majority_fbas(9, broken=True)},
+        ]
+        plain, fused = [], []
+        for fuse, out in ((False, plain), (True, fused)):
+            engine = ServeEngine(
+                backend="python", fuse_window_ms=(FUSE_MS if fuse else 0.0),
+            )
+            tickets = [engine.submit(nodes, query=q) for q in queries]
+            engine.start()  # queue before start: ONE drained batch
+            try:
+                out.extend(t.result(timeout=120.0) for t in tickets)
+            finally:
+                engine.stop(drain=True, timeout=30.0)
+        for p, f in zip(plain, fused):
+            assert f.intersects is p.intersects
+            assert f.result == p.result  # structured query payloads too
+        # The fused run actually flushed through the former.
+        events = [e for e in rec.events if e["name"] == "fuse.flush"]
+        assert events, "fused drain never flushed the batch former"
+
+    def test_cross_request_lanes_fill_one_tile(self, rec):
+        """Three sweep-sized requests from different clients fuse into one
+        lane pack: cross_request_lanes > 0, verdicts all one-shot-equal."""
+        streams = [majority_fbas(n) for n in (7, 9, 11)]
+        engine = ServeEngine(
+            backend="auto", pack=True, fuse_window_ms=200.0,
+        )
+        tickets = [engine.submit(s) for s in streams]  # queue before start:
+        engine.start()                                 # ONE drained batch
+        try:
+            got = [t.result(timeout=120.0) for t in tickets]
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        for stream, resp in zip(streams, got):
+            assert resp.intersects is True
+            assert resp.intersects is solve(
+                stream, backend="python"
+            ).intersects
+        counters, gauges = rec.snapshot()
+        assert counters.get("fuse.packs_formed", 0) > 0
+        assert counters.get("fuse.cross_request_lanes", 0) > 0
+        assert gauges.get("fuse.fill_pct", 0) > 0
+
+    def test_unset_window_is_byte_compatible_legacy_drain(self, rec):
+        """fuse_window_ms=0 (the QI_SERVE_FUSE_WINDOW_MS default): no
+        former, no fuse.* telemetry, no fused span attrs — the drain is
+        the pre-fusion code path."""
+        resp = serve_one(majority_fbas(7), fuse=False)
+        assert resp.intersects is True
+        counters, gauges = rec.snapshot()
+        assert not [k for k in counters if k.startswith("fuse.")]
+        assert not [k for k in gauges if k.startswith("fuse.")]
+        assert not [e for e in rec.events if e["name"].startswith("fuse.")]
+
+
+class TestMidPackCancel:
+    """One request's deadline retires ITS lanes; co-packed work survives
+    with full coverage (docs/PARITY.md §Fusion invariants)."""
+
+    def _trip_on_first_window(self, token):
+        """A sweep fault_point wrapper that cancels ``token`` at the FIRST
+        windows-loop iteration — the deterministic stand-in for a deadline
+        firing mid-pack."""
+        real = sweep_mod.fault_point
+        state = {"hits": 0}
+
+        def wrapper(point):
+            if point == "sweep.window":
+                state["hits"] += 1
+                if state["hits"] == 1:
+                    token.cancel()
+            return real(point)
+
+        return real, wrapper
+
+    def test_ledger_partition_exact(self, rec):
+        """check_many with per-job cancels: the cancelled job books its
+        unswept remainder, the co-packed job's ledger stays full, both sum
+        to 2^(n-1)."""
+        sources = [majority_fbas(13), majority_fbas(15)]
+        token = CancelToken()
+        real, wrapper = self._trip_on_first_window(token)
+        sweep_mod.fault_point = wrapper
+        try:
+            results = check_many(
+                sources, backend="auto", pack=True,
+                cancels=[token, None], origins=["req-dead", "req-live"],
+            )
+        finally:
+            sweep_mod.fault_point = real
+        dead, live = results
+        assert dead.stats.get("cancelled") is True
+        dead_cov = dead.cert["coverage"]
+        assert dead.cert["partial"] is True
+        assert dead.cert["verdict"] is None  # partial evidence, no verdict
+        assert dead_cov["windows_cancelled"] > 0
+        assert (
+            dead_cov["windows_enumerated"] + dead_cov["windows_pruned_guard"]
+            + dead_cov["windows_skipped_pack_fill"]
+            + dead_cov["windows_cancelled"]
+        ) == dead_cov["window_space"] == 2 ** (13 - 1)
+        assert live.intersects is True
+        assert not live.stats.get("cancelled")
+        live_cov = live.stats["cert"]
+        assert live_cov["windows_cancelled"] == 0
+        assert (
+            live_cov["windows_enumerated"] + live_cov["windows_pruned_guard"]
+            + live_cov["windows_skipped_pack_fill"]
+        ) == live_cov["window_space"] == 2 ** (15 - 1)
+
+    def test_pretripped_token_never_occupies_lanes(self, rec):
+        """A request already dead at dispatch is retired BEFORE packing:
+        its lanes go to live work, its ledger books everything cancelled."""
+        token = CancelToken()
+        token.cancel()
+        dead, live = check_many(
+            [majority_fbas(9), majority_fbas(11)], backend="auto", pack=True,
+            cancels=[token, None], origins=["req-dead", "req-live"],
+        )
+        assert dead.stats.get("cancelled") is True
+        cov = dead.cert["coverage"]
+        assert cov["windows_cancelled"] == cov["window_space"] == 2 ** 8
+        assert cov["windows_enumerated"] == 0
+        assert live.intersects is True
+
+    def test_serve_deadline_retires_lanes_copacked_cert_full(self, rec):
+        """Serve-level: a fused entry whose deadline fires mid-pack gets
+        DeadlineExceeded with ITS exact partial ledger; the co-packed
+        entry's verdict and checker-valid cert are untouched."""
+        real = sweep_mod.fault_point
+        state = {"hits": 0}
+
+        def slow_first_window(point):
+            if point == "sweep.window":
+                state["hits"] += 1
+                if state["hits"] == 1:
+                    time.sleep(1.2)  # outlive the 0.5 s deadline below
+            return real(point)
+
+        slow, fast = majority_fbas(13), majority_fbas(11)
+        engine = ServeEngine(backend="auto", pack=True, fuse_window_ms=200.0)
+        t_dead = engine.submit(slow, deadline_s=0.5)
+        t_live = engine.submit(fast)
+        sweep_mod.fault_point = slow_first_window
+        try:
+            engine.start()
+            live = t_live.result(timeout=120.0)
+            with pytest.raises(DeadlineExceeded) as err:
+                t_dead.result(timeout=120.0)
+        finally:
+            sweep_mod.fault_point = real
+            engine.stop(drain=True, timeout=30.0)
+        assert live.intersects is True
+        check_certificate(live.cert, fast)
+        partial = err.value.cert
+        assert partial is not None and partial["partial"] is True
+        cov = partial["coverage"]
+        assert cov["windows_cancelled"] > 0
+        assert (
+            cov["windows_enumerated"] + cov["windows_pruned_guard"]
+            + cov["windows_skipped_pack_fill"] + cov["windows_cancelled"]
+        ) == cov["window_space"] == 2 ** (13 - 1)
+
+
+class TestFuseFaultPoint:
+    def test_serve_fuse_fault_degrades_in_place(self, rec):
+        """serve.fuse=error: the batch drains unfused — right answers, a
+        counted degrade, zero former activity."""
+        faults.install_plan(faults.parse_faults("serve.fuse=error@1+"))
+        for fixture, verdict in FIXTURE_PAIRS:
+            nodes = fixture_nodes(fixture)
+            resp = serve_one(nodes, fuse=True)
+            assert resp.intersects is verdict
+            check_certificate(resp.cert, nodes)
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.fuse_faults", 0) >= len(FIXTURE_PAIRS)
+        assert counters.get("fuse.packs_formed", 0) == 0
+        assert [e for e in rec.events if e["name"] == "serve.fuse_degraded"]
+        assert not [e for e in rec.events if e["name"] == "fuse.flush"]
+
+
+class TestBatchFormerAccounting:
+    """Flush-reason accounting straight off the former, no engine."""
+
+    @staticmethod
+    def _fn(sources, cancels, origins):
+        return check_many(sources, backend="python")
+
+    def test_fill_flush_before_timer(self, rec):
+        """Two 9-node sources ladder to 16 lanes each: the second submit
+        fills a 32-lane tile and flushes NOW, not at the far timer."""
+        former = BatchFormer(self._fn, window_ms=60_000.0, lane_tile=32)
+        fbas = parse_fbas(majority_fbas(9))
+        assert estimate_lanes(fbas) == 16
+        former.register()
+        former.register()
+        outs = [None, None]
+
+        def worker(ix):
+            try:
+                outs[ix] = former.submit([fbas], origin=f"req-{ix}")
+            finally:
+                former.done()
+
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=worker, args=(ix,), daemon=True)
+            for ix in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert time.monotonic() - t0 < 30.0  # never waited for the timer
+        assert all(o is not None and o[0].intersects for o in outs)
+        assert former.flush_log and former.flush_log[0] in ("full", "drain")
+        assert sum(
+            1 for e in rec.events if e["name"] == "fuse.flush"
+        ) == len(former.flush_log)
+
+    def test_timer_flush_then_drain_flush(self, rec):
+        """With a registered producer still unsubmitted, only the window
+        timer can release the first unit; once that producer is the lone
+        submitter, drain releases it immediately."""
+        former = BatchFormer(self._fn, window_ms=120.0)
+        fbas = parse_fbas(majority_fbas(5))
+        former.register()  # p1
+        former.register()  # p2: not submitting yet — blocks "drain"
+        res1 = former.submit([fbas], origin="req-1")  # held until timer
+        former.done()
+        assert former.flush_log == ["timer"]
+        res2 = former.submit([fbas], origin="req-2")  # lone producer: drain
+        former.done()
+        assert former.flush_log == ["timer", "drain"]
+        assert res1[0].intersects is True
+        assert res2[0].intersects is True
+        flushes = [e for e in rec.events if e["name"] == "fuse.flush"]
+        assert [e["attrs"]["reason"] for e in flushes] == ["timer", "drain"]
+        assert all(e["attrs"]["units"] == 1 for e in flushes)
+
+    def test_deadline_beats_timer(self, rec):
+        """A pending unit's deadline earlier than the window timer flushes
+        with reason=deadline."""
+        former = BatchFormer(self._fn, window_ms=60_000.0)
+        fbas = parse_fbas(majority_fbas(5))
+        former.register()
+        former.register()  # a second producer blocks "drain"
+        res = former.submit(
+            [fbas], origin="req-1", deadline_t=time.monotonic() + 0.1,
+        )
+        former.done()
+        assert former.flush_log == ["deadline"]
+        assert res[0].intersects is True
+
+    def test_flush_failure_fans_out(self, rec):
+        def boom(sources, cancels, origins):
+            raise RuntimeError("flush exploded")
+
+        former = BatchFormer(boom, window_ms=10.0)
+        former.register()
+        with pytest.raises(RuntimeError, match="flush exploded"):
+            former.submit([parse_fbas(majority_fbas(5))], origin="req-1")
+        former.done()
+
+
+class TestForcedFuseSchedules:
+    """The flush-vs-late-submit interleaving, forced every run (the same
+    harness `python -m tools.analyze race` executes in CI)."""
+
+    @pytest.fixture(scope="class")
+    def results(self):
+        from tools.analyze.schedules import run_fuse_schedules
+
+        return run_fuse_schedules()
+
+    def test_all_schedules_pass_both_topologies(self, results):
+        from tools.analyze.schedules import FUSE_SCHEDULES
+
+        assert "fuse_flush_races_late_submit" in FUSE_SCHEDULES
+        assert len(results) == len(FUSE_SCHEDULES) * 2
+        bad = [r for r in results if not r.ok]
+        assert not bad, bad
+
+    def test_late_submit_lands_in_second_flush(self, results):
+        for r in results:
+            assert r.trace.index("fuse.flush.formed") < r.trace.index(
+                "fuse.flush.done"
+            )
+            # The late submit arrived while the first flush was in the air
+            # and still resolved — via its own (second) flush.
+            assert r.trace.count("fuse.submit") == 2
+
+    def test_hook_restored_and_no_leaked_workers(self, results):
+        assert fuse_mod._fuse_sync.__name__ == "<lambda>"
+        fuse_mod._fuse_sync("no-op")
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("qi-fuse-sched")
+        ]

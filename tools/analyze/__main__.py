@@ -177,6 +177,40 @@ def _race_pass(root: Path) -> tuple:
             f"one-shot pipeline"
         )
 
+    # qi-fuse schedules (ISSUE 16): the cross-request batch former's
+    # flush-vs-late-submit ordering, forced through fuse._fuse_sync the
+    # same way the serve orderings go through serve._serve_sync.
+    from tools.analyze.schedules import run_fuse_schedules
+
+    try:
+        fuse_results = run_fuse_schedules()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/fuse.py",
+            line=1, message=str(exc),
+        ))
+        fuse_results = []
+    for r in fuse_results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (one-shot pipeline says "
+                f"{r.expected})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/fuse.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if fuse_results:
+        notes.append(
+            f"fuse schedules: {len(fuse_results)} forced flush-vs-submit "
+            f"interleavings, verdicts identical to the one-shot pipeline"
+        )
+
     from quorum_intersection_tpu.backends.cpp import build_native_cli
 
     try:

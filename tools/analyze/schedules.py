@@ -804,6 +804,158 @@ def run_fleet_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
     return results
 
 
+# ---- qi-fuse batch-former schedules (ISSUE 16) ------------------------------
+#
+# The serve drain's cross-request BatchFormer (fuse.py) adds one more
+# concurrency surface: producers from different requests race the elected
+# flusher.  The ordering worth forcing is a LATE submit landing while a
+# flush is already formed — the late unit must ride the NEXT flush with a
+# correct result, never be dropped into the in-flight batch or lost.
+# ``fuse._fuse_sync`` is the hook, exactly like ``serve._serve_sync``.
+
+FUSE_SCHEDULES = (
+    "fuse_flush_races_late_submit",
+)
+
+_REQUIRED_FUSE_POINTS: Dict[str, tuple] = {
+    # The late producer must have entered submit while the first flush
+    # was formed-but-held, and a second flush must have completed.
+    "fuse_flush_races_late_submit": (
+        "fuse.submit", "fuse.flush.formed", "fuse.flush.done",
+    ),
+}
+
+
+def _run_fuse_one(schedule: str, data: object, expected: bool,
+                  topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.fuse as fuse_mod
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.fuse import BatchFormer
+    from quorum_intersection_tpu.pipeline import check_many
+
+    ctl = SyncController()
+    release = threading.Event()
+    verdict: Optional[bool] = None
+    error: Optional[str] = None
+    old_sync = fuse_mod._fuse_sync
+    fuse_mod._fuse_sync = ctl
+    workers: List[threading.Thread] = []
+    try:
+        if schedule == "fuse_flush_races_late_submit":
+            # Producer A's flush is formed (batch snapshotted, lock
+            # released) and HELD; producer B submits meanwhile.  B's unit
+            # must land in the next flush — two flushes total, both
+            # verdicts correct.
+            ctl.hold("fuse.flush.formed", release)
+            former = BatchFormer(
+                lambda sources, cancels, origins: check_many(
+                    sources, backend="python",
+                ),
+                window_ms=60_000.0,  # timer effectively off: drain flushes
+            )
+            outcomes: Dict[str, object] = {}
+
+            def producer(name: str, source: object) -> None:
+                former.register()
+                try:
+                    outcomes[name] = former.submit(
+                        [parse_fbas(source)], origin=name,
+                    )[0]
+                except BaseException as exc:  # noqa: BLE001 — recorded, re-raised as schedule error
+                    outcomes[name] = exc
+                finally:
+                    former.done()
+
+            t_a = threading.Thread(
+                target=producer, args=("A", data), name="qi-fuse-sched-a",
+            )
+            workers.append(t_a)
+            t_a.start()
+            if not ctl.reached_event("fuse.flush.formed").wait(WAIT_S):
+                raise ScheduleError("first flush never formed")
+            t_b = threading.Thread(
+                target=producer,
+                args=("B", majority_fbas(7, prefix="LATE", broken=False)),
+                name="qi-fuse-sched-b",
+            )
+            workers.append(t_b)
+            t_b.start()
+            deadline = time.monotonic() + WAIT_S
+            while ctl.trace.count("fuse.submit") < 2:
+                if time.monotonic() > deadline:
+                    raise ScheduleError("late submit never queued")
+                time.sleep(0.002)
+            release.set()
+            for t in workers:
+                t.join(WAIT_S)
+            res_a, res_b = outcomes.get("A"), outcomes.get("B")
+            if isinstance(res_a, BaseException) or res_a is None:
+                error = f"producer A failed: {res_a!r}"
+            elif isinstance(res_b, BaseException) or res_b is None:
+                error = f"late producer B failed: {res_b!r}"
+            elif len(former.flush_log) != 2:
+                error = (
+                    f"expected 2 flushes (early batch + late unit), got "
+                    f"{former.flush_log!r}"
+                )
+            elif res_b.intersects is not True:
+                error = "late producer's majority-7 verdict flipped"
+            else:
+                verdict = res_a.intersects
+        else:
+            raise ValueError(f"unknown fuse schedule {schedule!r}")
+    finally:
+        fuse_mod._fuse_sync = old_sync
+        release.set()
+        for t in workers:
+            t.join(timeout=WAIT_S)
+    missing = [
+        p for p in _REQUIRED_FUSE_POINTS[schedule] if p not in ctl.trace
+    ]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=bool(verdict),
+        expected=expected,
+        winner="fuse",
+        oracle_outcome="-",
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+def run_fuse_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
+    """Every fuse schedule × {intersecting, broken} topology; ground truth
+    from the one-shot pipeline (the byte-parity contract the fused drain
+    is held to).  Leaked producer threads are a failure."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend="python").intersects
+        for schedule in FUSE_SCHEDULES:
+            results.append(_run_fuse_one(schedule, data, expected, topology))
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("qi-fuse-sched-")
+    ]
+    for t in leaked:
+        t.join(timeout=join_timeout)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        raise ScheduleError(
+            f"{len(leaked)} fuse producer thread(s) still alive after "
+            f"{join_timeout}s — a schedule leaked its former"
+        )
+    return results
+
+
 def run_all(join_timeout: float = 5.0) -> List[ScheduleResult]:
     """Every schedule × {intersecting, broken} topology.  The expected
     verdict is computed by the sequential (race=False) chain with the real

@@ -183,6 +183,7 @@ def extract_surface(root: Path,
     surface.schedules = [
         *sched_mod.SCHEDULES, *sched_mod.SERVE_SCHEDULES,
         *sched_mod.DELTA_SCHEDULES, *sched_mod.FLEET_SCHEDULES,
+        *sched_mod.FUSE_SCHEDULES,
     ]
 
     from tools.analyze.wire import extract_channels

@@ -130,6 +130,17 @@ TRACKED: Dict[str, str] = {
     "query_relaxed_per_sec": "higher",
     "query_whatif_per_sec": "higher",
     "query_analytics_per_sec": "higher",
+    # qi-fuse cross-request pack fusion (ISSUE 16): benchmarks/serve.py
+    # --fuse rows.  `sweep_pack_fill_pct` is verdict-bearing lanes over
+    # dispatched 128-lane tiles under the mixed fused preset — the MXU
+    # utilization fusion exists to raise; `fuse_cross_request_lane_pct`
+    # is the share of fused lanes co-packed with a DIFFERENT request — a
+    # collapse to 0 means the batch former stopped merging requests (the
+    # drain silently fell back to per-request packs); the fused solve p99
+    # regresses by growing back toward its unfused twin.
+    "sweep_pack_fill_pct": "higher",
+    "fuse_cross_request_lane_pct": "higher",
+    "fuse_serve_solve_p99_ms": "lower",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -163,6 +174,9 @@ TELEMETRY_GAUGES = (
     "fleet.p99_ms",
     "fleet.e2e_p99_ms",
     "fleet.bench_verdicts_per_sec",
+    "fuse.fill_pct",
+    "fuse.bench_fill_pct",
+    "fuse.bench_cross_request_lane_pct",
 )
 
 

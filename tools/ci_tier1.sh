@@ -265,6 +265,19 @@ PYEOF
 purc=$?
 echo "PULSE=exit $purc"
 
+# qi-fuse gate (ISSUE 16, README §Serving fusion): the fused vs unfused
+# head-to-head on the mixed intersection/what-if stream — the driver
+# itself is the gate: cross-request lanes must actually form
+# (fuse.cross_request_lanes > 0), the fused tile fill must strictly
+# beat the legacy per-request drain, and every fused verdict/cert must
+# be byte-identical to its unfused twin (exit 1 otherwise).  The
+# window-unset byte-compat, mid-pack cancel partition, and serve.fuse
+# degrade contracts are pinned by tests/test_qi_fuse.py in the pytest
+# gate above.
+env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick --fuse
+furc=$?
+echo "FUSE_BENCH=exit $furc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -286,4 +299,5 @@ echo "TREND=exit $trc"
 [ "$fsrc" -ne 0 ] && exit "$fsrc"
 [ "$qrc" -ne 0 ] && exit "$qrc"
 [ "$purc" -ne 0 ] && exit "$purc"
+[ "$furc" -ne 0 ] && exit "$furc"
 exit "$trc"

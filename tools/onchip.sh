@@ -20,6 +20,7 @@
 # Usage: tools/onchip.sh --round rN [phase ...]
 #   default phases:   crossover frontier_scaling wide_run bench soak
 #   extra phases:     sweep_vs_native wide_kill crossover_pop2048 scc36
+#                     auto_race packed fuse
 #                     auto_race packed
 # Examples (the r4/r5 sequences, reproduced):
 #   tools/onchip.sh --round r4                                  # = onchip_r4.sh
@@ -124,6 +125,14 @@ run_phase() {
             timeout 3600 python -u benchmarks/sweep_vs_native.py --packed \
                 --metrics-json "$QI_METRICS_JSON" \
                 2>&1 | tee "$R/sweep_vs_native_packed_tpu_${ROUND}.txt" ;;
+        fuse)
+            # qi-fuse on real hardware: the fused vs unfused serve drain
+            # head-to-head (cross-request lanes, tile fill, byte-parity
+            # certs all gated by the driver itself) — on-chip is where the
+            # fused-tile win is a real MXU number, not CPU emulation
+            timeout 1800 python -u benchmarks/serve.py --fuse \
+                --backend tpu \
+                2>&1 | tee "$R/serve_fuse_tpu_${ROUND}.txt" ;;
         *)
             echo "unknown phase: $1" >&2; return 2 ;;
     esac
