@@ -501,7 +501,9 @@ def run_fuse_phase(args, record) -> tuple:
     from quorum_intersection_tpu.encode.circuit import LANE_TILE
     from quorum_intersection_tpu.fbas import synth
     from quorum_intersection_tpu.pipeline import solve
-    from quorum_intersection_tpu.serve import ServeEngine, _percentile
+    from quorum_intersection_tpu.serve import (
+        ServeEngine, ServeError, _percentile,
+    )
 
     # The packer only exists on the sweep path: force an auto-routed,
     # pack-enabled engine (the driver default "python" never packs).
@@ -539,8 +541,14 @@ def run_fuse_phase(args, record) -> tuple:
         # Queue the whole stream BEFORE the drain starts: one popped
         # batch, so the fused run's cross-request window actually sees
         # every distinct topology at once (the --quick preset is far too
-        # short for open-loop arrival overlap to do it).
-        tickets = [engine.submit(nodes, query=q) for nodes, q in workload]
+        # short for open-loop arrival overlap to do it).  Client ids
+        # (qi-cost, ISSUE 17) rotate over three tenants so the per-tenant
+        # attribution table has real multi-tenant content in the
+        # persisted stream.
+        tickets = [
+            engine.submit(nodes, query=q, client=f"bench-{i % 3}")
+            for i, (nodes, q) in enumerate(workload)
+        ]
         t0 = time.perf_counter()
         engine.start()
         responses = [t.result(timeout=300.0) for t in tickets]
@@ -576,6 +584,7 @@ def run_fuse_phase(args, record) -> tuple:
         }
 
     mismatches = []
+    cost0, _ = record.snapshot()
     # Unfused first: the fused run then reuses the XLA compile cache, so
     # the p99 comparison favors neither run on compile amortization (both
     # presets solve the same compiled shapes).
@@ -609,6 +618,151 @@ def run_fuse_phase(args, record) -> tuple:
         100.0 * fused["counters"]["fuse.cross_request_lanes"] / pack_lanes
         if pack_lanes else 0.0
     )
+
+    # ---- qi-cost auto-window arm (ISSUE 17) -----------------------------
+    # QI_SERVE_FUSE_WINDOW_MS=auto through the two regimes the controller
+    # must tell apart: a BURSTY phase (the whole workload pre-queued, the
+    # queue held visibly deep past the first pop) where the decision must
+    # pick a short POSITIVE window and match the fixed-window run's tile
+    # fill, and a SPARSE phase (one request at a time, queue drained
+    # between) where every decision must choose 0.0 and the p99 must not
+    # exceed the unfused run's.
+
+    def auto_bursty():
+        """Hot-queue arm.  A short tail of DISTINCT requests with an
+        already-tiny deadline keeps the queue deep when the first batch
+        pops (``batch_max`` = the workload's DISTINCT fingerprints —
+        repeats coalesce at admission and never occupy queue slots — so
+        the pop leaves exactly the tail behind); the tail then
+        deadline-expires at its own pop and never solves — it shapes the
+        decision input without adding a single pack to the fill
+        accounting."""
+        n0 = record.event_count()
+        distinct = len({
+            json.dumps([nodes, q], sort_keys=True) for nodes, q in workload
+        })
+        engine = ServeEngine(
+            backend=backend, pack=True, fuse_window_ms="auto",
+            batch_max=distinct, queue_depth=len(workload) + 16,
+            cache_max=args.cache_max,
+        )
+        tickets = [
+            engine.submit(nodes, query=q, client=f"bench-{i % 3}")
+            for i, (nodes, q) in enumerate(workload)
+        ]
+        tail = [
+            engine.submit(
+                synth.majority_fbas(5, prefix=f"TAIL{j}"),
+                deadline_s=0.001, client="bench-tail",
+            )
+            for j in range(4)
+        ]
+        # Let the queued burst AGE before the drain starts: the popped
+        # batch's queue waits (observed before the window decision) are
+        # what push the controller's wait-p99 input into hot-queue
+        # territory — a burst that waited ~100ms earns the capped window,
+        # exactly like real congestion.
+        time.sleep(0.12)
+        engine.start()
+        responses = [t.result(timeout=300.0) for t in tickets]
+        for t in tail:
+            try:
+                t.result(timeout=300.0)
+            except ServeError:
+                pass  # DeadlineExceeded is the tail's designed outcome
+        engine.stop(drain=True, timeout=600.0)
+        events = record.events_since(n0)
+        useful = 0.0
+        tile_lanes = 0
+        for e in events:
+            if e["name"] != "sweep.packed":
+                continue
+            attrs = e["attrs"]
+            useful += attrs["fill_pct"] * attrs["lanes"] / 100.0
+            tile_lanes += max(-(-attrs["lanes"] // LANE_TILE), 1) * LANE_TILE
+        decisions = [
+            e["attrs"]["window_ms"] for e in events
+            if e["name"] == "serve.fuse_window"
+        ]
+        return {
+            "responses": responses,
+            "fill_pct": (
+                round(100.0 * useful / tile_lanes, 2) if tile_lanes else 0.0
+            ),
+            "decisions": decisions,
+        }
+
+    def auto_sparse():
+        """Drained-queue arm: strictly serial submit→result, so every
+        pop leaves an empty queue behind and every window decision must
+        be 0.0 — fusion never taxes a stream with nobody to fuse with."""
+        n0 = record.event_count()
+        engine = ServeEngine(
+            backend=backend, pack=True, fuse_window_ms="auto",
+            batch_max=args.batch_max, queue_depth=len(workload) + 8,
+            cache_max=args.cache_max,
+        )
+        engine.start()
+        lat = []
+        for i, (nodes, q) in enumerate(workload):
+            resp = engine.submit(
+                nodes, query=q, client=f"bench-{i % 3}"
+            ).result(timeout=300.0)
+            lat.append(resp.seconds * 1000.0)
+        engine.stop(drain=True, timeout=600.0)
+        decisions = [
+            e["attrs"]["window_ms"] for e in record.events_since(n0)
+            if e["name"] == "serve.fuse_window"
+        ]
+        return {
+            "p99_ms": round(_percentile(sorted(lat), 99.0), 3),
+            "decisions": decisions,
+        }
+
+    bursty = auto_bursty()
+    sparse = auto_sparse()
+    for i, (r_auto, r_plain) in enumerate(
+        zip(bursty["responses"], unfused["responses"])
+    ):
+        if r_auto.intersects is not r_plain.intersects:
+            mismatches.append(
+                f"fuse auto step {i}: auto-window {r_auto.intersects} != "
+                f"unfused {r_plain.intersects}"
+            )
+    auto_window = max(bursty["decisions"], default=0.0)
+    if auto_window <= 0.0:
+        mismatches.append(
+            "fuse auto: bursty phase never chose a positive window "
+            f"(decisions {bursty['decisions']})"
+        )
+    if bursty["fill_pct"] < fused["fill_pct"]:
+        mismatches.append(
+            f"fuse auto: bursty fill {bursty['fill_pct']}% fell below the "
+            f"fixed-window fill {fused['fill_pct']}%"
+        )
+    if any(d > 0.0 for d in sparse["decisions"]):
+        mismatches.append(
+            "fuse auto: sparse phase chose a positive window "
+            f"(decisions {sparse['decisions']}) — idle traffic must never "
+            "wait on fusion"
+        )
+    if sparse["p99_ms"] > unfused["p99_ms"]:
+        mismatches.append(
+            f"fuse auto: sparse p99 {sparse['p99_ms']}ms exceeded the "
+            f"unfused p99 {unfused['p99_ms']}ms"
+        )
+    cost1, _ = record.snapshot()
+    lw_total = cost1.get("cost.lane_windows_total", 0) - cost0.get(
+        "cost.lane_windows_total", 0)
+    lw_attr = cost1.get("cost.lane_windows_attributed", 0) - cost0.get(
+        "cost.lane_windows_attributed", 0)
+    attributed_pct = round(100.0 * lw_attr / lw_total, 2) if lw_total else 0.0
+    if lw_total and lw_attr != lw_total:
+        mismatches.append(
+            f"fuse phase: only {lw_attr}/{lw_total} lane-windows were "
+            f"attributed in a fault-free run"
+        )
+
     row = {
         "fuse_requests": n_req,
         "fuse_window_ms": args.fuse_window,
@@ -620,10 +774,16 @@ def run_fuse_phase(args, record) -> tuple:
         "fuse_packs_unfused": unfused["packs"],
         "fuse_serve_solve_p99_ms": fused["p99_ms"],
         "fuse_serve_solve_p99_unfused_ms": unfused["p99_ms"],
+        "fuse_auto_window_ms": round(auto_window, 3),
+        "fuse_auto_fill_pct": bursty["fill_pct"],
+        "fuse_auto_sparse_p99_ms": sparse["p99_ms"],
+        "cost_attributed_pct": attributed_pct,
     }
     record.gauge("fuse.bench_fill_pct", row["sweep_pack_fill_pct"])
     record.gauge("fuse.bench_cross_request_lane_pct",
                  row["fuse_cross_request_lane_pct"])
+    record.gauge("fuse.bench_auto_window_ms", row["fuse_auto_window_ms"])
+    record.gauge("cost.bench_attributed_pct", row["cost_attributed_pct"])
     return row, mismatches
 
 
@@ -706,7 +866,14 @@ def main(argv=None) -> int:
                              "unfused solve p99 (tools/bench_trend.py "
                              "gates them), hard-failing unless "
                              "cross-request lanes formed and tile fill "
-                             "strictly improved")
+                             "strictly improved; includes the qi-cost "
+                             "auto-window arm (QI_SERVE_FUSE_WINDOW_MS="
+                             "auto): bursty traffic must pick a positive "
+                             "window and match the fixed-window fill, "
+                             "sparse traffic must pick 0 and not exceed "
+                             "the unfused p99, and every dispatched "
+                             "lane-window must be cost-attributed "
+                             "(cost_attributed_pct == 100)")
     parser.add_argument("--fuse-window", type=float, default=25.0,
                         help="fused-run batch-former window in ms "
                              "(QI_SERVE_FUSE_WINDOW_MS equivalent; "

@@ -54,7 +54,7 @@ from quorum_intersection_tpu.encode.circuit import (
 from quorum_intersection_tpu.fbas.graph import TrustGraph
 from quorum_intersection_tpu.fbas.semantics import max_quorum
 from quorum_intersection_tpu.utils.env import qi_env
-from quorum_intersection_tpu.utils.faults import fault_point
+from quorum_intersection_tpu.utils.faults import FaultInjected, fault_point
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
 from quorum_intersection_tpu.utils.timers import Throughput
@@ -1247,6 +1247,26 @@ class TpuSweepBackend:
                 "windows_resumed_prefix": start0,
             },
         }
+        # qi-cost/1 (ISSUE 17): an unfused solve occupied the whole device —
+        # lanes = the padded lane axis, one window per candidate row.  A
+        # wrong cost must become a dropped cost (cost.attribute degrade);
+        # the total still counts so attributed_pct honestly reflects the gap.
+        try:
+            fault_point("cost.attribute")
+            from quorum_intersection_tpu.cost import solo_cost
+            stats["cost"] = solo_cost(
+                circuit.n, candidates,
+                macs_per_candidate_row(circuit.n, circuit.n_units,
+                                       circuit.depth),
+                seconds,
+            )
+            rec.add("cost.lane_windows_attributed",
+                    int(stats["cost"]["lane_windows"]))
+            rec.add("cost.lane_windows_total", circuit.n * candidates)
+        except (FaultInjected, OSError) as exc:
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="sweep.solo", error=repr(exc))
+            rec.add("cost.lane_windows_total", circuit.n * candidates)
         if plan is not None and plan.windows:
             # The checkable pruned-block ledger: enough for the stdlib
             # checker to rebuild every block's maximal candidate in graph
@@ -1877,6 +1897,31 @@ class TpuSweepBackend:
             "pack_seconds": round(seconds, 4),
             "xla_compile_seconds": round(xla_s, 4),
         }
+        # qi-cost/1 (ISSUE 17): book this pack's device work to its member
+        # jobs by integer lane share (pad included).  The conserved quantity
+        # is lane·windows: per-job attribution sums to the pack total
+        # EXACTLY (asserted inside attribute_pack).  A cancelled job keeps
+        # its lane groups (retire_job never reassigns ownership), so dead
+        # lanes book to the request that died — and to nobody else.  A
+        # wrong cost degrades to a dropped cost; only the total counter
+        # moves then, so attributed_pct honestly shows the gap.
+        pack_costs: Dict[object, Dict[str, object]] = {}
+        pack_lane_windows = packed.circuit.n * pack_rows
+        try:
+            fault_point("cost.attribute")
+            from quorum_intersection_tpu.cost import attribute_pack
+            pack_costs = attribute_pack(
+                [g.job for g in groups], packed.circuit.n, packed.slot,
+                pack_rows, pack_stats["pack_macs_per_candidate_row"],
+                seconds,
+            )
+            rec.add("cost.lane_windows_attributed", pack_lane_windows)
+            rec.add("cost.lane_windows_total", pack_lane_windows)
+        except (FaultInjected, OSError) as exc:
+            pack_costs = {}
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="sweep.pack", error=repr(exc))
+            rec.add("cost.lane_windows_total", pack_lane_windows)
         # Same registry rule as the unpacked drive: only full-coverage
         # (no-hit) jobs speak for brute-force enumeration; a hit job's
         # retired pack-fill windows are early-exit savings, not pruning.
@@ -1925,6 +1970,9 @@ class TpuSweepBackend:
                 }
             if job.order_meta is not None:
                 stats["order"] = dict(job.order_meta)
+            job_cost = pack_costs.get(jix)
+            if job_cost is not None:
+                stats["cost"] = dict(job_cost)
             if origins is not None:
                 stats["pack_origin"] = origins[jix]
             if job.cancelled:

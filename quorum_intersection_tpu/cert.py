@@ -251,6 +251,13 @@ def build_certificate(
         # graph-space node ids, so the checker needs no decode help here
         # (a pruned ledger carries its own explicit `enumeration` block).
         cert["provenance"]["order"] = dict(order)  # type: ignore[index]
+    cost = stats.get("cost")
+    if isinstance(cost, dict):
+        # qi-cost/1 (ISSUE 17): which share of the device work this verdict
+        # paid for — lane·windows, MACs, pro-rated dispatch wall, delta
+        # reuse credits.  Provenance only: the checker ignores it, the
+        # serve/fleet wire and the per-tenant tables consume it.
+        cert["provenance"]["cost"] = dict(cost)  # type: ignore[index]
     summary: Dict[str, object] = {
         "verdict": bool(intersects),
         "backend": stats.get("backend", reason),

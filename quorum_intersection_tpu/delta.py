@@ -898,6 +898,21 @@ class DeltaEngine:
             "reused": True,
             "solved_seconds": stats.get("seconds"),
         }
+        # qi-cost/1 (ISSUE 17): a reused SCC did zero new device work — its
+        # cost is a reuse CREDIT (the lane·windows the cached solve booked,
+        # avoided here), replacing the cached stats' own cost so reuse is
+        # never double-billed.  Degrades to no cost, never a wrong verdict.
+        try:
+            fault_point("cost.attribute")
+            from quorum_intersection_tpu.cost import reuse_credit
+            cached_cost = stats.get("cost")
+            stats["cost"] = reuse_credit(
+                cached_cost if isinstance(cached_cost, dict) else None
+            )
+        except (FaultInjected, OSError) as exc:
+            stats.pop("cost", None)
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="delta.compose", error=repr(exc))
         delta_stamp = {
             "schema": DELTA_SCHEMA,
             "reused_sccs": 1,

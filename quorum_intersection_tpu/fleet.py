@@ -316,7 +316,8 @@ class ProcWorker:
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
                deadline_s: Optional[float],
                query: Optional[Dict[str, object]] = None,
-               trace: Optional[str] = None) -> bool:
+               trace: Optional[str] = None,
+               client: Optional[str] = None) -> bool:
         line: Dict[str, object] = {"request_id": request_id, "nodes": nodes}
         if deadline_s is not None:
             line["deadline_s"] = deadline_s
@@ -326,6 +327,9 @@ class ProcWorker:
             # qi-pulse: the front door's request-span context — the worker
             # adopts it so its spans join this request's trace.
             line["trace"] = trace
+        if client is not None:
+            # qi-cost: the tenant this request books to on the worker.
+            line["client"] = client
         return self._write(line)
 
     def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
@@ -436,13 +440,14 @@ class LocalWorker:
     def submit(self, request_id: str, nodes: List[Dict[str, object]],
                deadline_s: Optional[float],
                query: Optional[Dict[str, object]] = None,
-               trace: Optional[str] = None) -> bool:
+               trace: Optional[str] = None,
+               client: Optional[str] = None) -> bool:
         if self._dead:
             return False
         try:
             ticket = self.engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
-                query=query, trace=trace,
+                query=query, trace=trace, client=client,
             )
         except ServeError as exc:
             self._respond({"request_id": request_id,
@@ -501,6 +506,9 @@ class _Pending:
     # re-sent on every failover re-dispatch so the inheriting worker's
     # spans still join the original request's trace.
     trace: Optional[str] = None
+    # qi-cost (ISSUE 17): the client id forwarded to whichever worker ends
+    # up solving this request — failover re-dispatches keep the tenant.
+    client: Optional[str] = None
 
 
 class FleetEngine:
@@ -709,6 +717,7 @@ class FleetEngine:
         deadline_s: Optional[float] = None,
         query: Optional[object] = None,
         trace: Optional[str] = None,
+        client: Optional[str] = None,
     ) -> Ticket:
         """Admit one request: fingerprint, route, dispatch.  Same contract
         as ``ServeEngine.submit`` (typed errors, Ticket immediately).
@@ -766,6 +775,7 @@ class FleetEngine:
                     else rec.trace_id,
                     req_span.span_id, rec.pid,
                 ).to_env(),
+                client=client,
             )
             with self._lock:
                 # A client may reuse a request_id while the first request
@@ -838,7 +848,7 @@ class FleetEngine:
                 worker = self._workers.get(wid) if wid in self._live else None
             if worker is not None and worker.submit(
                 rid, pending.nodes, pending.deadline_s, pending.query,
-                pending.trace,
+                pending.trace, pending.client,
             ):
                 rec.add("fleet.routed")
                 return
@@ -901,6 +911,11 @@ class FleetEngine:
             # client sees the trace even from a pre-pulse worker.
             trace=(wire_trace if isinstance(wire_trace, str)
                    else pending.trace),
+            # qi-cost echo: the worker's attributed cost rides the wire
+            # line; absent from pre-cost workers, cache hits and degraded
+            # attribution — the response shape stays byte-compatible.
+            cost=(obj.get("cost")
+                  if isinstance(obj.get("cost"), dict) else None),
         )
         if not pending.internal:
             rec.add("fleet.verdicts")
@@ -1027,6 +1042,7 @@ class FleetEngine:
         # just this cycle's successes, so the merged view is monotonic
         # between evictions.
         self._aggregate_pulse(retained, rec)
+        self._aggregate_cost(retained, rec)
 
     def _aggregate_pulse(self, pongs: Dict[str, Dict[str, object]],
                          rec: RunRecord) -> None:
@@ -1070,6 +1086,43 @@ class FleetEngine:
             log.warning(
                 "pulse aggregation degraded this cycle (%s); per-worker "
                 "metrics remain available", exc,
+            )
+
+    def _aggregate_cost(self, pongs: Dict[str, Dict[str, object]],
+                        rec: RunRecord) -> None:
+        """The qi-cost aggregation plane (ISSUE 17): merge the workers'
+        pong-carried per-tenant cost snapshots into the front door's
+        fleet-wide tenant table.  Same pid-dedupe rule as the pulse merge
+        (local workers share one process table — summing N aliased pongs
+        would multiply the view N-fold) and the merge REPLACES the fleet
+        table each cycle: pong snapshots are cumulative, so accumulating
+        them would double-count every prior cycle.  Behind the
+        ``cost.attribute`` fault point: a failure degrades this CYCLE to
+        per-worker tables only, never touches a verdict."""
+        if not pongs:
+            return
+        try:
+            fault_point("cost.attribute")
+            from quorum_intersection_tpu.cost import (
+                fleet_tenant_table, merge_tenant_snapshots,
+            )
+            by_pid: Dict[object, Dict[str, Dict[str, object]]] = {}
+            for pong in pongs.values():
+                cost = pong.get("cost")
+                if isinstance(cost, dict) and cost:
+                    by_pid[pong.get("pid")] = cost  # type: ignore[assignment]
+            if not by_pid:
+                return
+            merged = merge_tenant_snapshots(list(by_pid.values()))
+            fleet_tenant_table().replace(merged)
+            rec.gauge("fleet.cost_tenants", len(merged))
+        except (FaultInjected, OSError, ValueError, TypeError, KeyError) as exc:
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="fleet.aggregate",
+                      error=str(exc))
+            log.warning(
+                "cost aggregation degraded this cycle (%s); per-worker "
+                "tables remain available", exc,
             )
 
     def healthz(self) -> Dict[str, object]:

@@ -265,6 +265,11 @@ class ServeResponse:
     # the fleet front door relaying worker responses) can join the
     # response to its distributed trace.  None on trace-less requests.
     trace: Optional[str] = None
+    # Per-request device cost (qi-cost/1, ISSUE 17): what this verdict
+    # paid for on the device — lane·windows, MACs, pro-rated dispatch
+    # wall, delta reuse credits.  None when attribution degraded, on
+    # cache hits (zero new device work) and on cost-less backends.
+    cost: Optional[Dict[str, object]] = None
 
 
 _Outcome = Tuple[str, object]  # ("ok", ServeResponse) | ("err", Exception)
@@ -281,6 +286,10 @@ class Ticket:
         # qi-pulse: THIS submission's wire trace — a coalesced waiter's
         # response must echo its OWN context, not the leader entry's.
         self.trace: Optional[str] = None
+        # qi-cost: THIS submission's client id — it rides the ticket (not
+        # the solve entry) so coalesced waiters and cache hits each book
+        # to their OWN tenant.  None books as "anon".
+        self.client: Optional[str] = None
         self._event = threading.Event()
         self._outcome: Optional[_Outcome] = None
         self._callbacks: List[Callable[["Ticket"], None]] = []
@@ -577,7 +586,7 @@ class ServeEngine:
         pack: Optional[bool] = None,
         delta: Optional[bool] = None,
         shared_store: Optional[SharedSccStore] = None,
-        fuse_window_ms: Optional[float] = None,
+        fuse_window_ms: Optional[Union[float, str]] = None,
     ) -> None:
         self.backend = backend
         self.queue_depth = (
@@ -610,10 +619,24 @@ class ServeEngine:
         # the drain runs each popped entry in its own worker and a shared
         # BatchFormer merges their window work into one lane-packed solve;
         # 0 (the default) keeps the byte-compatible legacy drain.
-        self.fuse_window_ms = (
+        # 'auto' (qi-cost, ISSUE 17): the window is chosen per flush cycle
+        # by cost.choose_fuse_window from the pulse queue-wait p99 and the
+        # SLO burn state — the raw env string is checked FIRST because
+        # qi_env_float would silently fall 'auto' back to the registered
+        # default.
+        fuse_raw: Union[float, str] = (
             fuse_window_ms if fuse_window_ms is not None
-            else qi_env_float("QI_SERVE_FUSE_WINDOW_MS", 0.0)
+            else qi_env("QI_SERVE_FUSE_WINDOW_MS")
         )
+        self.fuse_window_auto = (
+            isinstance(fuse_raw, str) and fuse_raw.strip().lower() == "auto"
+        )
+        if self.fuse_window_auto:
+            self.fuse_window_ms = 0.0
+        elif fuse_window_ms is not None:
+            self.fuse_window_ms = float(fuse_window_ms)
+        else:
+            self.fuse_window_ms = qi_env_float("QI_SERVE_FUSE_WINDOW_MS", 0.0)
         # Incremental re-analysis (qi-delta, ISSUE 9): the drain consults
         # the per-SCC verdict store BEFORE check_many, so a churn step that
         # leaves the quorum-bearing SCC structurally unchanged composes its
@@ -744,6 +767,7 @@ class ServeEngine:
         deadline_s: Optional[float] = None,
         query: Optional[object] = None,
         trace: Optional[str] = None,
+        client: Optional[str] = None,
     ) -> Ticket:
         """Admit one snapshot-verdict request.
 
@@ -779,6 +803,8 @@ class ServeEngine:
             deadline_t=(now + budget) if budget and budget > 0 else None,
         )
         ticket.trace = trace
+        # qi-cost: the tenant this request books to (None → "anon").
+        ticket.client = client
         ctx = TraceContext.from_env(trace) if trace else None
         with rec.adopted(ctx), rec.span(
             "serve.admit", request_id=request_id,
@@ -979,6 +1005,45 @@ class ServeEngine:
                 for entry in batch:
                     self._resolve_err(entry, exc, outcome="error")
 
+    def _auto_fuse_window(self) -> float:
+        """One adaptive fuse-window decision (qi-cost, ISSUE 17).
+
+        Inputs: the live queue depth beyond this batch, the pulse
+        queue-wait p99 (the bounded raw window — the same estimator
+        behind the p50/p99 gauges) and the SLO burn state (one lazy
+        evaluation — this is one of the plane's three trigger sites).
+        Every decision is a ``serve.fuse_window`` event carrying its
+        inputs; the active window rides the ``serve.fuse_window_ms``
+        gauge.  A broken controller degrades to 0.0 — no fusion wait,
+        never a lost verdict."""
+        rec = get_run_record()
+        try:
+            fault_point("cost.attribute")
+            from quorum_intersection_tpu.cost import (
+                choose_fuse_window, slo_plane,
+            )
+            with self._lock:
+                queue_depth = len(self._queue)
+            wait_p99 = rec.histogram(
+                "pulse.queue_wait_ms").window_percentile(99.0)
+            burning = False
+            slo = slo_plane()
+            if slo.enabled:
+                burning = bool(slo.evaluate().get("burning"))
+            window = choose_fuse_window(queue_depth, wait_p99, burning)
+            rec.gauge("serve.fuse_window_ms", round(window, 3))
+            rec.event(
+                "serve.fuse_window", window_ms=round(window, 3),
+                queue_depth=queue_depth, wait_p99_ms=round(wait_p99, 3),
+                burning=burning,
+            )
+            return window
+        except (FaultInjected, OSError) as exc:
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="serve.fuse_window",
+                      error=repr(exc))
+            return 0.0
+
     def _make_backend(self, cancel: Optional[CancelToken]) -> SearchBackend:
         """One backend per batch.  A string spec is constructed fresh with
         the deadline token threaded in where the engine supports it; a
@@ -1062,7 +1127,28 @@ class ServeEngine:
             per_request = True
             rec.add("serve.drain_faults")
             rec.event("serve.drain_degraded", error=str(exc))
+        live = self._partition_expired(batch, time.monotonic())
+        if not live:
+            return
+        # Stage histogram (qi-pulse): admission→pop queue wait, per solve
+        # unit (a requeued entry's wait accumulates from its original
+        # admission — the client-visible number).  Observed BEFORE the
+        # adaptive fuse-window decision below, so the controller reads a
+        # queue-wait p99 that includes THIS batch's waits — the freshest
+        # possible picture of the queue it is sizing the window for.
+        queue_h = rec.histogram("pulse.queue_wait_ms")
+        pop_t = time.monotonic()
+        for entry in live:
+            wait_ms = max((pop_t - entry.admitted_t) * 1000.0, 0.0)
+            queue_h.observe(wait_ms)
+            entry.stages["queue_wait_ms"] = round(wait_ms, 3)
         fuse_window = self.fuse_window_ms if not per_request else 0.0
+        if self.fuse_window_auto and not per_request:
+            # qi-cost closed loop (ISSUE 17): the window is chosen per
+            # flush cycle from the observed queue state and the SLO burn
+            # plane — 0.0 (sparse traffic / degraded controller) falls
+            # through to the byte-compatible unfused batch below.
+            fuse_window = self._auto_fuse_window()
         if fuse_window > 0:
             try:
                 fault_point("serve.fuse")
@@ -1073,18 +1159,6 @@ class ServeEngine:
                 fuse_window = 0.0
                 rec.add("serve.fuse_faults")
                 rec.event("serve.fuse_degraded", error=str(exc))
-        live = self._partition_expired(batch, time.monotonic())
-        if not live:
-            return
-        # Stage histogram (qi-pulse): admission→pop queue wait, per solve
-        # unit (a requeued entry's wait accumulates from its original
-        # admission — the client-visible number).
-        queue_h = rec.histogram("pulse.queue_wait_ms")
-        pop_t = time.monotonic()
-        for entry in live:
-            wait_ms = max((pop_t - entry.admitted_t) * 1000.0, 0.0)
-            queue_h.observe(wait_ms)
-            entry.stages["queue_wait_ms"] = round(wait_ms, 3)
         # Typed queries (qi-query, ISSUE 12) split out of the batched
         # intersection path: each kind resolves through its own engine
         # chain (whatif expands into its OWN lane-packed check_many batch;
@@ -1633,7 +1707,27 @@ class ServeEngine:
                 "journaled": self._journal is not None,
                 "latency_s": round(seconds, 6),
             }
+            if ticket.client is not None:
+                prov["serve"]["client"] = ticket.client
             cert["provenance"] = prov
+        # qi-cost (ISSUE 17): book this delivery to its tenant and attach
+        # the cost to the response.  A cache hit books the request but no
+        # cost (zero new device work — re-billing the original solve would
+        # double-count it); a degraded attribution drops the cost, touches
+        # nothing else (verdict, cert and latency stay byte-identical).
+        cost: Optional[Dict[str, object]] = None
+        try:
+            fault_point("cost.attribute")
+            raw_cost = res.stats.get("cost")
+            if not cached and isinstance(raw_cost, dict):
+                cost = dict(raw_cost)
+            from quorum_intersection_tpu.cost import tenant_table
+            tenant_table().book(ticket.client or "anon", cost)
+        except (FaultInjected, OSError) as exc:
+            cost = None
+            rec.add("cost.attribute_errors")
+            rec.event("cost.degraded", site="serve.respond",
+                      error=repr(exc))
         response = ServeResponse(
             request_id=ticket.request_id,
             intersects=bool(res.intersects),
@@ -1647,6 +1741,7 @@ class ServeEngine:
             # Wire trace echo (qi-pulse): the request's carried context
             # rides the response line so the caller can join the trace.
             trace=trace,
+            cost=cost,
         )
         outcome_err: Optional[BaseException] = None
         try:

@@ -47,6 +47,7 @@ import sys
 import threading
 from typing import Dict, Iterator, List, Optional, TextIO
 
+from quorum_intersection_tpu.cost import tenant_table
 from quorum_intersection_tpu.serve import (
     ServeEngine,
     ServeError,
@@ -104,7 +105,7 @@ def pong_payload(token: object) -> Dict[str, object]:
     counters, gauges = rec.snapshot()
     hists = rec.histograms_snapshot()
     replay = gauges.get("serve.replay_complete")
-    return {
+    payload = {
         "pong": token,
         "schema": PROTOCOL_SCHEMA,
         "pid": os.getpid(),
@@ -113,6 +114,15 @@ def pong_payload(token: object) -> Dict[str, object]:
         "gauges": {k: gauges.get(k, 0) for k in PONG_GAUGES},
         "pulse": {k: hists[k] for k in PONG_PULSE if k in hists},
     }
+    # qi-cost (ISSUE 17): the worker's cumulative per-tenant cost table
+    # rides the pong like the pulse histograms — the fleet front door
+    # pid-dedupes and REBUILDS its merged view each cycle (cumulative
+    # snapshots must replace, never accumulate).  Same deliberate rule as
+    # PONG_PULSE: only the LOCAL table ships, never a fleet-merged one.
+    tenants = tenant_table().snapshot()
+    if tenants:
+        payload["cost"] = tenants
+    return payload
 
 
 def ticket_response(
@@ -148,6 +158,11 @@ def ticket_response(
         # Typed-query payload (qi-query/1): verdict stays the boolean
         # summary, the structured table/witness/report rides alongside.
         line["result"] = resp.result
+    if resp.cost is not None:
+        # qi-cost/1 (ISSUE 17): what this verdict cost on the device —
+        # absent on cache hits, degraded attribution and legacy backends
+        # (the byte-compatible pre-cost response shape).
+        line["cost"] = resp.cost
     if emit_certs:
         line["cert"] = resp.cert
         line["stats"] = resp.stats
@@ -217,12 +232,19 @@ class JsonlSession:
                 # trace, the byte-compatible legacy request.
                 raw_trace = obj.get("trace")
                 trace = raw_trace if isinstance(raw_trace, str) else None
+                # qi-cost (ISSUE 17): optional client id — the tenant this
+                # request's device cost books to.  Absent ⇒ "anon", the
+                # byte-compatible legacy request.
+                raw_client = obj.get("client")
+                client = raw_client if isinstance(raw_client, str) else None
+            else:
+                client = None
             if not isinstance(nodes, list):
                 raise ValueError("expected a node array or "
                                  '{"request_id", "nodes"}')
             ticket = self._engine.submit(
                 nodes, request_id=request_id, deadline_s=deadline_s,
-                query=query, trace=trace,
+                query=query, trace=trace, client=client,
             )
         except ServeError as exc:
             self.emit({"request_id": request_id or f"line-{n + 1}",

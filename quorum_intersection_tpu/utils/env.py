@@ -322,7 +322,45 @@ QI_SERVE_FUSE_WINDOW_MS = _declare(
     "batch former (fuse.py BatchFormer) and dispatches when the estimated "
     "lane tile fills or this deadline-aware timer fires, so mixed traffic "
     "fills compiled MXU tiles instead of dispatching partial packs per "
-    "request.  0 (default): fusion off, the byte-compatible legacy drain.",
+    "request.  'auto' (qi-cost): the window is chosen each flush cycle by "
+    "cost.choose_fuse_window from the pulse queue-wait p99 and the SLO "
+    "burn state — hot queue ⇒ short positive window, sparse traffic ⇒ 0 "
+    "so latency never pays for an empty wait; every decision is a "
+    "serve.fuse_window event and the active value a serve.fuse_window_ms "
+    "gauge.  0 (default): fusion off, the byte-compatible legacy drain.",
+)
+QI_COST_TENANTS_MAX = _declare(
+    "QI_COST_TENANTS_MAX", "256",
+    "Per-tenant cost table capacity (cost.py qi-cost): the per-client-id "
+    "device-cost aggregation tables (local and fleet-merged) keep at most "
+    "this many tenants, evicting least-recently-booked beyond it (evictions "
+    "are counted on cost.tenants_evicted, never silent).  Bounds serve-tier "
+    "memory against client-id cardinality attacks.",
+)
+QI_SLO = _declare(
+    "QI_SLO", "",
+    "Declarative SLO targets (cost.py SloPlane): a comma-separated list of "
+    "'metric<bound' / 'metric>bound' clauses, e.g. "
+    "'serve_e2e_p99_ms<500,pack_fill_pct>60'.  Metric names resolve "
+    "against the live gauge registry ('_' also matches '.'); each scrape "
+    "of /healthz or /sloz and each adaptive fuse-window decision "
+    "evaluates multi-window burn rates (QI_SLO_FAST_S / QI_SLO_SLOW_S) "
+    "and emits slo.burn events + the slo.burning gauge.  Empty (default): "
+    "SLO plane off.",
+)
+QI_SLO_FAST_S = _declare(
+    "QI_SLO_FAST_S", "300",
+    "Fast burn-rate window in seconds (cost.py SloPlane): a target is "
+    "fast-burning when at least half the ring samples within this window "
+    "violate its bound.  Default 300 (5 minutes).",
+)
+QI_SLO_SLOW_S = _declare(
+    "QI_SLO_SLOW_S", "3600",
+    "Slow burn-rate window in seconds (cost.py SloPlane): a target is "
+    "slow-burning when at least a tenth of the ring samples within this "
+    "window violate its bound; 'burning' requires BOTH windows, so a "
+    "recovered metric stops firing as soon as the fast window clears.  "
+    "Default 3600 (1 hour).",
 )
 
 

@@ -303,6 +303,16 @@ PULSE_AGGREGATE = _declare(
     "+ pulse.agg_degraded event, loud; the fleet-wide /metrics view goes "
     "stale, per-worker scrapes and every verdict are untouched).",
 )
+COST_ATTRIBUTE = _declare(
+    "cost.attribute",
+    "Per-request device-cost attribution (cost.py qi-cost: the sweep pack "
+    "drain's per-origin booking, the serve tenant-table booking, SLO "
+    "burn-rate evaluation and the fleet cost merge): error simulates a "
+    "broken accounting plane — the step degrades to NO cost (cost."
+    "attribute_errors counter + cost.degraded event, loud; a wrong cost "
+    "must become a dropped cost, never a wrong verdict — verdicts, certs "
+    "and latency are byte-identical with attribution off).",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "

@@ -61,6 +61,15 @@ def healthz_payload() -> dict:
     the endpoint stays byte-stable between state changes and has no lock
     interaction with the engines.
     """
+    # qi-cost (ISSUE 17): a /healthz scrape is one of the SLO plane's lazy
+    # evaluation triggers (no background thread anywhere) — evaluate FIRST
+    # so the snapshot below reads a fresh slo.burning gauge.  Imported
+    # here, not at module top: this module sits under utils/ and must not
+    # import package-level engines at import time.
+    from quorum_intersection_tpu.cost import slo_plane
+    slo = slo_plane()
+    if slo.enabled:
+        slo.evaluate()
     rec = get_run_record()
     counters, gauges = rec.snapshot()
     return {
@@ -95,7 +104,21 @@ def healthz_payload() -> dict:
         # histograms, not the max of per-worker gauges.  0.0 until the
         # first aggregation cycle lands (or with QI_PULSE_AGG=0).
         "fleet_e2e_p99_ms": gauges.get("fleet.e2e_p99_ms", 0.0),
+        # qi-cost (ISSUE 17): the SLO burn picture — how many declared
+        # targets are burning in BOTH the fast and slow windows right now
+        # (0 with no QI_SLO targets), and the attribution health counters
+        # (/sloz has the full per-target ratios and the tenant tables).
+        "slo_burning": gauges.get("slo.burning", 0),
+        "cost_attribute_errors": counters.get("cost.attribute_errors", 0),
     }
+
+
+def sloz_payload() -> dict:
+    """The /sloz body (``qi-slo/1``): one lazy SLO evaluation (per-target
+    bounds, values, fast/slow burn ratios, burning flags) plus the
+    costliest tenants — local table and fleet-merged table."""
+    from quorum_intersection_tpu.cost import sloz_payload as _sloz
+    return _sloz()
 
 
 def readyz_payload() -> tuple:
@@ -167,6 +190,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload, status = readyz_payload()
             body = (json.dumps(payload, sort_keys=True) + "\n").encode()
             self._respond(status, "application/json", body)
+        elif path == "/sloz":
+            body = (
+                json.dumps(sloz_payload(), sort_keys=True) + "\n"
+            ).encode()
+            self._respond(200, "application/json", body)
         else:
             self._respond(404, "text/plain", b"not found\n")
 
@@ -196,7 +224,7 @@ class MetricsServer:
         )
         self._thread.start()
         log.info("metrics endpoint serving on http://%s:%d "
-                 "(/healthz, /readyz, /metrics)", host, self.port)
+                 "(/healthz, /readyz, /sloz, /metrics)", host, self.port)
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -101,7 +101,10 @@ import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Deque, Dict, Iterator, List, Optional, Protocol, Sequence, Tuple
+from typing import (
+    Callable, Deque, Dict, Iterator, List, Optional, Protocol, Sequence,
+    Tuple,
+)
 
 from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.logging import get_logger
@@ -301,6 +304,67 @@ class Histogram:
         snap = self.snapshot()
         snap.pop("schema", None)
         return {"kind": "histogram", "name": self.name, **snap}
+
+
+class SnapshotRing:
+    """Bounded ring of timestamped metric snapshots (``qi-cost/1`` SLO
+    plane).
+
+    Each :meth:`record` call appends ``(t, values)`` where ``values`` is a
+    flat name→float view of whatever the caller sampled (gauges, derived
+    histogram percentiles, cost rates).  :meth:`window` answers the samples
+    whose timestamps fall within the trailing ``seconds`` — the multi-window
+    burn-rate evaluator's only read.  Lock-protected (scrape threads and the
+    serve drain both record); the clock is injectable so burn-rate tests can
+    replay hours in microseconds.
+    """
+
+    def __init__(self, maxlen: int = 4096,
+                 clock: Optional[object] = None) -> None:
+        self._lock = threading.Lock()
+        self._ring: Deque[Tuple[float, Dict[str, float]]] = deque(
+            maxlen=maxlen)
+        self._clock = clock if callable(clock) else time.monotonic
+
+    def record(self, values: Dict[str, float],
+               t: Optional[float] = None) -> float:
+        """Append one snapshot; returns the timestamp used."""
+        now = float(t) if t is not None else float(self._clock())  # type: ignore[operator]
+        snap = {str(k): float(v) for k, v in values.items()}
+        with self._lock:
+            self._ring.append((now, snap))
+        return now
+
+    def window(self, seconds: float,
+               now: Optional[float] = None) -> List[Tuple[float, Dict[str, float]]]:
+        """Samples within the trailing ``seconds`` (oldest first)."""
+        end = float(now) if now is not None else float(self._clock())  # type: ignore[operator]
+        cutoff = end - float(seconds)
+        with self._lock:
+            return [(t, dict(v)) for t, v in self._ring if t >= cutoff]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+# Finish-time line providers (qi-cost, ISSUE 17): package-level modules
+# (cost.py's per-tenant table) register a callable here and its lines ride
+# the JSONL stream next to the counter/gauge/histogram dump — utils/ never
+# imports package engines, the dependency points the other way.  Providers
+# are best-effort by the telemetry contract: one that raises is skipped.
+_FINAL_LINE_PROVIDERS: List[Callable[[], List[dict]]] = []
+
+
+def register_final_lines(provider: Callable[[], List[dict]]) -> None:
+    """Register a finish-time JSONL line provider (idempotent)."""
+    if provider not in _FINAL_LINE_PROVIDERS:
+        _FINAL_LINE_PROVIDERS.append(provider)
+
 
 # In-memory retention caps: a 2^44 sweep drains millions of windows; the
 # JSONL sink streams them all, but the in-process lists (used by tests and
@@ -942,6 +1006,11 @@ class RunRecord:
         if dropped:
             lines.append({"kind": "counter", "name": "telemetry.dropped",
                           "value": dropped})
+        for provider in list(_FINAL_LINE_PROVIDERS):
+            try:
+                lines.extend(provider())
+            except Exception as exc:  # noqa: BLE001 — never cost the dump
+                log.info("final-line provider failed: %s", exc)
         return lines
 
     def summary_lines(self) -> List[str]:

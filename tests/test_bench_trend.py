@@ -59,7 +59,10 @@ class TestBenchTrend:
         # benchmarks/results history is clean.
         assert bench_trend.main(["--repo", str(REPO)]) == 0
         out = capsys.readouterr().out
-        assert "latest run:" in out
+        assert "latest bench run:" in out
+        # The dryrun multichip rounds trend as their own family, never
+        # against the single-chip baselines.
+        assert "latest multichip run:" in out
 
     def test_informational_reports_but_exits_zero(self, tmp_path, capsys):
         write_history(tmp_path, [GOOD, REGRESSED])

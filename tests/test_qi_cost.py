@@ -1,0 +1,577 @@
+"""qi-cost suite (ISSUE 17): attribution must be conserved and invisible.
+
+Acceptance, per ISSUE 17:
+
+- the conservation invariant, property-style: for every (lane tile, slot,
+  group count, window count) shape the sum of attributed lane·windows
+  equals the pack total *exactly* — including a mid-pack cancel (dead
+  lanes bill to the request that retired them) and the delta reuse
+  credit (zero new work, credit == the cached solve's lane·windows);
+- fused-vs-unfused cost parity modulo pad amortization: identical
+  topologies co-packed book the same per-request lane·windows as their
+  solo dispatches (zero pad), and the two live counters agree;
+- the SLO plane's multiwindow burn discipline: ``slo.burn`` fires exactly
+  once on a synthetic sustained breach, never on a lone spike, never on
+  recovery;
+- the ``cost.attribute`` fault point degrades to a *dropped* cost —
+  verdict and cert byte-identical with attribution off;
+- the adaptive fuse-window controller's decision table is pinned, and the
+  forced ``cost_window_decision_races_late_admit`` interleaving
+  (tools/analyze/schedules.py) passes on both topologies.
+"""
+
+import copy
+import json
+import threading
+
+import pytest
+
+from quorum_intersection_tpu.backends.base import CancelToken
+from quorum_intersection_tpu.cost import (
+    AUTO_WINDOW_BURN_CAP_MS,
+    AUTO_WINDOW_CAP_MS,
+    AUTO_WINDOW_FLOOR_MS,
+    SloPlane,
+    TenantTable,
+    attribute_pack,
+    choose_fuse_window,
+    fleet_tenant_table,
+    merge_costs,
+    merge_tenant_snapshots,
+    pack_lane_shares,
+    parse_slo,
+    reset_cost_state,
+    reuse_credit,
+    solo_cost,
+    tenant_table,
+)
+from quorum_intersection_tpu.fbas.synth import (
+    churn_trace,
+    majority_fbas,
+    stellar_like_fbas,
+)
+from quorum_intersection_tpu.pipeline import check_many, solve
+from quorum_intersection_tpu.serve import ServeEngine
+from quorum_intersection_tpu.utils import faults, telemetry
+import quorum_intersection_tpu.backends.tpu.sweep as sweep_mod
+import quorum_intersection_tpu.cost as cost_mod
+from tools.check_cert import check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    reset_cost_state()
+    yield record
+    faults.clear_plan()
+    reset_cost_state()
+    telemetry.reset_run_record()
+
+
+def serve_one(nodes, **kw):
+    engine = ServeEngine(backend=kw.pop("backend", "auto"), **kw)
+    try:
+        engine.start()
+        return engine.submit(nodes).result(timeout=120.0)
+    finally:
+        engine.stop(drain=True, timeout=30.0)
+
+
+def normalized(cert):
+    """A cert with the run-volatile provenance block dropped: what must
+    be byte-identical with attribution degraded."""
+    out = copy.deepcopy(cert)
+    out.pop("provenance", None)
+    return out
+
+
+class TestConservation:
+    """sum(attributed lane·windows) == pack total, exactly, always."""
+
+    SHAPES = [
+        (n_lanes, slot, k)
+        for n_lanes in (8, 16, 32, 64, 128)
+        for slot in (1, 2, 4, 8, 16)
+        for k in (1, 2, 3, 5, 7)
+        if k * slot <= n_lanes
+    ]
+
+    def test_lane_shares_conserve_every_shape(self):
+        for n_lanes, slot, k in self.SHAPES:
+            shares = pack_lane_shares(n_lanes, slot, k)
+            assert sum(shares) == n_lanes
+            assert len(shares) == k
+            assert all(s >= slot for s in shares)
+            # Pad splits as evenly as integers allow.
+            assert max(shares) - min(shares) <= 1
+
+    def test_lane_shares_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            pack_lane_shares(8, 4, 0)
+        with pytest.raises(ValueError):
+            pack_lane_shares(8, 8, 2)  # n_lanes < k*slot
+
+    def test_attribute_pack_conserves_every_shape(self):
+        for n_lanes, slot, k in self.SHAPES:
+            for pack_rows in (1, 7, 256):
+                # Duplicate origins (one request, many groups) merge.
+                origins = [f"job-{gix % max(1, k - 1)}" for gix in range(k)]
+                costs = attribute_pack(
+                    origins, n_lanes, slot, pack_rows,
+                    macs_per_row=n_lanes * 64, seconds=0.25,
+                )
+                total = sum(int(c["lane_windows"]) for c in costs.values())
+                assert total == n_lanes * pack_rows, (n_lanes, slot, k)
+                assert sum(int(c["lanes"]) for c in costs.values()) == n_lanes
+                assert sum(int(c["groups"]) for c in costs.values()) == k
+                # Pro-rated wall clock re-sums to the dispatch wall.
+                assert sum(float(c["device_s"]) for c in costs.values()) == \
+                    pytest.approx(0.25, abs=1e-6)
+
+    def test_cancelled_group_keeps_its_origin(self):
+        """A retired group's lanes bill to the canceller — ownership is
+        never reassigned mid-pack, so conservation needs no special
+        case for dead lanes."""
+        costs = attribute_pack(
+            ["req-dead", "req-live", "req-live"], 32, 8, 100,
+            macs_per_row=2048, seconds=0.1,
+        )
+        assert set(costs) == {"req-dead", "req-live"}
+        dead, live = costs["req-dead"], costs["req-live"]
+        assert int(dead["lane_windows"]) > 0
+        assert int(dead["lane_windows"]) + int(live["lane_windows"]) == \
+            32 * 100
+
+    def test_reuse_credit_is_zero_work_plus_credit(self):
+        cached = solo_cost(16, 256, macs_per_row=4096, seconds=0.5)
+        credit = reuse_credit(cached)
+        assert credit["reused"] is True
+        assert credit["lane_windows"] == 0
+        assert credit["macs"] == 0
+        assert credit["device_s"] == 0.0
+        assert credit["credit_lane_windows"] == cached["lane_windows"]
+        # A cost-less cached solve (python oracle) credits nothing.
+        assert reuse_credit(None)["credit_lane_windows"] == 0
+
+    def test_merge_costs_conserves_sums_and_credit(self):
+        parts = [
+            solo_cost(16, 256, macs_per_row=4096, seconds=0.5),
+            attribute_pack(["a"], 32, 16, 64,
+                           macs_per_row=2048, seconds=0.1)["a"],
+            reuse_credit(solo_cost(8, 32, macs_per_row=512, seconds=0.2)),
+        ]
+        merged = merge_costs(parts)
+        assert merged["lane_windows"] == \
+            sum(int(p["lane_windows"]) for p in parts)
+        assert merged["macs"] == sum(int(p["macs"]) for p in parts)
+        assert merged["fused"] is True
+        assert merged["reused"] is True
+        assert merged["credit_lane_windows"] == 8 * 32
+
+    def test_pack_counters_conserve_end_to_end(self, rec):
+        """Through the real sweep pack drain: attributed == total."""
+        streams = [majority_fbas(9, prefix=f"P{i}") for i in range(3)]
+        results = check_many(streams, backend="auto", pack=True,
+                             origins=["a", "b", "c"])
+        for res in results:
+            assert res.intersects is True
+            cost = res.stats.get("cost")
+            assert cost is not None and cost["fused"] is True
+            assert int(cost["lane_windows"]) > 0
+        counters, _ = rec.snapshot()
+        assert counters.get("cost.lane_windows_attributed", 0) > 0
+        assert counters["cost.lane_windows_attributed"] == \
+            counters["cost.lane_windows_total"]
+
+    def test_mid_pack_cancel_conserves_and_bills_canceller(self, rec):
+        """A token cancelled DURING the first sweep window retires its
+        lanes mid-pack; the dead request is still billed its full group
+        share and the live counters stay equal."""
+        tok = CancelToken()
+        real = sweep_mod.fault_point
+        state = {"hits": 0}
+
+        def cancel_mid(point):
+            if point == "sweep.window":
+                state["hits"] += 1
+                if state["hits"] == 1:
+                    tok.cancel()
+            return real(point)
+
+        sweep_mod.fault_point = cancel_mid
+        try:
+            dead, live = check_many(
+                [majority_fbas(13), majority_fbas(11)], backend="auto",
+                pack=True, cancels=[tok, None],
+                origins=["req-dead", "req-live"],
+            )
+        finally:
+            sweep_mod.fault_point = real
+        assert dead.stats.get("cancelled") is True
+        assert live.intersects is True
+        dead_cost = dead.stats.get("cost")
+        assert dead_cost is not None and int(dead_cost["lane_windows"]) > 0
+        counters, _ = rec.snapshot()
+        assert counters["cost.lane_windows_attributed"] == \
+            counters["cost.lane_windows_total"]
+
+
+class TestCostParity:
+    """Fused and unfused book the same work, modulo pad amortization."""
+
+    def test_identical_topologies_pad_free_parity(self, rec):
+        """Three identical-shape requests co-pack with zero pad, so each
+        fused share equals its solo dispatch's lane·windows exactly."""
+        streams = [majority_fbas(9, prefix=f"P{i}") for i in range(3)]
+        solo = solve(streams[0], backend="tpu-sweep").stats["cost"]
+        assert solo["fused"] is False
+        fused = check_many(streams, backend="auto", pack=True,
+                           origins=["a", "b", "c"])
+        for res in fused:
+            cost = res.stats["cost"]
+            assert cost["fused"] is True
+            assert cost["windows"] == solo["windows"]
+            assert cost["lane_windows"] == solo["lane_windows"]
+
+    def test_mixed_pack_amortizes_only_pad(self, rec):
+        """Different-size requests: each share is at least its ladder
+        slot and the excess over all slots is exactly the pack pad."""
+        streams = [majority_fbas(n) for n in (7, 9, 11)]
+        results = check_many(streams, backend="auto", pack=True,
+                             origins=list("abc"))
+        costs = [r.stats["cost"] for r in results]
+        windows = {int(c["windows"]) for c in costs}
+        assert len(windows) == 1  # one pack, one window count
+        lanes = [int(c["lanes"]) for c in costs]
+        slot = min(lanes)
+        n_lanes = sum(lanes)
+        assert sum(int(c["lane_windows"]) for c in costs) == \
+            n_lanes * windows.pop()
+        assert all(lane >= slot for lane in lanes)
+        assert max(lanes) - slot <= (n_lanes - 3 * slot) + 1
+
+
+class TestTenantTable:
+    def test_lru_bound_and_eviction_counter(self, rec):
+        table = TenantTable(capacity=3)
+        for i in range(5):
+            table.book(f"client-{i}",
+                       solo_cost(8, 4, macs_per_row=64, seconds=0.01))
+        assert len(table) == 3
+        snap = table.snapshot()
+        assert set(snap) == {"client-2", "client-3", "client-4"}
+        counters, _ = rec.snapshot()
+        assert counters.get("cost.tenants_evicted", 0) == 2
+
+    def test_booking_touches_lru_order(self, rec):
+        table = TenantTable(capacity=2)
+        table.book("a", None)
+        table.book("b", None)
+        table.book("a", None)  # touch: a is now most recent
+        table.book("c", None)  # evicts b, not a
+        assert set(table.snapshot()) == {"a", "c"}
+
+    def test_top_ranks_by_lane_windows_then_requests(self):
+        table = TenantTable(capacity=8)
+        table.book("small", solo_cost(1, 4, macs_per_row=1, seconds=0.0))
+        table.book("big", solo_cost(64, 64, macs_per_row=1, seconds=0.0))
+        table.book("chatty", None)
+        table.book("chatty", None)
+        ranked = [client for client, _ in table.top(2)]
+        assert ranked == ["big", "small"]
+
+    def test_merge_then_replace_never_double_counts(self):
+        part = {"t": {"requests": 2, "lane_windows": 100, "macs": 5,
+                      "credit_lane_windows": 0, "device_s": 0.5}}
+        merged = merge_tenant_snapshots([part, part])
+        assert merged["t"]["lane_windows"] == 200
+        fleet = TenantTable(capacity=8)
+        fleet.replace(merged)
+        fleet.replace(merged)  # cumulative snapshots: replace, not add
+        assert fleet.snapshot()["t"]["lane_windows"] == 200
+
+    def test_serve_books_clients_cache_hit_costless(self, rec):
+        """Alice's solve books real lane·windows; Bob's identical request
+        is a cache hit — the request books, zero new device work."""
+        nodes = majority_fbas(9)
+        engine = ServeEngine(backend="tpu-sweep")
+        try:
+            engine.start()
+            first = engine.submit(nodes, client="alice").result(timeout=120.0)
+            second = engine.submit(nodes, client="bob").result(timeout=120.0)
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        assert first.intersects is second.intersects is True
+        assert second.cached is True and second.cost is None
+        assert first.cost is not None
+        snap = tenant_table().snapshot()
+        assert snap["alice"]["requests"] == 1
+        assert snap["alice"]["lane_windows"] == first.cost["lane_windows"]
+        assert snap["bob"] == {"requests": 1, "lane_windows": 0, "macs": 0,
+                               "credit_lane_windows": 0, "device_s": 0.0}
+        assert first.cert["provenance"]["cost"] == first.cost
+
+    def test_serve_churn_books_delta_credit(self, rec):
+        """Delta-reused SCCs ride the wire as credits and aggregate into
+        the tenant's credit_lane_windows — never its lane_windows."""
+        base = stellar_like_fbas(n_core_orgs=3, per_org=2, n_watchers=12,
+                                 seed=7)
+        trace = churn_trace(base, 4, seed=3)
+        engine = ServeEngine(backend="tpu-sweep")
+        try:
+            engine.start()
+            responses = [
+                engine.submit(snap, client="churner").result(timeout=120.0)
+                for snap in trace
+            ]
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        assert all(r.intersects for r in responses)
+        reused = [r.cost for r in responses
+                  if r.cost is not None and r.cost.get("reused")]
+        assert reused, "churn never exercised delta reuse"
+        assert all(r["lane_windows"] == 0 for r in reused)
+        assert all(int(r["credit_lane_windows"]) > 0 for r in reused)
+        row = tenant_table().snapshot()["churner"]
+        assert row["requests"] == len(trace)
+        assert int(row["credit_lane_windows"]) >= len(reused)
+
+    def test_final_lines_carry_tenant_table(self, rec):
+        """The finish-time JSONL stream exports the table — and stays
+        byte-identical when nothing was booked."""
+        kinds = [line.get("kind") for line in rec.final_lines()]
+        assert "tenants" not in kinds
+        tenant_table().book("alice",
+                            solo_cost(8, 4, macs_per_row=64, seconds=0.01))
+        lines = [line for line in rec.final_lines()
+                 if line.get("kind") == "tenants"]
+        assert len(lines) == 1
+        assert lines[0]["schema"] == "qi-cost/1"
+        assert lines[0]["tenants"]["alice"]["requests"] == 1
+
+
+class TestSloPlane:
+    SPEC = "serve_e2e_p99_ms<500"
+
+    def test_parse_slo_clauses(self):
+        targets = parse_slo("serve_e2e_p99_ms<500, pack_fill_pct>60")
+        assert [(t.metric, t.op, t.bound) for t in targets] == [
+            ("serve_e2e_p99_ms", "<", 500.0),
+            ("pack_fill_pct", ">", 60.0),
+        ]
+        assert targets[0].violated(700.0) and not targets[0].violated(100.0)
+        assert targets[1].violated(50.0) and not targets[1].violated(80.0)
+        # Malformed clauses skip loudly, never raise.
+        assert parse_slo("nonsense,p99<abc,,") == []
+        assert parse_slo("") == [] and SloPlane(spec="").enabled is False
+
+    def _drive(self, plane, rec, value, start, n, step=60.0):
+        rec.gauge("serve.p99_ms", value)
+        t = start
+        for _ in range(n):
+            status = plane.evaluate(now=t)
+            t += step
+        return t, status
+
+    def test_burn_fires_once_on_breach_never_on_recovery(self, rec):
+        plane = SloPlane(spec=self.SPEC, fast_s=300.0, slow_s=3600.0)
+        t, status = self._drive(plane, rec, 120.0, 1000.0, 20)
+        assert status["burning"] == 0
+
+        # A lone spike inside a healthy fast window must NOT page.
+        t, _ = self._drive(plane, rec, 900.0, t, 1)
+        t, status = self._drive(plane, rec, 120.0, t, 5)
+        assert status["burning"] == 0
+        assert not [e for e in rec.events if e["name"] == "slo.burn"]
+
+        # A sustained breach pages exactly once.
+        t, status = self._drive(plane, rec, 900.0, t, 10)
+        assert status["burning"] == 1
+        (target,) = status["targets"]
+        assert target["burning"] is True
+        assert target["fast_ratio"] >= 0.5
+        burns = [e for e in rec.events if e["name"] == "slo.burn"]
+        assert len(burns) == 1
+        assert burns[0]["attrs"]["metric"] == "serve_e2e_p99_ms"
+        _, gauges = rec.snapshot()
+        assert gauges.get("slo.burning") == 1
+
+        # Recovery clears the gauge silently — no recovery event, no
+        # re-fire while the fast window drains the bad samples out.
+        t, status = self._drive(plane, rec, 120.0, t, 10)
+        assert status["burning"] == 0
+        assert plane.burning_count() == 0
+        assert len([e for e in rec.events if e["name"] == "slo.burn"]) == 1
+        _, gauges = rec.snapshot()
+        assert gauges.get("slo.burning") == 0
+
+    def test_evaluate_degrades_through_fault_point(self, rec):
+        plane = SloPlane(spec=self.SPEC, fast_s=300.0, slow_s=3600.0)
+        faults.install_plan(faults.parse_faults("cost.attribute=error@1+"))
+        status = plane.evaluate(now=1.0)
+        assert status["degraded"] is True
+        counters, _ = rec.snapshot()
+        assert counters.get("cost.attribute_errors", 0) == 1
+        degr = [e for e in rec.events if e["name"] == "cost.degraded"]
+        assert degr and degr[0]["attrs"]["site"] == "slo.evaluate"
+
+    def test_sloz_payload_carries_tenants(self, rec, monkeypatch):
+        monkeypatch.setenv("QI_SLO", self.SPEC)
+        reset_cost_state()
+        tenant_table().book("alice",
+                            solo_cost(8, 4, macs_per_row=64, seconds=0.01))
+        fleet_tenant_table().replace({"bob": {
+            "requests": 3, "lane_windows": 64, "macs": 9,
+            "credit_lane_windows": 0, "device_s": 0.1,
+        }})
+        from quorum_intersection_tpu.utils.metrics_server import (
+            healthz_payload, sloz_payload,
+        )
+        payload = sloz_payload()
+        assert payload["schema"] == "qi-slo/1"
+        assert payload["enabled"] is True
+        assert payload["tenants"]["local"][0]["client"] == "alice"
+        assert payload["tenants"]["fleet"][0]["client"] == "bob"
+        health = healthz_payload()
+        assert "slo_burning" in health
+        assert "cost_attribute_errors" in health
+
+
+class TestCostFaultPoint:
+    """cost.attribute=error: dropped cost, byte-identical everything."""
+
+    def test_degrade_leaves_verdict_and_cert_byte_identical(self, rec):
+        clean = {}
+        for fixture, verdict in FIXTURE_PAIRS:
+            res = solve(fixture_nodes(fixture), backend="tpu-sweep")
+            assert res.intersects is verdict
+            clean[fixture] = res
+        # Guard-short-circuited fixtures never dispatch a sweep; at least
+        # the swept ones must have stamped provenance.cost when healthy.
+        assert any("cost" in r.cert.get("provenance", {})
+                   for r in clean.values())
+        faults.clear_plan()
+        telemetry.reset_run_record()
+        rec = telemetry.get_run_record()
+        faults.install_plan(faults.parse_faults("cost.attribute=error@1+"))
+        for fixture, verdict in FIXTURE_PAIRS:
+            res = solve(fixture_nodes(fixture), backend="tpu-sweep")
+            assert res.intersects is verdict
+            assert res.stats.get("cost") is None
+            assert "cost" not in res.cert.get("provenance", {})
+            assert json.dumps(normalized(res.cert), sort_keys=True) == \
+                json.dumps(normalized(clean[fixture].cert), sort_keys=True)
+            check_certificate(res.cert, fixture_nodes(fixture))
+        n_swept = sum(1 for r in clean.values()
+                      if "cost" in r.cert.get("provenance", {}))
+        counters, _ = rec.snapshot()
+        assert counters.get("cost.attribute_errors", 0) >= n_swept
+        assert counters.get("cost.lane_windows_attributed", 0) == 0
+        # The degraded total still counts the device work that happened.
+        assert counters.get("cost.lane_windows_total", 0) > 0
+        sites = {e["attrs"]["site"] for e in rec.events
+                 if e["name"] == "cost.degraded"}
+        assert "sweep.solo" in sites
+
+    def test_serve_degrade_books_nothing_answers_everything(self, rec):
+        faults.install_plan(faults.parse_faults("cost.attribute=error@1+"))
+        resp = serve_one(majority_fbas(9), backend="tpu-sweep")
+        assert resp.intersects is True
+        assert resp.cost is None
+        assert "cost" not in resp.cert.get("provenance", {})
+        check_certificate(resp.cert, majority_fbas(9))
+        assert len(tenant_table()) == 0
+        counters, _ = rec.snapshot()
+        assert counters.get("cost.attribute_errors", 0) >= 1
+
+
+class TestAutoWindow:
+    """The closed loop's decision table, pinned."""
+
+    @pytest.mark.parametrize("depth,p99,burning,expect", [
+        (0, 100.0, False, 0.0),     # sparse: never wait on nothing
+        (0, 100.0, True, 0.0),
+        (5, 100.0, False, AUTO_WINDOW_CAP_MS),
+        (4, 60.0, False, 15.0),     # p99/4 inside [floor, cap]
+        (3, 2.0, False, AUTO_WINDOW_FLOOR_MS),
+        (5, 100.0, True, AUTO_WINDOW_BURN_CAP_MS),
+        (3, 2.0, True, AUTO_WINDOW_FLOOR_MS),  # floor already under cap
+    ])
+    def test_decision_table(self, depth, p99, burning, expect):
+        assert choose_fuse_window(depth, p99, burning) == expect
+
+    def test_decision_bounds_hold_everywhere(self):
+        for depth in (0, 1, 3, 17):
+            for p99 in (0.0, 1.0, 40.0, 10_000.0):
+                for burning in (False, True):
+                    w = choose_fuse_window(depth, p99, burning)
+                    assert 0.0 <= w <= AUTO_WINDOW_CAP_MS
+                    if depth <= 0:
+                        assert w == 0.0
+                    else:
+                        assert w >= min(AUTO_WINDOW_FLOOR_MS,
+                                        AUTO_WINDOW_CAP_MS)
+                        if burning:
+                            assert w <= AUTO_WINDOW_BURN_CAP_MS
+
+    def test_engine_accepts_auto_and_decides_per_flush(self, rec):
+        """End-to-end: an 'auto' engine answers correctly and logs a
+        serve.fuse_window decision for its drain cycle."""
+        engine = ServeEngine(backend="python", fuse_window_ms="auto")
+        try:
+            engine.start()
+            resp = engine.submit(majority_fbas(9)).result(timeout=120.0)
+        finally:
+            engine.stop(drain=True, timeout=30.0)
+        assert resp.intersects is True
+        decisions = [e for e in rec.events
+                     if e["name"] == "serve.fuse_window"]
+        assert decisions
+        for d in decisions:
+            assert 0.0 <= d["attrs"]["window_ms"] <= AUTO_WINDOW_CAP_MS
+        _, gauges = rec.snapshot()
+        assert "serve.fuse_window_ms" in gauges
+
+
+class TestForcedCostSchedules:
+    """The window-decision-vs-late-admit interleaving, forced every run
+    (the same harness `python -m tools.analyze race` executes in CI)."""
+
+    @pytest.fixture(scope="class")
+    def results(self):
+        from tools.analyze.schedules import run_cost_schedules
+
+        return run_cost_schedules()
+
+    def test_all_schedules_pass_both_topologies(self, results):
+        from tools.analyze.schedules import COST_SCHEDULES
+
+        assert "cost_window_decision_races_late_admit" in COST_SCHEDULES
+        assert len(results) == len(COST_SCHEDULES) * 2
+        bad = [r for r in results if not r.ok]
+        assert not bad, bad
+
+    def test_late_admit_gets_its_own_decision(self, results):
+        for r in results:
+            assert r.trace.count("cost.window.decide") >= 2
+
+    def test_hook_restored_and_no_leaked_drains(self, results):
+        assert cost_mod._cost_sync is None
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("qi-serve-drain")
+        ]

@@ -211,6 +211,40 @@ def _race_pass(root: Path) -> tuple:
             f"interleavings, verdicts identical to the one-shot pipeline"
         )
 
+    # qi-cost schedules (ISSUE 17): the adaptive fuse-window controller's
+    # decision-vs-late-admit ordering, forced through cost._cost_sync the
+    # same way the fuse orderings go through fuse._fuse_sync.
+    from tools.analyze.schedules import run_cost_schedules
+
+    try:
+        cost_results = run_cost_schedules()
+    except ScheduleError as exc:
+        findings.append(Finding(
+            rule="race-schedule", path="quorum_intersection_tpu/cost.py",
+            line=1, message=str(exc),
+        ))
+        cost_results = []
+    for r in cost_results:
+        if not r.ok:
+            detail = (
+                r.error if r.error is not None else
+                f"produced verdict {r.verdict} (one-shot pipeline says "
+                f"{r.expected})"
+            )
+            findings.append(Finding(
+                rule="race-schedule",
+                path="quorum_intersection_tpu/cost.py", line=1,
+                message=(
+                    f"forced interleaving {r.schedule!r} on {r.topology}: "
+                    f"{detail}"
+                ),
+            ))
+    if cost_results:
+        notes.append(
+            f"cost schedules: {len(cost_results)} forced window-decision "
+            f"interleavings, verdicts identical to the one-shot pipeline"
+        )
+
     from quorum_intersection_tpu.backends.cpp import build_native_cli
 
     try:

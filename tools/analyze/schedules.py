@@ -956,6 +956,121 @@ def run_fuse_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
     return results
 
 
+# ---- qi-cost adaptive-window schedules (ISSUE 17) ---------------------------
+#
+# The pulse-driven fuse-window controller (cost.choose_fuse_window, called
+# from the serve drain's _auto_fuse_window) adds one more ordering surface:
+# an admission landing WHILE a window decision is in flight.  The late
+# request must ride the next drain cycle and earn its OWN decision — never
+# wedge behind a held controller, never silently inherit the in-flight
+# batch.  ``cost._cost_sync`` is the hook, exactly like serve/fuse's.
+
+COST_SCHEDULES = (
+    "cost_window_decision_races_late_admit",
+)
+
+_REQUIRED_COST_POINTS: Dict[str, tuple] = {
+    # The controller must have decided at least twice: once for the batch
+    # it was held on, once for the late admission's own drain cycle.
+    "cost_window_decision_races_late_admit": ("cost.window.decide",),
+}
+
+
+def _run_cost_one(schedule: str, data: object, expected: bool,
+                  topology: str) -> ScheduleResult:
+    import quorum_intersection_tpu.cost as cost_mod
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.serve import ServeEngine
+
+    ctl = SyncController()
+    release = threading.Event()
+    verdict: Optional[bool] = None
+    error: Optional[str] = None
+    old_sync = cost_mod._cost_sync
+    cost_mod._cost_sync = ctl
+    engine: Optional[ServeEngine] = None
+    try:
+        if schedule == "cost_window_decision_races_late_admit":
+            # The drain pops request A and its window decision is HELD
+            # mid-flight; request B is admitted meanwhile.  On release, A
+            # must drain with the held decision's window, B must pop on
+            # the NEXT cycle with a fresh decision — two decisions in the
+            # trace, both verdicts correct.
+            ctl.hold("cost.window.decide", release)
+            engine = ServeEngine(
+                backend="python", fuse_window_ms="auto", batch_max=1,
+                queue_depth=8,
+            )
+            ticket_a = engine.submit(data)
+            engine.start()
+            if not ctl.reached_event("cost.window.decide").wait(WAIT_S):
+                raise ScheduleError("window decision never reached")
+            ticket_b = engine.submit(majority_fbas(7, prefix="LATE"))
+            release.set()
+            resp_a = ticket_a.result(timeout=WAIT_S)
+            resp_b = ticket_b.result(timeout=WAIT_S)
+            engine.stop(drain=True, timeout=WAIT_S)
+            if ctl.trace.count("cost.window.decide") < 2:
+                error = (
+                    f"late admission never earned its own window decision "
+                    f"(trace {ctl.trace!r})"
+                )
+            elif resp_b.intersects is not True:
+                error = "late request's majority-7 verdict flipped"
+            else:
+                verdict = resp_a.intersects
+        else:
+            raise ValueError(f"unknown cost schedule {schedule!r}")
+    finally:
+        cost_mod._cost_sync = old_sync
+        release.set()
+        if engine is not None:
+            engine.stop(drain=False, timeout=WAIT_S)
+    missing = [
+        p for p in _REQUIRED_COST_POINTS[schedule] if p not in ctl.trace
+    ]
+    if error is None and missing:
+        error = f"ordering never happened: sync point(s) {missing} not reached"
+    return ScheduleResult(
+        schedule=schedule,
+        topology=topology,
+        verdict=bool(verdict),
+        expected=expected,
+        winner="cost",
+        oracle_outcome="-",
+        trace=list(ctl.trace),
+        error=error,
+    )
+
+
+def run_cost_schedules(join_timeout: float = 5.0) -> List[ScheduleResult]:
+    """Every cost schedule × {intersecting, broken} topology; ground truth
+    from the one-shot pipeline.  Leaked drain threads are a failure."""
+    from quorum_intersection_tpu.fbas.synth import majority_fbas
+    from quorum_intersection_tpu.pipeline import solve
+
+    results: List[ScheduleResult] = []
+    for broken in (False, True):
+        data = majority_fbas(9, broken=broken)
+        topology = "majority9-broken" if broken else "majority9"
+        expected = solve(data, backend="python").intersects
+        for schedule in COST_SCHEDULES:
+            results.append(_run_cost_one(schedule, data, expected, topology))
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("qi-serve-drain")
+    ]
+    for t in leaked:
+        t.join(timeout=join_timeout)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        raise ScheduleError(
+            f"{len(leaked)} serve drain thread(s) still alive after "
+            f"{join_timeout}s — a cost schedule leaked its engine"
+        )
+    return results
+
+
 def run_all(join_timeout: float = 5.0) -> List[ScheduleResult]:
     """Every schedule × {intersecting, broken} topology.  The expected
     verdict is computed by the sequential (race=False) chain with the real

@@ -141,6 +141,20 @@ TRACKED: Dict[str, str] = {
     "sweep_pack_fill_pct": "higher",
     "fuse_cross_request_lane_pct": "higher",
     "fuse_serve_solve_p99_ms": "lower",
+    # qi-cost attribution + adaptive fusion (ISSUE 17): benchmarks/serve.py
+    # --fuse auto-window arm.  `fuse_auto_window_ms` is the controller's
+    # bursty-phase decision — a collapse to 0 means adaptive fusion
+    # stopped recognizing a hot queue (every burst drains unfused);
+    # `cost_attributed_pct` is attributed lane-windows over dispatched
+    # lane-windows — anything under 100 in a fault-free bench means part
+    # of the device bill silently lost its owner.
+    "fuse_auto_window_ms": "higher",
+    "cost_attributed_pct": "higher",
+    # Multichip dryrun rows (MULTICHIP_r*.json driver wrappers): the mesh
+    # smoke's sweep-candidate count and frontier device-resident states —
+    # a drop means the sharded paths silently shrank their coverage.
+    "multichip_sweep_candidates": "higher",
+    "multichip_frontier_states": "higher",
     # latency-shaped rows
     "snapshot_verdict_seconds": "lower",
     "verdict_256.auto_seconds": "lower",
@@ -177,6 +191,11 @@ TELEMETRY_GAUGES = (
     "fuse.fill_pct",
     "fuse.bench_fill_pct",
     "fuse.bench_cross_request_lane_pct",
+    "serve.fuse_window_ms",
+    "fuse.bench_auto_window_ms",
+    "cost.bench_attributed_pct",
+    "slo.burning",
+    "fleet.cost_tenants",
 )
 
 
@@ -247,6 +266,54 @@ def load_bench_wrapper(path: Path) -> Tuple[Optional[dict], str]:
     return row, "ok"
 
 
+_MULTICHIP_RE = re.compile(
+    r"dryrun_multichip OK: (\d+)-device mesh, (\d+) (?:sweep )?candidates"
+)
+_MULTICHIP_STATES_RE = re.compile(r"\((\d+) device-resident states\)")
+
+
+def load_multichip_wrapper(path: Path) -> Tuple[Optional[dict], str]:
+    """One ``MULTICHIP_r*.json`` dryrun wrapper -> (bench row, note).
+
+    The dryrun prints a human OK line, not a JSON row, so the metrics are
+    lifted by regex from the tail: mesh size, sweep-candidate count and
+    (when the frontier path ran) device-resident states.  A failed or
+    skipped round — or a tail whose OK line was buried under runtime
+    noise (r01's AOT loader spew) — is a skipped run, never a schema
+    error.  The device string is ``dryrun-mesh-N`` so these rows only
+    ever baseline against other dryruns of the same mesh size, never
+    against real bench rows.
+    """
+    try:
+        wrapper = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SchemaError(f"{path.name}: unreadable multichip wrapper: {exc}")
+    if not isinstance(wrapper, dict) or "tail" not in wrapper:
+        raise SchemaError(
+            f"{path.name}: expected a driver wrapper with a 'tail' field"
+        )
+    if wrapper.get("rc") not in (0, None) or wrapper.get("skipped"):
+        return None, (
+            f"skipped (rc={wrapper.get('rc')}, "
+            f"skipped={bool(wrapper.get('skipped'))}: dryrun did not "
+            f"complete)"
+        )
+    tail = str(wrapper.get("tail", ""))
+    m = _MULTICHIP_RE.search(tail)
+    if m is None:
+        return None, "skipped (no dryrun_multichip OK line in tail)"
+    n_devices = int(m.group(1))
+    row: dict = {
+        "multichip_devices": n_devices,
+        "multichip_sweep_candidates": int(m.group(2)),
+        "device": f"dryrun-mesh-{n_devices}",
+    }
+    states = _MULTICHIP_STATES_RE.search(tail)
+    if states is not None:
+        row["multichip_frontier_states"] = int(states.group(1))
+    return row, "ok"
+
+
 def load_result_row(path: Path) -> dict:
     """One complete bench row under benchmarks/results/."""
     try:
@@ -277,6 +344,9 @@ def load_history(
     for path in sorted(repo.glob("BENCH_r*.json")):
         row, note = load_bench_wrapper(path)
         entries.append(((_round_of(path.name), 0), path.name, row, note))
+    for path in sorted(repo.glob("MULTICHIP_r*.json")):
+        row, note = load_multichip_wrapper(path)
+        entries.append(((_round_of(path.name), 2), path.name, row, note))
     results = repo / "benchmarks" / "results"
     if results.is_dir():
         for path in sorted(results.glob("bench_full_r*_onchip.json")):
@@ -412,23 +482,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"bench-trend: {len(runs)} parseable run(s) under {repo}")
     for note in notes:
         print(f"  note: {note}")
+    # The multichip dryrun family trends in its OWN lane: its rows carry
+    # none of the bench metrics, so letting a MULTICHIP round become "the
+    # latest run" would silently un-gate every real bench number.
+    multichip = [r for r in runs if r[2].startswith("dryrun-mesh-")]
+    bench = [r for r in runs if not r[2].startswith("dryrun-mesh-")]
+    rc = 0
+    regressions: List[str] = []
     if not runs:
         print("no bench history to compare — nothing gated")
-        rc = 0
-    else:
-        print(f"latest run: {runs[-1][0]} (device: {runs[-1][2]})")
-        rows, regressions = trend(runs, tolerances, args.tolerance)
+    for label, family in (("bench", bench), ("multichip", multichip)):
+        if not family:
+            continue
+        print(f"latest {label} run: {family[-1][0]} "
+              f"(device: {family[-1][2]})")
+        rows, regs = trend(family, tolerances, args.tolerance)
+        regressions.extend(regs)
         if rows:
             print(_table(
                 rows, ["metric", "best_prior", "latest", "delta", "status"]
             ))
         else:
             print("(no tracked metrics present)")
-        rc = 0
-        if regressions:
-            for reg in regressions:
-                print(f"REGRESSION: {reg}", file=sys.stderr)
-            rc = 0 if args.informational else 1
+    if regressions:
+        for reg in regressions:
+            print(f"REGRESSION: {reg}", file=sys.stderr)
+        rc = 0 if args.informational else 1
 
     if args.telemetry:
         text, sry = telemetry_section(args.telemetry[:2])

@@ -278,6 +278,57 @@ env JAX_PLATFORMS=cpu python benchmarks/serve.py --quick --fuse
 furc=$?
 echo "FUSE_BENCH=exit $furc"
 
+# qi-cost gate (ISSUE 17, docs/OBSERVABILITY.md §Cost & SLO): a mixed
+# fused stream where every delivered verdict carries its own bill —
+# attributed lane·windows must equal the device total EXACTLY (the
+# conservation invariant, 100% attribution), the per-response costs
+# must re-sum to the attributed counter, and a live /sloz scrape must
+# answer the declared target plus the per-tenant tables.
+env JAX_PLATFORMS=cpu QI_SLO="serve_e2e_p99_ms<600000" python - <<'PYEOF'
+import json
+import urllib.request
+
+from quorum_intersection_tpu.fbas.synth import majority_fbas
+from quorum_intersection_tpu.serve import ServeEngine
+from quorum_intersection_tpu.utils.metrics_server import MetricsServer
+from quorum_intersection_tpu.utils.telemetry import get_run_record
+
+rec = get_run_record()
+workload = [majority_fbas(n, prefix=f"T{i}")
+            for i, n in enumerate((7, 9, 11, 9, 7, 11))]
+engine = ServeEngine(backend="auto", pack=True, fuse_window_ms=200.0)
+tickets = [engine.submit(nodes, client=f"ci-{i % 2}")
+           for i, nodes in enumerate(workload)]
+engine.start()  # queue before start: the drain fuses the whole burst
+try:
+    responses = [t.result(timeout=300.0) for t in tickets]
+finally:
+    engine.stop(drain=True, timeout=60.0)
+assert all(r.intersects for r in responses)
+counters, _ = rec.snapshot()
+attr = counters.get("cost.lane_windows_attributed", 0)
+total = counters.get("cost.lane_windows_total", 0)
+assert total > 0 and attr == total, f"conservation broke: {attr} != {total}"
+delivered = sum(r.cost["lane_windows"] for r in responses if r.cost)
+assert delivered == attr, f"delivered {delivered} != attributed {attr}"
+assert any(r.cost and r.cost.get("fused") for r in responses), \
+    "no response billed a fused pack"
+srv = MetricsServer(port=0)
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/sloz", timeout=10).read()
+finally:
+    srv.stop()
+payload = json.loads(body)
+assert payload["schema"] == "qi-slo/1" and payload["enabled"] is True
+tenants = {row["client"] for row in payload["tenants"]["local"]}
+assert tenants >= {"ci-0", "ci-1"}, tenants
+print(f"COST: {len(responses)} verdicts, {attr} lane-windows attributed "
+      f"== device total (100%), /sloz tenants {sorted(tenants)}")
+PYEOF
+corc=$?
+echo "COST=exit $corc"
+
 # Bench-trend sentinel (docs/OBSERVABILITY.md §Trends): the committed
 # BENCH_r*.json history rendered as a trend table, informational on
 # regressions (the measurement rig varies per round) but hard on schema
@@ -300,4 +351,5 @@ echo "TREND=exit $trc"
 [ "$qrc" -ne 0 ] && exit "$qrc"
 [ "$purc" -ne 0 ] && exit "$purc"
 [ "$furc" -ne 0 ] && exit "$furc"
+[ "$corc" -ne 0 ] && exit "$corc"
 exit "$trc"

@@ -75,6 +75,7 @@ def load_stream(path: str) -> dict:
     counters: Dict[str, float] = defaultdict(float)
     gauges: Dict[str, object] = {}
     histograms: Dict[str, dict] = {}
+    tenants: List[dict] = []
     meta: List[dict] = []
     bad = 0
     with open(path, "r", encoding="utf-8") as fh:
@@ -101,6 +102,10 @@ def load_stream(path: str) -> dict:
                     _merge_histogram(histograms, line)
                 except ValueError:
                     bad += 1
+            elif kind == "tenants":
+                # qi-cost (ISSUE 17): one per-tenant cost table per process,
+                # emitted at record finish; merged client-wise on render.
+                tenants.append(line)
             elif kind == "meta":
                 meta.append(line)
             # "log" lines (QI_LOG_JSON interleaving) pass through silently
@@ -110,6 +115,7 @@ def load_stream(path: str) -> dict:
         "counters": dict(counters),
         "gauges": gauges,
         "histograms": histograms,
+        "tenants": tenants,
         "meta": meta,
         "bad_lines": bad,
     }
@@ -333,6 +339,55 @@ def histogram_table(histograms: Dict[str, dict]) -> str:
                          "p50_le_ms", "p99_le_ms"])
 
 
+def merge_tenants(tenant_lines: List[dict]) -> Dict[str, dict]:
+    """Fold the per-process ``kind: tenants`` lines (qi-cost, ISSUE 17)
+    into one client→cost view — field-wise addition, the table's own merge
+    law, the stdlib twin of ``cost.merge_tenant_snapshots`` so this
+    reporter stays import-free of the package."""
+    merged: Dict[str, dict] = {}
+    for line in tenant_lines:
+        table = line.get("tenants")
+        if not isinstance(table, dict):
+            continue
+        for client, row in table.items():
+            if not isinstance(row, dict):
+                continue
+            cur = merged.setdefault(str(client), {
+                "requests": 0, "lane_windows": 0, "macs": 0,
+                "credit_lane_windows": 0, "device_s": 0.0,
+            })
+            for key in ("requests", "lane_windows", "macs",
+                        "credit_lane_windows"):
+                cur[key] += int(row.get(key) or 0)
+            cur["device_s"] += float(row.get("device_s") or 0.0)
+    return merged
+
+
+def tenant_table_section(tenant_lines: List[dict], top: int) -> str:
+    """The ``--top N`` per-tenant device-cost table: who occupied the MXU,
+    ranked by attributed lane·windows (ties by request count)."""
+    merged = merge_tenants(tenant_lines)
+    ranked = sorted(
+        merged.items(),
+        key=lambda kv: (-kv[1]["lane_windows"], -kv[1]["requests"], kv[0]),
+    )
+    rows = [
+        [client, int(r["requests"]), int(r["lane_windows"]),
+         int(r["credit_lane_windows"]), int(r["macs"]),
+         f"{r['device_s']:.6f}"]
+        for client, r in ranked[:max(top, 0) or len(ranked)]
+    ]
+    if not rows:
+        return "(no tenant costs)"
+    head = (f"tenants: {len(merged)}"
+            + (f"   (top {top} by lane_windows)"
+               if 0 < top < len(merged) else ""))
+    return head + "\n" + _table(
+        rows, ["client", "requests", "lane_windows", "credit_lw", "macs",
+               "device_s"],
+    )
+
+
 def export_chrome(data: dict, out_path: str, merge: bool = False) -> int:
     """Export a loaded stream as Chrome/Perfetto trace-event JSON
     (ISSUE 15): spans become complete duration events on their real
@@ -480,7 +535,7 @@ def render_diff(path_a: str, path_b: str) -> str:
     )
 
 
-def render(path: str, tail: int = 0) -> str:
+def render(path: str, tail: int = 0, top: int = 10) -> str:
     data = load_stream(path)
     pids = {m.get("pid") for m in data["meta"]}
     head = (
@@ -506,6 +561,13 @@ def render(path: str, tail: int = 0) -> str:
             "\n== latency histograms (qi-pulse) ==\n"
             + histogram_table(data["histograms"])
         )
+    if data["tenants"]:
+        # Same conditional-append discipline: a stream without cost lines
+        # renders byte-identically to its pre-cost report.
+        sections.append(
+            "\n== per-tenant device cost (qi-cost) ==\n"
+            + tenant_table_section(data["tenants"], top)
+        )
     return "\n".join(sections)
 
 
@@ -514,6 +576,11 @@ def main() -> int:
     parser.add_argument("path", help="qi-telemetry/1 JSONL file")
     parser.add_argument("--windows", type=int, default=0, metavar="N",
                         help="also list the last N sweep windows")
+    parser.add_argument("--top", type=int, default=10, metavar="N",
+                        help="per-tenant cost table depth (qi-cost): show "
+                             "the N costliest clients by attributed "
+                             "lane-windows (0 = all; the section renders "
+                             "only when the stream carries cost lines)")
     parser.add_argument("--diff", metavar="PATH_B", default=None,
                         help="compare PATH (baseline) against PATH_B: "
                              "counter/gauge/span-total deltas instead of "
@@ -533,7 +600,7 @@ def main() -> int:
         if args.diff:
             print(render_diff(args.path, args.diff))
         else:
-            print(render(args.path, args.windows))
+            print(render(args.path, args.windows, args.top))
         if args.chrome:
             n = export_chrome(load_stream(args.path), args.chrome,
                               merge=args.merge)

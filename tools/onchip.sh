@@ -129,8 +129,13 @@ run_phase() {
             # qi-fuse on real hardware: the fused vs unfused serve drain
             # head-to-head (cross-request lanes, tile fill, byte-parity
             # certs all gated by the driver itself) — on-chip is where the
-            # fused-tile win is a real MXU number, not CPU emulation
-            timeout 1800 python -u benchmarks/serve.py --fuse \
+            # fused-tile win is a real MXU number, not CPU emulation.
+            # QI_SLO arms the qi-cost burn plane so the auto-window arm
+            # exercises the full closed loop (decision events + burn
+            # clamping) against real device latencies; the loose bound
+            # never burns on a healthy chip.
+            timeout 1800 env QI_SLO="serve_e2e_p99_ms<600000" \
+                python -u benchmarks/serve.py --fuse \
                 --backend tpu \
                 2>&1 | tee "$R/serve_fuse_tpu_${ROUND}.txt" ;;
         *)
