@@ -27,7 +27,6 @@ tests (``tests/test_pagerank.py``).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -115,10 +114,18 @@ def pagerank_np(
 
 
 def _jitted_power_loops():
-    """Module-cached jitted loops (dense and sparse): compiled once per
-    array *shape*, so repeat calls — and equal-size graphs — reuse the
-    executable instead of re-tracing (a fresh ``jax.jit(lambda …)`` per
-    call would recompile every time)."""
+    """Module-cached jitted loops (dense and sparse), **ladder-shaped**:
+    operands are padded to the canonical :data:`PAD_LADDER` rung by
+    :func:`pagerank` and the true sizes ride along as *traced* scalars,
+    so one compile serves every graph in a rung bucket.  (The previous
+    shape-specialized signature recompiled the while_loop program for
+    every distinct graph size — a recompile hazard on the serve-drain
+    hot path, flagged by ``tools/analyze`` pass 7.)
+
+    Padding is inert by construction: padded rows/columns/edges carry
+    zero out-degree and zero scatter weight, so they contribute exact
+    ``0.0`` terms to every accumulation; the per-vertex base mass is
+    masked to the true ``n`` vertices."""
     global _POWER_LOOPS
     if _POWER_LOOPS is not None:
         return _POWER_LOOPS
@@ -128,7 +135,11 @@ def _jitted_power_loops():
 
     def loop(matvec, outdeg_j, mf, conv, max_iterations, n):
         inv_out = jnp.where(outdeg_j > 0, 1.0 / jnp.maximum(outdeg_j, 1.0), 0.0)
-        base = mf / n
+        base = mf / n.astype(jnp.float32)
+        # 1.0 on the true n vertices, 0.0 on ladder padding: the base
+        # mass lands only on real vertices (padded matvec/outdeg terms
+        # are already exactly zero).
+        mask = (jnp.arange(outdeg_j.shape[0]) < n).astype(jnp.float32)
 
         def cond(carry):
             rank, diff, it = carry
@@ -137,24 +148,25 @@ def _jitted_power_loops():
         def body(carry):
             rank, _, it = carry
             send = (1 - mf) * inv_out * rank
-            tmp = base + matvec(send)
+            tmp = (base + matvec(send)) * mask
             total = mf + jnp.sum(outdeg_j * send)
             diff = jnp.sum(jnp.abs(tmp - rank))
             return tmp / total, diff, it + 1
 
-        rank0 = jnp.zeros(n, dtype=jnp.float32).at[0].set(1.0)
+        rank0 = jnp.zeros(outdeg_j.shape[0], dtype=jnp.float32).at[0].set(1.0)
         rank, _, _ = lax.while_loop(cond, body, (rank0, conv + 1, jnp.int32(0)))
         return rank
 
     @jax.jit
-    def dense(a, mf, conv, max_iterations):
+    def dense(a, mf, conv, max_iterations, n):
         return loop(lambda s: a.T @ s, a.sum(axis=1), mf, conv,
-                    max_iterations, a.shape[0])
+                    max_iterations, n)
 
-    @partial(jax.jit, static_argnames=("n",))
-    def sparse(src, dst, outdeg_j, mf, conv, max_iterations, n):
+    @jax.jit
+    def sparse(src, dst, outdeg_j, edge_mask, mf, conv, max_iterations, n):
         def matvec(send):
-            return jnp.zeros(n, dtype=jnp.float32).at[dst].add(send[src])
+            return jnp.zeros(outdeg_j.shape[0], dtype=jnp.float32) \
+                .at[dst].add(send[src] * edge_mask)
 
         return loop(matvec, outdeg_j, mf, conv, max_iterations, n)
 
@@ -176,25 +188,45 @@ def pagerank(
 
     Dense path: one matvec per iteration on the MXU.  Sparse path: gather +
     ``.at[dst].add`` segment-sum — O(E) work and memory per iteration.
+    Vertex and edge counts round up to the canonical pad ladder
+    (``encode/circuit.py``), so compiled program shapes collapse to one
+    per rung bucket instead of one per exact graph size.
     """
     n = graph.n
     if n == 0:
         return np.zeros(0, dtype=np.float32)
     import jax.numpy as jnp
 
+    from quorum_intersection_tpu.encode.circuit import ladder_up
+
     dense_fn, sparse_fn = _jitted_power_loops()
     mf = jnp.float32(m)
     conv = jnp.float32(convergence)
     max_it = jnp.int32(max_iterations)
+    n_pad = ladder_up(n)
+    n_true = jnp.int32(n)
     if _use_dense(graph, dense):
-        rank = dense_fn(jnp.asarray(adjacency_counts(graph)), mf, conv, max_it)
+        a_np = adjacency_counts(graph)
+        if n_pad != n:
+            a_np = np.pad(a_np, ((0, n_pad - n), (0, n_pad - n)))
+        rank = dense_fn(jnp.asarray(a_np), mf, conv, max_it, n_true)
     else:
         src_np, dst_np, outdeg_np = edge_arrays(graph)
+        n_edges = len(src_np)
+        e_pad = ladder_up(max(n_edges, 1))
+        edge_mask = np.zeros(e_pad, dtype=np.float32)
+        edge_mask[:n_edges] = 1.0
+        src_p = np.zeros(e_pad, dtype=np.int32)
+        src_p[:n_edges] = src_np
+        dst_p = np.zeros(e_pad, dtype=np.int32)
+        dst_p[:n_edges] = dst_np
+        outdeg_p = np.pad(outdeg_np, (0, n_pad - n))
         rank = sparse_fn(
-            jnp.asarray(src_np), jnp.asarray(dst_np), jnp.asarray(outdeg_np),
-            mf, conv, max_it, n,
+            jnp.asarray(src_p), jnp.asarray(dst_p), jnp.asarray(outdeg_p),
+            jnp.asarray(edge_mask), mf, conv, max_it, n_true,
         )
-    return np.asarray(rank)
+    # qi-lint: allow(hygiene-host-sync) — the single sanctioned readback after convergence; one transfer per query
+    return np.asarray(rank)[:n]
 
 
 

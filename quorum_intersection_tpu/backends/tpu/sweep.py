@@ -1715,6 +1715,7 @@ class TpuSweepBackend:
             jobs[j].cancelled_windows = dropped
             jobs[j].resolved = True
             unresolved.discard(j)
+            rec.add("sweep.windows_cancelled", dropped)
             rec.add("cert.windows_cancelled", dropped)
             rec.event(
                 "sweep.cancelled", packed=True,

@@ -1,0 +1,8 @@
+"""BAD twin: the early return delivers no closure leg — a silent drop."""
+
+
+def resolve(rec, entry, verdict):
+    if entry.cancelled:
+        return None  # BAD: neither verdicts nor errors booked on this exit
+    rec.add("serve.verdicts", 1)
+    return verdict

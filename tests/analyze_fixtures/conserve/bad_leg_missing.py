@@ -1,0 +1,15 @@
+"""BAD twin: the except arm books only the ledger leg of the cancel pair."""
+
+
+def drain(rec, jobs):
+    done = 0
+    for job in jobs:
+        try:
+            job.run()
+            rec.add("sweep.windows_cancelled", 0)
+            rec.add("cert.windows_cancelled", 0)
+            done += 1
+        except RuntimeError:
+            rec.add("cert.windows_cancelled", 1)
+            return done  # BAD: exits with only the ledger twin booked
+    return done

@@ -1,0 +1,9 @@
+"""GOOD twin: every exit path books exactly one closure leg."""
+
+
+def resolve(rec, entry, verdict):
+    if entry.cancelled:
+        rec.add("serve.errors", 1)
+        return None
+    rec.add("serve.verdicts", 1)
+    return verdict

@@ -1,0 +1,18 @@
+"""BAD twin: a per-window host readback inside the hot drive loop."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x):
+    return jnp.sum(x * x)
+
+
+def drive(rec, xs):
+    entry = jax.jit(_kernel)
+    with rec.span("sweep.drive"):
+        total = 0.0
+        for x in xs:
+            y = entry(x)
+            total += float(y)  # BAD: hidden device sync every window
+        return total

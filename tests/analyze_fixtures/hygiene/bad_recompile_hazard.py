@@ -1,0 +1,17 @@
+"""BAD twin: the jit entry sees a new operand shape per iteration."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x):
+    return jnp.sum(x * x)
+
+
+def drive(rec, sizes):
+    entry = jax.jit(_kernel)
+    with rec.span("sweep.drive"):
+        outs = []
+        for n in sizes:
+            outs.append(entry(jnp.zeros(n)))  # BAD: one compile per size
+        return outs

@@ -1,0 +1,18 @@
+"""BAD twin: the same host table re-uploads on every hot iteration."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x):
+    return jnp.sum(x * x)
+
+
+def drive(rec, table, xs):
+    entry = jax.jit(_kernel)
+    with rec.span("sweep.drive"):
+        outs = []
+        for x in xs:
+            w = jnp.asarray(table)  # BAD: loop-invariant upload per pass
+            outs.append(entry(w))
+        return outs

@@ -1,0 +1,19 @@
+"""GOOD twin: operand sizes route through the canonical pad ladder."""
+
+import jax
+import jax.numpy as jnp
+
+from quorum_intersection_tpu.encode.circuit import ladder_up
+
+
+def _kernel(x):
+    return jnp.sum(x * x)
+
+
+def drive(rec, sizes):
+    entry = jax.jit(_kernel)
+    with rec.span("sweep.drive"):
+        outs = []
+        for n in sizes:
+            outs.append(entry(jnp.zeros(ladder_up(n))))
+        return outs

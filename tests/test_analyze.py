@@ -148,6 +148,22 @@ class TestRepoClean:
         findings, _notes = run_wire(REPO_ROOT)
         assert findings == [], "\n".join(f.render() for f in findings)
 
+    def test_hygiene_clean(self):
+        # ISSUE 18: the hot paths carry no unsanctioned host syncs,
+        # recompile hazards, or in-loop transfers at HEAD.
+        from tools.analyze.hygiene import run_hygiene
+
+        findings, _notes = run_hygiene(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_conserve_clean_and_doc_table_current(self):
+        # ISSUE 18: every declared conservation obligation proves on all
+        # exit paths AND the docs mirror matches the frozen table.
+        from tools.analyze.conserve import run_conserve
+
+        findings, _notes = run_conserve(REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
 
 class TestTypingRatchet:
     def test_regression_is_a_finding(self, tmp_path, monkeypatch):
@@ -842,3 +858,269 @@ class TestTracerLeakPrecision:
         )
         findings = self._findings(src, tmp_path)
         assert [f.rule for f in findings] == ["jax-tracer-leak"]
+
+
+class TestHygienePass:
+    """qi-hygiene (ISSUE 18 tentpole): one fixture pair per finding kind,
+    hot-region seeding from the span inventory, and the witness chain."""
+
+    PAIRS = {
+        "hygiene-host-sync": "hygiene/host_sync",
+        "hygiene-recompile-hazard": "hygiene/recompile_hazard",
+        "hygiene-transfer-in-loop": "hygiene/transfer_in_loop",
+    }
+
+    @pytest.mark.parametrize("rule,stem", sorted(PAIRS.items()))
+    def test_bad_fixture_yields_exactly_one_finding(self, rule, stem):
+        from tools.analyze.hygiene import run_hygiene
+
+        rel = str(Path("tests/analyze_fixtures") / f"{Path(stem).parent}" /
+                  f"bad_{Path(stem).name}.py")
+        findings, _ = run_hygiene(REPO_ROOT, targets=[rel])
+        assert [f.rule for f in findings] == [rule], findings
+        marked = [
+            i + 1 for i, line in enumerate(
+                (REPO_ROOT / rel).read_text().splitlines())
+            if "BAD" in line
+        ]
+        assert findings[0].line in marked
+
+    @pytest.mark.parametrize("rule,stem", sorted(PAIRS.items()))
+    def test_good_fixture_is_clean(self, rule, stem):
+        from tools.analyze.hygiene import run_hygiene
+
+        rel = str(Path("tests/analyze_fixtures") / f"{Path(stem).parent}" /
+                  f"good_{Path(stem).name}.py")
+        findings, _ = run_hygiene(REPO_ROOT, targets=[rel])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_finding_carries_hot_path_witness(self):
+        from tools.analyze.hygiene import run_hygiene
+
+        rel = "tests/analyze_fixtures/hygiene/bad_host_sync.py"
+        findings, _ = run_hygiene(REPO_ROOT, targets=[rel])
+        assert "[hot via span sweep.drive: drive]" in findings[0].message
+
+    def test_suppression_applies(self, tmp_path):
+        from tools.analyze.hygiene import run_hygiene
+
+        src = (REPO_ROOT / "tests/analyze_fixtures/hygiene/"
+               "bad_host_sync.py").read_text()
+        src = src.replace(
+            "            total += float(y)",
+            "            # qi-lint: allow(hygiene-host-sync) — fixture\n"
+            "            total += float(y)",
+        )
+        (tmp_path / "suppressed.py").write_text(src)
+        findings, _ = run_hygiene(tmp_path, targets=["suppressed.py"])
+        assert findings == [], findings
+
+    def test_hot_region_seeded_from_span_inventory(self, tmp_path):
+        # The seeding contract: a seed span missing from the qi-surface
+        # inventory silently disables nothing — the function simply is
+        # not hot, so renaming a drive span shows up as the inventory
+        # diff (a reviewed contract change), not a stale hardcode.
+        from tools.analyze.hygiene import run_hygiene
+
+        rel = "tests/analyze_fixtures/hygiene/bad_host_sync.py"
+        inv = tmp_path / "inventory.json"
+        inv.write_text(json.dumps({"telemetry": {"span": []}}))
+        findings, _ = run_hygiene(REPO_ROOT, targets=[rel],
+                                  inventory_path=inv)
+        assert findings == []
+        inv.write_text(json.dumps({"telemetry": {"span": ["sweep.drive"]}}))
+        findings, _ = run_hygiene(REPO_ROOT, targets=[rel],
+                                  inventory_path=inv)
+        assert [f.rule for f in findings] == ["hygiene-host-sync"]
+
+    def test_cold_function_is_not_scanned(self, tmp_path):
+        # The same sink outside any hot region must not be a finding:
+        # the pass polices hot paths, not the whole package.
+        from tools.analyze.hygiene import run_hygiene
+
+        src = (REPO_ROOT / "tests/analyze_fixtures/hygiene/"
+               "bad_host_sync.py").read_text()
+        src = src.replace('rec.span("sweep.drive")', 'rec.span("cold.path")')
+        (tmp_path / "cold.py").write_text(src)
+        findings, _ = run_hygiene(tmp_path, targets=["cold.py"])
+        assert findings == [], findings
+
+    def test_injected_violation_fails_the_analyzer(self, tmp_path, monkeypatch):
+        # Acceptance: dropping a fixture violation into package code makes
+        # `python -m tools.analyze` exit nonzero.
+        import tools.analyze.__main__ as main_mod
+
+        pkg = tmp_path / "quorum_intersection_tpu"
+        pkg.mkdir()
+        (pkg / "hot.py").write_text(
+            (REPO_ROOT / "tests/analyze_fixtures/hygiene/"
+             "bad_host_sync.py").read_text()
+        )
+        monkeypatch.setattr(main_mod, "REPO_ROOT", tmp_path)
+        out = tmp_path / "findings.jsonl"
+        rc = main_mod.main(["hygiene", "--jsonl", str(out)])
+        assert rc == 1
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        events = [l for l in lines if l["kind"] == "event"]
+        assert len(events) == 1
+        assert events[0]["attrs"]["rule"] == "hygiene-host-sync"
+        assert events[0]["attrs"]["pass"] == "hygiene"
+
+
+class TestConservePass:
+    """qi-conserve (ISSUE 18 tentpole): fixture pairs for both obligation
+    modes, suppression, region/table gates, and the injection acceptance."""
+
+    @staticmethod
+    def _leg_table(kind):
+        return ((
+            "fixture-cancel",
+            f"tests/analyze_fixtures/conserve/{kind}_leg_missing.py:drain",
+            "paired", "all",
+            "sweep.windows_cancelled;cert.windows_cancelled", "fixture"),)
+
+    @staticmethod
+    def _exit_table(kind):
+        return ((
+            "fixture-closure",
+            f"tests/analyze_fixtures/conserve/{kind}_exit_closure.py:resolve",
+            "exit", "all", "serve.verdicts|serve.errors", "fixture"),)
+
+    @pytest.mark.parametrize("stem,table_of", [
+        ("leg_missing", "_leg_table"), ("exit_closure", "_exit_table"),
+    ])
+    def test_bad_fixture_yields_exactly_one_finding(self, stem, table_of):
+        from tools.analyze.conserve import run_conserve
+
+        rel = f"tests/analyze_fixtures/conserve/bad_{stem}.py"
+        findings, _ = run_conserve(
+            REPO_ROOT, targets=[rel], table=getattr(self, table_of)("bad"),
+            check_docs=False)
+        assert [f.rule for f in findings] == ["conserve-leg-missing"], findings
+        marked = [
+            i + 1 for i, line in enumerate(
+                (REPO_ROOT / rel).read_text().splitlines())
+            if "BAD" in line
+        ]
+        assert findings[0].line in marked
+
+    @pytest.mark.parametrize("stem,table_of", [
+        ("leg_missing", "_leg_table"), ("exit_closure", "_exit_table"),
+    ])
+    def test_good_fixture_is_clean(self, stem, table_of):
+        from tools.analyze.conserve import run_conserve
+
+        rel = f"tests/analyze_fixtures/conserve/good_{stem}.py"
+        findings, _ = run_conserve(
+            REPO_ROOT, targets=[rel], table=getattr(self, table_of)("good"),
+            check_docs=False)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_suppression_applies(self, tmp_path):
+        from tools.analyze.conserve import run_conserve
+
+        src = (REPO_ROOT / "tests/analyze_fixtures/conserve/"
+               "bad_leg_missing.py").read_text()
+        src = src.replace(
+            "            return done  # BAD",
+            "            # qi-lint: allow(conserve-leg-missing) — fixture\n"
+            "            return done  # BAD",
+        )
+        (tmp_path / "suppressed.py").write_text(src)
+        table = (("fixture-cancel", "suppressed.py:drain", "paired", "all",
+                  "sweep.windows_cancelled;cert.windows_cancelled", "f"),)
+        findings, _ = run_conserve(tmp_path, targets=["suppressed.py"],
+                                   table=table, check_docs=False)
+        assert findings == [], findings
+
+    def test_vanished_region_is_loud(self, tmp_path):
+        from tools.analyze.conserve import run_conserve
+
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        table = (("fixture-gone", "empty.py:drain", "paired", "all",
+                  "sweep.windows_cancelled;cert.windows_cancelled", "f"),)
+        findings, _ = run_conserve(tmp_path, targets=["empty.py"],
+                                   table=table, check_docs=False)
+        assert [f.rule for f in findings] == ["conserve-region-missing"]
+
+    def test_region_that_books_nothing_is_loud(self, tmp_path):
+        # A paired region that stopped booking ANY declared leg means the
+        # invariant moved out from under the table — as loud as a break.
+        from tools.analyze.conserve import run_conserve
+
+        (tmp_path / "hollow.py").write_text(
+            "def drain(rec, jobs):\n"
+            "    for job in jobs:\n"
+            "        job.run()\n"
+            "    return len(jobs)\n"
+        )
+        table = (("fixture-hollow", "hollow.py:drain", "paired", "all",
+                  "sweep.windows_cancelled;cert.windows_cancelled", "f"),)
+        findings, _ = run_conserve(tmp_path, targets=["hollow.py"],
+                                   table=table, check_docs=False)
+        assert [f.rule for f in findings] == ["conserve-region-missing"]
+
+    def test_raise_filter_ignores_return_paths(self, tmp_path):
+        # exits="raise" scopes the obligation to abnormal exits only —
+        # the shape the serve admission gate needs (normal admissions
+        # close later, via the resolve regions).
+        from tools.analyze.conserve import run_conserve
+
+        (tmp_path / "admit.py").write_text(
+            "def admit(rec, q, entry):\n"
+            "    if q.full():\n"
+            "        rec.add(\"serve.errors\", 1)\n"
+            "        raise RuntimeError(\"shed\")\n"
+            "    q.put_nowait(entry)\n"
+            "    return \"queued\"\n"
+        )
+        table = (("fixture-admit", "admit.py:admit", "exit", "raise",
+                  "serve.errors", "f"),)
+        findings, _ = run_conserve(tmp_path, targets=["admit.py"],
+                                   table=table, check_docs=False)
+        assert findings == [], findings
+
+    def test_doc_table_round_trips(self):
+        from tools.analyze.conserve import (
+            CONSERVATION_TABLE,
+            doc_table_rows,
+            render_table,
+        )
+
+        expected = [(r[0], r[1], r[2], r[3], r[4])
+                    for r in CONSERVATION_TABLE]
+        assert doc_table_rows(render_table()) == expected
+
+    def test_missing_doc_mirror_is_drift(self, tmp_path):
+        from tools.analyze.conserve import run_conserve
+
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        findings, _ = run_conserve(tmp_path, targets=["empty.py"], table=(),
+                                   check_docs=True)
+        assert [f.rule for f in findings] == ["conserve-table-drift"]
+
+    def test_injected_leg_drop_fails_the_analyzer(self, tmp_path):
+        # Acceptance: re-introducing the pre-existing retire_job violation
+        # (the operational cancel leg dropped) into a package copy makes
+        # the conserve pass report it against the real table.
+        import shutil
+
+        from tools.analyze.conserve import run_conserve
+
+        shutil.copytree(REPO_ROOT / "quorum_intersection_tpu",
+                        tmp_path / "quorum_intersection_tpu")
+        sweep = (tmp_path / "quorum_intersection_tpu" / "backends" / "tpu"
+                 / "sweep.py")
+        src = sweep.read_text()
+        needle = (
+            '            rec.add("sweep.windows_cancelled", dropped)\n'
+            '            rec.add("cert.windows_cancelled", dropped)\n'
+        )
+        assert needle in src
+        sweep.write_text(src.replace(
+            needle, '            rec.add("cert.windows_cancelled", dropped)\n'))
+        findings, _ = run_conserve(tmp_path, check_docs=False)
+        assert any(
+            f.rule == "conserve-leg-missing" and "sweep-retire-pack"
+            in f.message for f in findings
+        ), "\n".join(f.render() for f in findings)

@@ -2,7 +2,7 @@
 
 Usage::
 
-    python -m tools.analyze                      # all: lint surface locks wire typing race
+    python -m tools.analyze                      # all: lint surface locks wire typing race hygiene conserve
     python -m tools.analyze lint typing          # a subset
     python -m tools.analyze --jsonl out.jsonl    # findings as qi-telemetry/1
     python -m tools.analyze typing --update-ratchet
@@ -31,7 +31,8 @@ from tools.analyze.typing_gate import run_typing_gate
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
-PASSES = ("lint", "surface", "locks", "wire", "typing", "race")
+PASSES = ("lint", "surface", "locks", "wire", "typing", "race", "hygiene",
+          "conserve")
 
 
 def _race_pass(root: Path) -> tuple:
@@ -372,6 +373,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif pass_name == "race":
             findings, ns = _race_pass(REPO_ROOT)
             per_pass["race"] = findings
+            notes.extend(ns)
+        elif pass_name == "hygiene":
+            from tools.analyze.hygiene import run_hygiene
+
+            findings, ns = run_hygiene(REPO_ROOT)
+            per_pass["hygiene"] = findings
+            notes.extend(ns)
+        elif pass_name == "conserve":
+            from tools.analyze.conserve import run_conserve
+
+            findings, ns = run_conserve(REPO_ROOT)
+            per_pass["conserve"] = findings
             notes.extend(ns)
 
     total = 0

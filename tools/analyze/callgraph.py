@@ -1,0 +1,373 @@
+"""Shared call-edge machinery for the interprocedural analyzer passes.
+
+Factored out of ``tools/analyze/locks.py`` (ISSUE 18) so the lock pass,
+the device-hygiene pass and the conservation pass resolve call edges
+identically: ``self.m()`` methods, module functions, cross-module
+imports within the analyzed file set, typed ``self.attr.m()`` instance
+attributes (``self.X = ClassName(...)``), nested defs, and a
+unique-method-name fallback that refuses builtin-collection collisions.
+
+Two layers live here:
+
+- :class:`CallGraph` — the resolution core (``resolve``) over the duck
+  shape locks' ``Model`` already had: ``functions`` keyed by
+  :data:`FnKey`, ``classes`` whose values expose ``.methods``,
+  ``imports``, ``method_index``.  ``locks.Model`` now subclasses it.
+- :class:`PackageGraph` / :func:`build_graph` — a lightweight
+  whole-package graph (per-function call lists + telemetry span names)
+  used by the hygiene and conserve passes, where lock semantics are
+  irrelevant but reachability ("is this function on a hot path, and
+  via which call chain?") is the whole game.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.lint import FileContext, resolve_name_arg
+
+FnKey = Tuple[str, str]  # (rel path, qualname)
+
+# Method names the unique-name call-resolution fallback must never claim:
+# they collide with builtin container/file/threading APIs (``counters.get``
+# is a dict read, not SharedSccStore.get), and a wrong edge invents
+# reachability (or a deadlock cycle) out of thin air.  Typed receivers
+# (``self.X`` whose class is known from its constructor assignment) still
+# resolve these precisely.
+AMBIGUOUS_METHODS = frozenset({
+    "get", "add", "pop", "append", "appendleft", "popleft", "update",
+    "clear", "extend", "remove", "discard", "insert", "setdefault", "keys",
+    "values", "items", "copy", "join", "split", "strip", "sort", "index",
+    "count", "read", "write", "close", "flush", "open", "set", "wait",
+    "notify", "notify_all", "acquire", "release", "put", "send", "recv",
+    "emit", "finish", "start", "stop", "run", "scan",
+})
+
+_THREADING_CTORS = frozenset({"Lock", "RLock", "Condition", "Event", "Thread"})
+
+
+@dataclass(frozen=True)
+class CallRef:
+    """An unresolved callee reference, resolved against a whole graph."""
+
+    kind: str          # "self" | "name" | "attr" | "instattr"
+    name: str
+    rel: str           # referencing file
+    cls: Optional[str] = None  # class of the referencing method
+
+
+def threading_call(node: ast.AST, names: Iterable[str]) -> Optional[str]:
+    """``threading.X(...)`` / bare ``X(...)`` for X in names → X."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return name if name in set(names) else None
+
+
+def ctor_name(call: ast.AST) -> Optional[str]:
+    """Capitalized constructor name of ``X(...)`` / ``mod.X(...)``, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    ctor = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None
+    )
+    if ctor is not None and ctor[:1].isupper():
+        return ctor
+    return None
+
+
+def instance_attrs(cls: ast.ClassDef) -> Dict[str, str]:
+    """``self.X = ClassName(...)`` attrs → class name, threading ctors excluded.
+
+    The typed-receiver map behind ``instattr`` resolution: a later
+    ``self.X.m()`` resolves to ``ClassName.m`` wherever that class lives
+    in the analyzed file set.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        if threading_call(node.value, _THREADING_CTORS) is not None:
+            continue
+        ctor = ctor_name(node.value)
+        if ctor is not None:
+            out[tgt.attr] = ctor
+    return out
+
+
+def ref_of(expr: ast.AST, rel: str, cls_name: Optional[str],
+           instances: Dict[str, str]) -> Optional[CallRef]:
+    """Classify a callee expression into a :class:`CallRef` (or None)."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return CallRef("self", expr.attr, rel, cls_name)
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Attribute) \
+            and isinstance(expr.value.value, ast.Name) \
+            and expr.value.value.id == "self":
+        inst_cls = instances.get(expr.value.attr)
+        if inst_cls is not None:
+            return CallRef("instattr", f"{inst_cls}.{expr.attr}", rel, cls_name)
+    if isinstance(expr, ast.Name):
+        return CallRef("name", expr.id, rel, cls_name)
+    if isinstance(expr, ast.Attribute):
+        return CallRef("attr", expr.attr, rel, cls_name)
+    return None
+
+
+def module_rel_map(rels: Iterable[str]) -> Dict[str, str]:
+    """Dotted module path → rel path for the analyzed file set."""
+    return {rel[:-3].replace("/", "."): rel for rel in rels}
+
+
+def collect_imports(rel: str, tree: ast.Module, rel_by_module: Dict[str, str],
+                    deep: bool = False) -> Dict[Tuple[str, str], str]:
+    """``from mod import name`` edges landing inside the analyzed set.
+
+    ``deep=True`` also walks function bodies (the repo's lazy local
+    imports — ``query.py`` imports the analytics resolvers inside the
+    resolving method), which the hot-region map needs; the locks pass
+    keeps the historical top-level-only view.
+    """
+    out: Dict[Tuple[str, str], str] = {}
+    nodes: Iterable[ast.AST] = ast.walk(tree) if deep else tree.body
+    for node in nodes:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            target_rel = rel_by_module.get(node.module)
+            if target_rel is not None:
+                for alias in node.names:
+                    out[(rel, alias.asname or alias.name)] = target_rel
+    return out
+
+
+def iter_defs(tree: ast.Module) -> Iterable[Tuple[str, Optional[str], ast.AST]]:
+    """Yield ``(qualname, class name or None, def node)`` for a module.
+
+    Locks' exact registration scheme: top-level functions, class methods,
+    and nested defs one level below either (qual ``outer.inner``), first
+    qualname wins on duplicates.
+    """
+    seen: Set[str] = set()
+
+    def register(fn_node: ast.AST, qual: str, cls: Optional[str],
+                 out: List[Tuple[str, Optional[str], ast.AST]]) -> None:
+        if qual in seen:
+            return
+        seen.add(qual)
+        out.append((qual, cls, fn_node))
+        for stmt in ast.walk(fn_node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not fn_node \
+                    and f"{qual}.{stmt.name}" not in seen:
+                seen.add(f"{qual}.{stmt.name}")
+                out.append((f"{qual}.{stmt.name}", cls, stmt))
+
+    out: List[Tuple[str, Optional[str], ast.AST]] = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    register(sub, f"{node.name}.{sub.name}", node.name, out)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            register(node, node.name, None, out)
+    return out
+
+
+class CallGraph:
+    """Resolution core shared by every interprocedural pass.
+
+    Subclasses populate ``functions`` / ``classes`` / ``imports`` /
+    ``method_index``; ``classes`` values must expose ``.methods``
+    (a set of method names) — both locks' ``ClassModel`` and
+    :class:`ClassInfo` do.
+    """
+
+    def __init__(self) -> None:
+        self.classes: Dict[Tuple[str, str], object] = {}
+        self.functions: Dict[FnKey, object] = {}
+        self.module_fns: Dict[str, Set[str]] = {}
+        self.imports: Dict[Tuple[str, str], str] = {}
+        self.method_index: Dict[str, List[FnKey]] = {}
+        self.ctxs: Dict[str, FileContext] = {}
+
+    def resolve(self, ref: CallRef) -> Optional[FnKey]:
+        if ref.kind == "self" and ref.cls is not None:
+            key = (ref.rel, f"{ref.cls}.{ref.name}")
+            if key in self.functions:
+                return key
+            return None
+        if ref.kind == "name":
+            if (ref.rel, ref.name) in self.imports:
+                target_rel = self.imports[(ref.rel, ref.name)]
+                key = (target_rel, ref.name)
+                return key if key in self.functions else None
+            key = (ref.rel, ref.name)
+            if key in self.functions:
+                return key
+            # nested function of some scope in the same file
+            for cand_key in self.functions:
+                if cand_key[0] == ref.rel and cand_key[1].endswith(
+                        f".{ref.name}"):
+                    return cand_key
+            return None
+        if ref.kind == "instattr":
+            # self.<attr>.<method>() with the attr's class known from its
+            # constructor assignment
+            cls_name, method = ref.name.split(".", 1)
+            for (rel, name), cls in self.classes.items():
+                if name == cls_name and method in getattr(cls, "methods", set()):
+                    return (rel, f"{name}.{method}")
+            return None
+        # attribute call on an unknown receiver: unique-method-name
+        # fallback, builtin-collection collisions excluded
+        if ref.name in AMBIGUOUS_METHODS:
+            return None
+        cands = self.method_index.get(ref.name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# whole-package graph (hygiene / conserve consumer)
+
+
+@dataclass
+class ClassInfo:
+    """Minimal class shape the resolution core needs."""
+
+    name: str
+    rel: str
+    methods: Set[str] = field(default_factory=set)
+    instances: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FnInfo:
+    """One function body: its call edges and the telemetry spans it opens."""
+
+    key: FnKey
+    cls_name: Optional[str]
+    node: ast.AST
+    calls: List[Tuple[CallRef, int]] = field(default_factory=list)
+    spans: Set[str] = field(default_factory=set)
+
+
+class PackageGraph(CallGraph):
+    """Call graph over an arbitrary file set, spans attached per function."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.infos: Dict[FnKey, FnInfo] = {}
+
+    def span_owners(self, span: str) -> List[FnKey]:
+        """Functions whose body opens the named telemetry span."""
+        return sorted(k for k, fn in self.infos.items() if span in fn.spans)
+
+
+def _scan_fn(graph: PackageGraph, info: FnInfo, ctx: FileContext,
+             instances: Dict[str, str]) -> None:
+    rel = info.key[0]
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not info.node:
+            continue  # nested defs are modeled as their own functions
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "span" and node.args:
+            name = resolve_name_arg(ctx, node.args[0])
+            if name:
+                info.spans.add(name.rstrip("*"))
+        ref = ref_of(f, rel, info.cls_name, instances)
+        if ref is not None:
+            info.calls.append((ref, node.lineno))
+        # callables passed by reference (thread targets, callbacks,
+        # registered resolvers) are edges too — they run eventually
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, (ast.Attribute, ast.Name)):
+                aref = ref_of(arg, rel, info.cls_name, instances)
+                if aref is not None:
+                    info.calls.append((aref, node.lineno))
+
+
+def build_graph(root: Path, targets: Sequence[str]) -> PackageGraph:
+    """Build a :class:`PackageGraph` over ``targets`` (rel paths)."""
+    graph = PackageGraph()
+    trees: List[Tuple[str, ast.Module, FileContext]] = []
+    for rel in targets:
+        path = root / rel
+        if not path.is_file():
+            continue
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = FileContext(path, rel, source)
+        except (OSError, SyntaxError):
+            continue
+        graph.ctxs[rel] = ctx
+        trees.append((rel, ctx.tree, ctx))
+    rel_by_module = module_rel_map(rel for rel, _, _ in trees)
+    instances_by_cls: Dict[Tuple[str, str], Dict[str, str]] = {}
+    for rel, tree, _ in trees:
+        graph.module_fns[rel] = set()
+        graph.imports.update(collect_imports(rel, tree, rel_by_module,
+                                             deep=True))
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = ClassInfo(name=node.name, rel=rel,
+                                 instances=instance_attrs(node))
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info.methods.add(sub.name)
+                graph.classes[(rel, node.name)] = info
+                instances_by_cls[(rel, node.name)] = info.instances
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                graph.module_fns[rel].add(node.name)
+        for qual, cls_name, fn_node in iter_defs(tree):
+            info = FnInfo(key=(rel, qual), cls_name=cls_name, node=fn_node)
+            graph.functions[info.key] = info
+            graph.infos[info.key] = info
+    for key, info in graph.infos.items():
+        graph.method_index.setdefault(key[1].split(".")[-1], []).append(key)
+    for info in graph.infos.values():
+        instances = instances_by_cls.get((info.key[0], info.cls_name or ""), {})
+        _scan_fn(graph, info, graph.ctxs[info.key[0]], instances)
+    return graph
+
+
+def reachable(graph: PackageGraph, seeds: Dict[FnKey, str],
+              ) -> Dict[FnKey, Tuple[str, Tuple[str, ...]]]:
+    """BFS closure of ``seeds`` with witness chains.
+
+    Returns key → ``(seed label, call chain of qualnames)``; the chain is
+    the shortest span-seeded path that makes the function hot, rendered
+    into every hygiene finding so a reader can check the reachability
+    claim instead of trusting it.
+    """
+    out: Dict[FnKey, Tuple[str, Tuple[str, ...]]] = {}
+    frontier: List[FnKey] = []
+    for key in sorted(seeds):
+        if key in graph.infos and key not in out:
+            out[key] = (seeds[key], (key[1],))
+            frontier.append(key)
+    while frontier:
+        key = frontier.pop(0)
+        label, chain = out[key]
+        for ref, _line in graph.infos[key].calls:
+            callee = graph.resolve(ref)
+            if callee is None or callee in out or callee not in graph.infos:
+                continue
+            out[callee] = (label, chain + (callee[1],))
+            frontier.append(callee)
+    return out

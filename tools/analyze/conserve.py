@@ -1,0 +1,461 @@
+"""qi-conserve: exception-path conservation proofs for ledgers (pass 8).
+
+The repo's load-bearing conservation invariants — the sweep ledger
+partition (``enumerated + pruned + skipped + cancelled == 2^(|scc|-1)``),
+qi-cost (``sum(attributed) + dropped == total``), the serve closure
+(``requests == verdicts + errors``) — were until now enforced only
+dynamically: a new early return or ``except`` arm that skips one counter
+leg ships silently until a soak run catches it.  This pass proves the
+counter *bookkeeping* statically.
+
+:data:`CONSERVATION_TABLE` declares each invariant's **maintaining
+region** (one function, resolved through the shared call graph) and its
+**legs** in a frozen machine-parsed table (mirrored verbatim in
+``docs/STATIC_ANALYSIS.md`` §Pass 8 — drift between code and docs is
+itself a finding).  A CFG path enumeration then walks every exit path
+of the region — normal completion, early ``return``, ``raise``, and
+``except`` arms (handlers are entered with the *worst-case* prefix:
+no body event yet) — and checks the declared obligation:
+
+- ``paired`` mode: any path that books one leg of the invariant must
+  book **every** leg group (conservation as co-occurrence: the path
+  that bumps ``cert.windows_cancelled`` must also bump
+  ``sweep.windows_cancelled``, or the operational plane silently
+  drifts from the certificate ledger).
+- ``exit`` mode: every exit path of the region (optionally filtered
+  to ``return``/``raise`` exits) must book at least one leg from each
+  group — e.g. every ``_resolve_*`` delivery books ``serve.verdicts``
+  or ``serve.errors``.
+
+Legs are counters (``serve.errors``), telemetry events
+(``event:cost.degraded``), gauges (``gauge:slo.burning``) or calls
+(``call:reuse_credit``); alternatives within a group separate with
+``|``, groups with ``;``.  Violations report ``conserve-leg-missing``
+with the offending exit path; a region that no longer books any
+declared leg (or vanished) reports ``conserve-region-missing``.
+Suppression uses the standard ``# qi-lint: allow(rule) — reason``.
+
+The analysis is path-insensitive (infeasible branch combinations are
+enumerated too), so obligations are declared on small, single-purpose
+regions where every syntactic path is a real path.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.callgraph import PackageGraph, build_graph
+from tools.analyze.hygiene import default_targets
+from tools.analyze.lint import (
+    FileContext,
+    Finding,
+    _looks_like_record,
+    resolve_name_arg,
+)
+
+DOC_PATH = "docs/STATIC_ANALYSIS.md"
+
+# Paths kept per block before deterministic truncation.  Path sets are
+# deduplicated by event content, so only event-carrying branch points
+# multiply; the declared regions stay far below this.
+PATH_CAP = 512
+
+# (id, region "rel:qual", mode, exits, legs "group;group" with "|"
+#  alternatives, law) — FROZEN: docs/STATIC_ANALYSIS.md §Pass 8 mirrors
+# this table verbatim and the drift gate compares them field by field.
+CONSERVATION_TABLE: Tuple[Tuple[str, str, str, str, str, str], ...] = (
+    ("sweep-cancel-solo",
+     "quorum_intersection_tpu/backends/tpu/sweep.py:TpuSweepBackend.check_scc.check_cancel",
+     "paired", "all",
+     "sweep.windows_cancelled;cert.windows_cancelled",
+     "a cooperative cancel books the operational counter and the ledger twin together"),
+    ("sweep-cancel-pack",
+     "quorum_intersection_tpu/backends/tpu/sweep.py:TpuSweepBackend._run_pack.check_cancel",
+     "paired", "all",
+     "sweep.windows_cancelled;cert.windows_cancelled",
+     "the packed drain's cancel books both twins like the unpacked drive"),
+    ("sweep-retire-pack",
+     "quorum_intersection_tpu/backends/tpu/sweep.py:TpuSweepBackend._run_pack.retire_job",
+     "paired", "all",
+     "sweep.windows_cancelled;cert.windows_cancelled",
+     "a per-job retirement's unswept remainder books both cancel twins"),
+    ("sweep-cost-solo",
+     "quorum_intersection_tpu/backends/tpu/sweep.py:TpuSweepBackend.check_scc",
+     "paired", "all",
+     "cost.lane_windows_total;cost.lane_windows_attributed|cost.attribute_errors",
+     "sum(attributed) + dropped == total: the total leg moves on every attribution path"),
+    ("sweep-cost-pack",
+     "quorum_intersection_tpu/backends/tpu/sweep.py:TpuSweepBackend._run_pack",
+     "paired", "all",
+     "cost.lane_windows_total;cost.lane_windows_attributed|cost.attribute_errors",
+     "the pack twin of sweep-cost-solo"),
+    ("serve-closure-ok",
+     "quorum_intersection_tpu/serve.py:ServeEngine._resolve_ok",
+     "exit", "all",
+     "serve.verdicts|serve.errors",
+     "requests == verdicts + errors: every delivery books exactly one closure leg"),
+    ("serve-closure-deadline",
+     "quorum_intersection_tpu/serve.py:ServeEngine._resolve_deadline",
+     "exit", "all",
+     "serve.deadline_expired;serve.errors",
+     "an expired deadline is a typed error AND its own diagnostic counter"),
+    ("serve-closure-err",
+     "quorum_intersection_tpu/serve.py:ServeEngine._resolve_err",
+     "exit", "all",
+     "serve.errors",
+     "a failed batch books one error per waiter — never a silent drop"),
+    ("serve-admit-reject",
+     "quorum_intersection_tpu/serve.py:ServeEngine._admit",
+     "exit", "raise",
+     "serve.errors",
+     "every typed admission rejection counts toward requests == verdicts + errors"),
+    ("cost-degrade-slo",
+     "quorum_intersection_tpu/cost.py:SloPlane.evaluate",
+     "paired", "all",
+     "cost.attribute_errors;event:cost.degraded",
+     "a degraded SLO evaluation bumps the error leg and emits the degrade event"),
+    ("cost-degrade-fuse",
+     "quorum_intersection_tpu/serve.py:ServeEngine._auto_fuse_window",
+     "paired", "all",
+     "cost.attribute_errors;event:cost.degraded",
+     "a broken fusion controller degrades observably, never silently"),
+    ("cost-degrade-respond",
+     "quorum_intersection_tpu/serve.py:ServeEngine._resolve_ok",
+     "paired", "all",
+     "cost.attribute_errors;event:cost.degraded",
+     "a dropped per-request cost attribution is counted and evented"),
+    ("delta-compose",
+     "quorum_intersection_tpu/delta.py:DeltaEngine._compose",
+     "exit", "all",
+     "call:reuse_credit|cost.attribute_errors",
+     "every composed reuse credits its cost or routes through the cost.attribute degrade"),
+)
+
+
+def parse_legs(spec: str) -> Tuple[FrozenSet[str], ...]:
+    """``"a;b|c"`` → ``(frozenset({a}), frozenset({b, c}))``."""
+    return tuple(
+        frozenset(alt.strip() for alt in group.split("|") if alt.strip())
+        for group in spec.split(";") if group.strip()
+    )
+
+
+def render_table() -> str:
+    """The frozen table as markdown — embedded in the docs and uploaded
+    as the CI artifact next to the findings stream."""
+    lines = [
+        "| id | region | mode | exits | legs | law |",
+        "| --- | --- | --- | --- | --- | --- |",
+    ]
+    for row_id, region, mode, exits, legs, law in CONSERVATION_TABLE:
+        legs_md = legs.replace("|", "\\|")  # keep the markdown cell intact
+        lines.append(
+            f"| {row_id} | `{region}` | {mode} | {exits} | `{legs_md}` | {law} |")
+    return "\n".join(lines) + "\n"
+
+
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*(?P<id>[a-z0-9-]+)\s*\|\s*`(?P<region>[^`]+)`\s*\|"
+    r"\s*(?P<mode>\w+)\s*\|\s*(?P<exits>\w+)\s*\|\s*`(?P<legs>[^`]+)`\s*\|"
+)
+
+
+def doc_table_rows(doc_text: str) -> List[Tuple[str, str, str, str, str]]:
+    """Parse the docs mirror of the table (id, region, mode, exits, legs)."""
+    rows: List[Tuple[str, str, str, str, str]] = []
+    for line in doc_text.splitlines():
+        m = _DOC_ROW_RE.match(line.strip())
+        if m is not None:
+            rows.append((m.group("id"), m.group("region"), m.group("mode"),
+                         m.group("exits"),
+                         m.group("legs").replace("\\|", "|")))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# CFG path enumeration
+
+
+class _Paths:
+    """Event-set path bundles flowing out of a statement block."""
+
+    def __init__(self, normal: Set[FrozenSet[str]],
+                 brk: Optional[Set[FrozenSet[str]]] = None,
+                 cont: Optional[Set[FrozenSet[str]]] = None) -> None:
+        self.normal = normal
+        self.brk = brk if brk is not None else set()
+        self.cont = cont if cont is not None else set()
+
+
+class _RegionWalker:
+    """Enumerate exit paths of one region function as event sets."""
+
+    def __init__(self, ctx: FileContext, fn_node: ast.AST) -> None:
+        self.ctx = ctx
+        self.fn_node = fn_node
+        # (exit kind "return"|"raise", events, line)
+        self.exits: List[Tuple[str, FrozenSet[str], int]] = []
+        self.truncated = False
+
+    def walk(self) -> None:
+        body = list(getattr(self.fn_node, "body", []))
+        out = self._seq(body, {frozenset()})
+        last = body[-1].lineno if body else getattr(self.fn_node, "lineno", 1)
+        for events in out.normal:
+            self.exits.append(("return", events, last))
+
+    # -- events --------------------------------------------------------------
+
+    def _events(self, node: Optional[ast.AST]) -> FrozenSet[str]:
+        if node is None:
+            return frozenset()
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in ("add", "event", "gauge") and sub.args \
+                        and _looks_like_record(self.ctx, f.value):
+                    name = resolve_name_arg(self.ctx, sub.args[0])
+                    if name:
+                        prefix = "" if f.attr == "add" else f"{f.attr}:"
+                        out.add(f"{prefix}{name}")
+                out.add(f"call:{f.attr}")
+            elif isinstance(f, ast.Name):
+                out.add(f"call:{f.id}")
+        return frozenset(out)
+
+    # -- path algebra --------------------------------------------------------
+
+    def _cap(self, paths: Set[FrozenSet[str]]) -> Set[FrozenSet[str]]:
+        if len(paths) <= PATH_CAP:
+            return paths
+        self.truncated = True
+        ordered = sorted(paths, key=lambda p: (len(p), tuple(sorted(p))))
+        return set(ordered[:PATH_CAP])
+
+    def _extend(self, paths: Set[FrozenSet[str]],
+                events: FrozenSet[str]) -> Set[FrozenSet[str]]:
+        if not events:
+            return paths
+        return self._cap({p | events for p in paths})
+
+    def _seq(self, stmts: Sequence[ast.stmt],
+             entry: Set[FrozenSet[str]]) -> _Paths:
+        cur = set(entry)
+        brk: Set[FrozenSet[str]] = set()
+        cont: Set[FrozenSet[str]] = set()
+        for stmt in stmts:
+            if not cur:
+                break
+            p = self._stmt(stmt, cur)
+            brk |= p.brk
+            cont |= p.cont
+            cur = self._cap(p.normal)
+        return _Paths(cur, brk, cont)
+
+    def _stmt(self, stmt: ast.stmt, cur: Set[FrozenSet[str]]) -> _Paths:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return _Paths(cur)  # nested defs are their own regions
+        if isinstance(stmt, ast.Return):
+            events = self._events(stmt.value)
+            for p in cur:
+                self.exits.append(("return", p | events, stmt.lineno))
+            return _Paths(set())
+        if isinstance(stmt, ast.Raise):
+            events = self._events(stmt.exc) | self._events(stmt.cause)
+            for p in cur:
+                self.exits.append(("raise", p | events, stmt.lineno))
+            return _Paths(set())
+        if isinstance(stmt, ast.Break):
+            return _Paths(set(), brk=set(cur))
+        if isinstance(stmt, ast.Continue):
+            return _Paths(set(), cont=set(cur))
+        if isinstance(stmt, ast.If):
+            base = self._extend(cur, self._events(stmt.test))
+            p_then = self._seq(stmt.body, base)
+            p_else = self._seq(stmt.orelse, base)
+            return _Paths(self._cap(p_then.normal | p_else.normal),
+                          brk=p_then.brk | p_else.brk,
+                          cont=p_then.cont | p_else.cont)
+        if isinstance(stmt, (ast.For, ast.While)):
+            head = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+            base = self._extend(cur, self._events(head))
+            p_body = self._seq(stmt.body, base)
+            # zero-or-one iteration: the after-loop set joins the skip
+            # path, one full body pass, and any break/continue escape
+            after = base | p_body.normal | p_body.brk | p_body.cont
+            p_else = self._seq(stmt.orelse, self._cap(after))
+            return _Paths(self._cap(p_else.normal))
+        if isinstance(stmt, ast.With):
+            events = frozenset().union(
+                *(self._events(item.context_expr) for item in stmt.items)
+            ) if stmt.items else frozenset()
+            return self._seq(stmt.body, self._extend(cur, events))
+        if isinstance(stmt, ast.Try):
+            n_before = len(self.exits)
+            p_body = self._seq(stmt.body, cur)
+            normal = set(p_body.normal)
+            brk = set(p_body.brk)
+            cont = set(p_body.cont)
+            for handler in stmt.handlers:
+                # worst-case prefix: the exception fired before any body
+                # event landed, so the handler starts from the try entry
+                p_h = self._seq(handler.body, cur)
+                normal |= p_h.normal
+                brk |= p_h.brk
+                cont |= p_h.cont
+            p_else = self._seq(stmt.orelse, self._cap(normal)) \
+                if stmt.orelse else _Paths(normal)
+            normal = p_else.normal
+            brk |= p_else.brk
+            cont |= p_else.cont
+            if stmt.finalbody:
+                p_fin = self._seq(stmt.finalbody, {frozenset()})
+                fin_sets = p_fin.normal or {frozenset()}
+                # exits recorded inside the try ALSO run the finally
+                for ix in range(n_before, len(self.exits)):
+                    kind, events, line = self.exits[ix]
+                    self.exits[ix] = (
+                        kind, events | next(iter(sorted(
+                            fin_sets, key=lambda s: tuple(sorted(s))))), line)
+                normal = self._cap(
+                    {n | f for n in normal for f in fin_sets})
+                brk = self._cap({b | f for b in brk for f in fin_sets})
+                cont = self._cap({c | f for c in cont for f in fin_sets})
+            return _Paths(self._cap(normal), brk=brk, cont=cont)
+        # plain statement: every embedded telemetry/call event lands
+        return _Paths(self._extend(cur, self._events(stmt)))
+
+
+# ---------------------------------------------------------------------------
+# obligations
+
+
+def _check_region(graph: PackageGraph, row: Tuple[str, str, str, str, str, str],
+                  findings: List[Finding]) -> Tuple[int, int]:
+    """Returns ``(leg_missing, region_missing)`` counts for one table row."""
+    row_id, region, mode, exits, legs_spec, _law = row
+    rel, qual = region.split(":", 1)
+    key = (rel, qual)
+    info = graph.infos.get(key)
+    ctx = graph.ctxs.get(rel)
+    if info is None or ctx is None:
+        findings.append(Finding(
+            rule="conserve-region-missing", path=rel, line=1,
+            message=f"[{row_id}] maintaining region {qual} not found — the "
+                    f"conservation table is stale or the region was "
+                    f"renamed; update CONSERVATION_TABLE and the docs "
+                    f"mirror together"))
+        return 0, 1
+    groups = parse_legs(legs_spec)
+    all_legs = frozenset().union(*groups)
+    walker = _RegionWalker(ctx, info.node)
+    walker.walk()
+    leg_missing = 0
+    region_missing = 0
+    maintained = False
+    reported: Set[Tuple[int, str]] = set()
+    for kind, events, line in walker.exits:
+        if exits != "all" and kind != exits:
+            continue
+        if mode == "paired" and not (events & all_legs):
+            continue
+        if all(events & g for g in groups):
+            maintained = True
+            continue
+        missing = [sorted(g) for g in groups if not (events & g)]
+        booked = sorted(events & all_legs)
+        mark = (line, ",".join("|".join(m) for m in missing))
+        if mark in reported or ctx.suppressed("conserve-leg-missing", line):
+            continue
+        reported.add(mark)
+        leg_missing += 1
+        findings.append(Finding(
+            rule="conserve-leg-missing", path=rel, line=line,
+            message=f"[{row_id}] {kind} path out of {qual} books "
+                    f"{booked or ['no declared leg']} but not "
+                    f"{' nor '.join('|'.join(m) for m in missing)} — every "
+                    f"{exits if exits != 'all' else 'exit'} path must "
+                    f"update all legs of the invariant (or route through "
+                    f"its declared degrade leg)"))
+    if mode == "paired" and not maintained and leg_missing == 0:
+        line = getattr(info.node, "lineno", 1)
+        if not ctx.suppressed("conserve-region-missing", line):
+            region_missing += 1
+            findings.append(Finding(
+                rule="conserve-region-missing", path=rel, line=line,
+                message=f"[{row_id}] no path through {qual} books the "
+                        f"declared legs ({legs_spec}) — the invariant is "
+                        f"no longer maintained here; fix the region or "
+                        f"update the table (docs mirror included)"))
+    return leg_missing, region_missing
+
+
+def _check_doc_mirror(root: Path, findings: List[Finding]) -> int:
+    doc = root / DOC_PATH
+    expected = [(r[0], r[1], r[2], r[3], r[4]) for r in CONSERVATION_TABLE]
+    try:
+        got = doc_table_rows(doc.read_text(encoding="utf-8"))
+    except OSError:
+        got = []
+    if got == expected:
+        return 0
+    findings.append(Finding(
+        rule="conserve-table-drift", path=DOC_PATH, line=1,
+        message="the conservation table in docs/STATIC_ANALYSIS.md §Pass 8 "
+                "does not match tools/analyze/conserve.py "
+                "CONSERVATION_TABLE — regenerate the docs mirror with "
+                "`python -m tools.analyze.conserve --dump-table` and paste "
+                "it verbatim (the table is frozen: code and docs move "
+                "together)"))
+    return 1
+
+
+def run_conserve(root: Path, targets: Optional[Sequence[str]] = None,
+                 table: Optional[Sequence[Tuple[str, str, str, str, str, str]]]
+                 = None, check_docs: bool = True,
+                 ) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)`` — the conservation-proof pass."""
+    rels = list(targets) if targets is not None else default_targets(root)
+    rows = tuple(table) if table is not None else CONSERVATION_TABLE
+    graph = build_graph(root, rels)
+    findings: List[Finding] = []
+    legs = 0
+    regions = 0
+    for row in rows:
+        lm, rm = _check_region(graph, row, findings)
+        legs += lm
+        regions += rm
+    drift = _check_doc_mirror(root, findings) if check_docs else 0
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    notes = [
+        f"conserve: {len(rows)} obligation(s) over "
+        f"{len({r[1] for r in rows})} region(s); "
+        f"{legs} leg-missing, {regions} region-missing, "
+        f"{drift} table-drift"
+    ]
+    return findings, notes
+
+
+if __name__ == "__main__":  # pragma: no cover — tiny CI artifact helper
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="conservation-table tooling (the pass itself runs "
+                    "under `python -m tools.analyze`)")
+    ap.add_argument("--dump-table", metavar="FILE", default=None,
+                    help="write the frozen table as markdown (CI artifact; "
+                         "'-' for stdout)")
+    ns = ap.parse_args()
+    if ns.dump_table:
+        text = render_table()
+        if ns.dump_table == "-":
+            print(text, end="")
+        else:
+            Path(ns.dump_table).write_text(text, encoding="utf-8")

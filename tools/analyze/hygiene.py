@@ -1,0 +1,445 @@
+"""qi-hygiene: device-interaction discipline on the hot paths (pass 7).
+
+The search is NP-hard, so every accidental host↔device sync or silent
+recompile inside the window-enumeration and serve-drain loops multiplies
+across ``2^n`` candidates and millions of requests.  This pass builds a
+**hot-region map** — every function reachable from the sweep drive/pack
+drain loops, the serve drain (fused and unfused), ``BatchFormer._flush``
+and the frontier worklist, seeded from the telemetry span inventory in
+``surface_inventory.json`` — over the shared call graph
+(:mod:`tools.analyze.callgraph`), then checks three rules inside it:
+
+- ``hygiene-host-sync`` — ``.item()``/``.tolist()``/``float()``/
+  ``bool()``/``int()``/``np.asarray``/``device_get``/
+  ``block_until_ready`` applied to a **device value**, taint-tracked
+  from jit/pallas dispatch results (the way ``jax-tracer-leak`` tracks
+  tracers).  Each one is a device round-trip that stalls the pipeline.
+- ``hygiene-recompile-hazard`` — a jit entry invoked with argument
+  arrays built outside the canonical pad ladder
+  (``encode/circuit.py``: ``ladder_up``/``pad_targets``/…), with
+  weak-shape positionals (string/dict/list literals retrace per value
+  or per structure), or a ``jax.jit`` constructed inside a hot loop
+  (a fresh jit object re-traces every call).
+- ``hygiene-transfer-in-loop`` — ``device_put``/``jnp.asarray``
+  materialization inside a hot loop whose operand is loop-invariant
+  and should hoist.
+
+Taint is deliberately shallow — direct assignment chains only, no
+container flow — so a finding is worth reading; every finding carries
+its **hot-path witness** (the span-seeded call chain that makes the
+function hot).  Suppress a reviewed sanctioned site with
+``# qi-lint: allow(rule) — reason`` like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.callgraph import (
+    FnInfo,
+    FnKey,
+    PackageGraph,
+    build_graph,
+    reachable,
+    ref_of,
+)
+from tools.analyze.lint import FileContext, Finding
+
+PACKAGE = "quorum_intersection_tpu"
+
+# Hot-loop telemetry spans (must exist in the span inventory): the sweep
+# drive/pack drain loops, the serve drain + solve stages, the pipeline
+# many-SCC loop.
+HOT_SPAN_SEEDS = (
+    "pipeline.check_many",
+    "serve.batch",
+    "serve.solve",
+    "sweep.drive",
+    "sweep.pack",
+)
+
+# Hot functions without their own span: the fuse flush (runs inside the
+# serve drain's fuse window) and the frontier worklist.
+HOT_FUNCTION_SEEDS = (
+    ("quorum_intersection_tpu/fuse.py", "BatchFormer._flush"),
+    ("quorum_intersection_tpu/backends/tpu/frontier.py",
+     "TpuFrontierBackend.check_scc"),
+)
+
+INVENTORY = "tools/analyze/surface_inventory.json"
+
+# The canonical pad ladder surface in encode/circuit.py: an argument
+# whose shape went through any of these is compile-cache-friendly.
+LADDER_NAMES = frozenset({
+    "ladder_up", "pad_targets", "pad_circuit", "pack_circuits",
+    "plan_packs", "PAD_LADDER", "LANE_TILE",
+})
+
+_JIT_NAMES = frozenset({"jit", "pallas_call"})
+_ARRAY_CTORS = frozenset({"asarray", "array", "zeros", "ones", "full"})
+_ARRAY_MODULES = frozenset({"np", "numpy", "jnp"})
+_DEVICE_MODULES = frozenset({"jnp"})
+_SYNC_METHODS = frozenset({"item", "tolist"})
+_SYNC_CASTS = frozenset({"int", "float", "bool"})
+
+
+def default_targets(root: Path) -> List[str]:
+    """Every package module, sorted for deterministic output."""
+    pkg = root / PACKAGE
+    return sorted(
+        str(p.relative_to(root)) for p in pkg.rglob("*.py")
+    )
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _mentions(node: ast.AST, names: FrozenSet[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in names:
+            return True
+    return False
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_jit_ctor(call: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``pl.pallas_call(...)``."""
+    return _callee_name(call) in _JIT_NAMES
+
+
+def _direct_jit_fns(graph: PackageGraph) -> Set[FnKey]:
+    """Functions whose own body mentions jit/pallas_call (dispatch factories)."""
+    return {
+        key for key, fn in graph.infos.items()
+        if _mentions(fn.node, _JIT_NAMES)
+    }
+
+
+def _laddered_fns(graph: PackageGraph) -> Set[FnKey]:
+    """Functions routing through the pad ladder, transitively over calls."""
+    out = {
+        key for key, fn in graph.infos.items()
+        if _mentions(fn.node, LADDER_NAMES)
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, fn in graph.infos.items():
+            if key in out:
+                continue
+            for call_ref, _line in fn.calls:
+                callee = graph.resolve(call_ref)
+                if callee is not None and callee in out:
+                    out.add(key)
+                    changed = True
+                    break
+    return out
+
+
+def _load_span_inventory(root: Path,
+                         inventory_path: Optional[Path]) -> Optional[Set[str]]:
+    path = inventory_path if inventory_path is not None else root / INVENTORY
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+        spans = data["telemetry"]["span"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return {str(s) for s in spans}
+
+
+class _HygieneScanner:
+    """One hot function: shallow device taint + the three rules."""
+
+    def __init__(self, graph: PackageGraph, fn: FnInfo, ctx: FileContext,
+                 witness: str, jit_fns: Set[FnKey], ladder_fns: Set[FnKey],
+                 instances: Dict[str, str]) -> None:
+        self.graph = graph
+        self.fn = fn
+        self.ctx = ctx
+        self.witness = witness
+        self.jit_fns = jit_fns
+        self.ladder_fns = ladder_fns
+        self.instances = instances
+        self.dispatchers: Set[str] = set()
+        self.tainted: Set[str] = set()
+        self.laddered: Set[str] = set()
+        self.loop_assigned: List[Set[str]] = []
+        self.findings: List[Tuple[str, int, str]] = []
+
+    # -- expression classification ------------------------------------------
+
+    def _resolve_call(self, call: ast.Call) -> Optional[FnKey]:
+        cls = self.fn.cls_name
+        call_ref = ref_of(call.func, self.fn.key[0], cls, self.instances)
+        if call_ref is None:
+            return None
+        return self.graph.resolve(call_ref)
+
+    def _kinds(self, expr: ast.AST) -> Set[str]:
+        """``{"device", "dispatcher"}`` membership of an expression."""
+        if isinstance(expr, ast.Name):
+            out: Set[str] = set()
+            if expr.id in self.tainted:
+                out.add("device")
+            if expr.id in self.dispatchers:
+                out.add("dispatcher")
+            return out
+        if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._kinds(expr.value) & {"device"}
+        if isinstance(expr, ast.IfExp):
+            return self._kinds(expr.body) | self._kinds(expr.orelse)
+        if isinstance(expr, ast.Call):
+            name = _callee_name(expr)
+            if _is_jit_ctor(expr):
+                return {"dispatcher"}
+            if name == "device_put":
+                return {"device"}
+            if name is not None and name in self.dispatchers:
+                # calling a dispatch entry yields a device value; factory
+                # chains (a factory returning a factory) stay dispatchers
+                return {"device", "dispatcher"}
+            callee = self._resolve_call(expr)
+            if callee is not None and callee in self.jit_fns:
+                return {"dispatcher"}
+            return set()
+        return set()
+
+    def _expr_laddered(self, expr: ast.AST) -> bool:
+        if _mentions(expr, LADDER_NAMES):
+            return True
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.laddered:
+                return True
+            if isinstance(sub, ast.Call):
+                callee = self._resolve_call(sub)
+                if callee is not None and callee in self.ladder_fns:
+                    return True
+        return False
+
+    # -- findings -----------------------------------------------------------
+
+    def _flag(self, rule: str, line: int, message: str) -> None:
+        self.findings.append(
+            (rule, line,
+             f"{message} [hot via {self.witness}]"))
+
+    # -- sinks / hazards ----------------------------------------------------
+
+    def _check_call(self, call: ast.Call, loop_depth: int) -> None:
+        f = call.func
+        name = _callee_name(call)
+        in_loop = loop_depth > 0
+        # host-sync sinks -------------------------------------------------
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_METHODS and "device" in self._kinds(f.value):
+                self._flag(
+                    "hygiene-host-sync", call.lineno,
+                    f".{f.attr}() on a device value blocks on the device "
+                    f"and round-trips to host in a hot region — keep the "
+                    f"value on device or batch the readback")
+            elif f.attr == "block_until_ready":
+                self._flag(
+                    "hygiene-host-sync", call.lineno,
+                    "block_until_ready() stalls the dispatch pipeline in "
+                    "a hot region — only sanctioned at explicit "
+                    "measurement/drain barriers")
+            elif f.attr in _ARRAY_CTORS and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy") \
+                    and any("device" in self._kinds(a) for a in call.args):
+                self._flag(
+                    "hygiene-host-sync", call.lineno,
+                    f"np.{f.attr}() on a device value forces a synchronous "
+                    f"device→host transfer in a hot region")
+        if name == "device_get":
+            self._flag(
+                "hygiene-host-sync", call.lineno,
+                "device_get() is a synchronous device→host transfer in a "
+                "hot region")
+        elif name in _SYNC_CASTS and isinstance(f, ast.Name) \
+                and len(call.args) == 1 \
+                and "device" in self._kinds(call.args[0]):
+            self._flag(
+                "hygiene-host-sync", call.lineno,
+                f"{name}() on a device value blocks until the device "
+                f"result is ready — a hidden sync point in a hot region")
+        # recompile hazards ----------------------------------------------
+        if _is_jit_ctor(call) and in_loop:
+            self._flag(
+                "hygiene-recompile-hazard", call.lineno,
+                "jit constructed inside a hot loop: a fresh jit object "
+                "re-traces on every call — hoist it to module scope or a "
+                "cached factory")
+        if name is not None and name in self.dispatchers:
+            for arg in call.args:
+                if isinstance(arg, (ast.Dict, ast.List, ast.Set)) or (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    self._flag(
+                        "hygiene-recompile-hazard", call.lineno,
+                        "weak-shape positional argument (str/dict/list "
+                        "literal) to a jit entry retraces per value or "
+                        "per structure — pass arrays, or bind statics in "
+                        "the factory")
+                elif isinstance(arg, ast.Call) \
+                        and _callee_name(arg) in _ARRAY_CTORS \
+                        and not self._expr_laddered(arg):
+                    self._flag(
+                        "hygiene-recompile-hazard", call.lineno,
+                        "jit-entry argument built outside the canonical "
+                        "pad ladder: every distinct shape compiles a new "
+                        "program — route the size through "
+                        "encode/circuit.py ladder_up/pad_targets")
+        # transfer-in-loop -----------------------------------------------
+        if in_loop:
+            is_put = name == "device_put"
+            is_jnp_ctor = isinstance(f, ast.Attribute) \
+                and f.value and isinstance(f.value, ast.Name) \
+                and f.value.id in _DEVICE_MODULES and f.attr in _ARRAY_CTORS
+            if is_put or is_jnp_ctor:
+                loop_vars: Set[str] = set()
+                for assigned in self.loop_assigned:
+                    loop_vars |= assigned
+                arg_names: Set[str] = set()
+                for arg in call.args:
+                    arg_names |= _names_in(arg)
+                if call.args and not (arg_names & loop_vars):
+                    what = "device_put" if is_put else f"jnp.{f.attr}"
+                    self._flag(
+                        "hygiene-transfer-in-loop", call.lineno,
+                        f"{what}() of a loop-invariant operand inside a "
+                        f"hot loop re-uploads the same data every "
+                        f"iteration — hoist it above the loop")
+
+    # -- taint bookkeeping --------------------------------------------------
+
+    def _assign(self, node: ast.Assign) -> None:
+        kinds = self._kinds(node.value)
+        lad = self._expr_laddered(node.value)
+        targets: List[ast.expr] = []
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple):
+                targets.extend(tgt.elts)
+            else:
+                targets.append(tgt)
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            for kind, pool in (("device", self.tainted),
+                               ("dispatcher", self.dispatchers)):
+                if kind in kinds:
+                    pool.add(tgt.id)
+                else:
+                    pool.discard(tgt.id)
+            if lad:
+                self.laddered.add(tgt.id)
+            else:
+                self.laddered.discard(tgt.id)
+
+    def _collect_assigned(self, body: Sequence[ast.stmt]) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store):
+                    out.add(node.id)
+        return out
+
+    # -- walking ------------------------------------------------------------
+
+    def scan(self) -> None:
+        for stmt in getattr(self.fn.node, "body", []):
+            self._visit(stmt, 0)
+
+    def _visit(self, node: ast.AST, loop_depth: int) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not self.fn.node:
+            return  # nested defs are modeled as their own functions
+        if isinstance(node, (ast.For, ast.While)):
+            self.loop_assigned.append(self._collect_assigned(node.body))
+            if isinstance(node, ast.For):
+                self.loop_assigned[-1] |= _names_in(node.target)
+                self._visit(node.iter, loop_depth)
+            else:
+                self._visit(node.test, loop_depth + 1)
+            for child in node.body + node.orelse:
+                self._visit(child, loop_depth + 1)
+            self.loop_assigned.pop()
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, loop_depth)
+        if isinstance(node, ast.Assign):
+            self._visit(node.value, loop_depth)
+            self._assign(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, loop_depth)
+
+
+def hot_region(graph: PackageGraph, span_inventory: Optional[Set[str]],
+               ) -> Dict[FnKey, Tuple[str, Tuple[str, ...]]]:
+    """Seed-and-close the hot-region map with witness chains."""
+    seeds: Dict[FnKey, str] = {}
+    for span in HOT_SPAN_SEEDS:
+        if span_inventory is not None and span not in span_inventory:
+            continue
+        for key in graph.span_owners(span):
+            seeds.setdefault(key, f"span {span}")
+    for rel, qual in HOT_FUNCTION_SEEDS:
+        if (rel, qual) in graph.infos:
+            seeds.setdefault((rel, qual), f"fn {qual}")
+    return reachable(graph, seeds)
+
+
+def run_hygiene(root: Path, targets: Optional[Sequence[str]] = None,
+                inventory_path: Optional[Path] = None,
+                ) -> Tuple[List[Finding], List[str]]:
+    """``(findings, notes)`` — the device-interaction hygiene pass."""
+    rels = list(targets) if targets is not None else default_targets(root)
+    graph = build_graph(root, rels)
+    spans = _load_span_inventory(root, inventory_path)
+    hot = hot_region(graph, spans)
+    jit_fns = _direct_jit_fns(graph)
+    ladder_fns = _laddered_fns(graph)
+    findings: List[Finding] = []
+    per_rule: Dict[str, int] = {}
+    for key in sorted(hot):
+        fn = graph.infos[key]
+        label, chain = hot[key]
+        witness = f"{label}: " + " -> ".join(chain)
+        ctx = graph.ctxs[key[0]]
+        cls_info = graph.classes.get((key[0], fn.cls_name or ""))
+        instances = getattr(cls_info, "instances", {}) if cls_info else {}
+        scanner = _HygieneScanner(
+            graph, fn, ctx, witness, jit_fns, ladder_fns, instances)
+        scanner.scan()
+        seen: Set[Tuple[str, int]] = set()
+        for rule, line, message in scanner.findings:
+            if (rule, line) in seen or ctx.suppressed(rule, line):
+                continue
+            seen.add((rule, line))
+            per_rule[rule] = per_rule.get(rule, 0) + 1
+            findings.append(
+                Finding(rule=rule, path=key[0], line=line, message=message))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    notes = [
+        f"hygiene: {len(hot)} hot function(s) from span+fn seeds over "
+        f"{len(graph.infos)} analyzed; "
+        f"{per_rule.get('hygiene-host-sync', 0)} host-sync, "
+        f"{per_rule.get('hygiene-recompile-hazard', 0)} recompile-hazard, "
+        f"{per_rule.get('hygiene-transfer-in-loop', 0)} transfer-in-loop"
+    ]
+    return findings, notes

@@ -44,8 +44,19 @@ from __future__ import annotations
 import ast
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
+from tools.analyze.callgraph import (
+    CallGraph,
+    CallRef,
+    FnKey,
+    collect_imports,
+    ctor_name,
+    iter_defs,
+    module_rel_map,
+    ref_of,
+)
+from tools.analyze.callgraph import threading_call as _is_threading_call
 from tools.analyze.lint import FileContext, Finding, _looks_like_record
 
 # The heavily-threaded surface this pass covers (ISSUE 13).
@@ -71,23 +82,6 @@ _MUTATING_METHODS = frozenset({
 _SUBPROCESS_BLOCKING = frozenset({
     "run", "call", "check_call", "check_output",
 })
-
-FnKey = Tuple[str, str]  # (rel path, qualname)
-
-# Method names the unique-name call-resolution fallback must never claim:
-# they collide with builtin container/file/threading APIs (``counters.get``
-# is a dict read, not SharedSccStore.get), and a wrong edge here invents a
-# deadlock cycle out of thin air.  Typed receivers (``self.X`` whose class
-# is known from its constructor assignment) still resolve these precisely.
-_AMBIGUOUS_METHODS = frozenset({
-    "get", "add", "pop", "append", "appendleft", "popleft", "update",
-    "clear", "extend", "remove", "discard", "insert", "setdefault", "keys",
-    "values", "items", "copy", "join", "split", "strip", "sort", "index",
-    "count", "read", "write", "close", "flush", "open", "set", "wait",
-    "notify", "notify_all", "acquire", "release", "put", "send", "recv",
-    "emit", "finish", "start", "stop", "run", "scan",
-})
-
 
 @dataclass
 class ClassModel:
@@ -139,80 +133,24 @@ class FnModel:
     entry_union: FrozenSet[str] = frozenset()
 
 
-@dataclass(frozen=True)
-class CallRef:
-    """An unresolved callee reference, resolved against the whole model."""
+class Model(CallGraph):
+    """Whole-program model over the target files.
 
-    kind: str          # "self" | "name" | "attr"
-    name: str
-    rel: str           # referencing file
-    cls: Optional[str] = None  # class of the referencing method
-
-
-class Model:
-    """Whole-program model over the target files."""
+    Call-edge resolution (``resolve``) is inherited from the shared
+    :class:`tools.analyze.callgraph.CallGraph`; this subclass adds the
+    lock-specific state.
+    """
 
     def __init__(self) -> None:
+        super().__init__()
         self.classes: Dict[Tuple[str, str], ClassModel] = {}
         self.functions: Dict[FnKey, FnModel] = {}
-        self.module_fns: Dict[str, Set[str]] = {}
         self.module_locks: Dict[Tuple[str, str], str] = {}
         self.reentrant: Set[str] = set()  # RLock ids (legal re-acquisition)
-        self.imports: Dict[Tuple[str, str], str] = {}  # (rel, name) -> target rel
-        self.method_index: Dict[str, List[FnKey]] = {}
-        self.ctxs: Dict[str, FileContext] = {}
-
-    def resolve(self, ref: CallRef) -> Optional[FnKey]:
-        if ref.kind == "self" and ref.cls is not None:
-            key = (ref.rel, f"{ref.cls}.{ref.name}")
-            if key in self.functions:
-                return key
-            return None
-        if ref.kind == "name":
-            if (ref.rel, ref.name) in self.imports:
-                target_rel = self.imports[(ref.rel, ref.name)]
-                key = (target_rel, ref.name)
-                return key if key in self.functions else None
-            key = (ref.rel, ref.name)
-            if key in self.functions:
-                return key
-            # nested function of some scope in the same file
-            for cand_key in self.functions:
-                if cand_key[0] == ref.rel and cand_key[1].endswith(
-                        f".{ref.name}"):
-                    return cand_key
-            return None
-        if ref.kind == "instattr":
-            # self.<attr>.<method>() with the attr's class known from its
-            # constructor assignment
-            cls_name, method = ref.name.split(".", 1)
-            for (rel, name), cls in self.classes.items():
-                if name == cls_name and method in cls.methods:
-                    return (rel, f"{name}.{method}")
-            return None
-        # attribute call on an unknown receiver: unique-method-name
-        # fallback, builtin-collection collisions excluded
-        if ref.name in _AMBIGUOUS_METHODS:
-            return None
-        cands = self.method_index.get(ref.name, [])
-        if len(cands) == 1:
-            return cands[0]
-        return None
 
 
 # ---------------------------------------------------------------------------
 # model construction
-
-
-def _is_threading_call(node: ast.AST, names: Iterable[str]) -> Optional[str]:
-    """``threading.X(...)`` / bare ``X(...)`` for X in names → X."""
-    if not isinstance(node, ast.Call):
-        return None
-    f = node.func
-    name = f.attr if isinstance(f, ast.Attribute) else (
-        f.id if isinstance(f, ast.Name) else None
-    )
-    return name if name in set(names) else None
 
 
 def _scan_class(rel: str, cls: ast.ClassDef) -> ClassModel:
@@ -247,12 +185,9 @@ def _scan_class(rel: str, cls: ast.ClassDef) -> ClassModel:
             model.events.add(tgt.attr)
         elif kind == "Thread":
             model.threads.add(tgt.attr)
-        elif kind is None and isinstance(node.value, ast.Call):
-            f = node.value.func
-            ctor = f.id if isinstance(f, ast.Name) else (
-                f.attr if isinstance(f, ast.Attribute) else None
-            )
-            if ctor is not None and ctor[:1].isupper():
+        elif kind is None:
+            ctor = ctor_name(node.value)
+            if ctor is not None:
                 model.instances[tgt.attr] = ctor
     return model
 
@@ -383,23 +318,9 @@ class _FnScanner:
                     (f"subprocess.{attr}", held, node.lineno, None))
 
     def _ref_of(self, expr: ast.AST) -> Optional[CallRef]:
-        rel = self.fn.key[0]
         cls = self.fn.cls.name if self.fn.cls is not None else None
-        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
-                and expr.value.id == "self":
-            return CallRef("self", expr.attr, rel, cls)
-        if isinstance(expr, ast.Attribute) \
-                and isinstance(expr.value, ast.Attribute) \
-                and isinstance(expr.value.value, ast.Name) \
-                and expr.value.value.id == "self" and self.fn.cls is not None:
-            inst_cls = self.fn.cls.instances.get(expr.value.attr)
-            if inst_cls is not None:
-                return CallRef("instattr", f"{inst_cls}.{expr.attr}", rel, cls)
-        if isinstance(expr, ast.Name):
-            return CallRef("name", expr.id, rel, cls)
-        if isinstance(expr, ast.Attribute):
-            return CallRef("attr", expr.attr, rel, cls)
-        return None
+        instances = self.fn.cls.instances if self.fn.cls is not None else {}
+        return ref_of(expr, self.fn.key[0], cls, instances)
 
     def _note_call(self, node: ast.Call, held: FrozenSet[str]) -> None:
         self._note_blocking(node, held)
@@ -452,12 +373,11 @@ def build_model(root: Path, targets: Sequence[str]) -> Model:
             continue
         model.ctxs[rel] = ctx
         trees.append((rel, ctx.tree, ctx))
-    rel_by_module = {
-        rel[:-3].replace("/", "."): rel for rel, _, _ in trees
-    }
+    rel_by_module = module_rel_map(rel for rel, _, _ in trees)
     # pass 1: classes, module locks/functions, imports
     for rel, tree, _ in trees:
         model.module_fns[rel] = set()
+        model.imports.update(collect_imports(rel, tree, rel_by_module))
         for node in tree.body:
             if isinstance(node, ast.ClassDef):
                 cls_model = _scan_class(rel, node)
@@ -473,37 +393,14 @@ def build_model(root: Path, targets: Sequence[str]) -> Model:
                     model.module_locks[(rel, name)] = f"{rel}:{name}"
                     if kind == "RLock":
                         model.reentrant.add(f"{rel}:{name}")
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                target_rel = rel_by_module.get(node.module)
-                if target_rel is not None:
-                    for alias in node.names:
-                        model.imports[(rel, alias.asname or alias.name)] = \
-                            target_rel
-    # pass 2: function bodies (methods, module functions, nested defs)
+    # pass 2: function bodies (methods, module functions, nested defs —
+    # registration scheme shared with the other passes via iter_defs)
     for rel, tree, ctx in trees:
-        def register(fn_node: ast.AST, qual: str,
-                     cls: Optional[ClassModel]) -> None:
-            fn = FnModel(key=(rel, qual), cls=cls, node=fn_node)
+        for qual, cls_name, fn_node in iter_defs(tree):
+            cls_model = model.classes.get((rel, cls_name)) \
+                if cls_name is not None else None
+            fn = FnModel(key=(rel, qual), cls=cls_model, node=fn_node)
             model.functions[fn.key] = fn
-            # nested defs get their own entries (they run on other threads
-            # or as callbacks, never inline at the def site)
-            for stmt in ast.walk(fn_node):
-                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                        and stmt is not fn_node \
-                        and f"{qual}.{stmt.name}" not in (
-                            k[1] for k in model.functions):
-                    nested = FnModel(
-                        key=(rel, f"{qual}.{stmt.name}"), cls=cls, node=stmt)
-                    model.functions[nested.key] = nested
-
-        for node in tree.body:
-            if isinstance(node, ast.ClassDef):
-                cls_model = model.classes[(rel, node.name)]
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        register(sub, f"{node.name}.{sub.name}", cls_model)
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                register(node, node.name, None)
     # method-name index for unique-name resolution
     for key, fn in model.functions.items():
         model.method_index.setdefault(key[1].split(".")[-1], []).append(key)

@@ -76,6 +76,13 @@ TYPING_TARGETS = (
     # is the one seam every backend, cert and batch path flows through.
     "quorum_intersection_tpu/serve.py",
     "quorum_intersection_tpu/pipeline.py",
+    # ISSUE 18: the analyzer's own device-hygiene tier joins the spine —
+    # the shared call graph and the two passes built on it (hot-path
+    # hygiene, conservation proofs) gate every other module, so a type
+    # confusion here silently weakens every gate downstream.
+    "tools/analyze/callgraph.py",
+    "tools/analyze/hygiene.py",
+    "tools/analyze/conserve.py",
 )
 
 
