@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -486,6 +487,238 @@ def run_fleet_phase(args, record) -> tuple:
     return row, mismatches
 
 
+def run_mesh_phase(args, record) -> tuple:
+    """The qi-mesh phase (ISSUE 19, ``--fleet --fleet-join``): the zipfian
+    churn stream through a SOCKET-JOINED fleet — one local worker plus one
+    remote peer admitted over the versioned wire handshake — with a
+    partition window and both elasticity legs exercised mid-stream:
+
+    - **hedge window**: the joined peer is suspected for the middle third
+      of the stream, so every request routed to its arc is hedged to the
+      next arc owner; the window closes with a lease renewal (rejoin, not
+      eviction) — measured as ``fleet_hedge_pct`` (hedged dispatches over
+      served verdicts);
+    - **elasticity**: a forced scale-up tick mid-stream (an elastic
+      ``e``-prefixed worker joins the ring) and a forced drain-retire tick
+      after the stream drains — measured as ``fleet_scale_events``
+      (scale-up + scale-down bookings; the phase gates on at least one of
+      EACH, and on the retire never breaching ``scale_min``).
+
+    Every served verdict is still oracle-parity-gated and the zero-lost /
+    typed-outcomes-only accounting applies — partition and resize must be
+    invisible in the answers.  ``--fleet-join auto`` spawns a real
+    ``serve --socket`` subprocess to join; ``HOST:PORT`` joins an already
+    listening peer.  Returns ``(row_fields, mismatches)``."""
+    from quorum_intersection_tpu.fbas import synth
+    from quorum_intersection_tpu.fleet import FleetEngine
+    from quorum_intersection_tpu.pipeline import solve
+    from quorum_intersection_tpu.serve import ServeError, _percentile
+
+    requests = args.fleet_requests or (24 if args.quick else 60)
+    base = synth.benchmark_fbas(
+        args.fleet_core + 17, args.fleet_core, seed=args.seed + 1,
+    )
+    trace = synth.churn_trace(
+        base, requests - 1, seed=args.seed + 1, skew=args.fleet_skew,
+    )
+    memo = {}
+    expected = []
+    for snap in trace:
+        key = json.dumps(snap, sort_keys=True)
+        if key not in memo:
+            memo[key] = solve(snap, backend="python").intersects
+        expected.append(memo[key])
+
+    mismatches = []
+    tmp = tempfile.TemporaryDirectory(prefix="qi-mesh-bench-")
+    peer = None
+    if args.fleet_join == "auto":
+        # A REAL remote: a serve --socket subprocess with its own journal,
+        # joined through the same handshake an operator's peer would use.
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONUNBUFFERED"] = "1"
+        for k in ("QI_METRICS_JSON", "QI_METRICS_PROM", "QI_TRACE_OUT"):
+            env.pop(k, None)
+        peer = subprocess.Popen(
+            [sys.executable, "-u", "-m", "quorum_intersection_tpu",
+             "serve", "--socket", "0", "--backend", "python",
+             "--journal", os.path.join(tmp.name, "peer.journal")],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        addr = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            line = peer.stdout.readline()
+            if not line:
+                break
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("kind") == "listening":
+                addr = f"{obj['host']}:{obj['port']}"
+                break
+        if addr is None:
+            peer.kill()
+            tmp.cleanup()
+            return {}, ["mesh: join peer never announced a listening port"]
+    else:
+        addr = args.fleet_join
+
+    engine = FleetEngine(
+        1, backend=args.backend, worker_mode="local",
+        journal_dir=tmp.name, joins=[addr],
+        # A long probe interval keeps the bench's suspicion window under
+        # the driver's control (no background pong closes it early), and
+        # respawn_max=0 keeps the membership deterministic — an eviction
+        # here is a phase failure, not something to quietly redial.
+        probe_interval_s=30.0, respawn_max=0,
+        queue_depth=requests + 8, scale_min=1, scale_max=4,
+    )
+    engine.start()
+    joined = [w for w in engine.worker_ids() if w.startswith("j")]
+    if not joined:
+        engine.stop(drain=True)
+        if peer is not None:
+            peer.stdin.close()
+            peer.wait(timeout=30.0)
+        tmp.cleanup()
+        return {}, [f"mesh: no socket peer joined from {addr} (degraded "
+                    f"to standalone — the wire tier never formed)"]
+    jid = joined[0]
+    c0, _ = record.snapshot()
+    saved = (engine.scale_up_ms, engine.scale_down_ms)
+    tickets = []
+    t0 = time.perf_counter()
+    with record.span("fleet.mesh_bench", requests=requests, join=addr):
+        for i, snap in enumerate(trace):
+            if i == requests // 3:
+                # Partition opens: the peer stops answering (as far as
+                # membership is concerned) but its wire stays up — arc
+                # traffic hedges, nothing waits on the suspect alone.
+                engine._suspect_worker(jid, "bench partition window")
+            if i == (2 * requests) // 3:
+                # Partition heals (rejoin, not eviction) and the queue
+                # pressure verdict flips to scale-up: one elastic worker.
+                engine._renew_lease(jid)
+                engine.scale_up_ms = -1.0
+                decision = engine.scale_tick(force=True)
+                engine.scale_up_ms, engine.scale_down_ms = saved
+                if decision != "up":
+                    mismatches.append(
+                        f"mesh: forced scale-up tick decided {decision!r}"
+                    )
+            try:
+                tickets.append((i, engine.submit(snap)))
+            except ServeError as exc:
+                mismatches.append(
+                    f"mesh step {i}: typed admission error {exc}"
+                )
+        served = 0
+        errors = 0
+        lost = 0
+        lat = []
+        for i, ticket in tickets:
+            try:
+                resp = ticket.result(timeout=120.0)
+            except ServeError:
+                errors += 1
+                continue
+            except TimeoutError:
+                lost += 1
+                mismatches.append(
+                    f"mesh step {i}: SILENT DROP (no outcome 120s after "
+                    f"submission)"
+                )
+                continue
+            served += 1
+            lat.append(resp.seconds * 1000.0)
+            if resp.intersects is not expected[i]:
+                mismatches.append(
+                    f"mesh step {i}: served {resp.intersects} != oracle "
+                    f"{expected[i]}"
+                )
+    wall = time.perf_counter() - t0
+    # The stream drained: queue pressure is gone, so the drain-retire leg
+    # must fire — and must take the elastic worker, never the floor.
+    engine.scale_up_ms = engine.scale_down_ms = 1e12
+    decision = engine.scale_tick(force=True)
+    engine.scale_up_ms, engine.scale_down_ms = saved
+    if decision != "down":
+        mismatches.append(
+            f"mesh: forced drain-retire tick decided {decision!r}"
+        )
+    survivors = engine.worker_ids()
+    c1, _ = record.snapshot()
+    engine.stop(drain=True)
+    if peer is not None:
+        try:
+            peer.stdin.close()
+            peer.wait(timeout=30.0)
+        except (OSError, subprocess.TimeoutExpired):
+            peer.kill()
+    tmp.cleanup()
+
+    hedges = int(c1.get("fleet.hedges", 0) - c0.get("fleet.hedges", 0))
+    scale_ups = int(
+        c1.get("fleet.scale_ups", 0) - c0.get("fleet.scale_ups", 0)
+    )
+    scale_downs = int(
+        c1.get("fleet.scale_downs", 0) - c0.get("fleet.scale_downs", 0)
+    )
+    evictions = int(
+        c1.get("fleet.evictions", 0) - c0.get("fleet.evictions", 0)
+    )
+    rejoins = int(
+        c1.get("fleet.rejoins", 0) - c0.get("fleet.rejoins", 0)
+    )
+    if hedges < 1:
+        mismatches.append(
+            "mesh: partition window produced no hedged dispatches (the "
+            "suspect's arc was never exercised)"
+        )
+    if rejoins < 1:
+        mismatches.append("mesh: partition never healed as a rejoin")
+    if evictions:
+        mismatches.append(
+            f"mesh: {evictions} eviction(s) during a heal-able partition "
+            f"(suspicion escalated instead of hedging)"
+        )
+    if len(survivors) < engine.scale_min:
+        mismatches.append(
+            f"mesh: drain-retire breached scale_min ({survivors})"
+        )
+    if errors:
+        mismatches.append(
+            f"mesh: {errors} typed error(s) — those steps were never "
+            f"parity-checked"
+        )
+    lat.sort()
+    row = {
+        "fleet_join": addr if args.fleet_join != "auto" else "auto",
+        "fleet_mesh_requests": requests,
+        "fleet_mesh_verdicts_per_sec": (
+            round(served / wall, 2) if wall else 0.0
+        ),
+        "fleet_mesh_p99_ms": round(_percentile(lat, 99.0), 3),
+        "fleet_scale_events": scale_ups + scale_downs,
+        "fleet_scale_ups": scale_ups,
+        "fleet_scale_downs": scale_downs,
+        "fleet_hedge_pct": (
+            round(100.0 * hedges / served, 2) if served else 0.0
+        ),
+        "fleet_mesh_rejoins": rejoins,
+        "fleet_mesh_lost": lost,
+        "fleet_mesh_typed_errors": errors,
+    }
+    record.gauge("fleet.bench_scale_events", row["fleet_scale_events"])
+    record.gauge("fleet.bench_hedge_pct", row["fleet_hedge_pct"])
+    return row, mismatches
+
+
 def run_fuse_phase(args, record) -> tuple:
     """The qi-fuse phase (ISSUE 16): the same quick zipfian mixed stream —
     sweep-sized intersection snapshots of several distinct topologies plus
@@ -878,6 +1111,18 @@ def main(argv=None) -> int:
                         help="fused-run batch-former window in ms "
                              "(QI_SERVE_FUSE_WINDOW_MS equivalent; "
                              "default 25)")
+    parser.add_argument("--fleet-join", default=None, metavar="HOST:PORT",
+                        help="with --fleet, append the qi-mesh phase "
+                             "(ISSUE 19): drive the churn stream through "
+                             "a socket-joined fleet (one local worker + "
+                             "this remote peer) with a mid-stream "
+                             "suspect→hedge→rejoin partition window and "
+                             "forced scale-up / drain-retire elasticity "
+                             "ticks — measures fleet_hedge_pct and "
+                             "fleet_scale_events (tools/bench_trend.py "
+                             "tracks both), oracle-parity gated; the "
+                             "special value 'auto' spawns a real "
+                             "`serve --socket` subprocess to join")
     parser.add_argument("--fleet-local", action="store_true",
                         help="run fleet workers in-process instead of as "
                              "subprocesses (faster smoke, same routing/"
@@ -1026,6 +1271,11 @@ def main(argv=None) -> int:
         row.update(fleet_row)
         mismatches.extend(fleet_mismatches)
         row["verdict_ok"] = not mismatches
+        if args.fleet_join:
+            mesh_row, mesh_mismatches = run_mesh_phase(args, record)
+            row.update(mesh_row)
+            mismatches.extend(mesh_mismatches)
+            row["verdict_ok"] = not mismatches
     if args.fuse:
         fuse_row, fuse_mismatches = run_fuse_phase(args, record)
         row.update(fuse_row)

@@ -60,10 +60,15 @@ zipfian churn at N ∈ {1, 2, 4}, with a kill-one-worker bench phase).
 from __future__ import annotations
 
 import argparse
+import base64
 import bisect
 import hashlib
+import hmac
 import json
 import os
+import random
+import socket
+import socketserver
 import subprocess
 import sys
 import tempfile
@@ -71,9 +76,9 @@ import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Callable, Dict, List, Optional, Set, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from quorum_intersection_tpu.delta import SharedSccStore
+from quorum_intersection_tpu.delta import STORE_SCHEMA, SharedSccStore
 from quorum_intersection_tpu.fbas.graph import build_graph
 from quorum_intersection_tpu.fbas.schema import Fbas, parse_fbas
 from quorum_intersection_tpu.query import Query
@@ -87,7 +92,11 @@ from quorum_intersection_tpu.serve import (
     snapshot_fingerprint,
 )
 from quorum_intersection_tpu.serve_transport import (
+    MESH_PROTOCOL,
+    PROTOCOL_SCHEMA,
     JsonlSession,
+    fleet_token_digest,
+    package_fingerprint,
     pong_payload,
     run_jsonl_loop,
     ticket_response,
@@ -120,6 +129,36 @@ _fleet_sync: Callable[[str], None] = lambda point: None
 # Histogram primitive in utils/telemetry.py (ISSUE 15 dedupe) — the front
 # door's pulse.fleet_e2e_ms histogram carries both the mergeable buckets
 # and the bounded raw window those gauges derive from.
+
+
+# ---- typed mesh errors (qi-mesh, ISSUE 19) ----------------------------------
+
+
+class MeshHandshakeError(ServeError):
+    """A join handshake the peer REFUSED with a typed ``hello_err``
+    (protocol_mismatch / fingerprint_mismatch / bad_token): the mesh
+    contract is a typed reject, never a silently skewed fleet — this is
+    never retried, it propagates to the operator."""
+
+    code = "mesh_handshake"
+
+    def __init__(self, reject_code: str, message: str) -> None:
+        self.reject_code = reject_code
+        super().__init__(
+            f"mesh join rejected ({reject_code}): {message}"
+        )
+
+
+class JournalUnreadableError(ServeError):
+    """``adopt_journal`` was handed a path this host cannot read —
+    missing, permission-denied, or (the common multi-host mistake) a path
+    that only exists on a REMOTE peer's filesystem.  Typed so callers are
+    routed to the mesh ship protocol (``serve --socket`` +
+    ``fleet --join``: the journal streams over the wire, chunked +
+    digest-checked + fsync-before-ack) instead of debugging a bare
+    OSError."""
+
+    code = "journal_unreadable"
 
 
 # ---- consistent-hash ring ---------------------------------------------------
@@ -178,6 +217,23 @@ class HashRing:
         if ix == len(self._points):
             ix = 0
         return self._points[ix][1]
+
+    def route_excluding(self, key: str,
+                        exclude: Set[str]) -> Optional[str]:
+        """The first arc owner at or after ``key``'s hash whose worker is
+        NOT in ``exclude`` — the hedge secondary's "next arc owner"
+        contract (qi-mesh): walking the ring point-by-point keeps the
+        secondary deterministic for a given worker set, like
+        :meth:`route` itself.  ``None`` when every point is excluded."""
+        if not self._points:
+            return None
+        h = self._hash(key)
+        start = bisect.bisect_left(self._points, (h, ""))
+        for k in range(len(self._points)):
+            wid = self._points[(start + k) % len(self._points)][1]
+            if wid not in exclude:
+                return wid
+        return None
 
     def workers(self) -> List[str]:
         return sorted(self._workers)
@@ -479,6 +535,389 @@ class LocalWorker:
             self.engine.stop(drain=True, timeout=timeout)
 
 
+class SocketWorker:
+    """One REMOTE serve worker joined over TCP (qi-mesh, ISSUE 19): a
+    peer running ``serve --socket PORT [--bind ADDR]`` on another host,
+    behind the same handle duck-type as :class:`ProcWorker` /
+    :class:`LocalWorker` — the front door cannot tell them apart.
+
+    The constructor performs the versioned join handshake (protocol +
+    package fingerprint + ``QI_FLEET_TOKEN`` digest); the peer's
+    ``hello_ok`` carries its replay report (readiness), a ``hello_err``
+    is a TYPED reject surfaced via :attr:`handshake_error` — never a
+    silently skewed mesh.  The hello also advertises the front door's
+    store gateway, so the peer's SCC fragments flow both ways
+    (fetch-on-miss, publish-on-solve).
+
+    Liveness is two-tier: a broken CONNECTION (reader EOF) is death —
+    same as a ProcWorker's pipe EOF; missed *pings on a live connection*
+    are a PARTITION signal the front door turns into suspicion + lease
+    accounting, because a stalled wire heals where a dead process never
+    does.  ``journal_path`` is ``None`` — the peer's journal lives on its
+    host and ships over the wire (:meth:`ship_journal`) instead.
+    """
+
+    kind = "socket"
+
+    def __init__(
+        self,
+        worker_id: str,
+        addr: Tuple[str, int],
+        on_response: _OnResponse,
+        *,
+        store_port: Optional[int] = None,
+        on_exit: Optional[Callable[[str], None]] = None,
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.worker_id = worker_id
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.journal_path: Optional[Path] = None  # remote: ships over the wire
+        self._on_response = on_response
+        self._on_exit = on_exit
+        self._closing = False
+        self._dead = False
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pings: Dict[str, Tuple[threading.Event, List[Dict[str, object]]]] = {}
+        self._ready = threading.Event()
+        self.replay_report: Optional[Dict[str, object]] = None
+        self.handshake_error: Optional[Dict[str, object]] = None
+        # Journal-ship collector.  _ship_lock guards only the collector
+        # fields (quick mutations — waiting and fsync happen outside any
+        # lock); ship serialization itself is the callers' contract: the
+        # evict path is deduplicated by _dead_handled and the retire path
+        # removed the worker from _live first, so at most one ship is in
+        # flight per worker.
+        self._ship_lock = threading.Lock()
+        self._ship_done = threading.Event()
+        self._ship_chunks: Dict[int, bytes] = {}
+        self._ship_end: Optional[Dict[str, object]] = None
+        self._ship_err: Optional[Dict[str, object]] = None
+        self._sock = socket.create_connection(self.addr, timeout=timeout_s)
+        # Reads block on the reader thread; every write is deadline-free
+        # JSONL guarded by _wlock (a stuck peer surfaces as ping misses,
+        # not a wedged front door — the socket's send buffer absorbs the
+        # line or the OS errors the write).
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("r", encoding="utf-8")
+        self._wfile = self._sock.makefile("w", encoding="utf-8")
+        hello: Dict[str, object] = {
+            "schema": PROTOCOL_SCHEMA,
+            "protocol": MESH_PROTOCOL,
+            "fingerprint": package_fingerprint(),
+            "token": fleet_token_digest(),
+            "peer": worker_id,
+        }
+        if store_port is not None:
+            # The address THIS host is reachable at from the peer's side
+            # of this very connection — the one host answer that is
+            # correct on loopback and multi-homed hosts alike.
+            hello["store"] = {
+                "host": self._sock.getsockname()[0],
+                "port": int(store_port),
+            }
+        # qi-lint: allow(cancel-token-plumbed) — socket demultiplexer; close()/kill() end it via EOF
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"qi-fleet-sock-{worker_id}",
+            daemon=True,
+        )
+        if not self._write({"hello": hello}):
+            raise OSError(f"mesh hello write to {self.addr} failed")
+        self._reader.start()
+
+    # ---- wire ------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._rfile:
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                if "hello_ok" in obj:
+                    ok = obj["hello_ok"]
+                    if isinstance(ok, dict):
+                        rep = ok.get("replay")
+                        self.replay_report = (
+                            rep if isinstance(rep, dict) else None
+                        )
+                    self._ready.set()
+                    continue
+                if "hello_err" in obj:
+                    err = obj["hello_err"]
+                    self.handshake_error = (
+                        err if isinstance(err, dict)
+                        else {"code": "hello_err"}
+                    )
+                    self._ready.set()
+                    continue
+                if "ship_chunk" in obj:
+                    self._collect_chunk(obj["ship_chunk"])
+                    continue
+                if "ship_end" in obj:
+                    end = obj["ship_end"]
+                    with self._ship_lock:
+                        self._ship_end = end if isinstance(end, dict) else {}
+                    self._ship_done.set()
+                    continue
+                if "ship_err" in obj:
+                    err = obj["ship_err"]
+                    with self._ship_lock:
+                        self._ship_err = err if isinstance(err, dict) else {}
+                    self._ship_done.set()
+                    continue
+                if "pong" in obj:
+                    token = str(obj.get("pong"))
+                    with self._plock:
+                        waiter = self._pings.pop(token, None)
+                    if waiter is not None:
+                        waiter[1].append(obj)
+                        waiter[0].set()
+                    continue
+                self._on_response(self.worker_id, obj)
+        except (OSError, ValueError):
+            pass
+        self._dead = True
+        self._ready.set()  # a join blocked in wait_ready wakes to False
+        self._ship_done.set()  # a ship blocked mid-stream wakes to None
+        if not self._closing and self._on_exit is not None:
+            self._on_exit(self.worker_id)
+
+    def _collect_chunk(self, chunk: object) -> None:
+        if not isinstance(chunk, dict):
+            return
+        try:
+            data = base64.b64decode(str(chunk.get("data") or ""))
+            seq = int(chunk.get("seq") or 0)
+            want = int(chunk.get("len"))  # type: ignore[arg-type]
+        except (ValueError, TypeError):
+            return  # a malformed chunk fails the digest check downstream
+        if len(data) == want:
+            with self._ship_lock:
+                self._ship_chunks[seq] = data
+
+    def _write(self, obj: Dict[str, object]) -> bool:
+        try:
+            with self._wlock:
+                self._wfile.write(json.dumps(obj, default=str) + "\n")
+                self._wfile.flush()
+            return True
+        except (OSError, ValueError):
+            # Broken connection: the peer (or the wire) is gone — the
+            # caller turns this into suspicion/eviction.
+            return False
+
+    # ---- worker duck-type ------------------------------------------------
+
+    def wait_ready(self, timeout: float) -> bool:
+        if not self._ready.wait(timeout):
+            return False
+        return self.handshake_error is None and not self._dead
+
+    def submit(self, request_id: str, nodes: List[Dict[str, object]],
+               deadline_s: Optional[float],
+               query: Optional[Dict[str, object]] = None,
+               trace: Optional[str] = None,
+               client: Optional[str] = None) -> bool:
+        if self._dead:
+            return False
+        line: Dict[str, object] = {"request_id": request_id, "nodes": nodes}
+        if deadline_s is not None:
+            line["deadline_s"] = deadline_s
+        if query is not None:
+            line["query"] = query
+        if trace is not None:
+            line["trace"] = trace
+        if client is not None:
+            line["client"] = client
+        return self._write(line)
+
+    def ping(self, timeout: float = 2.0) -> Optional[Dict[str, object]]:
+        if self._dead:
+            return None
+        token = f"{self.worker_id}-{time.monotonic_ns():x}"
+        ev: threading.Event = threading.Event()
+        box: List[Dict[str, object]] = []
+        with self._plock:
+            self._pings[token] = (ev, box)
+        if not self._write({"ping": token}) or not ev.wait(timeout):
+            with self._plock:
+                self._pings.pop(token, None)
+            return None
+        return box[0]
+
+    def alive(self) -> bool:
+        # Connection-level liveness only: a SIGSTOPped/partitioned peer
+        # keeps its TCP session and stays "alive" here — its missed
+        # pings drive the suspect→lease-lapse path instead, because a
+        # partition heals where a dead process never does.
+        return not self._dead
+
+    def ship_journal(self, spool_dir: Path,
+                     timeout: float = 30.0) -> Optional[Path]:
+        """Pull the peer's crash-only journal into a local spool file:
+        chunked + length-checked + digest-verified, and **fsynced before
+        the ack goes back** — an acked ship is durable on this side, and
+        a torn stream is detected (digest mismatch), never replayed.
+        ``None`` on a broken wire or failed verification."""
+        with self._ship_lock:
+            self._ship_chunks = {}
+            self._ship_end = None
+            self._ship_err = None
+        self._ship_done.clear()
+        if not self._write(
+            {"ship_journal": {"token": fleet_token_digest()}}
+        ):
+            return None
+        if not self._ship_done.wait(timeout):
+            return None
+        with self._ship_lock:
+            end = self._ship_end
+            err = self._ship_err
+            chunks = dict(self._ship_chunks)
+        if err is not None or end is None:
+            return None
+        raw = b"".join(chunks[i] for i in sorted(chunks))
+        try:
+            intact = (
+                len(chunks) == int(end.get("chunks") or 0)
+                and len(raw) == int(end.get("bytes") or -1)
+                and hashlib.sha256(raw).hexdigest() == end.get("sha256")
+            )
+        except (ValueError, TypeError):
+            intact = False
+        if not intact:
+            return None
+        spool_dir.mkdir(parents=True, exist_ok=True)
+        spool = spool_dir / f"{self.worker_id}.shipped.journal"
+        with spool.open("wb") as fh:
+            fh.write(raw)
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._write({"ship_ack": {"bytes": len(raw)}})
+        return spool
+
+    def kill(self) -> None:
+        """Hard-drop the CONNECTION (the peer process keeps running on
+        its host; from this fleet's view the worker is gone)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Graceful: half-close the write side so the peer sees EOF and
+        drains this session (every accepted request answers through the
+        still-open read half), then tear down."""
+        self._closing = True
+        try:
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        self._reader.join(timeout=timeout)
+        self._dead = True
+        for closer in (self._rfile, self._wfile, self._sock):
+            try:
+                closer.close()
+            except OSError:
+                pass
+
+
+class StoreGateway:
+    """qi-store/1 over TCP (qi-mesh, ISSUE 19): the front door's
+    :class:`~quorum_intersection_tpu.delta.SharedSccStore` served to
+    socket-joined peers, so SCC fragments flow across hosts with no
+    shared filesystem — fetch-on-miss, publish-on-solve, through
+    ``delta.RemoteStoreClient`` on the peer side.
+
+    Sessions open with a token-authenticated ``store_hello`` (digest
+    compare, like the join handshake); each subsequent line is one
+    ``get``/``put`` op answered with one ``{"ok": ...}`` line.  Serving
+    reads/writes the same atomic file tier the local workers share, and
+    safety is unchanged: a forged, torn or stale payload fails the
+    client's strict shape validation and re-verification — it is only
+    ever a miss, never a trusted verdict.
+    """
+
+    def __init__(self, store: SharedSccStore, *,
+                 host: Optional[str] = None, port: int = 0) -> None:
+        outer = self
+        host = host or qi_env("QI_SERVE_BIND") or "127.0.0.1"
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                outer._serve_conn(self.rfile, self.wfile)
+
+        self.store = store
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True,
+        )
+        self._srv.daemon_threads = True
+        self.host = host
+        self.port = int(self._srv.server_address[1])
+        # qi-lint: allow(cancel-token-plumbed) — daemon accept loop, no solve work; stop() shuts it down
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="qi-store-gateway",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("fleet store gateway on %s:%d", host, self.port)
+
+    def _serve_conn(self, rfile: object, wfile: object) -> None:
+        """One authenticated gateway session; a client that dies mid-line
+        ends THIS session (logged), never the acceptor."""
+        rec = get_run_record()
+
+        def reply(obj: Dict[str, object]) -> None:
+            wfile.write(  # type: ignore[attr-defined]
+                (json.dumps(obj, default=str) + "\n").encode("utf-8")
+            )
+            wfile.flush()  # type: ignore[attr-defined]
+
+        try:
+            first = (rfile.readline() or b"null")  # type: ignore[attr-defined]
+            hello = json.loads(first.decode("utf-8", errors="replace"))
+            inner = (hello.get("store_hello")
+                     if isinstance(hello, dict) else None)
+            if not (isinstance(inner, dict) and hmac.compare_digest(
+                str(inner.get("token") or ""), fleet_token_digest(),
+            )):
+                rec.add("fleet.store_gateway_rejects")
+                rec.event("fleet.store_gateway_rejected")
+                reply({"ok": False, "error": "store_hello token mismatch"})
+                return
+            reply({"ok": True, "schema": STORE_SCHEMA})
+            for line in rfile:  # type: ignore[attr-defined]
+                op = json.loads(line.decode("utf-8", errors="replace"))
+                if not isinstance(op, dict):
+                    reply({"ok": False, "error": "op is not an object"})
+                    continue
+                kind = str(op.get("kind") or "")
+                fp = str(op.get("fp") or "")
+                scope = str(op.get("scope") or "")
+                if op.get("op") == "get":
+                    reply({"ok": True,
+                           "payload": self.store.get(kind, fp, scope)})
+                elif op.get("op") == "put":
+                    payload = op.get("payload")
+                    stored = (
+                        self.store.put(kind, fp, payload, scope)
+                        if isinstance(payload, dict) else False
+                    )
+                    reply({"ok": True, "stored": stored})
+                else:
+                    reply({"ok": False, "error": "unknown op"})
+        except (OSError, ValueError) as exc:
+            log.warning("store gateway session ended (%s); acceptor "
+                        "unaffected", exc)
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
 # ---- the front door ---------------------------------------------------------
 
 
@@ -539,13 +978,31 @@ class FleetEngine:
         probe_interval_s: Optional[float] = None,
         probe_fails: Optional[int] = None,
         respawn_max: Optional[int] = None,
+        joins: Optional[Sequence[str]] = None,
+        lease_s: Optional[float] = None,
+        scale_interval_s: Optional[float] = None,
+        scale_min: Optional[int] = None,
+        scale_max: Optional[int] = None,
     ) -> None:
         if worker_mode not in ("subprocess", "local"):
             raise ValueError(f"unknown worker_mode {worker_mode!r}")
+        # Socket joins (qi-mesh, ISSUE 19): "HOST:PORT" peers running
+        # ``serve --socket``; slot ids j0.. so the respawn machinery can
+        # REDIAL a slot's address after an eviction (the rejoin path).
+        self._join_addrs: Dict[str, Tuple[str, int]] = {}
+        for i, spec in enumerate(joins or ()):
+            host, _, port = str(spec).rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"--join expects HOST:PORT, got {spec!r}"
+                )
+            self._join_addrs[f"j{i}"] = (host, int(port))
         self.n_workers = max(
             workers if workers is not None
             else qi_env_int("QI_FLEET_WORKERS", 2),
-            1,
+            # A pure socket mesh may run with ZERO local workers; without
+            # joins at least one local worker keeps the ring non-empty.
+            0 if self._join_addrs else 1,
         )
         self.backend = backend
         self.worker_mode = worker_mode
@@ -574,6 +1031,36 @@ class FleetEngine:
             0,
         )
         self._respawn_counts: Dict[str, int] = {}
+        # Heartbeat leases (qi-mesh): a socket peer that misses its probe
+        # hysteresis is SUSPECTED — routed around with hedged dispatch —
+        # and only evicted when its lease (renewed by every pong) lapses.
+        self.lease_s = max(
+            lease_s if lease_s is not None
+            else qi_env_float("QI_FLEET_LEASE_S", 3.0),
+            0.1,
+        )
+        self._suspected: Set[str] = set()
+        self._leases: Dict[str, float] = {}
+        self._store_gateway: Optional[StoreGateway] = None
+        # Elasticity (qi-mesh): the pulse→fleet-size supervisor. 0 = off.
+        self.scale_interval_s = (
+            scale_interval_s if scale_interval_s is not None
+            else qi_env_float("QI_FLEET_SCALE_INTERVAL_S", 0.0)
+        )
+        self.scale_up_ms = qi_env_float("QI_FLEET_SCALE_UP_MS", 250.0)
+        self.scale_down_ms = qi_env_float("QI_FLEET_SCALE_DOWN_MS", 20.0)
+        self.scale_min = max(
+            scale_min if scale_min is not None
+            else qi_env_int("QI_FLEET_SCALE_MIN", 1),
+            1,
+        )
+        self.scale_max = max(
+            scale_max if scale_max is not None
+            else qi_env_int("QI_FLEET_SCALE_MAX", 8),
+            self.scale_min,
+        )
+        self._next_scale_t = 0.0
+        self._elastic_seq = 0
         self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
         if journal_dir is None:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="qi-fleet-")
@@ -625,9 +1112,20 @@ class FleetEngine:
         self.store_dir.mkdir(parents=True, exist_ok=True)
         with rec.span("fleet.start", workers=self.n_workers,
                       mode=self.worker_mode):
+            if self._join_addrs:
+                # Socket peers need a wire path to the shared fragment
+                # tier: serve this front door's store directory over the
+                # mesh (its address rides each join hello).
+                self._store_gateway = StoreGateway(
+                    SharedSccStore(self.store_dir),
+                )
             for i in range(self.n_workers):
                 wid = f"w{i}"
                 self._workers[wid] = self._make_worker(wid)
+            for wid in sorted(self._join_addrs):
+                joined = self._join_worker(wid, self._join_addrs[wid])
+                if joined is not None:
+                    self._workers[wid] = joined
             reports: Dict[str, object] = {}
             for wid, worker in self._workers.items():
                 if not worker.wait_ready(timeout=120.0):
@@ -640,6 +1138,7 @@ class FleetEngine:
                 with self._lock:
                     self._live.add(wid)
                     self._ring.add(wid)
+                    self._leases[wid] = time.monotonic() + self.lease_s
         with self._lock:
             live, ring_size = len(self._live), len(self._ring)
         rec.gauge("fleet.workers_live", live)
@@ -661,11 +1160,24 @@ class FleetEngine:
             "replay": reports,
         }
 
-    def _make_worker(self, wid: str) -> Union[ProcWorker, LocalWorker]:
+    def _make_worker(
+        self, wid: str,
+    ) -> Union[ProcWorker, LocalWorker, "SocketWorker"]:
         """Construct one worker for slot/replacement id ``wid`` — shared
-        by :meth:`start` and the auto-respawn path, so a replacement is
-        configured byte-identically to the worker it replaces (only its
-        journal file is fresh: the dead journal already failed over)."""
+        by :meth:`start`, the auto-respawn path and the elastic scale-up
+        path, so a replacement is configured byte-identically to the
+        worker it replaces (only its journal file is fresh: the dead
+        journal already failed over).  A join slot (``j<i>`` or its
+        ``.r<n>`` replacement) REDIALS its peer address instead — the
+        respawn machinery doubles as the mesh rejoin path."""
+        addr = self._join_addrs.get(wid.split(".", 1)[0])
+        if addr is not None:
+            joined = self._join_worker(wid, addr)
+            if joined is None:
+                raise OSError(
+                    f"re-join of {addr[0]}:{addr[1]} failed"
+                )
+            return joined
         make = ProcWorker if self.worker_mode == "subprocess" else LocalWorker
         return make(
             wid, self.journal_dir / f"{wid}.journal",
@@ -678,6 +1190,63 @@ class FleetEngine:
             scope_to_scc=self.scope_to_scc,
             on_exit=self._on_worker_exit,
         )
+
+    def _join_worker(self, wid: str,
+                     addr: Tuple[str, int]) -> Optional[SocketWorker]:
+        """Dial one remote peer behind the ``fleet.join`` fault point:
+        versioned handshake, deadline on the connect, bounded
+        backoff+jitter retries.  A typed handshake reject
+        (:class:`MeshHandshakeError`) PROPAGATES — a skewed mesh is
+        refused, never retried into; wire/injected errors degrade to a
+        fleet WITHOUT this peer (standalone workers keep serving),
+        loudly (``fleet.join_errors`` + ``fleet.join_degraded``)."""
+        rec = get_run_record()
+        store = self._store_gateway
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            if attempt:
+                # Bounded backoff+jitter: a rebooting peer gets breathing
+                # room, a blip retries almost immediately.
+                time.sleep(
+                    min(0.1 * (2 ** (attempt - 1)), 1.0)
+                    * (1.0 + random.random())
+                )
+            worker: Optional[SocketWorker] = None
+            try:
+                fault_point("fleet.join")
+                worker = SocketWorker(
+                    wid, addr, self._on_response,
+                    store_port=store.port if store is not None else None,
+                    on_exit=self._on_worker_exit,
+                )
+                if not worker.wait_ready(timeout=120.0):
+                    err = worker.handshake_error
+                    worker.kill()
+                    if err is not None:
+                        raise MeshHandshakeError(
+                            str(err.get("code") or "hello_err"),
+                            str(err.get("message") or ""),
+                        )
+                    raise OSError("join handshake timed out")
+                rec.add("fleet.joins")
+                rec.event("fleet.joined", worker=wid,
+                          addr=f"{addr[0]}:{addr[1]}")
+                log.info("fleet worker %s joined from %s:%d", wid,
+                         addr[0], addr[1])
+                return worker
+            except MeshHandshakeError:
+                raise
+            except (FaultInjected, OSError, ValueError) as exc:
+                last = exc
+                if worker is not None:
+                    worker.kill()
+        rec.add("fleet.join_errors")
+        rec.event("fleet.join_degraded", worker=wid, error=str(last))
+        log.warning(
+            "fleet join %s (%s:%d) failed after retries (%s); continuing "
+            "without this peer", wid, addr[0], addr[1], last,
+        )
+        return None
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Close admission, drain (or kill) every worker, resolve whatever
@@ -692,6 +1261,9 @@ class FleetEngine:
                 worker.close(timeout=timeout)
             else:
                 worker.kill()
+        if self._store_gateway is not None:
+            self._store_gateway.stop()
+            self._store_gateway = None
         with self._lock:
             leftovers = list(self._pending.values())
             self._pending.clear()
@@ -846,7 +1418,13 @@ class FleetEngine:
                 if pending.worker_id != wid:
                     return  # a concurrent failover re-routed it already
                 worker = self._workers.get(wid) if wid in self._live else None
-            if worker is not None and worker.submit(
+                suspected = wid in self._suspected
+            if worker is not None and suspected:
+                # The arc owner is under suspicion (missed heartbeats on
+                # a live connection): hedge instead of betting on it.
+                if self._hedge_dispatch(pending, wid, worker, rec, rid):
+                    return
+            elif worker is not None and worker.submit(
                 rid, pending.nodes, pending.deadline_s, pending.query,
                 pending.trace, pending.client,
             ):
@@ -867,6 +1445,87 @@ class FleetEngine:
             )))
             self._note_replay_resolved(pending)
 
+    # ---- hedged dispatch (qi-mesh) ---------------------------------------
+
+    def _next_arc_owner(
+        self, fingerprint: str, exclude: Set[str],
+    ) -> Optional[Tuple[str, Union[ProcWorker, LocalWorker, SocketWorker]]]:
+        """The next LIVE, unsuspected worker on the ring after the
+        excluded arc owner(s) — the hedge secondary."""
+        with self._lock:
+            skip = set(exclude) | self._suspected | {
+                w for w in self._ring.workers() if w not in self._live
+            }
+            wid = self._ring.route_excluding(fingerprint, skip)
+            worker = self._workers.get(wid) if wid is not None else None
+        if wid is None or worker is None:
+            return None
+        return (wid, worker)
+
+    def _hedge_dispatch(self, pending: _Pending, wid: str,
+                        worker: Union[ProcWorker, LocalWorker, SocketWorker],
+                        rec: RunRecord, rid: str) -> bool:
+        """Dispatch to a SUSPECTED primary and simultaneously to the next
+        live arc owner under the SAME wire id: whichever answers first
+        resolves the client ticket, the straggler's answer books
+        ``fleet.duplicate_responses`` — the PR 11 dedup IS the hedge
+        dedup, so a primary that rejoins mid-hedge cannot double-answer.
+        The ``fleet.hedge`` fault point degrades to a SINGLE dispatch to
+        the secondary (one bet on the healthy peer, none on the
+        suspect).  Every exit books exactly one of ``fleet.hedges`` /
+        ``fleet.hedge_errors`` (pass-8 conservation: a hedge decision is
+        never silent); ``False`` sends the caller down the
+        worker-death/re-route path."""
+        _fleet_sync("hedge.decided")
+        secondary = self._next_arc_owner(pending.fingerprint, {wid})
+        try:
+            fault_point("fleet.hedge")
+        except (FaultInjected, OSError) as exc:
+            rec.add("fleet.hedge_errors")
+            rec.event("fleet.hedge_degraded", worker=wid, error=str(exc))
+            log.warning(
+                "hedge degraded (%s): single dispatch to the next arc "
+                "owner", exc,
+            )
+            twid, tworker = secondary if secondary is not None else (
+                wid, worker,
+            )
+            with self._lock:
+                pending.worker_id = twid
+            if tworker.submit(rid, pending.nodes, pending.deadline_s,
+                              pending.query, pending.trace, pending.client):
+                rec.add("fleet.routed")
+                return True
+            return False
+        sent = 0
+        if worker.submit(rid, pending.nodes, pending.deadline_s,
+                         pending.query, pending.trace, pending.client):
+            sent += 1
+        if secondary is not None:
+            swid, sworker = secondary
+            if sworker.submit(rid, pending.nodes, pending.deadline_s,
+                              pending.query, pending.trace, pending.client):
+                sent += 1
+                with self._lock:
+                    # Failover bookkeeping follows the HEALTHY secondary:
+                    # if the suspect lapses, this request is already owned
+                    # by a live peer and must not re-dispatch.
+                    pending.worker_id = swid
+        if not sent:
+            rec.add("fleet.hedge_errors")
+            rec.event("fleet.hedge_degraded", worker=wid,
+                      error="neither hedge leg accepted the request")
+            return False
+        rec.add("fleet.hedges")
+        rec.add("fleet.routed")
+        rec.event(
+            "fleet.hedged", worker=wid,
+            secondary=secondary[0] if secondary is not None else "",
+            legs=sent,
+        )
+        _fleet_sync("hedge.sent")
+        return True
+
     # ---- responses -------------------------------------------------------
 
     def _on_response(self, worker_id: str, obj: Dict[str, object]) -> None:
@@ -877,11 +1536,12 @@ class FleetEngine:
                 self._pending.pop(rid, None) if isinstance(rid, str) else None
             )
         if pending is None:
-            # A late answer for a request that already failed over (both
-            # the dead worker and its inheritor solved it): the first
-            # resolution won, the client never sees two outcomes.
+            # A late answer for a request that already failed over or was
+            # hedged (two workers solved it): the first resolution won,
+            # the client never sees two outcomes.
             rec.add("fleet.duplicate_responses")
             return
+        _fleet_sync("response.delivered")
         err = obj.get("error")
         if isinstance(err, dict):
             exc = ServeError(str(err.get("message") or "upstream serve error"))
@@ -990,13 +1650,297 @@ class FleetEngine:
                     fails[wid] = fails.get(wid, 0) + 1
                     rec.add("fleet.probe_timeouts")
                     if fails[wid] >= self.probe_fails:
-                        self._handle_worker_death(
-                            wid, f"{fails[wid]} consecutive failed probes",
-                        )
+                        reason = f"{fails[wid]} consecutive failed probes"
+                        if worker.kind == "socket":
+                            # A live-connection socket peer that stops
+                            # ponging is PARTITIONED, not dead: suspect
+                            # (hedged routing) and let the lease decide.
+                            self._suspect_worker(wid, reason)
+                        else:
+                            self._handle_worker_death(wid, reason)
                 else:
                     fails[wid] = 0
                     pongs[wid] = pong
+                    self._renew_lease(wid)
             self._aggregate_health(pongs)
+            self._expire_leases()
+            self.scale_tick()
+
+    # ---- partition tolerance: suspect → hedge → lease (qi-mesh) ----------
+
+    def _suspect_worker(self, wid: str, reason: str) -> None:
+        """Missed heartbeats on a SOCKET peer mean *suspected*, never
+        immediately dead — a partition heals where a dead process does
+        not.  A suspect keeps its ring arc, but every request routed to
+        it is HEDGED to the next arc owner until it pongs again (rejoin)
+        or its lease lapses (eviction + journal ship)."""
+        rec = get_run_record()
+        with self._lock:
+            if wid in self._suspected or wid not in self._live:
+                return
+            self._suspected.add(wid)
+            n_susp = len(self._suspected)
+        rec.add("fleet.suspects")
+        rec.gauge("fleet.suspected", n_susp)
+        rec.event("fleet.suspected", worker=wid, reason=reason)
+        log.warning(
+            "fleet worker %s suspected (%s); its requests hedge to the "
+            "next arc owner until it pongs or its %.3gs lease lapses",
+            wid, reason, self.lease_s,
+        )
+
+    def _renew_lease(self, wid: str) -> None:
+        """A pong renews the worker's heartbeat lease; a SUSPECTED worker
+        answering again is a REJOIN — it takes its ring arc back, and its
+        in-flight hedges deduplicate by wire request id (first answer
+        resolves the ticket, the straggler books
+        ``fleet.duplicate_responses``)."""
+        rec = get_run_record()
+        with self._lock:
+            self._leases[wid] = time.monotonic() + self.lease_s
+            rejoined = wid in self._suspected
+            if rejoined:
+                self._suspected.discard(wid)
+                n_susp = len(self._suspected)
+        if rejoined:
+            rec.add("fleet.rejoins")
+            rec.gauge("fleet.suspected", n_susp)
+            rec.event("fleet.rejoined", worker=wid)
+            log.info("fleet worker %s rejoined; suspicion lifted", wid)
+
+    def _expire_leases(self) -> None:
+        """Evict suspected peers whose heartbeat lease lapsed — behind
+        the ``fleet.lease`` fault point, which degrades to SUSPECT-ONLY:
+        a broken lease clock must never evict a healthy-but-slow peer
+        (hedging keeps its requests answered), while a DEAD connection
+        still evicts immediately through the reader-EOF path."""
+        rec = get_run_record()
+        now = time.monotonic()
+        with self._lock:
+            lapsed = [
+                wid for wid in sorted(self._suspected)
+                if wid in self._live and now > self._leases.get(wid, 0.0)
+            ]
+        if not lapsed:
+            return
+        try:
+            fault_point("fleet.lease")
+        except (FaultInjected, OSError) as exc:
+            rec.add("fleet.lease_errors")
+            rec.event("fleet.lease_degraded", error=str(exc))
+            log.warning(
+                "lease-lapse check degraded (%s); lapsed peers stay "
+                "suspect-only (hedged) this cycle", exc,
+            )
+            return
+        for wid in lapsed:
+            self._handle_worker_death(wid, "heartbeat lease lapsed")
+
+    # ---- elasticity (qi-mesh) --------------------------------------------
+
+    def scale_tick(self, *, force: bool = False) -> Optional[str]:
+        """One elasticity decision — the probe loop calls this every
+        cycle when ``QI_FLEET_SCALE_INTERVAL_S`` > 0 (rate-limited to
+        that cadence); tests and the bench drive it deterministically
+        with ``force=True``.  Returns "up" / "down" / ``None``."""
+        if not force:
+            if self.scale_interval_s <= 0:
+                return None
+            now = time.monotonic()
+            with self._lock:
+                if now < self._next_scale_t or self._closed:
+                    return None
+                self._next_scale_t = now + self.scale_interval_s
+        return self._apply_scale()
+
+    def _apply_scale(self) -> Optional[str]:
+        """The pulse→fleet-size control loop: the fleet-MERGED queue-wait
+        p99 (the aggregation plane's ``fleet.pulse.queue_wait_ms``) plus
+        the SLO plane's burn count turn into a spawn / retire / hold
+        decision, bounded by ``QI_FLEET_SCALE_MIN``/``_MAX``.  Behind the
+        ``fleet.scale`` fault point: any failure FREEZES the fleet at its
+        current size, loudly.  Every exit books exactly one of
+        ``fleet.scale_ups`` / ``fleet.scale_downs`` /
+        ``fleet.scale_holds`` / ``fleet.scale_errors`` (the pass-8
+        conservation law: a scale decision is never silent)."""
+        rec = get_run_record()
+        try:
+            fault_point("fleet.scale")
+            p99 = rec.histogram("fleet.pulse.queue_wait_ms").quantile_ms(99.0)
+            from quorum_intersection_tpu.cost import slo_plane
+
+            burning = slo_plane().burning_count()
+            with self._lock:
+                live = len(self._live)
+            if (p99 > self.scale_up_ms or burning) and live < self.scale_max:
+                wid = self._spawn_elastic()
+                if wid is None:
+                    rec.add("fleet.scale_errors")
+                    rec.event("fleet.scale_degraded",
+                              error="elastic spawn failed")
+                    log.warning(
+                        "elasticity degraded (elastic spawn failed); "
+                        "fleet size frozen at its current size",
+                    )
+                    return None
+                rec.add("fleet.scale_ups")
+                rec.event("fleet.scaled", direction="up", worker=wid,
+                          queue_p99_ms=round(p99, 3), burning=burning)
+                log.info(
+                    "fleet scaled UP to %s (queue p99 %.1fms, %d SLO "
+                    "target(s) burning)", wid, p99, burning,
+                )
+                return "up"
+            if (p99 < self.scale_down_ms and not burning
+                    and live > self.scale_min):
+                wid = self._retire_one()
+                if wid is not None:
+                    rec.add("fleet.scale_downs")
+                    rec.event("fleet.scaled", direction="down", worker=wid,
+                              queue_p99_ms=round(p99, 3))
+                    log.info(
+                        "fleet scaled DOWN (%s drained + retired, queue "
+                        "p99 %.1fms)", wid, p99,
+                    )
+                    return "down"
+            rec.add("fleet.scale_holds")
+            return None
+        except (FaultInjected, OSError, ValueError) as exc:
+            rec.add("fleet.scale_errors")
+            rec.event("fleet.scale_degraded", error=str(exc))
+            log.warning(
+                "elasticity degraded (%s); fleet size frozen at its "
+                "current size", exc,
+            )
+            return None
+
+    def _spawn_elastic(self) -> Optional[str]:
+        """Scale-up: one fresh ``e<n>`` worker through the same
+        construction + ready gate the respawn machinery uses, spawned
+        synchronously (the scale loop already runs off the probe
+        thread, never on a request path)."""
+        with self._lock:
+            self._elastic_seq += 1
+            wid = f"e{self._elastic_seq}"
+        worker = self._make_worker(wid)
+        if not worker.wait_ready(timeout=120.0):
+            worker.kill()
+            return None
+        with self._lock:
+            arrived_dead = self._closed
+            if not arrived_dead:
+                self._workers[wid] = worker
+                self._live.add(wid)
+                self._ring.add(wid)
+                self._leases[wid] = time.monotonic() + self.lease_s
+                live, ring_size = len(self._live), len(self._ring)
+        if arrived_dead:
+            worker.kill()
+            return None
+        rec = get_run_record()
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        return wid
+
+    def _retire_one(self) -> Optional[str]:
+        """Scale-down by DRAIN-THROUGH-JOURNAL-INHERITANCE: admission to
+        the retiree closes first (ring + live removal, so a racing
+        dispatch re-routes through the shrunken ring), it drains
+        gracefully (every accepted request answers), and then its journal
+        — local file, or SHIPPED over the wire for a socket peer — runs
+        the standard failover dedup: zero lost, zero duplicated, the
+        PR 11 guarantee extended to voluntary shrink.  Prefers the
+        newest elastic (``e<n>``) worker; never touches the last
+        ``scale_min``."""
+        rec = get_run_record()
+        with self._lock:
+            if len(self._live) <= self.scale_min:
+                return None
+            order = sorted(self._live, reverse=True)
+            elastic = [w for w in order if w.startswith("e")]
+            target = (elastic or order)[0]
+            self._live.discard(target)
+            self._ring.remove(target)
+            # The voluntary close below must not re-enter death handling
+            # when the reader thread sees its EOF.
+            self._dead_handled.add(target)
+            self._suspected.discard(target)
+            self._leases.pop(target, None)
+            live, ring_size = len(self._live), len(self._ring)
+        rec.gauge("fleet.workers_live", live)
+        rec.gauge("fleet.ring_size", ring_size)
+        rec.gauge("fleet.suspected", len(self._suspected))
+        _fleet_sync("scale.retire")
+        worker = self._workers.get(target)
+        if worker is None:
+            return target
+        journal: Optional[Path] = worker.journal_path
+        if isinstance(worker, SocketWorker):
+            # Quiesce, then pull the journal BEFORE closing the wire —
+            # after the half-close there is nothing left to ship over.
+            self._await_quiesce(target, timeout=30.0)
+            journal = self._ship_journal(worker)
+            worker.close(timeout=30.0)
+        else:
+            worker.close(timeout=60.0)
+        self._failover(target, journal)
+        return target
+
+    def _await_quiesce(self, wid: str, timeout: float) -> None:
+        """Bounded wait for every in-flight request assigned to ``wid``
+        to resolve (their responses are still flowing on the open
+        connection); leftovers after the bound re-route through the
+        failover path anyway — bounded staleness, never a lost ticket."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = any(
+                    p.worker_id == wid for p in self._pending.values()
+                )
+            if not busy:
+                return
+            time.sleep(0.02)
+
+    def _ship_journal(self, worker: SocketWorker) -> Optional[Path]:
+        """Pull a remote peer's crash-only journal over the wire before
+        its failover replay (chunked + digest-checked + fsync-before-ack
+        in :meth:`SocketWorker.ship_journal`).  The ``fleet.ship`` fault
+        point — or a wire already dead — degrades to LOCAL-JOURNAL-ONLY
+        failover, loudly: the front door's own in-flight tickets still
+        re-route (zero lost for everything it admitted), and the peer's
+        journaled-but-unshipped work waits for its host to rejoin.
+        Every exit books exactly one of ``fleet.ships`` /
+        ``fleet.ship_errors`` (pass-8 conservation)."""
+        rec = get_run_record()
+        with rec.span("fleet.ship", worker=worker.worker_id):
+            spool: Optional[Path] = None
+            try:
+                fault_point("fleet.ship")
+                spool = worker.ship_journal(self.journal_dir / "shipped")
+            except (FaultInjected, OSError) as exc:
+                rec.add("fleet.ship_errors")
+                rec.event("fleet.ship_degraded", worker=worker.worker_id,
+                          error=str(exc))
+                log.warning(
+                    "journal ship from %s degraded (%s); failover "
+                    "re-routes the front door's own in-flight tickets "
+                    "only", worker.worker_id, exc,
+                )
+                return None
+            if spool is None:
+                rec.add("fleet.ship_errors")
+                rec.event("fleet.ship_degraded", worker=worker.worker_id,
+                          error="wire broken or stream torn")
+                log.warning(
+                    "journal ship from %s degraded (wire broken or stream "
+                    "torn); failover re-routes the front door's own "
+                    "in-flight tickets only", worker.worker_id,
+                )
+                return None
+            rec.add("fleet.ships")
+            rec.event("fleet.shipped", worker=worker.worker_id,
+                      path=str(spool))
+            return spool
 
     def _aggregate_health(self, pongs: Dict[str, Dict[str, object]]) -> None:
         """Fold the workers' pong snapshots into the fleet gauges the
@@ -1135,6 +2079,7 @@ class FleetEngine:
                 "workers_live": len(self._live),
                 "ring_size": len(self._ring),
                 "pending": len(self._pending),
+                "suspected": sorted(self._suspected),
                 "workers": dict(self._pongs),
             }
 
@@ -1160,10 +2105,14 @@ class FleetEngine:
             self._dead_handled.add(worker_id)
             self._live.discard(worker_id)
             self._ring.remove(worker_id)
+            self._suspected.discard(worker_id)
+            self._leases.pop(worker_id, None)
             live, ring_size = len(self._live), len(self._ring)
+            n_susp = len(self._suspected)
         rec.add("fleet.evictions")
         rec.gauge("fleet.workers_live", live)
         rec.gauge("fleet.ring_size", ring_size)
+        rec.gauge("fleet.suspected", n_susp)
         rec.event("fleet.evicted", worker=worker_id, reason=reason)
         log.warning(
             "fleet worker %s evicted (%s); its hash range and unfinished "
@@ -1171,10 +2120,13 @@ class FleetEngine:
         )
         _fleet_sync("evict.removed")
         worker = self._workers.get(worker_id)
-        self._failover(
-            worker_id,
-            worker.journal_path if worker is not None else None,
-        )
+        journal = worker.journal_path if worker is not None else None
+        if journal is None and isinstance(worker, SocketWorker):
+            # A remote peer's journal lives on its host: ship it over the
+            # wire while (if) the connection still answers — a lease
+            # lapse often leaves a usable wire, a hard kill does not.
+            journal = self._ship_journal(worker)
+        self._failover(worker_id, journal)
         self._maybe_respawn(worker_id)
 
     # ---- auto-respawn ----------------------------------------------------
@@ -1241,6 +2193,7 @@ class FleetEngine:
                 self._workers[new_id] = worker
                 self._live.add(new_id)
                 self._ring.add(new_id)
+                self._leases[new_id] = time.monotonic() + self.lease_s
                 live, ring_size = len(self._live), len(self._ring)
         if arrived_dead:
             worker.kill()
@@ -1261,8 +2214,31 @@ class FleetEngine:
         journaled-but-unfinished request re-solves on the worker its hash
         range now belongs to.  Returns the number of requests replayed
         (the front-door-restart recovery path; also the schedule
-        harness's deterministic failover entry)."""
-        return self._failover(worker_id, Path(journal_path))
+        harness's deterministic failover entry).
+
+        The path must be readable on THIS host — an unreadable or
+        remote-host path raises the typed
+        :class:`JournalUnreadableError` (code ``journal_unreadable``)
+        pointing at the mesh ship protocol, instead of letting the
+        ``fleet.replay`` degrade path silently swallow what is really a
+        caller mistake."""
+        path = Path(journal_path)
+        try:
+            with path.open("rb"):
+                pass
+        except OSError as exc:
+            rec = get_run_record()
+            rec.add("fleet.errors")
+            rec.event("fleet.adopt_rejected", path=str(path),
+                      error=str(exc))
+            raise JournalUnreadableError(
+                f"journal {path} is not readable on this host ({exc}); a "
+                f"REMOTE peer's journal cannot be adopted by path — join "
+                f"the peer over the mesh (serve --socket + fleet --join) "
+                f"and let the ship_journal protocol stream it (chunked, "
+                f"digest-checked, fsync-before-ack)"
+            ) from exc
+        return self._failover(worker_id, path)
 
     def _failover(self, worker_id: str,
                   journal_path: Optional[Path]) -> int:
@@ -1415,6 +2391,27 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     p.add_argument("--local-workers", action="store_true",
                    help="run the workers in-process instead of as "
                         "subprocesses (debug/smoke mode)")
+    p.add_argument("--join", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="join a REMOTE serve worker into the ring (a peer "
+                        "running 'serve --socket PORT --bind ADDR'); "
+                        "repeatable.  The join runs the versioned qi-mesh "
+                        "handshake — protocol + package fingerprint + "
+                        "QI_FLEET_TOKEN digest — and a mismatch is a "
+                        "typed reject, never a silently skewed mesh")
+    p.add_argument("--lease-s", type=float, default=None, metavar="F",
+                   help="heartbeat lease for socket-joined peers (env "
+                        "twin: QI_FLEET_LEASE_S).  Missed probes SUSPECT "
+                        "a peer (its requests hedge to the next arc "
+                        "owner); only a lapsed lease evicts and ships its "
+                        "journal")
+    p.add_argument("--scale-interval-s", type=float, default=None,
+                   metavar="F",
+                   help="elasticity cadence (env twin: "
+                        "QI_FLEET_SCALE_INTERVAL_S; 0 disables): the "
+                        "fleet-merged pulse queue-wait p99 + SLO burn "
+                        "state drive spawn/retire between "
+                        "QI_FLEET_SCALE_MIN and QI_FLEET_SCALE_MAX")
     p.add_argument("--deadline-s", type=float, default=None, metavar="F",
                    help="per-request deadline budget forwarded to the "
                         "workers (env twin: QI_SERVE_DEADLINE_S)")
@@ -1464,6 +2461,9 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
         dangling=args.dangling_policy,
         scc_select=args.scc_select,
         scope_to_scc=args.scope_scc,
+        joins=args.join,
+        lease_s=args.lease_s,
+        scale_interval_s=args.scale_interval_s,
     )
     session = JsonlSession(
         engine,  # type: ignore[arg-type] — same submit/Ticket contract

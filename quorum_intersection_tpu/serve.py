@@ -63,6 +63,7 @@ import argparse
 import hashlib
 import json
 import os
+import tempfile
 import threading
 import time
 from collections import OrderedDict, deque
@@ -690,6 +691,42 @@ class ServeEngine:
         self._replay_report: Optional[Dict[str, object]] = None
 
     # ---- lifecycle -------------------------------------------------------
+
+    @property
+    def journal_path(self) -> Optional[Path]:
+        """This engine's crash-only journal file (``None`` without one) —
+        the mesh ship protocol streams exactly this file to an inheriting
+        peer (serve_transport.py ``ship_journal``)."""
+        return self._journal.path if self._journal is not None else None
+
+    @property
+    def replay_report(self) -> Optional[Dict[str, object]]:
+        """The start-time journal replay report (``None`` before start or
+        without a journal) — the mesh hello_ok carries it so a joining
+        front door learns readiness without re-driving the replay."""
+        return self._replay_report
+
+    def attach_remote_store(self, remote: object) -> bool:
+        """Attach a remote fragment tier (qi-mesh, ISSUE 19): the shared
+        SCC store reads through to the front door's store gateway on
+        every local miss (fetch-on-miss) and publishes every banked
+        fragment back (publish-on-solve).  Safe by construction — a
+        fetched fragment passes the same strict shape validation as a
+        local file, and composed certs still re-verify through the
+        checker.  Returns ``False`` (degrade, loud at the caller) when
+        the delta tier is off — there is no fragment store to extend."""
+        if self._delta is None:
+            return False
+        store = self._delta.store
+        if store.shared is None:
+            # A worker joined with no shared directory of its own still
+            # participates in the mesh tier: fetched fragments bank into
+            # a private spill directory so a re-fetch is a local hit.
+            store.shared = SharedSccStore(
+                Path(tempfile.mkdtemp(prefix="qi-mesh-store-")),
+            )
+        store.shared.remote = remote
+        return True
 
     def start(self) -> Optional[Dict[str, object]]:
         """Replay the journal (if any), then start the drain loop.

@@ -40,6 +40,9 @@ Protocol (one JSON value per line, ``qi-serve/1``):
 from __future__ import annotations
 
 import argparse
+import base64
+import hashlib
+import hmac
 import json
 import os
 import socketserver
@@ -54,6 +57,7 @@ from quorum_intersection_tpu.serve import (
     ServeResponse,
     Ticket,
 )
+from quorum_intersection_tpu.utils.env import qi_env
 from quorum_intersection_tpu.utils.faults import FaultInjected
 from quorum_intersection_tpu.utils.logging import get_logger
 from quorum_intersection_tpu.utils.telemetry import get_run_record
@@ -61,6 +65,41 @@ from quorum_intersection_tpu.utils.telemetry import get_run_record
 log = get_logger("serve.transport")
 
 PROTOCOL_SCHEMA = "qi-serve/1"
+
+# qi-mesh (ISSUE 19): the versioned join handshake a multi-host fleet
+# front door performs before a socket worker enters its ring.  Bump on
+# any wire-incompatible change — a mismatch is a TYPED reject
+# (hello_err), never a silently skewed mesh.
+MESH_PROTOCOL = 1
+
+# Journal-ship framing (qi-mesh): chunk payload size before base64.  Each
+# chunk line carries its own byte length (length-prefixed framing on top
+# of JSONL) and the end line carries the stream digest — the receiver
+# fsyncs BEFORE acknowledging, so an acked ship is durable.
+SHIP_CHUNK_BYTES = 64 * 1024
+
+
+def package_fingerprint() -> str:
+    """The wire-compatibility fingerprint the join handshake compares:
+    package version + every schema string a mesh peer must agree on.  Two
+    hosts with different fingerprints get a typed reject instead of a
+    protocol skew that only surfaces as lost or wrong work."""
+    from quorum_intersection_tpu import __version__
+
+    basis = "|".join((
+        str(__version__), PROTOCOL_SCHEMA, f"mesh/{MESH_PROTOCOL}",
+    ))
+    return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:16]
+
+
+def fleet_token_digest() -> str:
+    """SHA-256 digest of the shared mesh secret (``QI_FLEET_TOKEN``) —
+    the wire never carries the raw token.  Empty token ⇒ empty digest:
+    unauthenticated loopback mode, and both sides must agree on it."""
+    token = qi_env("QI_FLEET_TOKEN")
+    if not token:
+        return ""
+    return hashlib.sha256(token.encode("utf-8")).hexdigest()
 
 # The counter/gauge slice one pong carries: enough for the fleet front
 # door to aggregate health (store hit %, delta reuse, queue depth) without
@@ -203,6 +242,120 @@ class JsonlSession:
             self._outstanding -= 1
             self._drained.notify_all()
 
+    # ---- qi-mesh handshake + journal shipping (ISSUE 19) -----------------
+
+    def _handle_hello(self, hello: object) -> None:
+        """The versioned join handshake: protocol + package fingerprint +
+        shared-secret digest must all match, or the peer gets a TYPED
+        ``hello_err`` — a mesh must never run skewed silently.  A valid
+        hello may announce the front door's store gateway; the engine then
+        reads through to it on every fragment miss (fetch-on-miss,
+        publish-on-solve)."""
+        rec = get_run_record()
+        hello = hello if isinstance(hello, dict) else {}
+
+        def _reject(code: str, message: str) -> None:
+            rec.add("serve.hello_rejects")
+            rec.event("serve.hello_rejected", code=code)
+            log.warning("mesh hello rejected (%s): %s", code, message)
+            self.emit({"hello_err": {"code": code, "message": message}})
+
+        schema = hello.get("schema")
+        protocol = hello.get("protocol")
+        if schema != PROTOCOL_SCHEMA or protocol != MESH_PROTOCOL:
+            _reject(
+                "protocol_mismatch",
+                f"peer speaks {schema!r}/mesh-{protocol!r}, this worker "
+                f"speaks {PROTOCOL_SCHEMA!r}/mesh-{MESH_PROTOCOL}",
+            )
+            return
+        fingerprint = hello.get("fingerprint")
+        if fingerprint != package_fingerprint():
+            _reject(
+                "fingerprint_mismatch",
+                f"peer package fingerprint {fingerprint!r} != "
+                f"{package_fingerprint()!r} — upgrade one side; a skewed "
+                f"mesh is refused, not guessed at",
+            )
+            return
+        token = hello.get("token")
+        if not hmac.compare_digest(
+            str(token or ""), fleet_token_digest(),
+        ):
+            _reject("bad_token", "QI_FLEET_TOKEN digest mismatch")
+            return
+        store = hello.get("store")
+        if isinstance(store, dict):
+            # The front door's store gateway: attach the remote fragment
+            # tier (fetch-on-miss, publish-on-solve).  Safe by
+            # construction — fragments re-verify through the checker, so
+            # a torn/corrupt/forged shipped fragment is just a miss.
+            from quorum_intersection_tpu.delta import RemoteStoreClient
+
+            client = RemoteStoreClient(
+                str(store.get("host") or "127.0.0.1"),
+                int(store.get("port") or 0),
+            )
+            self._engine.attach_remote_store(client)
+        rec.event("serve.hello_ok", peer=str(hello.get("peer") or ""))
+        _, gauges = rec.snapshot()
+        replay = gauges.get("serve.replay_complete")
+        self.emit({"hello_ok": {
+            "schema": PROTOCOL_SCHEMA,
+            "protocol": MESH_PROTOCOL,
+            "fingerprint": package_fingerprint(),
+            "pid": os.getpid(),
+            "ready": bool(replay) if replay is not None else True,
+            "replay": self._engine.replay_report,
+        }})
+
+    def _handle_ship(self, ship: object) -> None:
+        """Stream this worker's crash-only journal to the requesting peer:
+        chunked + length-prefixed (each ``ship_chunk`` carries its own
+        byte length, the ``ship_end`` line the stream digest), so the
+        receiver can fsync-then-ack and a torn stream is detected, never
+        replayed.  The journal file itself is append-fsynced by
+        construction — shipping reads a consistent prefix."""
+        rec = get_run_record()
+        ship = ship if isinstance(ship, dict) else {}
+        if not hmac.compare_digest(
+            str(ship.get("token") or ""), fleet_token_digest(),
+        ):
+            rec.add("serve.hello_rejects")
+            rec.event("serve.hello_rejected", code="bad_token")
+            self.emit({"ship_err": {"code": "bad_token",
+                                    "message": "QI_FLEET_TOKEN digest "
+                                               "mismatch"}})
+            return
+        path = self._engine.journal_path
+        if path is None:
+            self.emit({"ship_err": {"code": "no_journal",
+                                    "message": "this worker runs without "
+                                               "a request journal"}})
+            return
+        try:
+            raw = path.read_bytes()
+        except OSError as exc:
+            self.emit({"ship_err": {"code": "journal_unreadable",
+                                    "message": str(exc)}})
+            return
+        chunks = 0
+        for off in range(0, len(raw), SHIP_CHUNK_BYTES):
+            piece = raw[off:off + SHIP_CHUNK_BYTES]
+            self.emit({"ship_chunk": {
+                "seq": chunks,
+                "len": len(piece),
+                "data": base64.b64encode(piece).decode("ascii"),
+            }})
+            chunks += 1
+        self.emit({"ship_end": {
+            "chunks": chunks,
+            "bytes": len(raw),
+            "sha256": hashlib.sha256(raw).hexdigest(),
+        }})
+        rec.add("serve.journal_ships")
+        rec.event("serve.journal_shipped", chunks=chunks, bytes=len(raw))
+
     def handle_line(self, n: int, line: str) -> None:
         """One request line → submit (or ping/typed rejection), non-blocking."""
         line = line.strip()
@@ -213,6 +366,17 @@ class JsonlSession:
             obj = json.loads(line)
             if isinstance(obj, dict) and "ping" in obj:
                 self.emit(pong_payload(obj["ping"]))
+                return
+            if isinstance(obj, dict) and "hello" in obj:
+                self._handle_hello(obj["hello"])
+                return
+            if isinstance(obj, dict) and "ship_journal" in obj:
+                self._handle_ship(obj["ship_journal"])
+                return
+            if isinstance(obj, dict) and "ship_ack" in obj:
+                # The receiving peer fsynced the shipped journal: the
+                # hand-off is durable on the inheriting side.
+                get_run_record().event("serve.ship_acked")
                 return
             nodes = obj
             deadline_s: Optional[float] = None
@@ -280,14 +444,17 @@ def run_jsonl_loop(session: JsonlSession, reader: TextIO) -> None:
 
 class SocketServeServer:
     """JSONL-over-TCP twin of the stdio loop: one shared engine, many
-    concurrent connections (one :class:`JsonlSession` each), bound to
-    127.0.0.1 like the metrics endpoint — the serve protocol is not an
-    internet-facing surface.  ``port=0`` binds ephemeral; read ``.port``.
+    concurrent connections (one :class:`JsonlSession` each).  Binds
+    ``QI_SERVE_BIND`` (default loopback, like the metrics endpoint) — a
+    routable bind address is the multi-host fleet's explicit opt-in and
+    should ride with a non-empty ``QI_FLEET_TOKEN``.  ``port=0`` binds
+    ephemeral; read ``.port``.
     """
 
-    def __init__(self, engine: ServeEngine, *, host: str = "127.0.0.1",
+    def __init__(self, engine: ServeEngine, *, host: Optional[str] = None,
                  port: int = 0, emit_certs: bool = False) -> None:
         outer = self
+        host = host or qi_env("QI_SERVE_BIND") or "127.0.0.1"
 
         class _Handler(socketserver.StreamRequestHandler):
             def handle(self) -> None:
@@ -296,7 +463,22 @@ class SocketServeServer:
                 session = JsonlSession(
                     outer.engine, writer, emit_certs=outer.emit_certs,
                 )
-                run_jsonl_loop(session, reader)  # type: ignore[arg-type]
+                try:
+                    run_jsonl_loop(session, reader)  # type: ignore[arg-type]
+                except (OSError, ValueError) as exc:
+                    # A client that connects and dies mid-line (reset,
+                    # torn read) ends THIS session with a typed error —
+                    # the acceptor loop and every other connection stay
+                    # up, and any work the dead client already submitted
+                    # still drains below (its verdicts are cached and
+                    # journaled; a reconnect-and-retry is a cache hit).
+                    rec = get_run_record()
+                    rec.add("serve.errors")
+                    rec.event("serve.session_error", error=str(exc))
+                    log.warning(
+                        "socket session ended mid-line (%s); acceptor "
+                        "unaffected", exc,
+                    )
                 # Connection EOF drains the CONNECTION, not the engine:
                 # every response this client is owed goes out before the
                 # socket closes; other clients' work is untouched.
@@ -402,9 +584,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "mode; off by default for wire compatibility)")
     p.add_argument("--socket", type=int, default=None, metavar="PORT",
                    help="ALSO serve the same JSONL protocol over TCP on "
-                        "127.0.0.1:PORT (0 = ephemeral; the bound port is "
+                        "PORT (0 = ephemeral; the bound port is "
                         "announced as a {\"kind\": \"listening\"} line); "
                         "stdin EOF still drains and exits")
+    p.add_argument("--bind", metavar="ADDR", default=None,
+                   help="bind address of the --socket transport (env "
+                        "twin: QI_SERVE_BIND; default 127.0.0.1 — a "
+                        "routable address is the multi-host fleet opt-in "
+                        "and should ride with QI_FLEET_TOKEN)")
     p.add_argument("--metrics-json", metavar="PATH", default=None,
                    help="stream qi-telemetry/1 JSONL to PATH")
     p.add_argument("--metrics-prom", metavar="PATH", default=None,
@@ -445,7 +632,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             return 0
         if args.socket is not None:
             server = SocketServeServer(
-                engine, port=args.socket, emit_certs=args.emit_certs,
+                engine, host=args.bind, port=args.socket,
+                emit_certs=args.emit_certs,
             )
             session.emit({"kind": "listening", "host": server.host,
                           "port": server.port})

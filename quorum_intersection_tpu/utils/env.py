@@ -362,6 +362,68 @@ QI_SLO_SLOW_S = _declare(
     "recovered metric stops firing as soon as the fast window clears.  "
     "Default 3600 (1 hour).",
 )
+QI_FLEET_TOKEN = _declare(
+    "QI_FLEET_TOKEN", "",
+    "Shared secret of the multi-host fleet mesh (qi-mesh): every socket "
+    "join handshake (fleet.py SocketWorker ↔ serve_transport.py hello) "
+    "and every store-gateway session carries a SHA-256 digest of it; a "
+    "digest mismatch is a TYPED reject (hello_err code bad_token / "
+    "store_err), never a silent skew.  Empty (default): unauthenticated "
+    "loopback mode — both sides must agree on emptiness too.",
+)
+QI_SERVE_BIND = _declare(
+    "QI_SERVE_BIND", "127.0.0.1",
+    "Bind address of the serve socket transport and the fleet's store "
+    "gateway (serve_transport.py SocketServeServer, fleet.py "
+    "StoreGateway; CLI twin: serve --bind).  Default loopback — binding "
+    "a routable address is the explicit multi-host opt-in and should "
+    "ride with a non-empty QI_FLEET_TOKEN.",
+)
+QI_FLEET_LEASE_S = _declare(
+    "QI_FLEET_LEASE_S", "3.0",
+    "Heartbeat lease duration in seconds (fleet.py probe loop, qi-mesh): "
+    "every answered ping renews a worker's lease; QI_FLEET_PROBE_FAILS "
+    "consecutive misses only SUSPECT it (routed around, requests hedged "
+    "to the next arc owner), and eviction + journal inheritance waits "
+    "for the lease to lapse — a slow link is not a dead worker.  A dead "
+    "process is still evicted immediately.",
+)
+QI_FLEET_SCALE_INTERVAL_S = _declare(
+    "QI_FLEET_SCALE_INTERVAL_S", "0",
+    "Seconds between elasticity-supervisor evaluations (fleet.py "
+    "_scale_tick, qi-mesh): each evaluation turns the fleet-merged "
+    "pulse queue-wait p99 and the SloPlane burn state into a spawn / "
+    "retire / hold decision.  0 (default): elasticity off, the fixed-"
+    "size PR 11 fleet.",
+)
+QI_FLEET_SCALE_UP_MS = _declare(
+    "QI_FLEET_SCALE_UP_MS", "250",
+    "Scale-up threshold (fleet.py elasticity supervisor): when the "
+    "fleet-merged pulse.queue_wait_ms p99 exceeds this many ms — or any "
+    "declared SLO is burning — and the fleet is below "
+    "QI_FLEET_SCALE_MAX, one replacement-machinery spawn is scheduled "
+    "(fleet.scale_ups counter + fleet.scaled event).",
+)
+QI_FLEET_SCALE_DOWN_MS = _declare(
+    "QI_FLEET_SCALE_DOWN_MS", "20",
+    "Scale-down threshold (fleet.py elasticity supervisor): when the "
+    "fleet-merged pulse.queue_wait_ms p99 is below this many ms, no SLO "
+    "is burning, and the fleet is above QI_FLEET_SCALE_MIN, one worker "
+    "is retired by DRAINING through the journal-inheritance path — "
+    "routed around first, gracefully drained, its journal inherited — "
+    "never a dropped request.",
+)
+QI_FLEET_SCALE_MIN = _declare(
+    "QI_FLEET_SCALE_MIN", "1",
+    "Fleet-size floor of the elasticity supervisor (fleet.py): scale-"
+    "down decisions never retire below this many live workers.",
+)
+QI_FLEET_SCALE_MAX = _declare(
+    "QI_FLEET_SCALE_MAX", "8",
+    "Fleet-size ceiling of the elasticity supervisor (fleet.py): scale-"
+    "up decisions never spawn past this many live workers — a burn "
+    "spiral must not fork-bomb the host.",
+)
 
 
 # ---- reads -----------------------------------------------------------------

@@ -313,6 +313,69 @@ COST_ATTRIBUTE = _declare(
     "must become a dropped cost, never a wrong verdict — verdicts, certs "
     "and latency are byte-identical with attribution off).",
 )
+FLEET_JOIN = _declare(
+    "fleet.join",
+    "Socket-worker join handshake of the multi-host fleet (fleet.py "
+    "SocketWorker / FleetEngine start, qi-mesh): error simulates an "
+    "unreachable or rejecting peer — the join degrades to bounded "
+    "backoff+jitter retries and then to a standalone fleet without that "
+    "peer (fleet.join_errors counter + fleet.join_degraded event, loud; "
+    "capacity is lost, no verdict is), and a protocol/fingerprint/token "
+    "mismatch is always a typed reject, never a silently skewed mesh.",
+)
+FLEET_LEASE = _declare(
+    "fleet.lease",
+    "Heartbeat-lease evaluation of the fleet probe loop (fleet.py, "
+    "qi-mesh): error simulates a broken lease clock / partitioned probe "
+    "plane — the cycle degrades to SUSPECT-ONLY (fleet.lease_errors "
+    "counter + fleet.lease_degraded event): a worker may be routed "
+    "around and hedged, but an injected lease failure never evicts it, "
+    "so a partition can cost locality, never a spurious journal "
+    "inheritance; a dead process is still evicted immediately.",
+)
+FLEET_HEDGE = _declare(
+    "fleet.hedge",
+    "Hedged dispatch to a suspected worker's next arc owner (fleet.py "
+    "FleetEngine._hedge_dispatch, qi-mesh): error simulates a broken "
+    "hedging path — the request degrades to a SINGLE dispatch to the "
+    "next live arc owner (fleet.hedge_errors counter + "
+    "fleet.hedge_degraded event, loud; hedge latency cover is lost, "
+    "exactly-once resolution is not — duplicates are already deduplicated "
+    "by wire request id).",
+)
+FLEET_SHIP = _declare(
+    "fleet.ship",
+    "Cross-host journal shipping at failover/drain (fleet.py "
+    "FleetEngine._ship_journal ↔ serve_transport.py ship_journal, "
+    "qi-mesh): error/oserror simulate a dead wire or a torn stream — "
+    "shipping degrades to LOCAL-JOURNAL-ONLY and loud "
+    "(fleet.ship_errors counter + fleet.ship_degraded event: the "
+    "journal stays on the worker host for a later local replay), while "
+    "the front door's own in-flight tickets still re-route — never a "
+    "wrong or duplicated verdict, and a shipped journal is fsynced "
+    "before it is ever acknowledged.",
+)
+FLEET_SCALE = _declare(
+    "fleet.scale",
+    "Elasticity decision/actuation of the fleet supervisor (fleet.py "
+    "FleetEngine._apply_scale, qi-mesh): error simulates a broken "
+    "autoscaler — the fleet degrades to its FROZEN current size "
+    "(fleet.scale_errors counter + fleet.scale_degraded event, loud; "
+    "capacity stops tracking load, no verdict and no in-flight request "
+    "is touched — a retire drains through journal inheritance or does "
+    "not happen).",
+)
+STORE_FETCH = _declare(
+    "store.fetch",
+    "Remote SCC-fragment fetch/publish over the store-gateway wire "
+    "(delta.py RemoteStoreClient, qi-mesh): error/oserror simulate a "
+    "partitioned or lying store peer — the lookup degrades to a LOCAL "
+    "SOLVE (store.fetch_errors counter + store.fetch_degraded event, "
+    "loud; fleet-wide reuse is lost, the verdict is not), and a "
+    "torn/corrupt/forged shipped fragment fails shape validation and is "
+    "just a miss — fragments re-verify through the checker, so the wire "
+    "is never trusted.",
+)
 TELEMETRY_DUMP = _declare(
     "telemetry.dump",
     "Flight-recorder dump write (utils/telemetry.py dump_flight_recorder): "
@@ -563,20 +626,57 @@ _SERVE_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
 
 
 # What the fleet chaos soak can draw (tools/soak.py --fleet --chaos): the
-# four fleet.* boundaries plus the serve.*/delta.* points a routed request
+# fleet.* boundaries plus the serve.*/delta.* points a routed request
 # crosses inside its worker — one seeded window exercises routing, probing,
 # failover replay and the shared store tier alongside the per-worker
-# degradations.
+# degradations.  qi-mesh (ISSUE 19) adds the multi-host boundaries: join,
+# lease, hedge, ship, scale and the remote fragment fetch.
 _FLEET_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
     (FLEET_ROUTE, "error", 0.0),
     (FLEET_PROBE, "error", 0.0),
     (FLEET_REPLAY, "error", 0.0),
     (FLEET_STORE, "error", 0.0),
     (FLEET_STORE, "oserror", 0.0),
+    (FLEET_JOIN, "error", 0.0),
+    (FLEET_LEASE, "error", 0.0),
+    (FLEET_HEDGE, "error", 0.0),
+    (FLEET_SHIP, "error", 0.0),
+    (FLEET_SCALE, "error", 0.0),
+    (STORE_FETCH, "error", 0.0),
+    (STORE_FETCH, "oserror", 0.0),
     (SERVE_CACHE, "error", 0.0),
     (SERVE_JOURNAL, "oserror", 0.0),
     (DELTA_DIFF, "error", 0.0),
 )
+
+# What the socket-mesh soak round draws (tools/soak.py --fleet --chaos,
+# qi-mesh): only the wire-tier boundaries — join, lease and journal ship —
+# so every mesh instance exercises the adversarial-wire degradations while
+# the per-request oracle parity gate stays the same.
+_MESH_CHAOS_CHOICES: Tuple[Tuple[str, str, float], ...] = (
+    (FLEET_JOIN, "error", 0.0),
+    (FLEET_LEASE, "error", 0.0),
+    (FLEET_SHIP, "error", 0.0),
+)
+
+
+def sample_mesh_plan(seed: int) -> FaultPlan:
+    """Draw a deterministic socket-mesh fault schedule from ``seed`` — the
+    qi-mesh twin of :func:`sample_fleet_plan`, restricted to the wire-tier
+    boundaries (``fleet.join`` / ``fleet.lease`` / ``fleet.ship``)."""
+    rng = random.Random(seed * 53 + 11)
+    n_rules = 1 if rng.random() < 0.5 else 2
+    picks = rng.sample(range(len(_MESH_CHAOS_CHOICES)), n_rules)
+    rules = []
+    for ix in picks:
+        point, mode, seconds = _MESH_CHAOS_CHOICES[ix]
+        first = 1 if rng.random() < 0.6 else rng.randint(2, 3)
+        every = rng.random() < 0.6
+        rules.append(FaultRule(
+            point=point, mode=mode, first=first, every=every,
+            seconds=seconds,
+        ))
+    return FaultPlan(rules, label=f"mesh-chaos(seed={seed})")
 
 
 def sample_fleet_plan(seed: int) -> FaultPlan:

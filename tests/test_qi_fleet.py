@@ -480,9 +480,10 @@ class TestFleetSchedules:
         from tools.analyze.schedules import run_fleet_schedules
 
         results = run_fleet_schedules()
-        # 3 schedules (route-during-eviction, replay-races-new-request,
-        # respawn-restores-ring since ISSUE 12) × both topologies.
-        assert len(results) == 6
+        # 5 schedules (route-during-eviction, replay-races-new-request,
+        # respawn-restores-ring since ISSUE 12, hedge-races-primary-response
+        # and scale-down-races-dispatch since ISSUE 19) × both topologies.
+        assert len(results) == 10
         for r in results:
             assert r.ok, f"{r.schedule} on {r.topology}: {r.error}"
 

@@ -1,0 +1,767 @@
+"""qi-mesh suite (ISSUE 19): the multi-host fleet over an adversarial
+wire.  Versioned join handshake (typed hello_err on protocol / package /
+token skew — never a silently skewed mesh), the bind-address opt-in,
+mid-line client-death session hardening, the socket-joined fleet
+differential on the vendored fixture pairs (in-process and two-process)
+with checker-validated certs including a cross-host composed fragment
+through the store gateway, the partition matrix
+(suspect → hedge → rejoin-dedup vs suspect → lease-lapse → evict →
+journal-ship), pulse-driven elasticity (spawn + drain-retire with oracle
+parity), typed adopt_journal rejection, and every ``fleet.{join,lease,
+hedge,ship,scale}`` / ``store.fetch`` fault point degrading one rung."""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from quorum_intersection_tpu import fleet as fleet_mod
+from quorum_intersection_tpu.delta import RemoteStoreClient, SharedSccStore
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import churn_trace, majority_fbas
+from quorum_intersection_tpu.fleet import (
+    FleetEngine,
+    JournalUnreadableError,
+    MeshHandshakeError,
+    SocketWorker,
+    StoreGateway,
+)
+from quorum_intersection_tpu.pipeline import solve
+from quorum_intersection_tpu.serve import (
+    RequestJournal,
+    ServeEngine,
+    snapshot_fingerprint,
+)
+from quorum_intersection_tpu.serve_transport import (
+    MESH_PROTOCOL,
+    PROTOCOL_SCHEMA,
+    SocketServeServer,
+    fleet_token_digest,
+    package_fingerprint,
+)
+from quorum_intersection_tpu.utils import faults, telemetry
+from tools.check_cert import check_certificate
+
+from tests.conftest import VENDORED_DIR
+
+FIXTURE_PAIRS = [
+    ("trivial_correct", True),
+    ("trivial_broken", False),
+    ("nested_correct", True),
+    ("nested_broken", False),
+]
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def fixture_nodes(name):
+    return json.loads((VENDORED_DIR / f"{name}.json").read_text())
+
+
+def fingerprint_of(nodes):
+    return snapshot_fingerprint(build_graph(parse_fbas(nodes)))
+
+
+@pytest.fixture
+def rec():
+    record = telemetry.reset_run_record()
+    faults.clear_plan()
+    yield record
+    faults.clear_plan()
+    telemetry.reset_run_record()
+
+
+def _wait_counter(record, name, want, timeout=20.0):
+    """Poll the run record until counter ``name`` reaches ``want``."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        counters, _ = record.snapshot()
+        if counters.get(name, 0) >= want:
+            return counters.get(name, 0)
+        time.sleep(0.02)
+    counters, _ = record.snapshot()
+    return counters.get(name, 0)
+
+
+class _Peer:
+    """One in-process 'remote host': a ServeEngine behind the socket
+    transport, exactly as ``serve --socket`` runs it on another machine
+    (no shared store handed to the engine — fragments only flow over
+    the store gateway the join hello advertises)."""
+
+    def __init__(self, journal=None):
+        self.engine = ServeEngine(backend="python", journal=journal)
+        self.server = None
+
+    def __enter__(self):
+        self.engine.start()
+        self.server = SocketServeServer(self.engine, port=0, emit_certs=True)
+        return self
+
+    def __exit__(self, *exc):
+        self.server.stop()
+        self.engine.stop(drain=True, timeout=30.0)
+        return False
+
+    @property
+    def port(self):
+        return self.server.port
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.server.port}"
+
+
+class _Mesh:
+    """Context-managed socket-joined fleet with test-friendly defaults:
+    one local worker (w0) plus the given peers (j0..), no auto-respawn
+    (evictions stay deterministic), probes only on demand."""
+
+    def __init__(self, tmp_path, joins, n=1, **kwargs):
+        kwargs.setdefault("backend", "python")
+        kwargs.setdefault("worker_mode", "local")
+        kwargs.setdefault("journal_dir", tmp_path / "mesh")
+        kwargs.setdefault("probe_interval_s", 30.0)
+        kwargs.setdefault("respawn_max", 0)
+        self.engine = FleetEngine(n, joins=joins, **kwargs)
+
+    def __enter__(self):
+        self.engine.start()
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine.stop(drain=True, timeout=60.0)
+        return False
+
+
+def _routed_to(engine, want, tag, n=7, broken=False):
+    """Prefix-search a majority FBAS whose snapshot fingerprint routes
+    to worker ``want`` on ``engine``'s ring."""
+    for i in range(64):
+        cand = majority_fbas(n, broken=broken, prefix=f"{tag}{i}")
+        if engine._ring.route(fingerprint_of(cand)) == want:
+            return cand
+    pytest.skip(f"no prefix routed to {want}")
+
+
+def _jsonl(conn):
+    return conn.makefile("rw", encoding="utf-8")
+
+
+def _valid_hello(peer="test-peer"):
+    return {
+        "schema": PROTOCOL_SCHEMA,
+        "protocol": MESH_PROTOCOL,
+        "fingerprint": package_fingerprint(),
+        "token": fleet_token_digest(),
+        "peer": peer,
+    }
+
+
+# ---------------------------------------------------------------------------
+# versioned join handshake
+
+
+class TestMeshHandshake:
+    def test_valid_hello_answers_hello_ok(self, rec):
+        with _Peer() as peer:
+            with socket.create_connection(("127.0.0.1", peer.port),
+                                          timeout=10.0) as conn:
+                fh = _jsonl(conn)
+                fh.write(json.dumps({"hello": _valid_hello()}) + "\n")
+                fh.flush()
+                resp = json.loads(fh.readline())
+        ok = resp["hello_ok"]
+        assert ok["schema"] == PROTOCOL_SCHEMA
+        assert ok["protocol"] == MESH_PROTOCOL
+        assert ok["fingerprint"] == package_fingerprint()
+        assert ok["ready"] is True and "replay" in ok
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.hello_rejects", 0) == 0
+
+    @pytest.mark.parametrize("skew,code", [
+        ({"schema": "qi-serve/0"}, "protocol_mismatch"),
+        ({"protocol": MESH_PROTOCOL + 1}, "protocol_mismatch"),
+        ({"fingerprint": "0" * 16}, "fingerprint_mismatch"),
+        ({"token": "not-the-digest"}, "bad_token"),
+    ])
+    def test_skewed_hello_is_typed_reject(self, rec, skew, code):
+        """Every mismatch axis gets its own typed hello_err, and the
+        session survives the reject (still answers pings) — a reject is
+        a protocol answer, not a dropped connection."""
+        with _Peer() as peer:
+            with socket.create_connection(("127.0.0.1", peer.port),
+                                          timeout=10.0) as conn:
+                fh = _jsonl(conn)
+                hello = {**_valid_hello(), **skew}
+                fh.write(json.dumps({"hello": hello}) + "\n")
+                fh.flush()
+                resp = json.loads(fh.readline())
+                assert resp["hello_err"]["code"] == code
+                fh.write(json.dumps({"ping": "after-reject"}) + "\n")
+                fh.flush()
+                assert json.loads(fh.readline())["pong"] == "after-reject"
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.hello_rejects", 0) == 1
+
+    def test_skewed_join_propagates_never_runs_skewed(self, rec, tmp_path,
+                                                      monkeypatch):
+        """A fingerprint-skewed peer REFUSES the join with a typed error
+        that propagates to the operator — the front door must never
+        retry into (or silently run) a skewed mesh."""
+        with _Peer() as peer:
+            monkeypatch.setattr(fleet_mod, "package_fingerprint",
+                                lambda: "f" * 16)
+            engine = FleetEngine(
+                1, backend="python", worker_mode="local",
+                journal_dir=tmp_path / "skew", probe_interval_s=30.0,
+                respawn_max=0, joins=[peer.addr],
+            )
+            try:
+                with pytest.raises(MeshHandshakeError) as exc:
+                    engine.start()
+            finally:
+                engine.stop(drain=False, timeout=10.0)
+        assert exc.value.reject_code == "fingerprint_mismatch"
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.joins", 0) == 0
+
+    def test_join_fault_degrades_to_standalone(self, rec, tmp_path):
+        """An injected ``fleet.join`` wire failure (every attempt)
+        degrades to a fleet WITHOUT the peer — standalone workers keep
+        serving, loudly."""
+        faults.install_plan(faults.parse_faults("fleet.join=error@1+"))
+        with _Peer() as peer:
+            with _Mesh(tmp_path, [peer.addr]) as fleet:
+                assert fleet.worker_ids() == ["w0"]
+                resp = fleet.submit(
+                    fixture_nodes("trivial_correct")).result(timeout=60.0)
+                assert resp.intersects is True
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.join_errors", 0) == 1
+        assert counters.get("fleet.joins", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# bind-address opt-in (satellite: QI_SERVE_BIND / --bind)
+
+
+class TestBindAddress:
+    def test_default_bind_is_loopback(self, rec, monkeypatch):
+        monkeypatch.delenv("QI_SERVE_BIND", raising=False)
+        engine = ServeEngine(backend="python")
+        engine.start()
+        server = SocketServeServer(engine, port=0)
+        try:
+            assert server.host == "127.0.0.1"
+        finally:
+            server.stop()
+            engine.stop(drain=True, timeout=30.0)
+
+    def test_env_bind_honored_by_serve_and_store(self, rec, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("QI_SERVE_BIND", "localhost")
+        engine = ServeEngine(backend="python")
+        engine.start()
+        server = SocketServeServer(engine, port=0)
+        gateway = StoreGateway(SharedSccStore(tmp_path / "store"))
+        try:
+            assert server.host == "localhost"
+            assert gateway.host == "localhost"
+            with socket.create_connection(("localhost", server.port),
+                                          timeout=10.0) as conn:
+                fh = _jsonl(conn)
+                fh.write(json.dumps({"ping": "bound"}) + "\n")
+                fh.flush()
+                assert json.loads(fh.readline())["pong"] == "bound"
+        finally:
+            gateway.stop()
+            server.stop()
+            engine.stop(drain=True, timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# session hardening (satellite: client death mid-line)
+
+
+class TestSessionHardening:
+    def test_client_reset_mid_line_spares_acceptor(self, rec):
+        """A client that dies mid-line (RST, torn read) ends ITS session
+        with a typed error; the acceptor and later clients are
+        untouched."""
+        with _Peer() as peer:
+            conn = socket.create_connection(("127.0.0.1", peer.port),
+                                            timeout=10.0)
+            conn.sendall(b'{"request_id": "torn')  # no newline ever comes
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+            conn.close()  # RST while the session blocks on readline
+            assert _wait_counter(rec, "serve.errors", 1) >= 1
+            with socket.create_connection(("127.0.0.1", peer.port),
+                                          timeout=10.0) as conn2:
+                fh = _jsonl(conn2)
+                fh.write(json.dumps({"ping": "survivor"}) + "\n")
+                fh.flush()
+                assert json.loads(fh.readline())["pong"] == "survivor"
+
+
+# ---------------------------------------------------------------------------
+# socket-joined fleet differential (in-process peer)
+
+
+class TestMeshDifferential:
+    @pytest.mark.parametrize("fixture,verdict", FIXTURE_PAIRS)
+    def test_joined_fleet_equals_oracle(self, rec, tmp_path, fixture,
+                                        verdict):
+        nodes = fixture_nodes(fixture)
+        with _Peer() as peer:
+            with _Mesh(tmp_path, [peer.addr]) as fleet:
+                assert fleet.worker_ids() == ["j0", "w0"]
+                resp = fleet.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is verdict
+        assert resp.cert is not None and resp.cert["verdict"] is verdict
+        check_certificate(resp.cert, nodes)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.joins", 0) == 1
+        assert counters.get("fleet.verdicts", 0) == 1
+
+    def test_remote_routed_request_answers(self, rec, tmp_path):
+        """A request whose hash arc belongs to the SOCKET peer solves on
+        the remote engine and comes back over the wire, cert intact."""
+        with _Peer() as peer:
+            with _Mesh(tmp_path, [peer.addr]) as fleet:
+                nodes = _routed_to(fleet, "j0", "MR")
+                expected = solve(nodes, backend="python").intersects
+                resp = fleet.submit(nodes).result(timeout=60.0)
+        assert resp.intersects is expected
+        check_certificate(resp.cert, nodes)
+
+    def test_cross_host_composed_fragment(self, rec, tmp_path):
+        """The cross-host delta story end to end: a fragment SOLVED ON
+        THE REMOTE PEER publishes through the store gateway
+        (publish-on-solve), and a key-renamed twin routed to the LOCAL
+        worker composes its cert from that shipped fragment — zero
+        re-solve, and the composed cert passes the unmodified checker."""
+        with _Peer() as peer:
+            with _Mesh(tmp_path, [peer.addr],
+                       store_dir=tmp_path / "store") as fleet:
+                base = _routed_to(fleet, "j0", "CH")
+                twin = _routed_to(fleet, "w0", "CT")
+                first = fleet.submit(base).result(timeout=60.0)
+                assert first.intersects is True
+                assert _wait_counter(rec, "store.publishes", 1) >= 1
+                second = fleet.submit(twin).result(timeout=60.0)
+        assert second.intersects is True
+        stamp = second.cert["provenance"]["delta"]
+        assert stamp["reused_sccs"] == 1
+        assert stamp["resolved_sccs"] == 0
+        check_certificate(second.cert, twin)
+
+
+# ---------------------------------------------------------------------------
+# partition matrix: suspect → hedge → rejoin vs lease-lapse → evict → ship
+
+
+class TestPartitionMatrix:
+    def test_suspect_hedges_then_rejoin_dedups(self, rec, tmp_path):
+        """A suspected worker keeps its arc but its requests HEDGE to the
+        next arc owner; when it pongs again it REJOINS, and the in-flight
+        hedge deduplicates by wire request id (first answer wins, the
+        straggler books fleet.duplicate_responses)."""
+        with _Mesh(tmp_path, [], n=2) as fleet:
+            nodes = _routed_to(fleet, "w1", "PH")
+            expected = solve(nodes, backend="python").intersects
+            fleet._suspect_worker("w1", "forced partition (test)")
+            resp = fleet.submit(nodes).result(timeout=60.0)
+            assert resp.intersects is expected
+            assert _wait_counter(rec, "fleet.duplicate_responses", 1) >= 1
+            fleet._renew_lease("w1")
+            assert fleet.worker_ids() == ["w0", "w1"]
+        counters, gauges = rec.snapshot()
+        assert counters.get("fleet.suspects", 0) == 1
+        assert counters.get("fleet.hedges", 0) >= 1
+        assert counters.get("fleet.rejoins", 0) == 1
+        assert counters.get("fleet.evictions", 0) == 0
+        assert gauges.get("fleet.suspected") == 0
+
+    def test_hedge_fault_degrades_to_single_dispatch(self, rec, tmp_path):
+        """An injected ``fleet.hedge`` failure degrades to ONE dispatch
+        to the next arc owner — the request still answers, loudly."""
+        faults.install_plan(faults.parse_faults("fleet.hedge=error@1+"))
+        with _Mesh(tmp_path, [], n=2) as fleet:
+            nodes = _routed_to(fleet, "w1", "HF")
+            expected = solve(nodes, backend="python").intersects
+            fleet._suspect_worker("w1", "forced partition (test)")
+            resp = fleet.submit(nodes).result(timeout=60.0)
+            assert resp.intersects is expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.hedge_errors", 0) >= 1
+        assert counters.get("fleet.hedges", 0) == 0
+        assert counters.get("fleet.duplicate_responses", 0) == 0
+
+    def test_lease_fault_only_delays_eviction(self, rec, tmp_path):
+        """An injected ``fleet.lease`` failure leaves a lapsed suspect
+        SUSPECT-ONLY (hedged, still serving) — it can only DELAY the
+        eviction, which lands as soon as the fault clears."""
+        with _Mesh(tmp_path, [], n=2) as fleet:
+            fleet._suspect_worker("w1", "forced partition (test)")
+            with fleet._lock:
+                fleet._leases["w1"] = time.monotonic() - 1.0
+            faults.install_plan(faults.parse_faults("fleet.lease=error@1+"))
+            fleet._expire_leases()
+            assert fleet.worker_ids() == ["w0", "w1"]  # suspect-only
+            faults.clear_plan()
+            fleet._expire_leases()
+            assert fleet.worker_ids() == ["w0"]
+            nodes = _routed_to(fleet, "w0", "LE", n=5)
+            expected = solve(nodes, backend="python").intersects
+            assert fleet.submit(nodes).result(
+                timeout=60.0).intersects is expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.lease_errors", 0) == 1
+        assert counters.get("fleet.evictions", 0) == 1
+
+    def test_lease_lapse_evicts_socket_peer_and_ships(self, rec, tmp_path):
+        """The full partition death: a socket peer whose lease lapses is
+        evicted and its journal SHIPS over the still-open wire — the
+        pending entry it never finished replays on the survivor (zero
+        lost), its done entries never replay (zero duplicated)."""
+        pend = majority_fbas(5, prefix="SHPEND")
+        journal_path = tmp_path / "remote.journal"
+        with _Peer(journal=journal_path) as peer:
+            with _Mesh(tmp_path, [peer.addr]) as fleet:
+                done = _routed_to(fleet, "j0", "SD", n=5)
+                assert fleet.submit(done).result(
+                    timeout=60.0).intersects is True
+                # A journaled-but-unfinished entry on the peer's host:
+                # appended behind the engine (same O_APPEND file), as a
+                # crash between journal-append and solve would leave it.
+                extra = RequestJournal(journal_path)
+                extra.append_request("mesh-pend", fingerprint_of(pend),
+                                     pend, None)
+                extra.close()
+                fleet._suspect_worker("j0", "forced partition (test)")
+                with fleet._lock:
+                    fleet._leases["j0"] = time.monotonic() - 1.0
+                fleet._expire_leases()
+                assert fleet.worker_ids() == ["w0"]
+                assert _wait_counter(rec, "fleet.replayed_verdicts", 1) >= 1
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.ships", 0) == 1
+        assert counters.get("fleet.evictions", 0) == 1
+        assert counters.get("fleet.replays", 0) == 1  # pend only, not done
+        spool = tmp_path / "mesh" / "shipped" / "j0.shipped.journal"
+        assert spool.exists() and spool.stat().st_size > 0
+
+    def test_ship_fault_degrades_to_local_only(self, rec, tmp_path):
+        """An injected ``fleet.ship`` failure degrades the eviction to
+        local-journal-only failover — loud, never an exception on the
+        eviction path."""
+        faults.install_plan(faults.parse_faults("fleet.ship=error@1+"))
+        with _Peer(journal=tmp_path / "r.journal") as peer:
+            with _Mesh(tmp_path, [peer.addr]) as fleet:
+                fleet._suspect_worker("j0", "forced partition (test)")
+                with fleet._lock:
+                    fleet._leases["j0"] = time.monotonic() - 1.0
+                fleet._expire_leases()
+                assert fleet.worker_ids() == ["w0"]
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.ship_errors", 0) == 1
+        assert counters.get("fleet.ships", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# journal shipping (wire protocol)
+
+
+class TestJournalShipping:
+    def test_ship_roundtrip_byte_identical(self, rec, tmp_path):
+        """The shipped spool is byte-identical to the peer's journal
+        (chunked + length-checked + digest-verified + fsync-before-ack)."""
+        journal_path = tmp_path / "peer.journal"
+        with _Peer(journal=journal_path) as peer:
+            for i in range(3):
+                peer.engine.submit(
+                    majority_fbas(5, prefix=f"SJ{i}")).result(timeout=60.0)
+            worker = SocketWorker("j0", ("127.0.0.1", peer.port),
+                                  lambda wid, obj: None)
+            try:
+                assert worker.wait_ready(timeout=30.0)
+                spool = worker.ship_journal(tmp_path / "spool",
+                                            timeout=30.0)
+                assert spool is not None
+                assert spool.read_bytes() == journal_path.read_bytes()
+                assert spool.stat().st_size > 0
+            finally:
+                worker.close(timeout=10.0)
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.journal_ships", 0) == 1
+
+    def test_ship_without_journal_is_typed_miss(self, rec, tmp_path):
+        """A peer running journal-less answers ship_err (no_journal);
+        the puller degrades to None, never a bogus empty replay."""
+        with _Peer() as peer:  # no --journal
+            worker = SocketWorker("j0", ("127.0.0.1", peer.port),
+                                  lambda wid, obj: None)
+            try:
+                assert worker.wait_ready(timeout=30.0)
+                assert worker.ship_journal(tmp_path / "spool",
+                                           timeout=30.0) is None
+            finally:
+                worker.close(timeout=10.0)
+        counters, _ = rec.snapshot()
+        assert counters.get("serve.journal_ships", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# remote fragment store (qi-store/1 gateway + client)
+
+
+class TestStoreWire:
+    def test_gateway_rejects_bad_token(self, rec, tmp_path):
+        gateway = StoreGateway(SharedSccStore(tmp_path / "store"))
+        try:
+            with socket.create_connection(("127.0.0.1", gateway.port),
+                                          timeout=10.0) as conn:
+                fh = _jsonl(conn)
+                fh.write(json.dumps(
+                    {"store_hello": {"schema": "qi-store/1",
+                                     "token": "wrong"}}) + "\n")
+                fh.flush()
+                resp = json.loads(fh.readline())
+            assert resp["ok"] is False
+        finally:
+            gateway.stop()
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.store_gateway_rejects", 0) == 1
+
+    def test_client_roundtrip_and_miss(self, rec, tmp_path):
+        gateway = StoreGateway(SharedSccStore(tmp_path / "store"))
+        client = RemoteStoreClient("127.0.0.1", gateway.port)
+        try:
+            assert client.fetch("scan", "absent-fp") is None  # clean miss
+            payload = {"quorum_local": [1, 2, 3]}
+            assert client.publish("scan", "fp-a", payload) is True
+            assert client.fetch("scan", "fp-a") == payload
+        finally:
+            client.close()
+            gateway.stop()
+        counters, _ = rec.snapshot()
+        assert counters.get("store.fetches", 0) == 2
+        assert counters.get("store.publishes", 0) == 1
+        assert counters.get("store.fetch_errors", 0) == 0
+
+    def test_fetch_fault_degrades_to_local_solve(self, rec, tmp_path):
+        faults.install_plan(faults.parse_faults("store.fetch=error@1+"))
+        gateway = StoreGateway(SharedSccStore(tmp_path / "store"))
+        client = RemoteStoreClient("127.0.0.1", gateway.port,
+                                   timeout_s=0.5, retries=1)
+        try:
+            assert client.fetch("scan", "any-fp") is None
+            assert client.publish("scan", "any-fp", {"x": 1}) is False
+        finally:
+            client.close()
+            gateway.stop()
+        counters, _ = rec.snapshot()
+        assert counters.get("store.fetch_errors", 0) == 2
+
+    def test_dead_gateway_is_a_miss_never_a_raise(self, rec):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        client = RemoteStoreClient("127.0.0.1", dead_port,
+                                   timeout_s=0.2, retries=1)
+        try:
+            assert client.fetch("scan", "fp") is None
+        finally:
+            client.close()
+        counters, _ = rec.snapshot()
+        assert counters.get("store.fetch_errors", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# elasticity: the pulse→fleet-size supervisor
+
+
+class TestElasticity:
+    def test_scale_up_then_drain_retire_with_parity(self, rec, tmp_path):
+        """One pulse-driven spawn and one drain-retire, oracle parity on
+        both sides of each transition (the ISSUE 19 acceptance round)."""
+        nodes = majority_fbas(7, prefix="ELA")
+        expected = solve(nodes, backend="python").intersects
+        with _Mesh(tmp_path, [], n=1) as fleet:
+            fleet.scale_up_ms = -1.0  # any queue-wait p99 reads as hot
+            assert fleet.scale_tick(force=True) == "up"
+            ids = fleet.worker_ids()
+            assert len(ids) == 2 and any(w.startswith("e") for w in ids)
+            assert fleet.submit(nodes).result(
+                timeout=60.0).intersects is expected
+            fleet.scale_up_ms = 1e12  # cold again
+            fleet.scale_down_ms = 1e12
+            assert fleet.scale_tick(force=True) == "down"
+            assert fleet.worker_ids() == ["w0"]  # elastic worker retired
+            assert fleet.submit(nodes).result(
+                timeout=60.0).intersects is expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.scale_ups", 0) == 1
+        assert counters.get("fleet.scale_downs", 0) == 1
+        assert counters.get("fleet.errors", 0) == 0
+
+    def test_steady_state_books_a_hold(self, rec, tmp_path):
+        with _Mesh(tmp_path, [], n=1) as fleet:
+            assert fleet.scale_tick(force=True) is None
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.scale_holds", 0) == 1
+        assert counters.get("fleet.scale_ups", 0) == 0
+        assert counters.get("fleet.scale_downs", 0) == 0
+
+    def test_scale_fault_freezes_fleet_size(self, rec, tmp_path):
+        faults.install_plan(faults.parse_faults("fleet.scale=error@1+"))
+        with _Mesh(tmp_path, [], n=1) as fleet:
+            fleet.scale_up_ms = -1.0  # would scale up if healthy
+            assert fleet.scale_tick(force=True) is None
+            assert fleet.worker_ids() == ["w0"]  # frozen
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.scale_errors", 0) == 1
+        assert counters.get("fleet.scale_ups", 0) == 0
+
+    def test_scale_down_never_breaches_min(self, rec, tmp_path):
+        with _Mesh(tmp_path, [], n=1) as fleet:
+            fleet.scale_down_ms = 1e12  # always reads as cold
+            assert fleet.scale_tick(force=True) is None  # live == min
+            assert fleet.worker_ids() == ["w0"]
+
+
+# ---------------------------------------------------------------------------
+# adopt_journal: typed rejection (satellite)
+
+
+class TestAdoptJournal:
+    def test_unreadable_path_is_typed(self, rec, tmp_path):
+        with _Mesh(tmp_path, [], n=1) as fleet:
+            with pytest.raises(JournalUnreadableError) as exc:
+                fleet.adopt_journal(tmp_path / "only-on-some-other-host.journal")
+        assert exc.value.code == "journal_unreadable"
+        assert "ship_journal" in str(exc.value)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.replays", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# two-process rounds: a REAL serve subprocess joined over the wire
+
+
+def _spawn_serve(tmp_path, journal_name="remote.journal"):
+    """One real ``serve --socket 0`` subprocess; returns (proc, port,
+    journal_path).  Stdin stays open — closing it drains and exits."""
+    journal_path = tmp_path / journal_name
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "quorum_intersection_tpu", "serve",
+         "--socket", "0", "--backend", "python", "--emit-certs",
+         "--journal", str(journal_path)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        cwd=str(REPO_ROOT), env=env, text=True,
+    )
+    port = None
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        obj = json.loads(line)
+        if obj.get("kind") == "listening":
+            port = int(obj["port"])
+            break
+    if port is None:
+        proc.kill()
+        raise AssertionError("serve subprocess never announced its port")
+    return proc, port, journal_path
+
+
+def _stop_serve(proc):
+    try:
+        if proc.poll() is None:
+            proc.stdin.close()
+            proc.wait(timeout=30.0)
+    except (OSError, subprocess.TimeoutExpired):
+        proc.kill()
+        proc.wait(timeout=10.0)
+
+
+class TestTwoProcessMesh:
+    def test_cross_host_differential_and_partition(self, rec, tmp_path):
+        """The acceptance round, minus the SIGKILL: a real subprocess
+        peer joined over TCP answers both fixture pairs oracle-equal
+        with checker-validated certs; an injected ``fleet.lease``
+        partition only DELAYS its eviction; the cleared eviction ships
+        its journal cross-process."""
+        proc, port, journal_path = _spawn_serve(tmp_path)
+        try:
+            with _Mesh(tmp_path, [f"127.0.0.1:{port}"]) as fleet:
+                assert fleet.worker_ids() == ["j0", "w0"]
+                for fixture, verdict in FIXTURE_PAIRS:
+                    nodes = fixture_nodes(fixture)
+                    resp = fleet.submit(nodes).result(timeout=120.0)
+                    assert resp.intersects is verdict
+                    check_certificate(resp.cert, nodes)
+                # Partition: suspected + lapsed, but the lease check is
+                # faulted — suspect-only, the peer keeps serving hedged.
+                fleet._suspect_worker("j0", "forced partition (test)")
+                with fleet._lock:
+                    fleet._leases["j0"] = time.monotonic() - 1.0
+                faults.install_plan(
+                    faults.parse_faults("fleet.lease=error@1+"))
+                fleet._expire_leases()
+                assert "j0" in fleet.worker_ids()
+                faults.clear_plan()
+                fleet._expire_leases()
+                assert fleet.worker_ids() == ["w0"]
+        finally:
+            _stop_serve(proc)
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.verdicts", 0) == len(FIXTURE_PAIRS)
+        assert counters.get("fleet.lease_errors", 0) == 1
+        assert counters.get("fleet.evictions", 0) == 1
+        assert counters.get("fleet.ships", 0) == 1
+
+    @pytest.mark.slow
+    def test_sigkill_cross_host_zero_lost(self, rec, tmp_path):
+        """The real thing: SIGKILL the remote peer mid-stream — every
+        admitted ticket still resolves oracle-equal on the survivor
+        (zero lost, zero duplicated), and the dead peer is evicted."""
+        trace = churn_trace(majority_fbas(9, prefix="MKK"), 7, seed=6)
+        expected = [solve(s, backend="python").intersects for s in trace]
+        proc, port, _ = _spawn_serve(tmp_path)
+        try:
+            fleet = FleetEngine(
+                1, backend="python", worker_mode="local",
+                journal_dir=tmp_path / "mesh", probe_interval_s=0.2,
+                respawn_max=0, joins=[f"127.0.0.1:{port}"],
+            )
+            fleet.start()
+            try:
+                tickets = [fleet.submit(s) for s in trace[:5]]
+                os.kill(proc.pid, signal.SIGKILL)
+                tickets += [fleet.submit(s) for s in trace[5:]]
+                got = [t.result(timeout=120.0).intersects for t in tickets]
+            finally:
+                fleet.stop(drain=True, timeout=60.0)
+        finally:
+            _stop_serve(proc)
+        assert got == expected
+        counters, _ = rec.snapshot()
+        assert counters.get("fleet.evictions", 0) == 1
+        assert counters.get("fleet.errors", 0) == 0

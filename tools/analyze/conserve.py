@@ -132,6 +132,21 @@ CONSERVATION_TABLE: Tuple[Tuple[str, str, str, str, str, str], ...] = (
      "exit", "all",
      "call:reuse_credit|cost.attribute_errors",
      "every composed reuse credits its cost or routes through the cost.attribute degrade"),
+    ("fleet-hedge",
+     "quorum_intersection_tpu/fleet.py:FleetEngine._hedge_dispatch",
+     "exit", "all",
+     "fleet.hedges|fleet.hedge_errors",
+     "a hedge decision is never silent: both legs sent, or the degrade leg booked"),
+    ("fleet-ship",
+     "quorum_intersection_tpu/fleet.py:FleetEngine._ship_journal",
+     "exit", "all",
+     "fleet.ships|fleet.ship_errors",
+     "a cross-host journal ship resolves loudly: spooled + fsynced, or degraded to local-journal-only"),
+    ("fleet-scale",
+     "quorum_intersection_tpu/fleet.py:FleetEngine._apply_scale",
+     "exit", "all",
+     "fleet.scale_ups|fleet.scale_downs|fleet.scale_holds|fleet.scale_errors",
+     "every elasticity tick books exactly one decision leg — a scale decision is never silent"),
 )
 
 

@@ -622,6 +622,8 @@ FLEET_SCHEDULES = (
     "fleet_route_during_eviction",
     "fleet_replay_races_new_request",
     "fleet_respawn_restores_ring",
+    "fleet_hedge_races_primary_response",
+    "fleet_scale_down_races_dispatch",
 )
 
 _REQUIRED_FLEET_POINTS: Dict[str, tuple] = {
@@ -639,6 +641,19 @@ _REQUIRED_FLEET_POINTS: Dict[str, tuple] = {
     # post-respawn request serves through the restored ring.
     "fleet_respawn_restores_ring": (
         "evict.removed", "respawn.begin", "respawn.done",
+    ),
+    # the hedge must have been decided and sent to BOTH legs, and a
+    # response must have been delivered WHILE the hedging dispatch was
+    # still inside _hedge_dispatch (the hold releases hedge.sent only
+    # on response.delivered) — the straggler then deduplicates.
+    "fleet_hedge_races_primary_response": (
+        "hedge.decided", "hedge.sent", "response.delivered",
+    ),
+    # the dispatch must have resolved its route to the retiree BEFORE
+    # the retirement removed it from the ring (scale.retire); released,
+    # it must re-route through the shrunken ring and still deliver.
+    "fleet_scale_down_races_dispatch": (
+        "route.resolved", "scale.retire", "response.delivered",
     ),
 }
 
@@ -752,6 +767,95 @@ def _run_fleet_one(schedule: str, data: object, expected: bool,
                 error = f"ring size {ring_size} != 2 after respawn"
             else:
                 verdict = engine.submit(data).result(WAIT_S).intersects
+        elif schedule == "fleet_hedge_races_primary_response":
+            # qi-mesh (ISSUE 19): the routed arc owner sits SUSPECTED
+            # (missed heartbeats on a live connection), so the dispatch
+            # hedges the request to the next arc owner under the SAME
+            # wire id.  The hold parks the hedging dispatch between
+            # sending both legs and returning, until the FIRST response
+            # has already been delivered — the exact window where a
+            # suspect that answers races its own hedge.  The client must
+            # see exactly one outcome, and the straggler's answer must
+            # book fleet.duplicate_responses, never a second resolve.
+            from quorum_intersection_tpu.utils.telemetry import (
+                get_run_record,
+            )
+
+            ctl.hold("hedge.sent", ctl.reached_event("response.delivered"))
+            engine._suspect_worker(target, "forced partition (schedule)")
+            base = get_run_record().snapshot()[0].get(
+                "fleet.duplicate_responses", 0.0,
+            )
+            ticket = engine.submit(data)
+            verdict = ticket.result(WAIT_S).intersects
+            # Both legs answer (both workers are healthy local engines):
+            # the second answer must land as a deduplicated straggler.
+            deadline = time.monotonic() + WAIT_S
+            while get_run_record().snapshot()[0].get(
+                "fleet.duplicate_responses", 0.0,
+            ) < base + 1:
+                if time.monotonic() > deadline:
+                    error = (
+                        "the hedge straggler's answer was never "
+                        "deduplicated (fleet.duplicate_responses did "
+                        "not move)"
+                    )
+                    break
+                time.sleep(0.002)
+        elif schedule == "fleet_scale_down_races_dispatch":
+            # qi-mesh (ISSUE 19): a scale-down retirement races a
+            # dispatch already routed to the retiree.  _retire_one always
+            # picks the reverse-sorted newest worker (w1 here), so pick a
+            # fixture whose fingerprint routes to w1, park its dispatch
+            # at route.resolved, and drive scale_tick(force=True): the
+            # retiree leaves the ring, scale.retire releases the parked
+            # dispatch, and it must re-route through the shrunken ring —
+            # exactly one verdict, nothing lost to the voluntary shrink.
+            from quorum_intersection_tpu.fbas.synth import majority_fbas
+            from quorum_intersection_tpu.pipeline import solve
+
+            broken = topology.endswith("-broken")
+            data2 = None
+            for i in range(64):
+                cand = majority_fbas(9, prefix=f"SCALE{i}", broken=broken)
+                fp2 = snapshot_fingerprint(build_graph(parse_fbas(cand)))
+                if engine._ring.route(fp2) == "w1":
+                    data2 = cand
+                    break
+            if data2 is None:
+                raise ScheduleError(
+                    "no fixture routing to the retiree (w1) in 64 tries"
+                )
+            expected = solve(data2, backend="python").intersects
+            ctl.hold("route.resolved", ctl.reached_event("scale.retire"))
+            box3: Dict[str, object] = {}
+
+            def _submit2() -> None:
+                try:
+                    box3["ticket"] = engine.submit(data2)
+                except Exception as exc:  # noqa: BLE001 — the failure IS the observable
+                    box3["error"] = exc
+
+            # qi-lint: allow(cancel-token-plumbed) — bounded schedule thread, joined below
+            t = threading.Thread(target=_submit2, daemon=True)
+            t.start()
+            if not ctl.reached_event("route.resolved").wait(WAIT_S):
+                raise ScheduleError("submit never resolved a route")
+            decision = engine.scale_tick(force=True)
+            t.join(WAIT_S)
+            if t.is_alive():
+                raise ScheduleError("submit thread never returned")
+            if decision != "down":
+                error = f"scale tick decided {decision!r}, not 'down'"
+            elif "error" in box3:
+                error = f"submit raised {box3['error']!r}"
+            else:
+                with engine._lock:
+                    ring_size = len(engine._ring)
+                res = box3["ticket"].result(WAIT_S)  # type: ignore[union-attr]
+                verdict = res.intersects
+                if ring_size != 1:
+                    error = f"ring size {ring_size} != 1 after retirement"
         else:
             raise ValueError(f"unknown fleet schedule {schedule!r}")
     finally:

@@ -67,10 +67,21 @@ CHANNEL_SPECS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...],
         (
             ("quorum_intersection_tpu/fleet.py", "ProcWorker.submit"),
             ("quorum_intersection_tpu/fleet.py", "ProcWorker.ping"),
+            # qi-mesh (ISSUE 19): a socket-joined peer speaks the same
+            # request dialect over TCP — hello handshake, submit/ping, and
+            # the journal-ship pull + ack.
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker.__init__"),
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker.submit"),
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker.ping"),
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker.ship_journal"),
         ),
         (
             ("quorum_intersection_tpu/serve_transport.py",
              "JsonlSession.handle_line", ("obj",)),
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession._handle_hello", ("hello", "store")),
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession._handle_ship", ("ship",)),
         ),
     ),
     (
@@ -86,10 +97,22 @@ CHANNEL_SPECS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...],
             ("quorum_intersection_tpu/serve_transport.py", "serve_main"),
             ("quorum_intersection_tpu/serve.py",
              "ServeEngine._replay_journal"),
+            # qi-mesh (ISSUE 19): handshake replies + chunked journal
+            # shipping ride the response stream back to the joining fleet.
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession._handle_hello"),
+            ("quorum_intersection_tpu/serve_transport.py",
+             "JsonlSession._handle_ship"),
         ),
         (
             ("quorum_intersection_tpu/fleet.py", "ProcWorker._read_loop",
              ("obj",)),
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker._read_loop",
+             ("obj", "ok")),
+            ("quorum_intersection_tpu/fleet.py",
+             "SocketWorker._collect_chunk", ("chunk",)),
+            ("quorum_intersection_tpu/fleet.py", "SocketWorker.ship_journal",
+             ("end",)),
             ("quorum_intersection_tpu/fleet.py", "FleetEngine._on_response",
              ("obj", "err")),
             ("quorum_intersection_tpu/fleet.py",
@@ -112,6 +135,39 @@ CHANNEL_SPECS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...],
         ),
         (
             ("quorum_intersection_tpu/query.py", "Query.parse", ("raw",)),
+        ),
+    ),
+    (
+        # qi-store/1 client → gateway lines (qi-mesh, ISSUE 19): the
+        # store_hello session opener plus get/put fragment ops a socket
+        # worker sends to the front door's StoreGateway.
+        "store.request",
+        (
+            ("quorum_intersection_tpu/delta.py",
+             "RemoteStoreClient._connect_locked"),
+            ("quorum_intersection_tpu/delta.py", "RemoteStoreClient.fetch"),
+            ("quorum_intersection_tpu/delta.py",
+             "RemoteStoreClient.publish"),
+        ),
+        (
+            ("quorum_intersection_tpu/fleet.py", "StoreGateway._serve_conn",
+             ("hello", "inner", "op")),
+        ),
+    ),
+    (
+        # qi-store/1 gateway → client lines: one {"ok": ...} answer per
+        # op; the client's retry loop and fetch path parse them.
+        "store.response",
+        (
+            ("quorum_intersection_tpu/fleet.py", "StoreGateway._serve_conn"),
+        ),
+        (
+            ("quorum_intersection_tpu/delta.py",
+             "RemoteStoreClient._connect_locked", ("resp",)),
+            ("quorum_intersection_tpu/delta.py", "RemoteStoreClient._request",
+             ("resp",)),
+            ("quorum_intersection_tpu/delta.py", "RemoteStoreClient.fetch",
+             ("resp",)),
         ),
     ),
     (

@@ -120,6 +120,18 @@ TRACKED: Dict[str, str] = {
     "fleet_verdicts_per_sec": "higher",
     "fleet_p99_ms": "lower",
     "fleet_store_hit_pct": "higher",
+    # qi-mesh socket-joined fleet (ISSUE 19): benchmarks/serve.py --fleet
+    # --fleet-join rows.  `fleet_scale_events` counts the elasticity legs
+    # that actually fired (forced scale-up + drain-retire ticks; the phase
+    # expects exactly one of each, so a drop below 2 means a leg went
+    # dead and the fleet no longer resizes under pressure).
+    # `fleet_hedge_pct` is hedged dispatches over served verdicts across
+    # the phase's fixed partition window — a collapse to 0 means suspected
+    # peers no longer hedge (their arc traffic waits on a partitioned
+    # socket instead), the exact tail-latency hole hedging exists to
+    # close.
+    "fleet_scale_events": "higher",
+    "fleet_hedge_pct": "higher",
     # qi-query typed queries (ISSUE 12): benchmarks/serve.py --queries
     # rows.  One headline plus a per-kind breakdown, so a regression in
     # ONE resolver (a relaxed enumeration that stopped vectorizing, a

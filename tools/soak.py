@@ -78,6 +78,16 @@ eviction + journal failover path runs under the same contract: every
 request reaches exactly one outcome, the oracle verdict or a typed error,
 with zero lost and zero duplicated verdicts across the kill.
 
+**Socket-mesh round** (ISSUE 19, ``--fleet --chaos``): each seed
+additionally joins a REAL ``serve --socket`` subprocess over TCP
+(``fleet --join`` worker mode) under a seeded wire-tier schedule
+(``utils/faults.py sample_mesh_plan``: join, lease and journal-ship are
+drawable), and even seeds SIGSTOP the peer mid-stream — a PARTITION, not
+a death: the peer is suspected and its requests hedge to the next arc
+owner — then SIGCONT it so the rejoin path heals the mesh.  The contract
+is unchanged: every admitted request reaches exactly one outcome, the
+oracle verdict or a typed error, across partition, hedge and rejoin.
+
 Usage::
 
     python tools/soak.py                      # 40 instances from seed 0
@@ -745,6 +755,134 @@ def run_fleet_chaos_instance(seed: int, workdir: pathlib.Path,
             "typed_failures": typed_failures, "mismatches": mismatches}
 
 
+def run_mesh_chaos_instance(seed: int, workdir: pathlib.Path,
+                            chaos: bool) -> dict:
+    """Socket-mesh round (qi-mesh, ISSUE 19): a REAL ``serve --socket``
+    subprocess joined over TCP as worker ``j0`` next to one local worker,
+    streamed under a seeded wire-tier fault schedule
+    (``utils/faults.py sample_mesh_plan`` — join, lease and journal ship
+    are drawable).  Even seeds SIGSTOP the peer mid-stream (a PARTITION,
+    not a death: suspicion + hedged dispatch keep its arc answering) and
+    SIGCONT it afterwards (the rejoin path).  Every admitted request must
+    reach exactly one outcome — the oracle verdict or a typed error."""
+    from quorum_intersection_tpu.fleet import FleetEngine
+    from quorum_intersection_tpu.serve import ServeError
+    from quorum_intersection_tpu.utils import faults
+
+    desc, stream, oracle = make_serve_traffic(seed, requests=8)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1",
+        "QI_METRICS_JSON": "", "QI_METRICS_PROM": "", "QI_TRACE_OUT": "",
+    })
+    child = subprocess.Popen(
+        [sys.executable, "-u", "-m", "quorum_intersection_tpu", "serve",
+         "--socket", "0", "--backend", "python", "--emit-certs",
+         "--journal", str(workdir / f"mesh-{seed}.journal")],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=str(_REPO),
+    )
+    mismatches: list = []
+    typed_failures: list = []
+    served = 0
+    fired = 0
+    partitioned = False
+    schedule_label = "fault-free"
+    try:
+        port = None
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            line = child.stdout.readline()
+            if not line:
+                break
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("kind") == "listening":
+                port = int(obj["port"])
+                break
+        if port is None:
+            return {"seed": seed, "desc": desc, "schedule": schedule_label,
+                    "fired": 0, "served": 0, "partitioned": False,
+                    "mesh": True, "typed_failures": [],
+                    "mismatches": ["serve --socket peer never announced "
+                                   "its port"]}
+        faults.clear_plan()
+        plan = (
+            faults.install_plan(faults.sample_mesh_plan(seed))
+            if chaos else None
+        )
+        schedule_label = plan.label if plan is not None else "fault-free"
+        engine = FleetEngine(
+            1, backend="python", worker_mode="local",
+            journal_dir=workdir / f"mesh-fleet-{seed}",
+            probe_interval_s=0.2, respawn_max=0,
+            joins=[f"127.0.0.1:{port}"],
+        )
+        tickets = []
+        try:
+            # A join-faulted start degrades to standalone (the local
+            # worker keeps serving) — that IS the contract under test.
+            engine.start()
+            stall_at = len(stream) // 2 if seed % 2 == 0 else None
+            for i, (rid, snap) in enumerate(stream):
+                if (stall_at is not None and i == stall_at
+                        and child.poll() is None):
+                    os.kill(child.pid, signal.SIGSTOP)
+                    partitioned = True
+                try:
+                    tickets.append((rid, engine.submit(snap, request_id=rid)))
+                except (ServeError, faults.FaultInjected, OSError) as exc:
+                    typed_failures.append(f"{rid}: {type(exc).__name__}")
+            if partitioned:
+                # Long enough for missed probes to SUSPECT the peer (its
+                # requests hedge to the next arc owner), short of its
+                # lease — then the partition heals and it rejoins.
+                time.sleep(0.8)
+                os.kill(child.pid, signal.SIGCONT)
+            for rid, ticket in tickets:
+                try:
+                    resp = ticket.result(timeout=60.0)
+                except TimeoutError:
+                    mismatches.append(
+                        f"{rid}: SILENT DROP — no outcome 60s after submit "
+                        f"under {schedule_label}"
+                    )
+                    continue
+                except (ServeError, faults.FaultInjected, OSError) as exc:
+                    typed_failures.append(f"{rid}: {type(exc).__name__}")
+                    continue
+                except Exception as exc:  # noqa: BLE001 — an untyped crash IS a finding
+                    mismatches.append(
+                        f"{rid}: UNTYPED {type(exc).__name__}: {exc} "
+                        f"under {schedule_label}"
+                    )
+                    continue
+                served += 1
+                if resp.intersects is not oracle[rid]:
+                    mismatches.append(
+                        f"{rid}: SILENT verdict flip {resp.intersects} != "
+                        f"fault-free {oracle[rid]} under {schedule_label}"
+                    )
+        finally:
+            engine.stop(drain=True, timeout=60.0)
+            fired = len(plan.fired) if plan is not None else 0
+            faults.clear_plan()
+    finally:
+        try:
+            if child.poll() is None:
+                os.kill(child.pid, signal.SIGCONT)  # never leave it stopped
+                child.stdin.close()
+                child.wait(timeout=30.0)
+        except (OSError, subprocess.TimeoutExpired):
+            child.kill()
+    return {"seed": seed, "desc": desc, "schedule": schedule_label,
+            "fired": fired, "served": served, "partitioned": partitioned,
+            "mesh": True, "typed_failures": typed_failures,
+            "mismatches": mismatches}
+
+
 def fleet_soak_main(args: argparse.Namespace) -> int:
     """--fleet driver: fleet-tier chaos (+ kill-one-of-N) per seed."""
     t0 = time.time()
@@ -753,6 +891,8 @@ def fleet_soak_main(args: argparse.Namespace) -> int:
     total_typed = 0
     total_served = 0
     kill_rounds = 0
+    mesh_rounds = 0
+    partition_rounds = 0
     with tempfile.TemporaryDirectory(prefix="qi-fleet-soak-") as tmp:
         workdir = pathlib.Path(tmp)
         for i, seed in enumerate(range(args.seed, args.seed + args.instances)):
@@ -765,6 +905,21 @@ def fleet_soak_main(args: argparse.Namespace) -> int:
                 bad.append(rec)
                 print(f"FLEET CHAOS MISMATCH seed={seed} {rec['desc']} "
                       f"[{rec['schedule']}]: {rec['mismatches']}")
+            # Socket-mesh round (qi-mesh, ISSUE 19): a real --join peer
+            # under wire-tier chaos; even seeds get a SIGSTOP/SIGCONT
+            # partition (suspect → hedge → rejoin), never a kill.
+            if args.chaos:
+                mesh_rounds += 1
+                mrec = run_mesh_chaos_instance(seed, workdir,
+                                               chaos=args.chaos)
+                total_fired += mrec["fired"]
+                total_typed += len(mrec["typed_failures"])
+                total_served += mrec["served"]
+                partition_rounds += int(mrec["partitioned"])
+                if mrec["mismatches"]:
+                    bad.append(mrec)
+                    print(f"MESH CHAOS MISMATCH seed={seed} {mrec['desc']} "
+                          f"[{mrec['schedule']}]: {mrec['mismatches']}")
             if (i + 1) % 5 == 0:
                 print(f"  ... {i + 1}/{args.instances} fleet instances "
                       f"({time.time() - t0:.0f}s, {len(bad)} mismatches, "
@@ -775,6 +930,8 @@ def fleet_soak_main(args: argparse.Namespace) -> int:
         "window": [args.seed, args.seed + args.instances],
         "instances": args.instances,
         "kill_rounds": kill_rounds,
+        "mesh_rounds": mesh_rounds,
+        "partition_rounds": partition_rounds,
         "n_mismatches": len(bad),
         "mismatches": bad,
         "faults_fired": total_fired,
@@ -826,9 +983,14 @@ def main(argv=None) -> int:
                              "fleet (with --chaos: under seeded fleet.* "
                              "fault schedules — routing, probing, failover "
                              "replay, shared store) plus a kill-one-of-N "
-                             "round per even seed; oracle-equal verdicts "
-                             "or typed errors only, zero lost / zero "
-                             "duplicated across the kill")
+                             "round per even seed and, with --chaos, a "
+                             "socket-mesh round per seed (a real serve "
+                             "--socket peer joined over TCP under seeded "
+                             "fleet.{join,lease,ship} schedules, with a "
+                             "SIGSTOP/SIGCONT partition on even seeds); "
+                             "oracle-equal verdicts or typed errors only, "
+                             "zero lost / zero duplicated across the kill "
+                             "and the partition")
     parser.add_argument("--serve", action="store_true",
                         help="soak the serving layer (serve.py) instead of "
                              "one-shot solves: churn-trace streams through a "
