@@ -105,7 +105,7 @@ def _fake_auto(outcome: str):
                 burn_budget=not oracle_fast,
             )
 
-        def _sweep(self, cancel=None):
+        def _sweep(self, cancel=None, engine=None):
             return _FakeEngine(
                 SLOW_S if oracle_fast else FAST_S, "tpu-sweep", cancel=cancel
             )

@@ -211,6 +211,174 @@ def packed_row(scc: int, device: str) -> dict:
     return row
 
 
+def _bitset_workloads(quick: bool) -> list:
+    """(name, correct_snapshot, broken_snapshot) triples for the --bitset
+    rows: both vendored fixture pairs (org-nested 15-node SCC + the
+    149-node stellar-like snapshot's 21-node SCC), a symmetric k-of-n pair
+    (density ~1.0 — the dense-friendly end of the density axis), and the
+    ``sparse_giant`` preset (the crossover workload: 24-node core under
+    ~10k watcher nodes).  --quick shrinks only the watcher mass — the
+    cores, and therefore the sweep work, are identical."""
+    from quorum_intersection_tpu.fbas.synth import sparse_giant
+
+    fixdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "fixtures")
+
+    def fx(name):
+        with open(os.path.join(fixdir, name + ".json")) as fh:
+            return json.load(fh)
+
+    giant_nodes = 1_500 if quick else 10_000
+    return [
+        ("nested_fixture", fx("nested_correct"), fx("nested_broken")),
+        ("snapshot_fixture", fx("snapshot_correct"), fx("snapshot_broken")),
+        ("kofn16", kofn(16, 9, "KD"), kofn(16, 8, "KD")),
+        ("sparse_giant", sparse_giant(giant_nodes),
+         sparse_giant(giant_nodes, broken=True)),
+    ]
+
+
+def bitset_row(name: str, correct: list, broken: list, device: str) -> dict:
+    """One dense-vs-bitset-vs-oracle measurement (qi-sparse ISSUE 20) on a
+    correct+broken snapshot pair.
+
+    Times the SWEEP PHASE only (graph/circuit built once, outside the
+    clock — on ``sparse_giant`` the 10k-node front end would otherwise
+    drown the engines' difference), runs both engines on both twins, and
+    carries the shape model that makes the arithmetic-intensity claim
+    checkable off-chip: dense MACs-per-candidate vs bitset
+    words-per-candidate on the device shape that actually ran, plus the
+    streamed ``sweep_bytes_per_candidate`` (4 bytes per packed word).
+    ``scc_density`` is the routing feature calibration consumes
+    (``bitset_win_max_density``).  Verdict parity — dense == bitset ==
+    host oracle on BOTH twins, witness pair included — gates the row; any
+    mismatch marks it INVALID and the driver exits 1.
+    """
+    from quorum_intersection_tpu.backends.tpu.sweep import (
+        TpuSweepBackend,
+        bitset_words_per_candidate_row,
+        macs_per_candidate_row,
+    )
+    from quorum_intersection_tpu.encode.circuit import encode_circuit
+    from quorum_intersection_tpu.fbas.graph import build_graph
+    from quorum_intersection_tpu.fbas.schema import parse_fbas
+    from quorum_intersection_tpu.fbas.synth import (
+        graph_density,
+        scc_qset_density,
+    )
+    from quorum_intersection_tpu.pipeline import quorum_bearing_sccs
+
+    jobs = {}
+    for twin, data in (("correct", correct), ("broken", broken)):
+        graph = build_graph(parse_fbas(data))
+        circuit = encode_circuit(graph)
+        bearing = quorum_bearing_sccs(graph, allow_native=False)
+        assert bearing, f"{name}/{twin}: no quorum-bearing SCC"
+        # The broken twin of a fixture pair may split into several bearing
+        # SCCs; the engine differential runs on the largest (the one that
+        # carries the sweep work).
+        scc = max((s for _, s in bearing), key=len)
+        jobs[twin] = (graph, circuit, scc)
+
+    def device_quiesce():
+        """Wait out device work abandoned by the previous timed run.  An
+        early-hit verdict returns immediately BY DESIGN, dropping up to
+        max_inflight in-flight programs (the driver's bounded discard) —
+        but those keep executing on the backend's thread pool, and the
+        next engine's compile and dispatches queue behind them (measured:
+        a 0.3 s bitset compile stretched to ~18 s behind a dense broken-
+        twin's abandoned backlog).  A fresh trivial program round-trips
+        fast only once the queue is empty, so spin until it does.
+        """
+        import jax.numpy as jnp
+
+        while True:
+            t0 = time.perf_counter()
+            jnp.zeros(()).block_until_ready()
+            if time.perf_counter() - t0 < 0.05:
+                return
+
+    graph, _, scc = jobs["correct"]
+    timings = {}
+    results = {}
+    for engine in ("xla", "bitset"):
+        for twin, (g, c, s) in jobs.items():
+            device_quiesce()
+            t0 = time.perf_counter()
+            res = TpuSweepBackend(engine=engine).check_scc(
+                g, c, s, scope_to_scc=False
+            )
+            timings[(engine, twin)] = time.perf_counter() - t0
+            results[(engine, twin)] = res
+
+    # Host-oracle rung of the differential: the reference B&B disjointness
+    # search (cpp when a compiler is around, stdlib python otherwise) run on
+    # the SAME per-SCC problem.  Verdicts must agree three ways; witness
+    # pairs are compared engine-vs-engine only (the oracle's search order
+    # legitimately surfaces a different disjoint pair).
+    def oracle_intersects(g, s):
+        try:
+            from quorum_intersection_tpu.backends.cpp import CppOracleBackend
+            oracle = CppOracleBackend()
+        except Exception:  # noqa: BLE001 — no g++: the python oracle counts
+            from quorum_intersection_tpu.backends.python_oracle import (
+                PythonOracleBackend,
+            )
+            oracle = PythonOracleBackend()
+        return oracle.check_scc(g, None, s, scope_to_scc=False).intersects
+
+    verdict_ok = True
+    for twin in ("correct", "broken"):
+        g, _, s = jobs[twin]
+        dense = results[("xla", twin)]
+        bits = results[("bitset", twin)]
+        verdict_ok = verdict_ok and (
+            dense.intersects == bits.intersects
+            and dense.q1 == bits.q1 and dense.q2 == bits.q2
+            and dense.intersects == oracle_intersects(g, s)
+        )
+
+    dense_s = timings[("xla", "correct")]
+    bits_s = timings[("bitset", "correct")]
+    shape = (
+        results[("xla", "correct")].stats.get("padded_shape")
+        or results[("xla", "correct")].stats["device_shape"]
+    )
+    macs = macs_per_candidate_row(shape[0], shape[1], 0)
+    words = bitset_words_per_candidate_row(shape[0], shape[1], 0)
+    dens = graph_density(graph)
+    row = {
+        "bitset": True, "name": name, "device": device,
+        "scc": len(scc),
+        "nodes": int(dens["nodes"]),
+        "edge_density": round(dens["edge_density"], 6),
+        "qset_fanout_mean": round(dens["qset_fanout_mean"], 2),
+        "scc_density": round(scc_qset_density(graph, scc), 4),
+        "dense_seconds": round(dense_s, 3),
+        "bitset_seconds": round(bits_s, 3),
+        "bitset_speedup_vs_dense": round(dense_s / bits_s, 2)
+        if bits_s else None,
+        "broken_dense_seconds": round(timings[("xla", "broken")], 3),
+        "broken_bitset_seconds": round(timings[("bitset", "broken")], 3),
+        "bitset_cand_per_sec": round(
+            results[("bitset", "correct")].stats.get("candidates_per_sec", 0.0)
+        ),
+        "dense_cand_per_sec": round(
+            results[("xla", "correct")].stats.get("candidates_per_sec", 0.0)
+        ),
+        "dense_macs_per_candidate": macs,
+        "bitset_words_per_candidate": words,
+        "sweep_bytes_per_candidate": 4 * words,
+        "model_intensity_ratio": round(macs / words, 2) if words else None,
+        "encoding_stamped": (
+            results[("bitset", "correct")].stats.get("encoding") == "bitset"
+            and "encoding" not in results[("xla", "correct")].stats
+        ),
+        "verdict_ok": verdict_ok,
+    }
+    return row
+
+
 def pruned_row(core: int, device: str) -> dict:
     """One qi-prune measurement (ISSUE 10) on the ``near_disjoint_cores``
     pair (2*core+1 nodes, one SCC):
@@ -300,6 +468,12 @@ def main() -> int:
                         help="append the run's qi-telemetry/1 stream "
                              "(sweep.pack_* / sweep.prune_* counters "
                              "included) to PATH")
+    parser.add_argument("--bitset", action="store_true",
+                        help="add dense-vs-bitset-vs-oracle sweep rows on "
+                             "the fixture pairs, a k-of-n pair, and the "
+                             "sparse_giant preset (qi-sparse ISSUE 20: "
+                             "MACs- vs words-per-candidate shape model, "
+                             "crossover point, verdict-parity gated)")
     parser.add_argument("--pruned", action="store_true",
                         help="add rank-ordered + block-guard-pruned sweep "
                              "rows on the near_disjoint_cores pair "
@@ -406,6 +580,51 @@ def main() -> int:
                 f"{mfu if mfu is not None else '—'} |"
             )
             print(json.dumps(row), flush=True)
+        if not ok:
+            return 1
+
+    if args.bitset:
+        print("\n| workload | scc | density | dense (s) | bitset (s) | "
+              "speedup | MACs/cand | words/cand | bytes/cand |")
+        print("|---|---|---|---|---|---|---|---|---|")
+        ok = True
+        wins = []
+        for name, correct, broken in _bitset_workloads(args.quick):
+            row = bitset_row(name, correct, broken, device)
+            ok = ok and row["verdict_ok"]
+            flag = "" if row["verdict_ok"] else " **INVALID: verdict mismatch**"
+            print(
+                f"| {name} | {row['scc']} | {row['scc_density']} | "
+                f"{row['dense_seconds']:.2f} | {row['bitset_seconds']:.2f} | "
+                f"{row['bitset_speedup_vs_dense']}x{flag} | "
+                f"{row['dense_macs_per_candidate']} | "
+                f"{row['bitset_words_per_candidate']} | "
+                f"{row['sweep_bytes_per_candidate']} |"
+            )
+            print(json.dumps(row), flush=True)
+            if row["verdict_ok"] and (row["bitset_speedup_vs_dense"] or 0) > 1:
+                wins.append(row)
+        if wins:
+            # The crossover summary line the calibration parser's humans
+            # read; the parser itself consumes the JSON rows above.
+            win_sccs = sorted(r["scc"] for r in wins)
+            print(f"\nbitset crossover: wins from scc {min(win_sccs)} "
+                  f"(measured wins at {win_sccs})")
+            # Trend-gate summary row (tools/bench_trend.py TRACKED): the
+            # best winning row's end-to-end rate, the measured crossover
+            # point (creeping UP = the encoding stopped winning small
+            # SCCs), and the streamed bytes per candidate on the largest
+            # measured shape (creeping up = encoding bloat).  `bitset` is
+            # deliberately absent so calibration's row parser skips it.
+            best = max(wins, key=lambda r: r["bitset_cand_per_sec"])
+            widest = max(wins, key=lambda r: r["bitset_words_per_candidate"])
+            print(json.dumps({
+                "device": device,
+                "bitset_candidates_per_sec": best["bitset_cand_per_sec"],
+                "bitset_crossover_scc": min(win_sccs),
+                "sweep_bytes_per_candidate":
+                    widest["sweep_bytes_per_candidate"],
+            }), flush=True)
         if not ok:
             return 1
 

@@ -671,13 +671,62 @@ class AutoBackend:
         # scoped to the run (the CLI builds one AutoBackend per solve).
         self._ladder = DegradationLadder()
 
-    def _sweep(self, cancel: Optional[CancelToken] = None) -> SearchBackend:
+    def _sweep(
+        self,
+        cancel: Optional[CancelToken] = None,
+        engine: Optional[str] = None,
+    ) -> SearchBackend:
         from quorum_intersection_tpu.backends.tpu.sweep import TpuSweepBackend
 
         return TpuSweepBackend(
             checkpoint=self.checkpoint, mesh=self.mesh,
             cancel=cancel if cancel is not None else self.cancel,
+            engine=engine,
         )
+
+    def _bitset_hint(self, graph: TrustGraph, scc: List[int]) -> Optional[str]:
+        """Density-routed encoding hint for a sweep-bound solve (qi-sparse):
+        ``"bitset"`` when the measured win region covers this SCC, else
+        None (the sweep backend's own default resolution applies).
+
+        Every clause is the recorded-measurement discipline the other
+        routing gates follow: the region comes from committed --bitset
+        bench rows (calibration._bitset_win), the live device kind must
+        match the kind the win was measured on, |scc| must reach the
+        smallest measured winning size (extrapolation goes UP the scc
+        axis only — more windows amortize fixed costs further), and the
+        SCC's qset density must stay within the densest measured win
+        (denser qsets erode exactly the sparsity the encoding streams).
+        An explicit ``QI_SWEEP_ENGINE`` always wins: the ctor argument
+        this hint feeds would override the env knob inside the backend,
+        so a user-pinned engine must short-circuit the hint here."""
+        from quorum_intersection_tpu.utils.env import qi_env
+
+        if qi_env("QI_SWEEP_ENGINE").strip():
+            return None
+        win = CALIBRATION.bitset_win_min_scc
+        dmax = CALIBRATION.bitset_win_max_density
+        if win is None or dmax is None or len(scc) < win:
+            return None
+        from quorum_intersection_tpu.utils.platform import backend_kind
+
+        if backend_kind() != CALIBRATION.bitset_win_device:
+            return None
+        from quorum_intersection_tpu.fbas.synth import scc_qset_density
+
+        density = scc_qset_density(graph, scc)
+        if density > dmax:
+            return None
+        get_run_record().event(
+            "route.encoding", engine="bitset", scc=len(scc),
+            density=round(density, 4),
+            reason=(
+                f"measured win region: |scc| >= {win}, "
+                f"qset density <= {dmax:.4g} "
+                f"on {CALIBRATION.bitset_win_device}"
+            ),
+        )
+        return "bitset"
 
     def _cpu_oracle(
         self,
@@ -1032,7 +1081,10 @@ class AutoBackend:
                 return
             res = self._ladder.attempt(
                 "tpu-sweep",
-                lambda: self._sweep(cancel=sweep_cancel).check_scc(
+                lambda: self._sweep(
+                    cancel=sweep_cancel,
+                    engine=self._bitset_hint(graph, scc),
+                ).check_scc(
                     graph, circuit, scc, scope_to_scc=scope_to_scc
                 ),
                 fall_to="native",
@@ -1228,7 +1280,18 @@ class AutoBackend:
             packable = [i for i in packable if len(jobs[i][2]) <= limit]
         if packable:
             def run_packed() -> List[SccCheckResult]:
-                sweep = self._sweep()
+                # Encoding hint for the PACK: one fused drive serves every
+                # member, so the bitset twin engages only when every packed
+                # job's SCC sits inside the measured win region — one dense-
+                # friendly member routes the whole pack dense (the honest
+                # default; per-job engines would defeat the fusion).
+                hint = None
+                if all(
+                    self._bitset_hint(jobs[i][0], jobs[i][2]) == "bitset"
+                    for i in packable
+                ):
+                    hint = "bitset"
+                sweep = self._sweep(engine=hint)
                 rec.event(
                     "route.decision", engine="tpu-sweep",
                     scc=max(len(jobs[i][2]) for i in packable),
@@ -1353,7 +1416,9 @@ class AutoBackend:
                     # Construct FIRST: the route.decision event fires only
                     # for a sweep that actually exists (a jax-free box must
                     # not record engine=tpu-sweep for a host-oracle verdict).
-                    backend = self._sweep()
+                    backend = self._sweep(
+                        engine=self._bitset_hint(graph, scc)
+                    )
                     log.debug("auto: sweep backend for |scc|=%d", len(scc))
                     get_run_record().event(
                         "route.decision", engine="tpu-sweep", scc=len(scc),

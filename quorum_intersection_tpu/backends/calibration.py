@@ -182,6 +182,19 @@ class Calibration:
     # every routing claim in this module follows).
     pack_win_max_scc: Optional[int] = None
     pack_win_device: Optional[str] = None
+    # Measured bitset-encoding win region (benchmarks/sweep_vs_native.py
+    # --bitset rows): route a solve to the streaming bitset kernel twin
+    # when |scc| >= bitset_win_min_scc AND the SCC's qset density is <=
+    # bitset_win_max_density, on hardware of the measured kind.  Density
+    # is the routing FEATURE (fbas.synth.scc_qset_density): the bitset
+    # encoding wins exactly where the dense block-diagonal operand is
+    # mostly padding — sparse org-nested cores — and loses nothing where
+    # qsets are dense (k-of-n, density ~1.0), which is why both bounds
+    # gate together.  None = no measured win on record; the dense engine
+    # keeps every solve (the module's honest-measurement posture).
+    bitset_win_min_scc: Optional[int] = None
+    bitset_win_max_density: Optional[float] = None
+    bitset_win_device: Optional[str] = None
     # key -> "file.json: <field>=<value>" (or "default" when no artifact won)
     provenance: Dict[str, str] = field(default_factory=dict)
 
@@ -465,6 +478,103 @@ def _pack_win_max_scc(
     return None
 
 
+def _bitset_win(
+    paths: Iterable[pathlib.Path],
+) -> Optional[Tuple[int, float, str, str]]:
+    """Bitset-encoding win region from the newest sweep_vs_native artifact's
+    ``--bitset`` rows (``bitset_speedup_vs_dense`` + ``scc_density`` +
+    ``verdict_ok``).
+
+    Same conservative discipline as the pack gate, with the density axis
+    added: rows group by measured device kind (an accelerator win gates
+    accelerator routing only; when both kinds recorded wins the
+    accelerator's gate is kept); a ``verdict_ok: false`` bitset row
+    anywhere in the chosen artifact vetoes the whole gate (correctness
+    evidence against the ENCODING, not a slow workload); wins require a
+    >= 1.1x margin (a tie — kofn at density ~1.0 measures ~1.0x — is no
+    reason to leave the default engine); and any measured LOSS (< 1x)
+    falling inside the candidate region shrinks it — first by dropping
+    win rows at or above the losing row's density, so the density bound
+    moves below the loss — until no loss contradicts the region.  The
+    region returned is (min winning |scc|, max winning density): routing
+    extrapolates UP the scc axis (more windows amortize the fixed costs
+    even further) but never up the density axis (denser qsets erode
+    exactly the sparsity the encoding streams)."""
+    newest: Optional[
+        Tuple[int, str, Dict[str, List[Tuple[int, float, float]]], List[int]]
+    ] = None
+    for path in paths:
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        by_kind: Dict[str, List[Tuple[int, float, float]]] = {}
+        vetoes: List[int] = []
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("bitset") is not True:
+                continue
+            scc = rec.get("scc")
+            speed = rec.get("bitset_speedup_vs_dense")
+            density = rec.get("scc_density")
+            if (
+                not isinstance(scc, int)
+                or not isinstance(speed, (int, float))
+                or not isinstance(density, (int, float))
+            ):
+                continue
+            if not rec.get("verdict_ok", False):
+                vetoes.append(scc)
+                continue
+            by_kind.setdefault("tpu" if _is_tpu(rec) else "cpu", []).append(
+                (scc, float(density), float(speed))
+            )
+        if by_kind or vetoes:
+            rank = _round_rank(path.name)
+            if newest is None or rank > newest[0]:
+                newest = (rank, path.name, by_kind, vetoes)
+    if newest is None:
+        return None
+    _, name, by_kind, vetoes = newest
+    if vetoes:
+        log.warning(
+            "bitset-encoding gate vetoed: %s records verdict_ok=false at "
+            "bitset scc %s", name, sorted(set(vetoes)),
+        )
+        return None
+    for kind in ("tpu", "cpu"):
+        rows = by_kind.get(kind)
+        if not rows:
+            continue
+        wins = [(scc, d) for scc, d, v in rows if v >= 1.1]
+        losses = [(scc, d) for scc, d, v in rows if v < 1.0]
+        while wins:
+            min_scc = min(scc for scc, _ in wins)
+            max_density = max(d for _, d in wins)
+            inside = [
+                (scc, d) for scc, d in losses
+                if scc >= min_scc and d <= max_density
+            ]
+            if not inside:
+                break
+            # Shrink along the density axis past the densest inside loss.
+            bound = max(d for _, d in inside)
+            wins = [(scc, d) for scc, d in wins if d < bound]
+        if not wins:
+            continue
+        return min_scc, max_density, kind, (
+            f"{name}: bitset >= 1.1x dense for scc >= {min_scc} at qset "
+            f"density <= {max_density:.4g} on {kind}"
+        )
+    return None
+
+
 def _sweep_warm_ratio(
     paths: Iterable[pathlib.Path],
 ) -> Optional[Tuple[float, str]]:
@@ -551,8 +661,9 @@ def calibrate(
         crossover_paths = _crossover_paths() if paths is None else []
     if sweep_window_paths is None:
         sweep_window_paths = _sweep_window_paths() if paths is None else []
-    # Consumed twice below (sweep window + pack gate): materialize so a
-    # generator argument cannot silently starve the second pass.
+    # Consumed three times below (sweep window + pack gate + bitset gate):
+    # materialize so a generator argument cannot silently starve a later
+    # pass.
     sweep_window_paths = list(sweep_window_paths)
     if auto_race_paths is None:
         auto_race_paths = _auto_race_paths() if paths is None else []
@@ -586,6 +697,14 @@ def calibrate(
         if pw is not None:
             (cal.pack_win_max_scc, cal.pack_win_device,
              cal.provenance["pack"]) = pw
+    # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
+    except Exception:  # noqa: BLE001 — calibration must never break imports
+        pass
+    try:
+        bw = _bitset_win(sweep_window_paths)
+        if bw is not None:
+            (cal.bitset_win_min_scc, cal.bitset_win_max_density,
+             cal.bitset_win_device, cal.provenance["bitset"]) = bw
     # qi-lint: allow(degrade-via-ladder) — import-time artifact parsing
     except Exception:  # noqa: BLE001 — calibration must never break imports
         pass

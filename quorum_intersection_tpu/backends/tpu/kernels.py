@@ -522,6 +522,236 @@ def make_packed_aot_dispatch(step, k: int) -> Callable:
     return dispatch
 
 
+# ---------------------------------------------------------------------------
+# Bitset sweep kernels (ISSUE 20 qi-sparse): the same fixpoint semantics as
+# the dense kernels above over the packed-uint32 encoding
+# (``encode.circuit.BitsetCircuit``) — per-unit vote counts come from
+# intersect-and-popcount over 32-node words instead of an (n, U) matmul.
+# The dense dot streams the full vote matrix regardless of density; the
+# bitset word loop does ``words = ceil(n/32)`` AND+popcount passes over a
+# (B, U) tile each, a ~32× narrower operand stream that wins once n
+# outgrows a few lane tiles (the calibration crossover row).  Differential
+# parity with the dense path and the NumPy oracle is pinned by
+# tests/test_qi_sparse.py; the fused Pallas twin lives in pallas_sweep.py.
+
+
+class BitsetArrays:
+    """Device-resident bitset-circuit constants (the `CircuitArrays` twin).
+
+    Word tables upload TRANSPOSED — ``member_w`` is (words, U) so the vote
+    loop broadcasts one (B, 1) word column against one (1, U) table row per
+    word; ``child_w`` is (unit_words, U) likewise."""
+
+    def __init__(self, bitset) -> None:
+        self.n = bitset.n
+        self.n_units = bitset.n_units
+        self.depth = bitset.depth
+        self.words = bitset.words
+        self.unit_words = bitset.unit_words
+        self.has_inner = bitset.n_units > bitset.n and bitset.child_words is not None
+        self.member_w = jnp.asarray(np.ascontiguousarray(bitset.member_words.T))
+        self.thresholds = jnp.asarray(bitset.thresholds.astype(np.int32))
+        self.child_w = (
+            jnp.asarray(np.ascontiguousarray(bitset.child_words.T))
+            if self.has_inner
+            else None
+        )
+
+
+def popcount_votes(avail_words: jnp.ndarray, table_w: jnp.ndarray) -> jnp.ndarray:
+    """Per-unit vote counts: ``(B, W) uint32 × (W, U) uint32 → (B, U) int32``
+    via ``Σ_w popcount(avail[:, w] & table[w, :])`` — the bitset twin of the
+    dense ``avail @ membersᵀ`` dot.  The word loop is a static Python unroll
+    (W ≤ 32 for ladder shapes), keeping peak intermediates at (B, U)."""
+    votes = None
+    for w in range(int(table_w.shape[0])):
+        hits = lax.population_count(
+            avail_words[..., w : w + 1] & table_w[w][None, :]
+        ).astype(jnp.int32)
+        votes = hits if votes is None else votes + hits
+    return votes
+
+
+def pack_bits(bits: jnp.ndarray, words: int) -> jnp.ndarray:
+    """Pack 0/1 lanes ``(..., m)`` into uint32 words ``(..., words)`` on
+    device (LSB-first, the `encode.circuit.pack_mask_words` convention).
+    The shifted terms occupy disjoint bits, so the sum IS the bitwise OR."""
+    m = int(bits.shape[-1])
+    b = bits.astype(jnp.uint32)
+    pad = words * 32 - m
+    if pad > 0:
+        b = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, pad)])
+    b = b.reshape(b.shape[:-1] + (words, 32))
+    shifts = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(b * shifts, axis=-1, dtype=jnp.uint32)
+
+
+def bitset_count(words_arr: jnp.ndarray) -> jnp.ndarray:
+    """Population count over the word axis: ``(..., W) → (...,)`` int32."""
+    return jnp.sum(
+        lax.population_count(words_arr).astype(jnp.int32), axis=-1, dtype=jnp.int32
+    )
+
+
+def bitset_node_sat(ba: BitsetArrays, avail_words: jnp.ndarray) -> jnp.ndarray:
+    """Bitset twin of :func:`node_sat`: ``(B, words)`` availability words →
+    ``(B, words)`` satisfied-node words (Q4 self-availability included via
+    the trailing AND, exactly the dense path's ``sat[..., :n] * avail``)."""
+    base = popcount_votes(avail_words, ba.member_w)
+    sat = (base >= ba.thresholds).astype(jnp.int32)
+    for _ in range(ba.depth if ba.has_inner else 0):
+        inner = popcount_votes(pack_bits(sat, ba.unit_words), ba.child_w)
+        sat = ((base + inner) >= ba.thresholds).astype(jnp.int32)
+    return pack_bits(sat[..., : ba.n], ba.words) & avail_words
+
+
+def bitset_fixpoint(
+    ba: BitsetArrays,
+    avail_words: jnp.ndarray,
+    frozen_words: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Greatest-fixpoint quorum per batch row over packed words — the
+    :func:`fixpoint` twin: identical iteration structure and Q6 frozen
+    semantics, with OR standing in for the dense max (masks are 0/1)."""
+    if frozen_words is None:
+        frozen_row = jnp.zeros((ba.words,), dtype=jnp.uint32)
+    else:
+        frozen_row = frozen_words
+
+    def body(carry):
+        a, _ = carry
+        total = a | frozen_row  # frozen helpers always available
+        nxt = bitset_node_sat(ba, total) & a  # only candidates survive
+        return nxt, jnp.any(nxt != a)
+
+    # Same data-derived initial flag as the dense fixpoint (shard_map
+    # varyingness note there) — the bitset path never runs sharded today,
+    # but the idiom costs nothing and keeps the twins line-for-line.
+    changed0 = jnp.any(avail_words == avail_words)
+    out, _ = lax.while_loop(lambda c: c[1], body, (avail_words, changed0))
+    return out
+
+
+def bitset_sweep_step(
+    ba: BitsetArrays,
+    start: jnp.ndarray,
+    batch: int,
+    pos: jnp.ndarray,
+    scc_words: jnp.ndarray,
+    frozen_words: jnp.ndarray,
+    hi_words: Optional[jnp.ndarray] = None,
+    ba_d: Optional[BitsetArrays] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Bitset twin of :func:`sweep_step`: one contiguous candidate block,
+    identical hit definition (Q ≠ ∅ ∧ fixpoint(scc ∖ Q) ≠ ∅).
+
+    Candidates decode through the SAME ``pos`` table as the dense path and
+    pack on device; the complement is one AND-NOT (``scc & ~q``), and the
+    wide-sweep ``hi_words`` row ORs in like the dense ``maximum`` — the
+    bitset engine serves wide and SCC-restricted sweeps alike."""
+    bd = ba if ba_d is None else ba_d
+    avail = pack_bits(decode_masks(start, batch, pos, jnp.uint32), ba.words)
+    if hi_words is not None:
+        avail = avail | hi_words
+    q = bitset_fixpoint(ba, avail)
+    q_size = bitset_count(q)
+    complement = scc_words & ~q
+    d = bitset_fixpoint(bd, complement, frozen_words)
+    hit = jnp.logical_and(q_size > 0, bitset_count(d) > 0)
+    return hit, q_size
+
+
+def bitset_sweep_program_factory(
+    circuit: Circuit,
+    bit_nodes: np.ndarray,
+    scc_mask: np.ndarray,
+    frozen: Optional[np.ndarray],
+    batch: int,
+    circuit_d: Optional[Circuit] = None,
+) -> Callable[[int], Callable[[int], jnp.ndarray]]:
+    """Drop-in replacement for :func:`sweep_program_factory` on the bitset
+    encoding — same contract (``factory(steps_per_call)`` →
+    ``make_aot_dispatch`` program: min hit index or INT32_MAX, async
+    scalar, ``.precompile`` / ``.xla_compile_seconds`` hooks), so the sweep
+    driver's ramp/pipeline/checkpoint machinery composes unchanged."""
+    from quorum_intersection_tpu.encode.circuit import bitset_encode, pack_mask_words
+
+    ba = BitsetArrays(bitset_encode(circuit))
+    ba_d = None if circuit_d is None else BitsetArrays(bitset_encode(circuit_d))
+    pos_j = jnp.asarray(bit_positions(bit_nodes, circuit.n))
+    scc_words_j = jnp.asarray(pack_mask_words(np.asarray(scc_mask), ba.words))
+    frozen_words_j = (
+        jnp.zeros((ba.words,), dtype=jnp.uint32)
+        if frozen is None
+        else jnp.asarray(pack_mask_words(np.asarray(frozen), ba.words))
+    )
+    # The hi row crosses the dispatch boundary DENSE — (n,) 0/1, the same
+    # row the dense engine takes — and packs inside the program, so the
+    # driver's hi_row cache needs no bitset awareness.
+    zeros_hi = jnp.zeros((circuit.n,), dtype=jnp.uint32)
+
+    def block_min_hit(start, hi_words):
+        hit, _ = bitset_sweep_step(
+            ba, start, batch, pos_j, scc_words_j, frozen_words_j, hi_words,
+            ba_d=ba_d,
+        )
+        idx = start + jnp.arange(batch, dtype=jnp.int32)
+        return jnp.where(hit, idx, jnp.int32(INT32_MAX)).min()
+
+    def factory(steps_per_call: int) -> Callable[..., jnp.ndarray]:
+        @jax.jit
+        def step(start0, hi_mask):
+            hi_words = pack_bits(hi_mask, ba.words)
+            if steps_per_call == 1:
+                return block_min_hit(start0, hi_words)
+
+            def body(i, best):
+                return jnp.minimum(
+                    best, block_min_hit(start0 + i * batch, hi_words)
+                )
+
+            return lax.fori_loop(0, steps_per_call, body, jnp.int32(INT32_MAX))
+
+        return make_aot_dispatch(
+            step, zeros_hi, lambda x: jnp.asarray(x).astype(jnp.uint32)
+        )
+
+    return factory
+
+
+def bitset_guard_program_factory(
+    circuit: Circuit, batch: int
+) -> Callable[[np.ndarray], np.ndarray]:
+    """Bitset twin of :func:`guard_program_factory` (block-guard pruning):
+    (B, n) 0/1 maximal-candidate rows in, (B,) int32 survivor counts out.
+    The guard's pruning claim is encoding-independent — a zero count proves
+    the block's maximal candidate holds no quorum whichever representation
+    evaluated the fixpoint, so guard certs stay checker-valid unchanged."""
+    from quorum_intersection_tpu.encode.circuit import bitset_encode, pack_mask_words
+
+    ba = BitsetArrays(bitset_encode(circuit))
+    batch = max(int(batch), 1)
+
+    @jax.jit
+    def step(mask_words: jnp.ndarray) -> jnp.ndarray:
+        return bitset_count(bitset_fixpoint(ba, mask_words))
+
+    def run(masks: np.ndarray) -> np.ndarray:
+        rows = masks.shape[0]
+        packed = pack_mask_words(np.asarray(masks), ba.words)
+        out = np.empty((rows,), dtype=np.int32)
+        for lo in range(0, rows, batch):
+            chunk = packed[lo : lo + batch]
+            if chunk.shape[0] < batch:
+                pad = np.zeros((batch, ba.words), dtype=np.uint32)
+                pad[: chunk.shape[0]] = chunk
+                chunk = pad
+            out[lo : lo + batch] = np.asarray(step(jnp.asarray(chunk)))[: rows - lo]
+        return out
+
+    return run
+
+
 def make_aot_dispatch(step, zeros_hi: jnp.ndarray, cast) -> Callable:
     """Wrap a jitted ``step(start, hi_mask)`` into a dispatch function that
     AOT-compiles once and calls the Compiled object.

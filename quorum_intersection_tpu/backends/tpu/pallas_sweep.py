@@ -500,3 +500,236 @@ def pallas_packed_program_factory(
         return dispatch
 
     return factory
+
+
+# ---------------------------------------------------------------------------
+# Bitset twin (ISSUE 20 qi-sparse): the fused packed kernel over the
+# intersect-and-popcount encoding.  Adjacency lives VMEM-resident as packed
+# int32 words — (Np/32, Up) membership, (Up/32, Up) child links, (Np/32, Kp)
+# group indicators — and every vote count is a word-unrolled AND + popcount
+# (the Hacker's Delight bit-twiddle below: plain shifts/masks only, so the
+# identical code lowers through Mosaic and interpret mode; no reliance on a
+# native popcount instruction).  Same per-group min-hit output contract as
+# pallas_packed_program_factory.  Word arrays are int32 (Mosaic's native
+# 32-bit lane dtype); all ops below are pure bit manipulation, for which
+# signedness is irrelevant.
+
+
+def _shr32(v, k: int):
+    """Logical right shift of int32 bit patterns: arithmetic ``>>`` then
+    masking off the ``k`` sign-filled top bits."""
+    return (v >> k) & ((1 << (32 - k)) - 1)
+
+
+def _popcount32(v):
+    """Per-lane population count of int32 words (bit-twiddling identity)."""
+    v = v - (_shr32(v, 1) & 0x55555555)
+    v = (v & 0x33333333) + (_shr32(v, 2) & 0x33333333)
+    v = (v + _shr32(v, 4)) & 0x0F0F0F0F
+    v = v + _shr32(v, 8)
+    v = v + _shr32(v, 16)
+    return v & 0x3F
+
+
+def _pack_lanes32(bits):
+    """Pack 0/1 int32 lanes ``(B, 32·W) → (B, W)`` words (LSB-first, the
+    `encode.circuit.pack_mask_words` convention) via strided lane slices —
+    2-D only, no reshape, so the op stays in Mosaic's comfort zone."""
+    acc = bits[:, 0::32]
+    for l in range(1, 32):
+        acc = acc | (bits[:, l::32] << l)
+    return acc
+
+
+def _pack_words_host(mat: np.ndarray) -> np.ndarray:
+    """Host-side word packing for kernel constants: ``(rows, cols)`` 0/1 →
+    ``(rows/32, cols)`` int32 — bit ``r % 32`` of word ``r // 32`` is row
+    *r* (rows must be a multiple of 32; lane-tile layouts always are)."""
+    from quorum_intersection_tpu.encode.circuit import pack_mask_words
+
+    rows = mat.shape[0]
+    assert rows % 32 == 0, f"{rows} rows not word-aligned"
+    packed = pack_mask_words(np.ascontiguousarray(mat.T), rows // 32)  # (cols, W)
+    return np.ascontiguousarray(packed.T).view(np.int32)
+
+
+def pallas_bitset_program_factory(
+    circuit: Circuit,
+    circuit_d: Optional[Circuit],
+    pos: np.ndarray,
+    scc_mask: np.ndarray,
+    lane_group: np.ndarray,
+    group_ind: np.ndarray,
+    batch: int,
+    block: int = DEFAULT_BLOCK,
+    interpret: Optional[bool] = None,
+) -> Callable[[int], Callable]:
+    """Bitset twin of :func:`pallas_packed_program_factory` — identical
+    contract (``dispatch(starts)``: (K,) per-group starts in, (K,) min hit
+    indices out), identical decode and hit definition, with both fixpoints
+    running over packed words: vote counts are per-word AND + popcount
+    unrolls against the VMEM-resident word tables instead of MXU matmuls.
+    Thresholds (Q and D folds) ride unchanged from the dense layout, so
+    SCC-restriction and lane-packing semantics carry over verbatim."""
+    from quorum_intersection_tpu.encode.circuit import bitset_supported
+
+    if not bitset_supported(circuit):
+        raise ValueError(
+            "circuit has vote multiplicities > 1; the bitset kernel is "
+            "0/1-vote only — use the dense engines"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, block = plan_batch(batch, block)
+    n_blocks = batch // block
+
+    members_np, child_np, thr_np, np_, up = pad_circuit(circuit)
+    depth = circuit.depth if child_np is not None else 0
+    if circuit_d is not None:
+        _, _, thr_d_np, np_d, up_d = pad_circuit(circuit_d)
+        assert (np_d, up_d) == (np_, up), "packed Q6 twin must share shapes"
+    else:
+        thr_d_np = thr_np
+
+    w_n = np_ // 32  # availability words (node axis)
+    k = int(group_ind.shape[1])
+    kp = _round_up(k, LANE)
+    pos_row = _pad_row(pos, np_, 31, np.int32)
+    # Packed word constants: membership (W, Up), group indicator (W, Kp),
+    # scc row (1, W) — child links (Up/32, Up) when inner units exist.
+    members_w = _pack_words_host(members_np)
+    gind_full = np.zeros((np_, kp), dtype=np.int8)
+    gind_full[: group_ind.shape[0], :k] = group_ind.astype(np.int8)
+    gmask_np = _pack_words_host(gind_full)
+    scc_full = np.zeros((np_,), dtype=np.int8)
+    scc_full[: scc_mask.shape[0]] = scc_mask.astype(np.int8)
+    sccw_np = _pack_words_host(scc_full[:, None]).T  # (1, W)
+    child_w = _pack_words_host(child_np) if child_np is not None else None
+
+    members_j = jnp.asarray(members_w)
+    thr_j = jnp.asarray(thr_np)
+    thr_d_j = jnp.asarray(thr_d_np)
+    pos_j = jnp.asarray(pos_row)
+    sccw_j = jnp.asarray(np.ascontiguousarray(sccw_np))
+    gmask_j = jnp.asarray(gmask_np)
+    child_j = jnp.asarray(child_w) if child_w is not None else None
+    lane_group_h = np.asarray(lane_group, dtype=np.int64)
+
+    def kernel(sl_ref, sg_ref, pos_ref, members_ref, thr_ref, thr_d_ref,
+               sccw_ref, gmask_ref, *rest):
+        child_ref, out_ref = (rest[0], rest[1]) if child_j is not None else (None, rest[0])
+        row0 = pl.program_id(0) * block
+        row_n = row0 + lax.broadcasted_iota(jnp.int32, (block, np_), 0)
+        bits0 = (sl_ref[:] + row_n) >> pos_ref[:] & 1  # (B, Np) int32 0/1
+        avail0 = _pack_lanes32(bits0)  # (B, W)
+
+        members_tbl = members_ref[:]  # (W, Up)
+        child_tbl = child_ref[:] if child_ref is not None else None
+        gmask_tbl = gmask_ref[:]  # (W, Kp)
+
+        def votes(words, table):
+            # (B, Wx) × (Wx, U'): Σ_w popcount(words[:, w] & table[w, :]) —
+            # the bitset stand-in for the MXU vote matmul, unrolled over
+            # the (static, small) word count.
+            out = None
+            for w in range(int(table.shape[0])):
+                hits = _popcount32(words[:, w : w + 1] & table[w : w + 1, :])
+                out = hits if out is None else out + hits
+            return out
+
+        def unit_sat(a_w, thr):
+            base = votes(a_w, members_tbl)
+            sat = (base >= thr).astype(jnp.int32)
+            for _ in range(depth):
+                extra = votes(_pack_lanes32(sat), child_tbl)
+                sat = ((base + extra) >= thr).astype(jnp.int32)
+            return sat
+
+        def fixpoint(a0_w, thr):
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                a, _ = c
+                nxt = _pack_lanes32(unit_sat(a, thr)[:, :np_]) & a
+                # Arithmetic change detection, word-flavored: the fixpoint
+                # only ever clears bits, so a ^ nxt is exactly the removed
+                # set and its popcount is the survivor-count decrease.
+                changed = jnp.sum(_popcount32(a ^ nxt)) > 0
+                return nxt, changed
+
+            out, _ = lax.while_loop(cond, body, (a0_w, jnp.bool_(True)))
+            return out
+
+        q_w = fixpoint(avail0, thr_ref[:])
+        q_sizes = votes(q_w, gmask_tbl)  # (B, Kp) per-group survivors
+        comp = sccw_ref[:] & ~q_w
+        d_w = fixpoint(comp, thr_d_ref[:])
+        d_sizes = votes(d_w, gmask_tbl)
+        hit = jnp.logical_and(q_sizes > 0, d_sizes > 0)  # (B, Kp)
+        row_k = row0 + lax.broadcasted_iota(jnp.int32, (block, kp), 0)
+        idx = sg_ref[:] + row_k
+        out_ref[...] = jnp.min(
+            jnp.where(hit, idx, jnp.int32(INT32_MAX)), axis=0, keepdims=True
+        )
+
+    const_spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    in_specs = [
+        const_spec(),  # starts per lane (1, Np)
+        const_spec(),  # starts per group (1, Kp)
+        const_spec(),  # pos
+        const_spec(),  # membership words (W, Up)
+        const_spec(),  # thresholds (Q side)
+        const_spec(),  # thresholds (D probe)
+        const_spec(),  # scc words (1, W)
+        const_spec(),  # group-indicator words (W, Kp)
+    ]
+    operands = [pos_j, members_j, thr_j, thr_d_j, sccw_j, gmask_j]
+    if child_j is not None:
+        in_specs.append(const_spec())
+        operands.append(child_j)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, kp), jnp.int32),
+        interpret=interpret,
+    )
+
+    def one_call(starts_lane, starts_grp):
+        return jnp.min(call(starts_lane, starts_grp, *operands), axis=0)
+
+    def factory(steps_per_call: int) -> Callable:
+        @jax.jit
+        def step(starts_lane, starts_grp):
+            if steps_per_call == 1:
+                return one_call(starts_lane, starts_grp)[:k]
+
+            def body(i, best):
+                off = i * batch
+                return jnp.minimum(
+                    best, one_call(starts_lane + off, starts_grp + off)
+                )
+
+            return lax.fori_loop(
+                0, steps_per_call, body,
+                jnp.full((kp,), INT32_MAX, dtype=jnp.int32),
+            )[:k]
+
+        def dispatch(starts):
+            starts_h = np.asarray(starts, dtype=np.int32)
+            sl = np.zeros((1, np_), dtype=np.int32)
+            sl[0, : lane_group_h.shape[0]] = starts_h[lane_group_h]
+            sg = np.zeros((1, kp), dtype=np.int32)
+            sg[0, :k] = starts_h
+            return step(jnp.asarray(sl), jnp.asarray(sg))
+
+        return dispatch
+
+    return factory

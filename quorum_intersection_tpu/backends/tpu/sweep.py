@@ -276,25 +276,41 @@ def resolve_engine(
 ) -> EngineResolution:
     """The single source of truth for which kernel engine a sweep runs.
 
-    Precedence (first matching rule wins; every ``pallas`` request that
-    cannot be honored resolves to ``xla`` with the reason recorded):
+    Precedence (first matching rule wins; every ``pallas``/``bitset``
+    request that cannot be honored resolves to ``xla`` with the reason
+    recorded):
 
     1. ``xla`` requested — always honored (it is the universal engine);
-    2. mesh sharding — the pallas kernel has no sharded program;
-    3. wide (two-level, > 2^lo_bits) enumeration — the pallas kernel takes
+    2. mesh sharding — neither alternate engine has a sharded program;
+    3. ``bitset`` requested — honored on any circuit with 0/1 qset
+       multiplicities (wide AND restricted sweeps included: the bitset
+       step packs the hi-mask on device and carries D-probe thresholds);
+       multi-edges fall back to ``xla`` (a packed word holds one bit per
+       member);
+    4. wide (two-level, > 2^lo_bits) enumeration — the pallas kernel takes
        no hi-mask input;
-    4. SCC-restricted circuit — the unpacked pallas kernel carries no
+    5. SCC-restricted circuit — the unpacked pallas kernel carries no
        separate D-probe thresholds (the packed driver resolves with
        ``restricted=False``: its pallas kernel does);
-    5. vote counts beyond int8 — the pallas kernel is int8-only;
-    6. otherwise — ``pallas`` as requested.
+    6. vote counts beyond int8 — the pallas kernel is int8-only;
+    7. otherwise — ``pallas`` as requested.
     """
     if requested == "xla":
         return EngineResolution(requested, "xla", "as requested")
     if mesh:
         return EngineResolution(
-            requested, "xla", "mesh sharding: the pallas kernel has no sharded program"
+            requested, "xla",
+            f"mesh sharding: the {requested} kernel has no sharded program",
         )
+    if requested == "bitset":
+        from quorum_intersection_tpu.encode.circuit import bitset_supported
+
+        if not bitset_supported(circuit):
+            return EngineResolution(
+                requested, "xla",
+                "qset multiplicities exceed 1: the bitset encoding holds one bit per member",
+            )
+        return EngineResolution(requested, "bitset", "as requested")
     if wide:
         return EngineResolution(
             requested, "xla", "wide (two-level) enumeration: the pallas kernel has no hi-mask input"
@@ -345,6 +361,25 @@ def macs_per_candidate_row(n: int, n_units: int, depth: int, lane: int = 128) ->
     wn = lane * ((max(n, 1) + lane - 1) // lane)
     wu = lane * ((max(n_units, 1) + lane - 1) // lane)
     return wn * wu + depth * wu * wu
+
+
+def bitset_words_per_candidate_row(
+    n: int, n_units: int, depth: int, lane: int = 128
+) -> int:
+    """Shape-model u32 word-ops one candidate row costs per fixpoint
+    iteration on the bitset twin (qi-sparse ISSUE 20): the popcount vote
+    loop streams ``ceil(n/32)`` packed words against every (lane-padded)
+    unit column, plus ``depth`` child passes over ``ceil(units/32)`` words.
+    The dense/bitset ratio of this model against
+    :func:`macs_per_candidate_row` is the ~32x arithmetic-intensity claim
+    the ``--bitset`` bench rows make checkable off-chip: MACs touch one
+    operand byte per node pair, words touch 4 bytes per 32 node pairs.
+    """
+    wn = lane * ((max(n, 1) + lane - 1) // lane)
+    wu = lane * ((max(n_units, 1) + lane - 1) // lane)
+    words_n = (wn + 31) // 32
+    words_u = (wu + 31) // 32
+    return words_n * wu + depth * words_u * wu
 
 
 @dataclass
@@ -438,7 +473,7 @@ class TpuSweepBackend:
         mesh=None,
         checkpoint=None,
         max_inflight: int = MAX_INFLIGHT,
-        engine: str = "xla",
+        engine: Optional[str] = None,
         lo_bits: int = LO_BITS,
         cancel=None,
         pad_shapes: bool = True,
@@ -460,9 +495,12 @@ class TpuSweepBackend:
         # program shapes collapse into buckets so the persistent compile
         # cache serves the warm-start path; False keeps exact shapes.
         self.pad_shapes = pad_shapes
-        # "xla" (default — measured fastest end-to-end, see pallas_sweep
-        # module docs) or "pallas" (fused single-kernel engine).
-        if engine not in ("xla", "pallas"):
+        # None reads QI_SWEEP_ENGINE at check time; "xla" (default — measured
+        # fastest end-to-end on dense circuits, see pallas_sweep module
+        # docs), "pallas" (fused single-kernel engine), or "bitset"
+        # (qi-sparse: intersect-and-popcount over packed u32 words —
+        # density-routed for sparse giants).
+        if engine not in (None, "xla", "pallas", "bitset"):
             raise ValueError(f"unknown sweep engine {engine!r}")
         self.engine = engine
         # Device index math is int32 (kernels.decode_masks): lo_bits > 30
@@ -480,6 +518,17 @@ class TpuSweepBackend:
             raise ValueError(f"unknown sweep order {order!r}")
         self.order = order
         self.prune = prune
+
+    def _engine_mode(self) -> str:
+        """Engine request: ctor wins; else QI_SWEEP_ENGINE ("pallas" /
+        "bitset" honored, anything else — including unset — is "xla").
+        The request still flows through :func:`resolve_engine`, so forcing
+        ``bitset`` on an unsupported circuit degrades with a typed reason
+        rather than erroring."""
+        if self.engine is not None:
+            return self.engine
+        env = qi_env("QI_SWEEP_ENGINE").strip().lower()
+        return env if env in ("pallas", "bitset") else "xla"
 
     def _order_mode(self) -> str:
         if self.order is not None:
@@ -537,6 +586,18 @@ class TpuSweepBackend:
             from quorum_intersection_tpu.backends.tpu import pallas_sweep
 
             guard = pallas_sweep.pallas_guard_factory(circuit)
+        elif engine == "bitset":
+            # The block's maximal-candidate guard runs bitset-side too: the
+            # guard cert's rule (empty max-quorum at the block's top) is
+            # encoding-independent, so the checker validates these blocks
+            # exactly as dense-proved ones (docs/PARITY.md §Encoding).
+            from quorum_intersection_tpu.backends.tpu.kernels import (
+                bitset_guard_program_factory,
+            )
+
+            guard = bitset_guard_program_factory(
+                circuit, min(GUARD_BATCH, n_blocks)
+            )
         else:
             from quorum_intersection_tpu.backends.tpu.kernels import (
                 guard_program_factory,
@@ -721,7 +782,7 @@ class TpuSweepBackend:
         # documented precedence) recorded as a sweep.engine_resolved event
         # — never a warning that swerves control flow behind the log.
         resolution = resolve_engine(
-            self.engine,
+            self._engine_mode(),
             mesh=self.mesh is not None,
             wide=bool(hi_nodes),
             restricted=restricted,
@@ -854,6 +915,42 @@ class TpuSweepBackend:
             make_dispatch = pallas_sweep.pallas_sweep_program_factory(
                 circuit, lo_nodes, scc_mask, frozen, base_block
             )
+        elif engine == "bitset":
+            base_block = min(batch, max(lo_total, 1))
+            try:
+                fault_point("sweep.bitset")
+                from quorum_intersection_tpu.backends.tpu.kernels import (
+                    bitset_sweep_program_factory,
+                )
+
+                make_dispatch = bitset_sweep_program_factory(
+                    circuit, lo_nodes, scc_mask, frozen, base_block,
+                    circuit_d=circuit_d,
+                )
+            except SearchCancelled:
+                raise
+            # The bitset encoding degrades IN PLACE to the dense matmul path
+            # (ROBUSTNESS sweep.bitset row): same verdict/ledger contract,
+            # only the fixpoint's arithmetic differs, so the tpu-sweep rung
+            # keeps running untouched.
+            # qi-lint: allow(degrade-via-ladder) — in-place encoding degrade
+            except Exception as exc:  # noqa: BLE001
+                rec_b = get_run_record()
+                rec_b.add("sweep.bitset_errors")
+                rec_b.event("sweep.bitset_degraded", cause=str(exc), packed=False)
+                log.warning(
+                    "bitset sweep engine degraded to the dense encoding (%s)",
+                    exc,
+                )
+                engine = "xla"
+                from quorum_intersection_tpu.backends.tpu.kernels import (
+                    sweep_program_factory,
+                )
+
+                make_dispatch = sweep_program_factory(
+                    circuit, lo_nodes, scc_mask, frozen, base_block,
+                    circuit_d=circuit_d,
+                )
         else:
             from quorum_intersection_tpu.backends.tpu.kernels import sweep_program_factory
 
@@ -1284,6 +1381,11 @@ class TpuSweepBackend:
             # Rank-order provenance: cert.py lifts this into
             # provenance.order on every certificate of this solve.
             stats["order"] = dict(order_meta)
+        if engine == "bitset":
+            # qi-sparse provenance (cert.py lifts to provenance.encoding):
+            # stamped ONLY on the bitset path, so dense certs stay
+            # byte-identical to every release before this encoding existed.
+            stats["encoding"] = "bitset"
         rec.gauge("sweep.candidates_per_sec", round(throughput.per_second, 1))
         # Registry definition (docs/OBSERVABILITY.md): windows_enumerated /
         # window_space of a FULL sweep — 1.0 under pure brute force, driven
@@ -1526,10 +1628,21 @@ class TpuSweepBackend:
         if self._prune_enabled():
             try:
                 for jix, job in enumerate(jobs):
+                    # The guard speaks the drive's encoding (ISSUE 20): a
+                    # bitset pack proves its blocks with the bitset guard
+                    # (resolved per member circuit — a multi-edge member
+                    # falls back to the dense guard; either guard's cert is
+                    # checker-valid, the prune rule is encoding-agnostic).
+                    guard_engine = "xla"
+                    if self._engine_mode() == "bitset":
+                        guard_engine = resolve_engine(
+                            "bitset", mesh=False, wide=False,
+                            restricted=False, circuit=job.circuit,
+                        ).resolved
                     prune_plans[jix] = self._plan_pruning(
                         job.circuit,
                         np.arange(1, job.circuit.n, dtype=np.int64),
-                        job.bits, job.total, 0, "xla",
+                        job.bits, job.total, 0, guard_engine,
                     )
             except SearchCancelled:
                 raise
@@ -1597,11 +1710,36 @@ class TpuSweepBackend:
                 max(1 << min(p.block_bits for p in live_plans), 512),
             )
         resolution = resolve_engine(
-            self.engine, mesh=False, wide=False, restricted=False,
+            self._engine_mode(), mesh=False, wide=False, restricted=False,
             circuit=packed.circuit,
         )
         _emit_engine_resolution(resolution, packed=True)
-        if resolution.resolved == "pallas":
+        pack_engine = resolution.resolved
+        make_dispatch = None
+        if pack_engine == "bitset":
+            # Packed bitset drive runs the fused Pallas twin (same
+            # per-group min-hit contract as the packed dense path).
+            try:
+                fault_point("sweep.bitset")
+                from quorum_intersection_tpu.backends.tpu import pallas_sweep
+
+                batch, _ = pallas_sweep.plan_batch(batch)
+                make_dispatch = pallas_sweep.pallas_bitset_program_factory(
+                    packed.circuit, packed.circuit_d, pos, scc_mask,
+                    lane_group, group_ind, batch,
+                )
+            except SearchCancelled:
+                raise
+            # qi-lint: allow(degrade-via-ladder) — in-place encoding degrade
+            except Exception as exc:  # noqa: BLE001
+                rec.add("sweep.bitset_errors")
+                rec.event("sweep.bitset_degraded", cause=str(exc), packed=True)
+                log.warning(
+                    "packed bitset sweep degraded to the dense encoding (%s)",
+                    exc,
+                )
+                pack_engine = "xla"
+        if make_dispatch is None and pack_engine == "pallas":
             from quorum_intersection_tpu.backends.tpu import pallas_sweep
 
             batch, _ = pallas_sweep.plan_batch(batch)
@@ -1609,7 +1747,7 @@ class TpuSweepBackend:
                 packed.circuit, packed.circuit_d, pos, scc_mask, lane_group,
                 group_ind, batch,
             )
-        else:
+        elif make_dispatch is None:
             from quorum_intersection_tpu.backends.tpu.kernels import (
                 packed_sweep_program_factory,
             )
@@ -1624,7 +1762,7 @@ class TpuSweepBackend:
         rec.event(
             "sweep.packed",
             jobs=n_jobs, groups=k, slot=packed.slot, lanes=packed.circuit.n,
-            fill_pct=round(packed.fill_pct, 2), engine=resolution.resolved,
+            fill_pct=round(packed.fill_pct, 2), engine=pack_engine,
         )
         if origins is not None:
             # qi-fuse provenance telemetry: how many verdict-bearing lanes
@@ -1639,7 +1777,7 @@ class TpuSweepBackend:
             "packed sweep: %d jobs in %d lane groups (slot %d, %d lanes, "
             "%.1f%% fill, engine %s)",
             n_jobs, k, packed.slot, packed.circuit.n, packed.fill_pct,
-            resolution.resolved,
+            pack_engine,
         )
 
         dispatchers: Dict[int, object] = {}
@@ -1894,10 +2032,15 @@ class TpuSweepBackend:
             "pack_macs_per_candidate_row": macs_per_candidate_row(
                 packed.circuit.n, packed.circuit.n_units, packed.circuit.depth
             ),
-            "pack_engine": resolution.resolved,
+            "pack_engine": pack_engine,
             "pack_seconds": round(seconds, 4),
             "xla_compile_seconds": round(xla_s, 4),
         }
+        if pack_engine == "bitset":
+            # qi-sparse provenance, merged into every member job's stats
+            # (cert.py lifts to provenance.encoding); dense packs stay
+            # unstamped so their certs are byte-identical to prior releases.
+            pack_stats["encoding"] = "bitset"
         # qi-cost/1 (ISSUE 17): book this pack's device work to its member
         # jobs by integer lane share (pad included).  The conserved quantity
         # is lane·windows: per-job attribution sums to the pack total

@@ -251,6 +251,13 @@ def build_certificate(
         # graph-space node ids, so the checker needs no decode help here
         # (a pruned ledger carries its own explicit `enumeration` block).
         cert["provenance"]["order"] = dict(order)  # type: ignore[index]
+    encoding = stats.get("encoding")
+    if isinstance(encoding, str):
+        # qi-sparse (ISSUE 20): which adjacency encoding proved the verdict
+        # (only the bitset path stamps it — dense certs stay byte-identical
+        # to prior releases).  Provenance only: witness/ledger claims are
+        # encoding-independent and the checker never reads it.
+        cert["provenance"]["encoding"] = encoding  # type: ignore[index]
     cost = stats.get("cost")
     if isinstance(cost, dict):
         # qi-cost/1 (ISSUE 17): which share of the device work this verdict

@@ -731,3 +731,139 @@ def pack_circuits(
         circuit=fused, circuit_d=fused_d, groups=k, slot=slot, sizes=sizes,
         origins=tuple(origins) if origins is not None else None,
     )
+
+
+# ---------------------------------------------------------------------------
+# Bitset encoding (ISSUE 20 qi-sparse): the same threshold circuit as packed
+# uint32 membership words, for the intersect-and-popcount sweep kernels
+# (backends/tpu/kernels.py bitset_* / pallas_sweep.pallas_bitset_program_
+# factory).  The dense encoding pays one MAC per (node, unit) pair whether or
+# not the node votes anywhere; on a sparse graph (qset fanout ≪ n) that is
+# almost entirely multiplied zeros.  A bitset row covers 32 nodes per word,
+# so the per-unit vote count becomes ceil(n/32) AND+popcount lane ops —
+# density-independent too, but 32× narrower, which is what makes the sparse
+# engine win once n outgrows a few MXU tiles (benchmarks/sweep_vs_native.py
+# --bitset measures the crossover; backends/calibration.py carries it).
+#
+# Invariants (pinned by tests/test_qi_sparse.py):
+#
+# - **exact-shape encoding**: word counts derive from the circuit as given
+#   (``words = ceil(n/32)``, ``unit_words = ceil(n_units/32)``) — the driver
+#   pads circuits up the canonical PAD_LADDER *before* encoding, so bitset
+#   program shapes bucket by ladder rung exactly like the dense path
+#   (a 48-node rung is 2 words, 128 is 4, ... — one compiled shape each);
+# - **thresholds verbatim**: thresholds, unit_depth, and the inner-qset DAG
+#   structure are the dense circuit's arrays unchanged — only the vote
+#   MATRICES change representation, so restriction folds (including ≤ 0
+#   thresholds) and the Q2/Q3 normalizations carry over untouched;
+# - **multiplicity gate**: a membership bit can encode a vote count of 0 or
+#   1 only.  Circuits with repeated validators / repeated inner sets
+#   (members or child counts > 1 — pathological but legal input) are not
+#   bitset-encodable; callers gate on :func:`bitset_supported` and the
+#   sweep driver resolves such circuits back to the dense engine.
+
+BITSET_WORD_BITS = 32
+
+
+def pack_mask_words(mask: np.ndarray, words: int) -> np.ndarray:
+    """Pack 0/1 rows ``(..., m)`` into uint32 words ``(..., words)``.
+
+    Bit ``j % 32`` of word ``j // 32`` is column *j* (LSB-first within a
+    word, matching the kernels' ``(idx >> pos) & 1`` decode convention).
+    Values are truthiness-packed (any nonzero → bit set)."""
+    mask = np.asarray(mask)
+    m = mask.shape[-1]
+    if m > words * BITSET_WORD_BITS:
+        raise ValueError(f"{m} columns do not fit {words} uint32 words")
+    padded = np.zeros(mask.shape[:-1] + (words * BITSET_WORD_BITS,), dtype=np.uint64)
+    padded[..., :m] = mask != 0
+    shifts = np.uint64(1) << np.arange(BITSET_WORD_BITS, dtype=np.uint64)
+    packed = (padded.reshape(mask.shape[:-1] + (words, BITSET_WORD_BITS)) * shifts).sum(
+        axis=-1
+    )
+    return packed.astype(np.uint32)
+
+
+def unpack_mask_words(packed: np.ndarray, m: int) -> np.ndarray:
+    """Inverse of :func:`pack_mask_words`: ``(..., words)`` uint32 →
+    ``(..., m)`` uint8 0/1 (the round-trip the encoding tests pin)."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    j = np.arange(m)
+    return (
+        (packed[..., j // BITSET_WORD_BITS] >> (j % BITSET_WORD_BITS).astype(np.uint32))
+        & np.uint32(1)
+    ).astype(np.uint8)
+
+
+def bitset_supported(circuit: Circuit) -> bool:
+    """Can this circuit's vote matrices be represented as bitsets?
+    True iff every member and child vote count is 0/1 (see section note)."""
+    return (
+        int(circuit.members.max(initial=0)) <= 1
+        and int(circuit.child.max(initial=0)) <= 1
+    )
+
+
+@dataclass(frozen=True)
+class BitsetCircuit:
+    """Bitset twin of :class:`Circuit`: identical thresholds/DAG, packed
+    uint32 vote rows.
+
+    - ``member_words`` (U, words)      — bit *v* of unit *u*'s row set iff
+      node *v* votes in unit *u* (``circuit.members[u, v] == 1``);
+    - ``child_words``  (U, unit_words) — bit *c* set iff unit *c* is a
+      child of unit *u*; ``None`` when the circuit has no inner units;
+    - ``thresholds`` / ``unit_depth`` / ``depth`` — the dense arrays
+      verbatim (restriction folds included).
+    """
+
+    n: int
+    n_units: int
+    depth: int
+    words: int
+    unit_words: int
+    thresholds: np.ndarray
+    member_words: np.ndarray
+    child_words: Optional[np.ndarray]
+    unit_depth: np.ndarray
+
+    def decode_members(self) -> np.ndarray:
+        """(U, n) uint8 dense member matrix — must equal the source
+        circuit's ``members`` exactly (round-trip invariant)."""
+        return unpack_mask_words(self.member_words, self.n)
+
+    def decode_child(self) -> Optional[np.ndarray]:
+        """(U, U) uint8 dense child matrix (None when no inner units)."""
+        if self.child_words is None:
+            return None
+        return unpack_mask_words(self.child_words, self.n_units)
+
+
+def bitset_encode(circuit: Circuit) -> BitsetCircuit:
+    """Encode a (0/1-vote) circuit into its :class:`BitsetCircuit` twin.
+
+    Raises ``ValueError`` for circuits with vote multiplicities > 1 — the
+    sweep driver gates on :func:`bitset_supported` first, so reaching the
+    raise from the drivers indicates a routing bug (or an injected
+    ``sweep.bitset`` fault exercising the in-place dense degrade)."""
+    if not bitset_supported(circuit):
+        raise ValueError(
+            "circuit has vote multiplicities > 1; the bitset encoding is "
+            "0/1-vote only — use the dense engine"
+        )
+    words = (circuit.n + BITSET_WORD_BITS - 1) // BITSET_WORD_BITS
+    unit_words = (circuit.n_units + BITSET_WORD_BITS - 1) // BITSET_WORD_BITS
+    has_inner = circuit.n_units > circuit.n
+    return BitsetCircuit(
+        n=circuit.n,
+        n_units=circuit.n_units,
+        depth=circuit.depth,
+        words=max(words, 1),
+        unit_words=max(unit_words, 1),
+        thresholds=circuit.thresholds.astype(np.int32),
+        member_words=pack_mask_words(circuit.members, max(words, 1)),
+        child_words=(
+            pack_mask_words(circuit.child, max(unit_words, 1)) if has_inner else None
+        ),
+        unit_depth=circuit.unit_depth,
+    )

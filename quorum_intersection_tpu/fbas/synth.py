@@ -16,7 +16,10 @@ import copy
 import json
 import random
 from collections import Counter
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+
+if TYPE_CHECKING:  # annotation-only: synth stays import-light at runtime
+    from quorum_intersection_tpu.fbas.graph import TrustGraph
 
 
 def _node(key: str, name: str, qset: Dict) -> Dict:
@@ -377,6 +380,90 @@ def two_family_preset(
         rng.sample(core_keys, min(core, 4)) for _ in range(watchers)
     ]
     return family(k_classic), family(t_fast)
+
+
+def sparse_giant(
+    n_nodes: int = 10_000,
+    *,
+    broken: bool = False,
+    seed: int = 7,
+) -> List[Dict]:
+    """Sparse-giant preset (qi-sparse ISSUE 20): the bench workload behind
+    the dense-vs-bitset crossover row.
+
+    A :func:`nested_hierarchy` instance sized so the DENSE block-diagonal
+    sweep encoding is measurably memory/MAC-bound: ~10k nodes of watcher
+    tiers over an 8-org × 3-validator core — a 24-node quorum-bearing SCC
+    (2^23 sweep windows, enough device work that per-candidate arithmetic
+    dominates setup) whose restricted member matrix is the sparse regime
+    the bitset twin exists for (measured on CPU emulation: ~18x dense →
+    bitset, benchmarks/results/).  ``broken=True`` is the usual one-knob
+    twin (core org 0's threshold → 1, verdict flips to False).  Same
+    arguments ⇒ byte-identical snapshot; the seed is pinned so committed
+    crossover artifacts stay comparable across rounds.
+    """
+    return nested_hierarchy(
+        n_nodes, core_orgs=8, per_org=3, fanout=6, orgs_per_level=64,
+        broken=broken, seed=seed,
+    )
+
+
+def graph_density(graph: TrustGraph) -> Dict[str, float]:
+    """Density/fanout annotation of a built :class:`TrustGraph` (qi-sparse
+    ISSUE 20) — the workload-shape numbers the dense-vs-bitset routing and
+    the ``--bitset`` bench rows report.
+
+    ``edge_density`` is directed trust-edge fill ``edges / (n * (n-1))``
+    (self-loops counted toward edges but not capacity, multiplicity
+    preserved — the same edge semantics as ``TrustGraph.succ``);
+    ``qset_fanout_*`` summarize per-node successor counts — the row count
+    a node contributes to the dense member matrix vs the ~``n/32`` words
+    the bitset encoding stores regardless of fanout.
+    """
+    n = graph.n
+    fanouts = [len(s) for s in graph.succ]
+    edges = sum(fanouts)
+    return {
+        "nodes": float(n),
+        "edges": float(edges),
+        "edge_density": (edges / (n * (n - 1))) if n > 1 else 0.0,
+        "qset_fanout_mean": (edges / n) if n else 0.0,
+        "qset_fanout_max": float(max(fanouts, default=0)),
+        "qset_fanout_min": float(min(fanouts, default=0)),
+    }
+
+
+def scc_qset_density(graph: TrustGraph, scc: List[int]) -> float:
+    """Member-matrix fill estimate of one SCC's restricted circuit (qi-sparse
+    ISSUE 20): total in-SCC qset references / (qset units × |scc|).
+
+    Walks every SCC node's qset tree counting units (the node slice plus
+    each nested inner set) and references (in-SCC validators plus
+    inner-unit links) — the graph-side approximation of
+    ``nnz(members) / size`` of the dense encoding the sweep would build,
+    cheap enough for auto's routing hot path (no circuit encode, no
+    restriction).  A symmetric k-of-n core scores ~1.0 (every unit
+    references every member — the dense-friendly regime); an org-nested
+    core scores well under 0.2 (each inner set references its few members
+    — the regime the bitset twin wins).  Dedup of shared inner units is
+    deliberately NOT modeled: the estimate is a routing feature measured
+    and consumed under the same definition (calibration
+    ``bitset_win_max_density``), not a circuit-size claim.
+    """
+    sset = set(scc)
+    units = 0
+    refs = 0
+    for v in scc:
+        stack = [graph.qsets[v]]
+        while stack:
+            q = stack.pop()
+            units += 1
+            refs += sum(1 for m in q.members if m in sset)
+            for inner in q.inner:
+                refs += 1  # the parent's link to the inner unit
+                stack.append(inner)
+    denom = units * len(scc)
+    return (refs / denom) if denom else 0.0
 
 
 # The default churn mix (the three bounded mutations a live stellarbeat

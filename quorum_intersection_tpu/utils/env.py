@@ -221,6 +221,20 @@ QI_SWEEP_PRUNE = _declare(
     "windows_pruned_guard ledger term (tools/check_cert.py re-verifies "
     "every block).  Empty/'0' (default): unpruned brute force.",
 )
+QI_SWEEP_ENGINE = _declare(
+    "QI_SWEEP_ENGINE", "",
+    "Kernel-engine request of the exhaustive sweep "
+    "(backends/tpu/sweep.py): 'bitset' evaluates candidates by "
+    "intersect-and-popcount over packed u32 words (qi-sparse — the "
+    "sparse-graph twin, auto-routed by the measured density crossover "
+    "when this knob is unset), 'pallas' the fused single-kernel engine; "
+    "empty or anything else (default) the XLA block-diagonal matmul "
+    "path.  A constructor-supplied engine wins over the knob; every "
+    "request still flows through resolve_engine's documented precedence "
+    "(sweep.engine_resolved event), so forcing an engine a circuit "
+    "cannot honor degrades with a typed reason, never an error.  "
+    "Verdicts are engine-independent (tests/test_qi_sparse.py).",
+)
 QI_FLEET_WORKERS = _declare(
     "QI_FLEET_WORKERS", "2",
     "Worker count of the replicated serve tier (fleet.py; CLI twin: "

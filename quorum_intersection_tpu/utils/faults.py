@@ -177,6 +177,17 @@ SWEEP_PRUNE = _declare(
     "unchanged; pruning is an optimization, never a precondition for a "
     "verdict.",
 )
+SWEEP_BITSET = _declare(
+    "sweep.bitset",
+    "Bitset kernel-twin construction of the exhaustive sweep "
+    "(backends/tpu/sweep.py, fired before the bitset program factory is "
+    "built, solo and packed drives alike): error simulates a broken "
+    "sparse encoding — the sweep degrades IN PLACE to the dense "
+    "block-diagonal encoding (sweep.bitset_degraded event + "
+    "sweep.bitset_errors counter), verdict, witness and ledger "
+    "unchanged; the bitset twin only changes the fixpoint's arithmetic, "
+    "never its result.",
+)
 FRONTIER_CHUNK = _declare(
     "frontier.chunk",
     "Frontier device-chunk dispatch (backends/tpu/frontier.py): oom/error "

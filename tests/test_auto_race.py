@@ -91,7 +91,7 @@ def _fake_auto(log, oracle_fast, oracle_burns_budget=False, **kw):
                 burn_budget=oracle_burns_budget,
             )
 
-        def _sweep(self, cancel=None):
+        def _sweep(self, cancel=None, engine=None):
             return _RecordingEngine(
                 "tpu-sweep", log, cancel=cancel, fast=not oracle_fast
             )
@@ -124,7 +124,7 @@ class TestRaceWinnerSelection:
                     wait_for=sweep_started,
                 )
 
-            def _sweep(self, cancel=None):
+            def _sweep(self, cancel=None, engine=None):
                 return _RecordingEngine(
                     "tpu-sweep", log, cancel=cancel, fast=False,
                     announce=sweep_started,
@@ -171,7 +171,7 @@ class TestRaceWinnerSelection:
                 eng.burn_announce = burned
                 return eng
 
-            def _sweep(self, cancel=None):
+            def _sweep(self, cancel=None, engine=None):
                 return _RecordingEngine(
                     "tpu-sweep", log, cancel=cancel, fast=True,
                     wait_for=burned,
@@ -224,7 +224,7 @@ class TestRaceWinnerSelection:
                     "cpp", log, cancel=cancel, fast=True, wait_for=recorded
                 )
 
-            def _sweep(self, cancel=None):
+            def _sweep(self, cancel=None, engine=None):
                 return RecordingSweep(cancel)
 
         data = majority_fbas(9)
@@ -265,7 +265,7 @@ class TestRaceWinnerSelection:
                     )
                 return _RecordingEngine("cpp", log, cancel=cancel, fast=True)
 
-            def _sweep(self, cancel=None):  # pragma: no cover - must not run
+            def _sweep(self, cancel=None, engine=None):  # pragma: no cover - must not run
                 raise AssertionError("ineligible sweep was constructed")
 
         res = solve(majority_fbas(9), backend=Fake())

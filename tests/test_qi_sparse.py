@@ -1,0 +1,479 @@
+"""qi-sparse differential suite (ISSUE 20): the streaming bitset
+set-intersection engine twin and its density routing.
+
+Pins: the pack/unpack word round-trip and the BitsetCircuit encode
+invariants (decoded matrices equal the dense source exactly), engine
+resolution precedence (bitset honored on wide AND restricted sweeps,
+multi-edge circuits resolve back to xla with a typed reason), the
+four-rung differential — xla-dense vs bitset vs pallas vs the host
+oracle on the correct/broken pair with identical witnesses and coverage
+ledgers (certs differ only in ``provenance.encoding``) through the
+unmodified stdlib checker — including composition with rank ordering +
+block-guard pruning and the K>1 packed drive, the exact ledger
+partition under a mid-sweep cancel on the bitset path, the
+``sweep.bitset`` fault degrading IN PLACE to the dense encoding with
+the verdict unchanged, the calibration win-region parser
+(verdict veto, >= 1.1x win margin, loss-inside-region shrink), and
+auto's ``_bitset_hint`` routing gates (env pin, scc floor, density
+ceiling, device kind).
+"""
+
+import json
+from functools import lru_cache
+
+import numpy as np
+import pytest
+
+from quorum_intersection_tpu.backends import auto as auto_mod
+from quorum_intersection_tpu.backends.base import SearchCancelled
+from quorum_intersection_tpu.backends.calibration import _bitset_win, calibrate
+from quorum_intersection_tpu.backends.tpu.sweep import (
+    TpuSweepBackend,
+    resolve_engine,
+)
+from quorum_intersection_tpu.encode.circuit import (
+    bitset_encode,
+    bitset_supported,
+    encode_circuit,
+    pack_mask_words,
+    unpack_mask_words,
+)
+from quorum_intersection_tpu.fbas.graph import build_graph
+from quorum_intersection_tpu.fbas.schema import parse_fbas
+from quorum_intersection_tpu.fbas.synth import (
+    graph_density,
+    majority_fbas,
+    near_disjoint_cores,
+    scc_qset_density,
+    sparse_giant,
+)
+from quorum_intersection_tpu.pipeline import quorum_bearing_sccs, solve
+from quorum_intersection_tpu.utils import telemetry
+from tools.check_cert import check_certificate
+
+CORRECT = near_disjoint_cores(6, 1)
+BROKEN = near_disjoint_cores(6, 1, broken=True)
+FIXTURES = {"correct": (CORRECT, True), "broken": (BROKEN, False)}
+
+
+def sweep(engine, **kw):
+    kw.setdefault("batch", 256)
+    return TpuSweepBackend(engine=engine, **kw)
+
+
+@lru_cache(maxsize=None)
+def sweep_solve(fixture, engine, order="natural", prune=False):
+    data, _ = FIXTURES[fixture]
+    return solve(
+        json.dumps(data), backend=sweep(engine, order=order, prune=prune)
+    )
+
+
+@lru_cache(maxsize=None)
+def oracle_solve(fixture):
+    data, _ = FIXTURES[fixture]
+    return solve(json.dumps(data), backend="python")
+
+
+def make_job(data):
+    graph = build_graph(parse_fbas(data))
+    circuit = encode_circuit(graph)
+    [(_sid, scc)] = quorum_bearing_sccs(graph, allow_native=False)
+    return graph, circuit, scc
+
+
+@pytest.fixture
+def fresh_record():
+    rec = telemetry.reset_run_record()
+    yield rec
+    telemetry.reset_run_record()
+
+
+class TestEncoding:
+    @pytest.mark.parametrize("m", [1, 31, 32, 33, 64, 150])
+    def test_word_round_trip(self, m):
+        rng = np.random.default_rng(m)
+        mask = (rng.random((5, m)) < 0.3).astype(np.uint8)
+        words = (m + 31) // 32
+        packed = pack_mask_words(mask, words)
+        assert packed.dtype == np.uint32
+        assert packed.shape == (5, words)
+        np.testing.assert_array_equal(unpack_mask_words(packed, m), mask)
+
+    def test_overflow_rejected(self):
+        with pytest.raises(ValueError, match="do not fit"):
+            pack_mask_words(np.ones((2, 33), dtype=np.uint8), 1)
+
+    @pytest.mark.parametrize("fixture", ["correct", "broken"])
+    def test_circuit_round_trip(self, fixture):
+        data, _ = FIXTURES[fixture]
+        circuit = encode_circuit(build_graph(parse_fbas(data)))
+        assert bitset_supported(circuit)
+        bits = bitset_encode(circuit)
+        assert (bits.n, bits.n_units, bits.depth) == (
+            circuit.n, circuit.n_units, circuit.depth,
+        )
+        np.testing.assert_array_equal(bits.decode_members(), circuit.members)
+        np.testing.assert_array_equal(bits.thresholds, circuit.thresholds)
+        dense_child = bits.decode_child()
+        if dense_child is None:
+            assert circuit.n_units == circuit.n
+        else:
+            np.testing.assert_array_equal(dense_child, circuit.child)
+
+    def test_multiplicity_unsupported(self):
+        circuit = encode_circuit(build_graph(parse_fbas(CORRECT)))
+        circuit.members[0, int(np.argmax(circuit.members[0]))] = 2
+        assert not bitset_supported(circuit)
+        with pytest.raises(ValueError, match="0/1-vote only"):
+            bitset_encode(circuit)
+
+
+class TestEngineResolution:
+    def _circuit(self):
+        return encode_circuit(build_graph(parse_fbas(CORRECT)))
+
+    @pytest.mark.parametrize("wide", [False, True])
+    @pytest.mark.parametrize("restricted", [False, True])
+    def test_bitset_honored_wide_and_restricted(self, wide, restricted):
+        # Unlike pallas, the bitset engine serves EVERY sweep shape.
+        res = resolve_engine(
+            "bitset", mesh=False, wide=wide, restricted=restricted,
+            circuit=self._circuit(),
+        )
+        assert (res.resolved, res.reason) == ("bitset", "as requested")
+
+    def test_mesh_outranks_bitset(self):
+        res = resolve_engine(
+            "bitset", mesh=True, wide=False, restricted=False,
+            circuit=self._circuit(),
+        )
+        assert res.resolved == "xla"
+        assert "sharded" in res.reason
+
+    def test_multi_edge_circuit_falls_back(self):
+        circuit = self._circuit()
+        circuit.members[0, int(np.argmax(circuit.members[0]))] = 2
+        res = resolve_engine(
+            "bitset", mesh=False, wide=False, restricted=False,
+            circuit=circuit,
+        )
+        assert res.resolved == "xla"
+        assert "multiplicities" in res.reason
+
+    def test_env_knob_and_ctor_precedence(self, monkeypatch):
+        monkeypatch.delenv("QI_SWEEP_ENGINE", raising=False)
+        assert TpuSweepBackend()._engine_mode() == "xla"
+        monkeypatch.setenv("QI_SWEEP_ENGINE", "bitset")
+        assert TpuSweepBackend()._engine_mode() == "bitset"
+        assert TpuSweepBackend(engine="pallas")._engine_mode() == "pallas"
+        monkeypatch.setenv("QI_SWEEP_ENGINE", "chaotic")  # unknown → xla
+        assert TpuSweepBackend()._engine_mode() == "xla"
+
+    def test_unknown_ctor_engine_rejected(self):
+        with pytest.raises(ValueError):
+            TpuSweepBackend(engine="chaotic")
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("fixture", ["correct", "broken"])
+    def test_four_rung_parity(self, fixture):
+        _, verdict = FIXTURES[fixture]
+        dense = sweep_solve(fixture, "xla")
+        pallas = sweep_solve(fixture, "pallas")
+        bits = sweep_solve(fixture, "bitset")
+        assert oracle_solve(fixture).intersects is verdict
+        assert dense.intersects is verdict
+        assert pallas.intersects is verdict
+        assert bits.intersects is verdict
+        # The encoding swaps the arithmetic, not the enumeration: same
+        # first-hit window, same witness pair, engine-vs-engine.
+        assert (bits.q1, bits.q2) == (dense.q1, dense.q2)
+        assert bits.stats.get("hit_index") == dense.stats.get("hit_index")
+
+    @pytest.mark.parametrize("fixture", ["correct", "broken"])
+    def test_certs_identical_modulo_encoding(self, fixture):
+        data, _ = FIXTURES[fixture]
+        dense = sweep_solve(fixture, "xla")
+        bits = sweep_solve(fixture, "bitset")
+        # The whole evidence payload is byte-equal — coverage ledger on a
+        # True verdict, witness pair on a False one — and only the
+        # provenance stamp tells the engines apart (dense certs must stay
+        # byte-identical to every release before the encoding existed).
+        strip = lambda cert: {
+            k: v for k, v in cert.items() if k != "provenance"
+        }
+        assert strip(bits.cert) == strip(dense.cert)
+        assert bits.cert["provenance"].get("encoding") == "bitset"
+        assert "encoding" not in dense.cert["provenance"]
+        # The UNMODIFIED checker validates both: the cert schema carries
+        # no encoding-specific evidence forms.
+        check_certificate(dense.cert, data)
+        check_certificate(bits.cert, data)
+
+    @pytest.mark.parametrize("fixture", ["correct", "broken"])
+    def test_composes_with_order_and_prune(self, fixture):
+        _, verdict = FIXTURES[fixture]
+        dense = sweep_solve(fixture, "xla", order="rank", prune=True)
+        bits = sweep_solve(fixture, "bitset", order="rank", prune=True)
+        assert dense.intersects is verdict and bits.intersects is verdict
+        assert (bits.q1, bits.q2) == (dense.q1, dense.q2)
+        # The bitset guard proves the same blocks the dense guard does
+        # (the prune rule is encoding-agnostic), so the pruned ledgers —
+        # and their exact partition — are equal (False verdicts carry a
+        # witness instead of a ledger; it must match too).
+        assert {
+            k: v for k, v in bits.cert.items() if k != "provenance"
+        } == {k: v for k, v in dense.cert.items() if k != "provenance"}
+        if verdict:
+            led = bits.stats["cert"]
+            assert led["windows_pruned_guard"] > 0
+            assert (
+                led["windows_enumerated"] + led["windows_pruned_guard"]
+                == led["window_space"]
+            )
+        data, _ = FIXTURES[fixture]
+        notes = check_certificate(bits.cert, data)
+        if verdict:
+            assert any("guard-pruned" in n for n in notes)
+
+    def test_packed_bitset_matches_unpacked(self):
+        datas = [CORRECT, near_disjoint_cores(6, 1, seed=1), BROKEN]
+        jobs = [make_job(d) for d in datas]
+        unpacked = [
+            sweep("bitset").check_scc(g, c, s) for g, c, s in jobs
+        ]
+        packed = sweep("bitset").check_sccs(jobs)
+        for u, p in zip(unpacked, packed):
+            assert u.intersects == p.intersects
+            assert (u.q1, u.q2) == (p.q1, p.q2)
+            assert p.stats.get("encoding") == "bitset"
+        # Dense packs on the same jobs agree too (packed four-rung).
+        dense_packed = sweep("xla").check_sccs(jobs)
+        for d, p in zip(dense_packed, packed):
+            assert d.intersects == p.intersects
+            assert (d.q1, d.q2) == (p.q1, p.q2)
+            assert "encoding" not in d.stats
+
+
+class _TrippingCancel:
+    def __init__(self, after):
+        self.after = after
+        self.polls = 0
+
+    @property
+    def cancelled(self):
+        self.polls += 1
+        return self.polls > self.after
+
+
+class TestCancel:
+    def test_cancel_partition_on_bitset_path(self, fresh_record):
+        data = near_disjoint_cores(7, 1)  # 2^14 windows at batch 256
+        graph, circuit, scc = make_job(data)
+        backend = sweep(
+            "bitset", max_inflight=2, cancel=_TrippingCancel(6)
+        )
+        with pytest.raises(SearchCancelled):
+            backend.check_scc(graph, circuit, scc)
+        counters, _ = fresh_record.snapshot()
+        space = 1 << (len(scc) - 1)
+        enumerated = counters.get("cert.windows_enumerated", 0)
+        cancelled = counters.get("cert.windows_cancelled", 0)
+        # Exact partition even mid-flight: every window is enumerated or
+        # cancelled, never both, never lost — same conservation contract
+        # as the dense path (tools/analyze conserve pins the counters).
+        assert cancelled > 0
+        assert enumerated + cancelled == space
+        assert enumerated < space
+
+
+class TestFaultDegrade:
+    def test_bitset_fault_degrades_in_place_same_verdict(
+        self, monkeypatch, fresh_record
+    ):
+        monkeypatch.setenv("QI_FAULTS", "sweep.bitset=error")
+        res = solve(json.dumps(CORRECT), backend=sweep("bitset"))
+        assert res.intersects is True
+        # Degrade is IN PLACE to the dense encoding: no ladder hop, no
+        # encoding stamp (the cert honestly records what executed).
+        assert res.stats.get("encoding") is None
+        assert "encoding" not in res.cert["provenance"]
+        counters, _ = fresh_record.snapshot()
+        assert counters.get("sweep.bitset_errors", 0) >= 1
+        assert counters.get("faults.injected", 0) >= 1
+        assert any(
+            e.get("name") == "sweep.bitset_degraded"
+            for e in fresh_record.events
+        )
+        check_certificate(res.cert, CORRECT)
+
+    def test_bitset_fault_degrades_packed_pack(self, monkeypatch):
+        monkeypatch.setenv("QI_FAULTS", "sweep.bitset=error")
+        jobs = [make_job(CORRECT), make_job(BROKEN)]
+        results = sweep("bitset").check_sccs(jobs)
+        assert [r.intersects for r in results] == [True, False]
+        assert all(r.stats.get("encoding") is None for r in results)
+
+
+def _bitset_rows(tmp_path, name, rows):
+    path = tmp_path / name
+    path.write_text(
+        "| header noise |\n"
+        + "\n".join(
+            json.dumps({
+                "bitset": True, "device": device, "scc": scc,
+                "scc_density": density,
+                "bitset_speedup_vs_dense": speed, "verdict_ok": ok,
+            })
+            for device, scc, density, speed, ok in rows
+        )
+        + "\n"
+    )
+    return path
+
+
+class TestCalibrationParser:
+    # (device, scc, density, speedup, verdict_ok) — the r6 shape in
+    # miniature: a sub-crossover loss, a tie at density 1.0, two wins.
+    R6ISH = [
+        ("cpu", 15, 0.2222, 0.95, True),
+        ("cpu", 16, 1.0, 1.0, True),
+        ("cpu", 21, 0.1667, 6.66, True),
+        ("cpu", 24, 0.1481, 19.7, True),
+    ]
+
+    def test_win_region_extraction(self, tmp_path):
+        path = _bitset_rows(tmp_path, "sweep_vs_native_cpu_r1.txt", self.R6ISH)
+        min_scc, dmax, kind, prov = _bitset_win([path])
+        # The 1.0x tie never extends the density bound; the 0.95x loss at
+        # scc 15 sits below the winning sccs so it never shrinks it.
+        assert (min_scc, dmax, kind) == (21, 0.1667, "cpu")
+        assert "r1" in prov and "cpu" in prov
+
+    def test_verdict_veto(self, tmp_path):
+        rows = self.R6ISH + [("cpu", 22, 0.15, 9.0, False)]
+        path = _bitset_rows(tmp_path, "sweep_vs_native_cpu_r1.txt", rows)
+        assert _bitset_win([path]) is None
+
+    def test_loss_inside_region_shrinks_density_bound(self, tmp_path):
+        rows = [
+            ("cpu", 20, 0.30, 2.0, True),
+            ("cpu", 24, 0.15, 19.7, True),
+            ("cpu", 22, 0.25, 0.8, True),  # loss INSIDE (scc>=20, d<=0.30)
+        ]
+        path = _bitset_rows(tmp_path, "sweep_vs_native_cpu_r1.txt", rows)
+        min_scc, dmax, kind, _ = _bitset_win([path])
+        # The d=0.30 win is dropped (>= the losing density), the region
+        # re-derives from what survives.
+        assert (min_scc, dmax, kind) == (24, 0.15, "cpu")
+
+    def test_accelerator_rows_outrank_cpu(self, tmp_path):
+        rows = self.R6ISH + [("tpu", 18, 0.30, 3.0, True)]
+        path = _bitset_rows(tmp_path, "sweep_vs_native_tpu_r2.txt", rows)
+        min_scc, dmax, kind, _ = _bitset_win([path])
+        assert (min_scc, dmax, kind) == (18, 0.30, "tpu")
+
+    def test_newest_round_wins(self, tmp_path):
+        old = _bitset_rows(
+            tmp_path, "sweep_vs_native_cpu_r1.txt", self.R6ISH
+        )
+        new = _bitset_rows(
+            tmp_path, "sweep_vs_native_cpu_r2.txt",
+            [("cpu", 25, 0.10, 3.0, True)],
+        )
+        min_scc, dmax, _, prov = _bitset_win([old, new])
+        assert (min_scc, dmax) == (25, 0.10)
+        assert "r2" in prov
+
+    def test_calibrate_wires_the_gate(self, tmp_path):
+        path = _bitset_rows(tmp_path, "sweep_vs_native_cpu_r1.txt", self.R6ISH)
+        cal = calibrate(paths=[], sweep_window_paths=[path])
+        assert cal.bitset_win_min_scc == 21
+        assert cal.bitset_win_max_density == pytest.approx(0.1667)
+        assert cal.bitset_win_device == "cpu"
+        assert "bitset" in cal.provenance
+        empty = calibrate(paths=[], sweep_window_paths=[])
+        assert empty.bitset_win_min_scc is None
+        assert empty.bitset_win_max_density is None
+
+    def test_committed_artifact_lands_a_region(self):
+        # The repo's own committed rows must parse (the routing the next
+        # session inherits): whatever the region is, it must carry the
+        # full (scc, density, device) triple or be absent entirely.
+        cal = calibrate()
+        if cal.bitset_win_min_scc is not None:
+            assert cal.bitset_win_max_density is not None
+            assert cal.bitset_win_device in ("cpu", "tpu")
+
+
+class TestRouting:
+    def _arm(self, monkeypatch, win=5, dmax=1.0, device="cpu"):
+        monkeypatch.delenv("QI_SWEEP_ENGINE", raising=False)
+        cal = auto_mod.CALIBRATION
+        monkeypatch.setattr(cal, "bitset_win_min_scc", win)
+        monkeypatch.setattr(cal, "bitset_win_max_density", dmax)
+        monkeypatch.setattr(cal, "bitset_win_device", device)
+
+    def test_hint_engages_and_records_the_route(
+        self, monkeypatch, fresh_record
+    ):
+        self._arm(monkeypatch)
+        graph, _, scc = make_job(CORRECT)
+        assert auto_mod.AutoBackend()._bitset_hint(graph, scc) == "bitset"
+        [ev] = [
+            e for e in fresh_record.events
+            if e.get("name") == "route.encoding"
+        ]
+        assert ev["attrs"]["engine"] == "bitset"
+        assert ev["attrs"]["scc"] == len(scc)
+        assert "measured win region" in ev["attrs"]["reason"]
+
+    def test_env_pin_short_circuits_the_hint(self, monkeypatch):
+        self._arm(monkeypatch)
+        monkeypatch.setenv("QI_SWEEP_ENGINE", "pallas")
+        graph, _, scc = make_job(CORRECT)
+        assert auto_mod.AutoBackend()._bitset_hint(graph, scc) is None
+
+    def test_scc_floor_density_ceiling_and_device_gate(self, monkeypatch):
+        graph, _, scc = make_job(CORRECT)
+        backend = auto_mod.AutoBackend()
+        self._arm(monkeypatch, win=len(scc) + 1)
+        assert backend._bitset_hint(graph, scc) is None
+        self._arm(monkeypatch, dmax=0.01)  # near_disjoint cores are denser
+        assert backend._bitset_hint(graph, scc) is None
+        self._arm(monkeypatch, device="tpu")  # measured elsewhere
+        assert backend._bitset_hint(graph, scc) is None
+
+    def test_uncalibrated_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("QI_SWEEP_ENGINE", raising=False)
+        cal = auto_mod.CALIBRATION
+        monkeypatch.setattr(cal, "bitset_win_min_scc", None)
+        monkeypatch.setattr(cal, "bitset_win_max_density", None)
+        monkeypatch.setattr(cal, "bitset_win_device", None)
+        graph, _, scc = make_job(CORRECT)
+        assert auto_mod.AutoBackend()._bitset_hint(graph, scc) is None
+
+
+class TestWorkloadShapes:
+    def test_sparse_giant_deterministic_with_24_core(self):
+        data = sparse_giant(400)
+        assert data == sparse_giant(400)
+        assert data != sparse_giant(400, seed=8)
+        graph = build_graph(parse_fbas(data))
+        [(_sid, scc)] = quorum_bearing_sccs(graph, allow_native=False)
+        assert len(scc) == 24  # the 8-org x 3-validator core
+        # The whole point of the preset: an org-nested core well inside
+        # the measured bitset win region's density bound.
+        assert scc_qset_density(graph, scc) < 0.2
+
+    def test_density_annotations(self):
+        giant = build_graph(parse_fbas(sparse_giant(400)))
+        shape = graph_density(giant)
+        assert set(shape) >= {"edge_density", "qset_fanout_mean"}
+        assert 0.0 < shape["edge_density"] < 0.1  # sparse by construction
+        flat = build_graph(parse_fbas(majority_fbas(8)))
+        [(_sid, scc)] = quorum_bearing_sccs(flat, allow_native=False)
+        # A flat majority qset references every member from every unit —
+        # the dense-friendly regime the router must leave on the MXU path.
+        assert scc_qset_density(flat, scc) == pytest.approx(1.0)

@@ -200,7 +200,7 @@ def _run_one(schedule: str, data: object, expected: bool,
                 ignore_cancel=True,
             )
 
-        def make_sweep(self, cancel=None):
+        def make_sweep(self, cancel=None, engine=None):
             return FakeSweep(cancel=cancel)
 
     elif schedule == "cancel_during_compile":
@@ -211,7 +211,7 @@ def _run_one(schedule: str, data: object, expected: bool,
         def make_oracle(self, budget_s=None, cancel=None):
             return FakeOracle(cancel=cancel, wait_for=compiling)
 
-        def make_sweep(self, cancel=None):
+        def make_sweep(self, cancel=None, engine=None):
             return FakeSweep(
                 cancel=cancel, compiling=compiling, cancel_in_compile=True
             )
@@ -230,7 +230,7 @@ def _run_one(schedule: str, data: object, expected: bool,
                 ignore_cancel=True,
             )
 
-        def make_sweep(self, cancel=None):
+        def make_sweep(self, cancel=None, engine=None):
             return FakeSweep(cancel=cancel)
 
     elif schedule == "budget_burn_then_sweep_verdict":
@@ -239,7 +239,7 @@ def _run_one(schedule: str, data: object, expected: bool,
         def make_oracle(self, budget_s=None, cancel=None):
             return FakeOracle(cancel=cancel, burn_budget=True)
 
-        def make_sweep(self, cancel=None):
+        def make_sweep(self, cancel=None, engine=None):
             return FakeSweep(
                 cancel=cancel, wait_for=ctl.reached_event("oracle.returned")
             )

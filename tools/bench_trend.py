@@ -162,6 +162,16 @@ TRACKED: Dict[str, str] = {
     # of the device bill silently lost its owner.
     "fuse_auto_window_ms": "higher",
     "cost_attributed_pct": "higher",
+    # qi-sparse bitset-encoding rows (ISSUE 20): benchmarks/sweep_vs_native.py
+    # --bitset summary line.  The winning rate regresses by dropping; the
+    # measured crossover |scc| regresses by GROWING (the encoding stopped
+    # winning smaller SCCs — a kernel or routing regression, since the
+    # sparse workloads themselves are pinned presets); bytes streamed per
+    # candidate regresses by growing (encoding bloat: the packed operand
+    # stopped being 32x denser than the MAC-twin's padded lanes).
+    "bitset_candidates_per_sec": "higher",
+    "bitset_crossover_scc": "lower",
+    "sweep_bytes_per_candidate": "lower",
     # Multichip dryrun rows (MULTICHIP_r*.json driver wrappers): the mesh
     # smoke's sweep-candidate count and frontier device-resident states —
     # a drop means the sharded paths silently shrank their coverage.

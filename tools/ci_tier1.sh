@@ -149,6 +149,43 @@ print(f"PRUNED: snapshot_correct enumeration ratio {ratio:.4f} "
 PYEOF
 echo "PRUNED_CERTS=$PRUNEDIR (exit $prrc)"
 
+# qi-sparse gate (ISSUE 20): the same six fixture certs with the bitset
+# set-intersection twin forced through the sweep backend — the engine is
+# an encoding swap, so the UNMODIFIED independent checker must validate
+# every cert exactly as it does the dense ones (same coverage ledger
+# shape, same witness soundness rules; only provenance.encoding differs).
+# Rank ordering + block-guard pruning stay on to cover the composed
+# order/prune/bitset path, and a provenance assertion pins that the
+# bitset engine actually ran (a silent dense fallback would pass the
+# checker and hide the regression).
+SPARSEDIR="${TIER1_SPARSE:-/tmp/_t1_sparse}"
+rm -rf "$SPARSEDIR"
+mkdir -p "$SPARSEDIR"
+sprc=0
+for fx in trivial_correct trivial_broken nested_correct nested_broken \
+          snapshot_correct snapshot_broken; do
+    env JAX_PLATFORMS=cpu QI_SWEEP_ENGINE=bitset \
+        QI_SWEEP_ORDER=rank QI_SWEEP_PRUNE=1 \
+        python -m quorum_intersection_tpu --backend tpu-sweep \
+        --cert-out "$SPARSEDIR/$fx.cert.json" \
+        < "fixtures/$fx.json" > /dev/null
+    vrc=$?
+    [ "$vrc" -gt 1 ] && { echo "SPARSE: solve crashed on $fx (rc=$vrc)"; sprc=1; }
+    env JAX_PLATFORMS=cpu python tools/check_cert.py \
+        "$SPARSEDIR/$fx.cert.json" "fixtures/$fx.json" || sprc=1
+done
+env JAX_PLATFORMS=cpu python - "$SPARSEDIR" <<'PYEOF' || sprc=1
+import glob, json, sys
+certs = sorted(glob.glob(sys.argv[1] + "/*.cert.json"))
+assert len(certs) == 6, certs
+encodings = {json.load(open(p)).get("provenance", {}).get("encoding")
+             for p in certs}
+assert encodings == {"bitset"}, encodings
+print(f"SPARSE: {len(certs)} certs solved by the bitset engine "
+      "(provenance.encoding == bitset) and checker-validated")
+PYEOF
+echo "SPARSE_CERTS=$SPARSEDIR (exit $sprc)"
+
 # Serving-layer smoke (ISSUE 8): open-loop load through a live ServeEngine
 # — the driver itself is a parity gate (served verdict == one-shot oracle
 # for every request, zero silent drops, exit 1 otherwise).  --churn
@@ -344,6 +381,7 @@ echo "TREND=exit $trc"
 [ "$prc" -ne 0 ] && exit "$prc"
 [ "$certrc" -ne 0 ] && exit "$certrc"
 [ "$prrc" -ne 0 ] && exit "$prrc"
+[ "$sprc" -ne 0 ] && exit "$sprc"
 [ "$src" -ne 0 ] && exit "$src"
 [ "$ssrc" -ne 0 ] && exit "$ssrc"
 [ "$frc" -ne 0 ] && exit "$frc"

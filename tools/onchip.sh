@@ -20,8 +20,7 @@
 # Usage: tools/onchip.sh --round rN [phase ...]
 #   default phases:   crossover frontier_scaling wide_run bench soak
 #   extra phases:     sweep_vs_native wide_kill crossover_pop2048 scc36
-#                     auto_race packed fuse
-#                     auto_race packed
+#                     auto_race packed fuse sparse
 # Examples (the r4/r5 sequences, reproduced):
 #   tools/onchip.sh --round r4                                  # = onchip_r4.sh
 #   tools/onchip.sh --round r5                                  # = onchip_r5.sh
@@ -138,6 +137,18 @@ run_phase() {
                 python -u benchmarks/serve.py --fuse \
                 --backend tpu \
                 2>&1 | tee "$R/serve_fuse_tpu_${ROUND}.txt" ;;
+        sparse)
+            # qi-sparse on real hardware: bitset-vs-dense twin rows on the
+            # sparse presets.  The artifact name is distinct from the
+            # sweep_vs_native phase's (calibration's bitset parser only
+            # reads files that actually carry bitset rows, so the split
+            # keeps round-rank ties away from the sweep-window gate) and
+            # lands the TPU win region for backends/calibration.py's
+            # bitset gate — until it exists, auto routes bitset only on
+            # the CPU region measured in sweep_vs_native_cpu_r6.txt.
+            timeout 3600 python -u benchmarks/sweep_vs_native.py --bitset \
+                --metrics-json "$QI_METRICS_JSON" \
+                2>&1 | tee "$R/sweep_vs_native_bitset_tpu_${ROUND}.txt" ;;
         *)
             echo "unknown phase: $1" >&2; return 2 ;;
     esac
